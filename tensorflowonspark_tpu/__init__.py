@@ -1,0 +1,31 @@
+"""tensorflowonspark_tpu — a TPU-native rebuild of TensorFlowOnSpark.
+
+Re-implements the capabilities of ``dailong/TensorFlowOnSpark`` (reference:
+``tensorflowonspark/`` package — see SURVEY.md) as an idiomatic JAX/XLA/TPU
+framework.  Where the reference co-locates one TensorFlow node per Spark
+executor and feeds it RDD partitions through multiprocessing queues, this
+package co-locates one JAX process per TPU host, bootstraps the cluster via a
+TCP rendezvous + ``jax.distributed``, and feeds data through batch-granularity
+socket queues into the device infeed.
+
+Public API (mirrors the reference's user-facing contract,
+``tensorflowonspark/TFCluster.py`` / ``TFNode.py`` / ``pipeline.py``):
+
+    from tensorflowonspark_tpu import TPUCluster, InputMode
+    cluster = TPUCluster.run(map_fun, args, num_workers, input_mode=InputMode.SPARK)
+    cluster.train(data, num_epochs)
+    preds = cluster.inference(data)
+    cluster.shutdown()
+
+Inside ``map_fun(args, ctx)`` the user pulls data with ``ctx.get_data_feed()``
+(the ``TFNode.DataFeed`` equivalent).
+"""
+
+__version__ = "0.1.0"
+
+from tensorflowonspark_tpu.cluster import InputMode, TPUCluster  # noqa: F401
+from tensorflowonspark_tpu.datafeed import DataFeed  # noqa: F401
+from tensorflowonspark_tpu.node import NodeContext  # noqa: F401
+
+# Reference-compatible aliases (tensorflowonspark/TFCluster.py::TFCluster).
+TFCluster = TPUCluster

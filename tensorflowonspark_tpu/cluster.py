@@ -1,0 +1,415 @@
+"""Driver-side cluster orchestration: ``TPUCluster``.
+
+Equivalent of the reference's ``tensorflowonspark/TFCluster.py``.  The
+reference launches a Spark job whose tasks each boot one TF node
+(``TFCluster.py::run`` → ``sc.parallelize(...).foreachPartition(
+TFSparkNode.run(...))``); this rebuild replaces Spark with its own worker
+backends (SURVEY.md §2b "largest from-scratch piece"):
+
+- :class:`LocalProcessBackend` — N worker processes on this machine
+  (``multiprocessing`` spawn).  This is both the test backbone (the
+  reference's ``local-cluster[N,...]`` pattern, SURVEY.md §4) and the
+  correct shape for a single TPU host, where all chips belong to one
+  process.
+- an agent backend for multi-host pods (one host-agent per TPU-VM host
+  connecting to the driver's agent port) plugs in through the same
+  ``backend=`` parameter; see ``agent.py`` once present.
+
+The user-facing contract matches the reference exactly:
+
+    cluster = TPUCluster.run(map_fun, args, num_workers, input_mode=...)
+    cluster.train(data, num_epochs)      # InputMode.SPARK feeding
+    preds = cluster.inference(data)
+    cluster.shutdown(grace_secs=0)
+
+with ``InputMode.SPARK`` / ``InputMode.TENSORFLOW``
+(``TFCluster.py::InputMode``), role assignment via ``num_ps`` /
+``master_node`` / ``eval_node`` (``TFCluster.py::run``'s cluster template),
+error re-raise on shutdown, and ``tensorboard_url``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import multiprocessing as mp
+import os
+import secrets
+import tempfile
+import threading
+import time
+
+from tensorflowonspark_tpu import node as tpu_node
+from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition
+from tensorflowonspark_tpu.queues import DEFAULT_QUEUES, QueueClient
+from tensorflowonspark_tpu.reservation import Server
+
+logger = logging.getLogger(__name__)
+
+
+class InputMode:
+    """Reference: ``TFCluster.py::InputMode``."""
+
+    SPARK = 0        # driver pushes data partitions into node queues
+    TENSORFLOW = 1   # nodes read their own data (grain / tf.data equivalent)
+
+
+def _worker_entry(executor_id: int, env: dict, fn, tf_args, cluster_meta: dict,
+                  queues) -> None:
+    """Top-level child-process entry (must be picklable for mp 'spawn').
+
+    Sets per-worker env *before* jax import so platform/visibility flags take
+    effect, then runs the node harness (``node.run``), mirroring how a Spark
+    task process executes ``TFSparkNode._mapfn``.
+    """
+    os.environ.update({k: str(v) for k, v in env.items()})
+    import logging as _logging
+
+    _logging.basicConfig(level=_logging.INFO,
+                         format=f"%(asctime)s [node {executor_id}] %(message)s")
+    mapfn = tpu_node.run(fn, tf_args, cluster_meta, queues=queues)
+    mapfn(executor_id)
+
+
+class LocalProcessBackend:
+    """Spawn N worker processes on this host (the 'local-cluster' analogue)."""
+
+    def __init__(self, worker_env: dict | None = None):
+        self.worker_env = worker_env or {}
+        self.procs: list[mp.Process] = []
+
+    def start(self, num_workers: int, fn, tf_args, cluster_meta: dict, queues) -> None:
+        ctx = mp.get_context("spawn")  # fork is unsafe after jax/XLA init
+        for i in range(num_workers):
+            p = ctx.Process(
+                target=_worker_entry,
+                args=(i, self.worker_env, fn, tf_args, cluster_meta, queues),
+                name=f"tfos-node-{i}", daemon=False)
+            p.start()
+            self.procs.append(p)
+
+    def alive(self) -> list[bool]:
+        return [p.is_alive() for p in self.procs]
+
+    def failed(self) -> list[int]:
+        return [i for i, p in enumerate(self.procs)
+                if (not p.is_alive()) and p.exitcode not in (0, None)]
+
+    def join(self, timeout: float | None = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for p in self.procs:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            p.join(remaining)
+        return all(not p.is_alive() for p in self.procs)
+
+    def terminate(self) -> None:
+        for p in self.procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self.procs:
+            p.join(5)
+
+
+class TPUCluster:
+    """Handle for a running cluster.  Reference: ``TFCluster.py::TFCluster``."""
+
+    def __init__(self, backend, server: Server, cluster_info: list[dict],
+                 cluster_meta: dict, input_mode: int, working_dir: str,
+                 queues=DEFAULT_QUEUES):
+        self.backend = backend
+        self.server = server
+        self.cluster_info = cluster_info
+        self.cluster_meta = cluster_meta
+        self.input_mode = input_mode
+        self.working_dir = working_dir
+        self.queues = queues
+        self._clients: dict[int, QueueClient] = {}
+        self._shutdown_done = False
+
+    # ------------------------------------------------------------------ run
+    @classmethod
+    def run(cls, map_fun, tf_args, num_workers: int, num_ps: int = 0,
+            tensorboard: bool = False, input_mode: int = InputMode.SPARK,
+            master_node: str | None = None, eval_node: bool = False,
+            driver_ps_nodes: bool = False, reservation_timeout: float = 600.0,
+            queues=DEFAULT_QUEUES, backend=None, worker_env: dict | None = None,
+            working_dir: str | None = None, queue_depth: int = 64,
+            default_fs: str = "") -> "TPUCluster":
+        """Boot the cluster and block until every node has registered.
+
+        Mirrors ``TFCluster.py::run``'s signature and behavior: build the
+        job-name template, start the reservation server, launch workers,
+        await reservations, return the handle.  ``num_ps`` is honored as a
+        role label for parity, but on TPU those nodes join SPMD training as
+        embedding-shard owners rather than running a gRPC parameter server
+        (SURVEY.md §2c — PS is an anti-pattern on TPU).
+        """
+        assert num_workers > 0, "need at least one worker"
+        cluster_template = _build_cluster_template(
+            num_workers, num_ps, master_node, eval_node)
+        logger.info("cluster template: %s", cluster_template)
+
+        working_dir = working_dir or tempfile.mkdtemp(prefix="tfos_tpu_")
+        authkey = secrets.token_bytes(16)
+        server = Server(num_workers, authkey=authkey)
+        server_addr = server.start()
+
+        cluster_meta = {
+            "id": secrets.token_hex(4),
+            "cluster_template": cluster_template,
+            "num_workers": num_workers,
+            "server_addr": server_addr,
+            "authkey": authkey,
+            "default_fs": default_fs,
+            "working_dir": working_dir,
+            "queue_mode": "remote",
+            "queue_depth": queue_depth,
+            "reservation_timeout": reservation_timeout,
+            "tensorboard": tensorboard,
+        }
+
+        backend = backend or LocalProcessBackend(worker_env=worker_env)
+        backend.start(num_workers, map_fun, tf_args, cluster_meta, queues)
+
+        status: dict = {}
+        monitor = threading.Thread(
+            target=_watch_for_crashes, args=(backend, server, status), daemon=True)
+        monitor.start()
+        try:
+            cluster_info = server.await_reservations(
+                timeout=reservation_timeout, status=status)
+        except Exception:
+            backend.terminate()
+            server.stop()
+            _raise_worker_errors(working_dir, num_workers)
+            raise
+        logger.info("all %d nodes registered", num_workers)
+        return cls(backend, server, cluster_info, cluster_meta, input_mode,
+                   working_dir, queues)
+
+    # ---------------------------------------------------------------- feed
+    def _feedable_nodes(self) -> list[dict]:
+        """Nodes that consume the input queue: workers/chief/master, not
+        ps/evaluator (reference: ``TFCluster.py::train`` targets workers)."""
+        feedable = [n for n in self.cluster_info
+                    if n["job_name"] in ("worker", "chief", "master")]
+        return sorted(feedable, key=lambda n: n["executor_id"])
+
+    def _client_for(self, executor_id: int) -> QueueClient:
+        if executor_id not in self._clients:
+            info = next(n for n in self.cluster_info if n["executor_id"] == executor_id)
+            self._clients[executor_id] = QueueClient(info["addr"], info["authkey"])
+        return self._clients[executor_id]
+
+    def train(self, data, num_epochs: int = 1, qname: str = "input",
+              feed_timeout: float = 600.0, chunk_size: int = 256,
+              num_partitions: int | None = None) -> None:
+        """Feed ``data`` to the cluster (InputMode.SPARK path).
+
+        Reference: ``TFCluster.py::train`` — unions the RDD ``num_epochs``
+        times (``num_epochs=0`` streams forever) and pushes every partition
+        into whichever executor Spark scheduled; here partitions are routed
+        round-robin over feedable nodes and items travel in ``chunk_size``
+        chunks (the deliberate batch-granularity divergence, SURVEY.md §3.2).
+        Aborts when a node sets state ``'terminating'``.
+        """
+        assert self.input_mode == InputMode.SPARK, \
+            "train() feeds data only in InputMode.SPARK"
+        nodes = self._feedable_nodes()
+        partitions = _partition(data, num_partitions or len(nodes))
+
+        epoch_iter = itertools.count() if num_epochs == 0 else range(num_epochs)
+        for epoch in epoch_iter:
+            for pidx, part in enumerate(partitions):
+                target = nodes[pidx % len(nodes)]
+                client = self._client_for(target["executor_id"])
+                if client.kv_get("state") == "terminating":
+                    logger.info("feed: node requested termination; stopping")
+                    return
+                _feed_partition(client, part, qname, chunk_size, feed_timeout)
+            logger.info("feed: epoch %d delivered", epoch)
+
+    def inference(self, data, qname: str = "input", qname_out: str = "output",
+                  feed_timeout: float = 600.0, chunk_size: int = 256) -> list:
+        """Push data, collect an equal number of results.
+
+        Reference: ``TFCluster.py::inference`` → ``TFSparkNode._inference``
+        (push n items + EndPartition, pull exactly n results).  Results keep
+        partition order; ordering across nodes follows partition index.
+        """
+        assert self.input_mode == InputMode.SPARK
+        nodes = self._feedable_nodes()
+        partitions = _partition(data, len(nodes))
+        results: list = []
+        lock = threading.Lock()
+        errors: list = []
+
+        # One thread per *node* (not per partition): a node has a single
+        # input/output queue pair, so its partitions must be fed and
+        # collected sequentially or chunks from different partitions would
+        # interleave and threads would steal each other's results.
+        by_node: dict[int, list[tuple[int, list]]] = {}
+        for pidx, part in enumerate(partitions):
+            by_node.setdefault(pidx % len(nodes), []).append((pidx, part))
+
+        def _feed_and_collect(node_idx: int, parts: list[tuple[int, list]]) -> None:
+            try:
+                target = nodes[node_idx]
+                client = QueueClient(target["addr"], target["authkey"])
+                try:
+                    for pidx, part in parts:
+                        _feed_partition(client, part, qname, chunk_size, feed_timeout)
+                        got: list = []
+                        while len(got) < len(part):
+                            chunk = client.queue_get(qname_out, timeout=feed_timeout)
+                            got.extend(chunk if isinstance(chunk, list) else [chunk])
+                        with lock:
+                            results.append((pidx, got))
+                finally:
+                    client.close()
+            except Exception as e:  # surface feeder errors to caller
+                with lock:
+                    errors.append(e)
+
+        threads = [threading.Thread(target=_feed_and_collect, args=(n, ps), daemon=True)
+                   for n, ps in by_node.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        out: list = []
+        for _, got in sorted(results, key=lambda r: r[0]):
+            out.extend(got)
+        return out
+
+    # ------------------------------------------------------------ shutdown
+    def shutdown(self, grace_secs: float = 0.0, timeout: float = 259200.0) -> None:
+        """End feeding, join workers, re-raise the first worker error.
+
+        Reference: ``TFCluster.py::shutdown`` (push end-of-feed sentinels →
+        join the node RDD → re-raise worker exceptions → stop the reservation
+        server; default hard timeout 3 days).
+        """
+        if self._shutdown_done:
+            return
+        self._shutdown_done = True
+        if grace_secs:
+            time.sleep(grace_secs)
+        if self.input_mode == InputMode.SPARK:
+            for n in self._feedable_nodes():
+                try:
+                    self._client_for(n["executor_id"]).put("input", EndOfFeed(), timeout=5)
+                except Exception:
+                    logger.warning("could not send EndOfFeed to node %d", n["executor_id"])
+        finished = self.backend.join(timeout)
+        if not finished:
+            logger.warning("workers still alive after %.0fs; terminating", timeout)
+            self.backend.terminate()
+        for c in self._clients.values():
+            c.close()
+        self.server.stop()
+        _raise_worker_errors(self.working_dir, self.cluster_meta["num_workers"])
+        if not finished:
+            raise TimeoutError(f"cluster shutdown timed out after {timeout}s")
+
+    def tensorboard_url(self) -> str | None:
+        """Reference: ``TFCluster.py::tensorboard_url``."""
+        for n in self.cluster_info:
+            if n.get("tb_port"):
+                return f"http://{n['host']}:{n['tb_port']}"
+        return None
+
+
+# -- helpers ---------------------------------------------------------------
+
+def _build_cluster_template(num_workers: int, num_ps: int,
+                            master_node: str | None, eval_node: bool) -> dict:
+    """Map job names to executor-id lists.
+
+    Reference: the template logic at the top of ``TFCluster.py::run``
+    (ps nodes first, then chief/master, evaluator last, workers in between).
+    """
+    assert num_ps < num_workers, "num_ps must leave at least one worker"
+    executors = list(range(num_workers))
+    template: dict[str, list[int]] = {}
+    if num_ps:
+        template["ps"] = executors[:num_ps]
+        executors = executors[num_ps:]
+    if eval_node:
+        assert len(executors) > 1, "eval_node needs a spare executor"
+        template["evaluator"] = [executors[-1]]
+        executors = executors[:-1]
+    if master_node:
+        template[master_node] = [executors[0]]
+        executors = executors[1:]
+    if executors:
+        template["worker"] = executors
+    return template
+
+
+def _partition(data, n: int) -> list[list]:
+    """Split data into n round-robin partitions (RDD-partition stand-in).
+
+    Accepts a list of pre-made partitions (list of lists) via
+    ``Partitioned`` or splits a flat sequence evenly.
+    """
+    if isinstance(data, Partitioned):
+        return [list(p) for p in data.partitions]
+    items = list(data)
+    n = max(1, min(n, len(items)) if items else 1)
+    size = (len(items) + n - 1) // n
+    return [items[i * size:(i + 1) * size] for i in range(n) if items[i * size:(i + 1) * size]]
+
+
+class Partitioned:
+    """Explicitly pre-partitioned data (the RDD-with-partitions analogue)."""
+
+    def __init__(self, partitions):
+        self.partitions = list(partitions)
+
+
+def _feed_partition(client: QueueClient, part: list, qname: str,
+                    chunk_size: int, feed_timeout: float) -> None:
+    """Push one partition as chunks + EndPartition marker.
+
+    Reference hot loop: ``TFSparkNode.py::_train`` (per-item ``q.put`` with
+    ``feed_timeout``; aborts on state ``'terminating'``) — here chunked.
+    """
+    for start in range(0, len(part), chunk_size):
+        if client.kv_get("state") == "terminating":
+            return
+        client.put(qname, part[start:start + chunk_size], timeout=feed_timeout)
+    client.put(qname, EndPartition(), timeout=feed_timeout)
+
+
+def _watch_for_crashes(backend, server: Server, status: dict) -> None:
+    """Fail-fast bootstrap monitor: if a worker dies before registering,
+    surface it so ``await_reservations`` raises instead of hanging (the
+    reference gets this from Spark job failure + ``spark.task.maxFailures=1``)."""
+    while not server.done.is_set() and not server.reservations.done():
+        failed = backend.failed()
+        if failed:
+            status["error"] = (
+                f"worker(s) {failed} exited during bootstrap. If this driver "
+                "script runs at module top level, wrap it in `if __name__ == "
+                "'__main__':` — worker processes re-import the main module "
+                "(multiprocessing 'spawn'), like PySpark driver scripts."
+            )
+            return
+        time.sleep(0.25)
+
+
+def _raise_worker_errors(working_dir: str, num_workers: int) -> None:
+    """Re-raise the first worker traceback found in crash files.
+
+    Reference: ``TFCluster.py::shutdown`` re-raising errors drained from the
+    per-node ``'error'`` queues.
+    """
+    for i in range(num_workers):
+        crash = os.path.join(working_dir, f"error.{i}")
+        if os.path.exists(crash):
+            with open(crash) as f:
+                tb = f.read()
+            raise RuntimeError(f"worker {i} failed:\n{tb}")

@@ -1,0 +1,79 @@
+"""Accelerator discovery and visibility.
+
+Equivalent of the reference's ``tensorflowonspark/gpu_info.py``, which shells
+out to ``nvidia-smi`` to pick free GPUs and returns a ``CUDA_VISIBLE_DEVICES``
+string (``gpu_info.py::get_gpus``).  On TPU there is no contention-prone
+per-process device picker: libtpu owns the chips on a host and JAX enumerates
+them (``jax.devices()``).  What remains useful — and what this module provides
+— is (a) lazily-imported device/topology introspection, (b) the
+``TPU_VISIBLE_DEVICES``-style visibility env for tests and multi-process
+single-host runs, and (c) a ``get_gpus``-compatible shim for API parity.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+MAX_RETRIES = 3  # API parity with gpu_info.MAX_RETRIES; unused on TPU.
+
+
+def num_local_devices() -> int:
+    """Number of accelerator devices visible to this process."""
+    import jax
+
+    return jax.local_device_count()
+
+
+def device_summary() -> list[dict]:
+    """Introspect visible devices (kind, id, process, coords if TPU)."""
+    import jax
+
+    out = []
+    for d in jax.devices():
+        out.append({
+            "id": d.id,
+            "process_index": d.process_index,
+            "platform": d.platform,
+            "kind": getattr(d, "device_kind", "unknown"),
+            "coords": getattr(d, "coords", None),
+        })
+    return out
+
+
+def visibility_env(device_ids=None, platform: str | None = None,
+                   host_device_count: int | None = None) -> dict:
+    """Build the env-var dict that controls device visibility for a child.
+
+    The reference computed ``CUDA_VISIBLE_DEVICES`` per executor
+    (``gpu_info.py::get_gpus`` randomized free-GPU picking); the TPU analogue
+    is ``TPU_VISIBLE_DEVICES``/``TPU_PROCESS_BOUNDS`` for chip partitioning
+    and ``--xla_force_host_platform_device_count`` for CPU-simulated meshes.
+    """
+    env = {}
+    if device_ids is not None:
+        csv = ",".join(str(i) for i in device_ids)
+        env["TPU_VISIBLE_DEVICES"] = csv
+        env["CUDA_VISIBLE_DEVICES"] = csv  # harmless parity; ignored on TPU
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    if host_device_count:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={host_device_count}"
+        env["XLA_FLAGS"] = (flags + " " + flag).strip()
+    return env
+
+
+def get_gpus(num_gpu: int = 1, worker_index: int = -1, format_as_csv: bool = True):
+    """API-parity shim for ``gpu_info.py::get_gpus``.
+
+    On TPU hosts all chips belong to the single training process, so this
+    returns the first ``num_gpu`` local device ids rather than probing
+    ``nvidia-smi``.  Kept so reference-era user code keeps importing cleanly.
+    """
+    ids = list(range(num_local_devices()))[:num_gpu]
+    if worker_index >= 0 and not ids:
+        ids = [worker_index % max(1, num_local_devices())]
+    return ",".join(map(str, ids)) if format_as_csv else ids

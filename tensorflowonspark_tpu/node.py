@@ -1,0 +1,245 @@
+"""Per-worker node runtime: bootstrap, context, and the user-fn harness.
+
+Equivalent of the reference's ``tensorflowonspark/TFSparkNode.py`` — the code
+that runs once inside every worker process.  It
+
+1. starts this node's :class:`~tensorflowonspark_tpu.queues.QueueServer`
+   (reference: ``TFManager.start``),
+2. registers with the driver's reservation server and waits for the full
+   cluster spec (reference: ``reservation.Client.register`` /
+   ``await_reservations`` inside ``TFSparkNode.py::run``),
+3. exports the JAX coordination env (the reference's ``TF_CONFIG``
+   equivalent: ``coordinator_address`` / ``num_processes`` / ``process_id``
+   for ``jax.distributed.initialize``),
+4. builds a :class:`NodeContext` and invokes the user's ``map_fun(args, ctx)``,
+5. traps exceptions into the ``error`` queue + a crash file so the driver can
+   re-raise them (reference: the ``'error'`` queue consumed by
+   ``TFCluster.shutdown``).
+
+Structural divergence from the reference (deliberate): the reference forks a
+separate TF process per executor because the PySpark worker must return to
+feed data; here the driver feeds over TCP directly, so ``map_fun`` runs in
+the worker process itself — one process per host, which is exactly what
+JAX/libtpu require (a TPU host's chips belong to a single process).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import sys
+import traceback
+
+from tensorflowonspark_tpu import util
+from tensorflowonspark_tpu.datafeed import DataFeed
+from tensorflowonspark_tpu.queues import DEFAULT_QUEUES, QueueServer
+from tensorflowonspark_tpu.reservation import Client, get_ip_address
+
+logger = logging.getLogger(__name__)
+
+
+class NodeContext:
+    """Context object passed to the user's ``map_fun(args, ctx)``.
+
+    Equivalent of ``TFSparkNode.py::TFNodeContext`` (executor_id, job_name,
+    task_index, cluster_spec, defaultFS, working_dir, mgr) with TPU-era
+    additions: the coordination parameters for ``jax.distributed`` and a
+    one-call mesh helper.
+    """
+
+    def __init__(self, executor_id: int, job_name: str, task_index: int,
+                 cluster_info: list[dict], default_fs: str = "",
+                 working_dir: str | None = None, mgr: QueueServer | None = None):
+        self.executor_id = self.worker_num = executor_id
+        self.job_name = job_name
+        self.task_index = task_index
+        self.cluster_info = cluster_info
+        self.default_fs = self.defaultFS = default_fs
+        self.working_dir = working_dir or os.getcwd()
+        self.mgr = mgr
+        self.num_workers = len(cluster_info)
+
+    # -- cluster spec ------------------------------------------------------
+    @property
+    def cluster_spec(self) -> dict:
+        """``{job_name: [host:port, ...]}``, the reference's ClusterSpec shape."""
+        spec: dict[str, list[str]] = {}
+        for node in sorted(self.cluster_info, key=lambda n: (n["job_name"], n["task_index"])):
+            spec.setdefault(node["job_name"], []).append(f"{node['host']}:{node['port']}")
+        return spec
+
+    def nodes_with_job(self, job_name: str) -> list[dict]:
+        return sorted((n for n in self.cluster_info if n["job_name"] == job_name),
+                      key=lambda n: n["task_index"])
+
+    @property
+    def is_chief(self) -> bool:
+        """True on the node that should export/checkpoint (reference: the
+        ``chief``/``master`` role, else worker:0)."""
+        chiefs = [n for n in self.cluster_info if n["job_name"] in ("chief", "master")]
+        if chiefs:
+            return (self.job_name, self.task_index) == (
+                chiefs[0]["job_name"], chiefs[0]["task_index"])
+        return self.job_name == "worker" and self.task_index == 0
+
+    @property
+    def num_hosts(self) -> int:
+        return len({n["host"] for n in self.cluster_info})
+
+    # -- JAX coordination --------------------------------------------------
+    def distributed_env(self) -> dict:
+        """Env for ``jax.distributed.initialize``: process 0's coordinator
+        address plus this node's process id (the reference's ``TF_CONFIG``)."""
+        ordered = sorted(self.cluster_info, key=lambda n: n["executor_id"])
+        coord = ordered[0]
+        return {
+            "coordinator_address": f"{coord['host']}:{coord['coordinator_port']}",
+            "num_processes": len(ordered),
+            "process_id": self.executor_id,
+        }
+
+    def initialize_distributed(self) -> None:
+        """Wire this process into the JAX multi-host runtime.
+
+        Only needed when the cluster spans >1 process with real accelerators;
+        single-process meshes (one host's chips, or a CPU-simulated mesh)
+        skip it.  Reference analogue: exporting ``TF_CONFIG`` before the
+        strategy constructor in the user's ``map_fun``.
+        """
+        import jax
+
+        env = self.distributed_env()
+        if env["num_processes"] <= 1:
+            return
+        jax.distributed.initialize(
+            coordinator_address=env["coordinator_address"],
+            num_processes=env["num_processes"],
+            process_id=env["process_id"],
+        )
+
+    # -- user conveniences -------------------------------------------------
+    def get_data_feed(self, train_mode: bool = True, qname_in: str = "input",
+                      qname_out: str = "output",
+                      input_mapping: dict | None = None) -> DataFeed:
+        """The reference's ``TFNode.DataFeed(ctx.mgr, ...)``."""
+        if self.mgr is None:
+            raise RuntimeError("no queue manager on this node (InputMode.TENSORFLOW?)")
+        return DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+
+    def absolute_path(self, path: str) -> str:
+        """The reference's ``TFNode.hdfs_path(ctx, path)``."""
+        return util.hdfs_path(self, path)
+
+    def export_dir(self, subdir: str = "export") -> str:
+        return self.absolute_path(subdir)
+
+
+def start_cluster_server(ctx: NodeContext, num_devices: int = 1, rdma: bool = False):
+    """API-parity shim for the reference's TF1-era
+    ``TFNode.py::start_cluster_server`` (built a ``tf.train.Server`` with
+    protocol ``grpc``/``grpc+verbs``).  On TPU the ICI fabric is managed by
+    libtpu/XLA — there is no user-space server to start, and ``rdma`` is
+    advisory (ICI is already RDMA-class, SURVEY.md §2b).  Returns the context
+    so legacy call sites keep working."""
+    if rdma:
+        logger.info("rdma=True is advisory on TPU (ICI transport is native)")
+    ctx.initialize_distributed()
+    return ctx
+
+
+def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
+    """Build the per-worker harness: ``_mapfn(executor_id)``.
+
+    Reference: ``TFSparkNode.py::run`` returning ``_mapfn(iter)`` for
+    ``foreachPartition``.  The returned callable is executed once in each
+    worker process by the cluster backend.  The queue server is started in
+    both input modes: SPARK mode feeds through it; TENSORFLOW mode still
+    uses its ``error`` queue and ``state`` kv for failure propagation.
+    """
+
+    def _mapfn(executor_id: int):
+        crash_file = None
+        if cluster_meta.get("working_dir"):
+            crash_file = os.path.join(cluster_meta["working_dir"], f"error.{executor_id}")
+        mgr = None
+        client = None
+        try:
+            job_name, task_index = _role_for(cluster_meta["cluster_template"], executor_id)
+            host = get_ip_address()
+
+            # 1. data-plane queue server (TFManager.start equivalent);
+            #    'remote' lets the driver/feeders connect from another host.
+            mgr = QueueServer(authkey=cluster_meta["authkey"], qnames=queues,
+                              mode=cluster_meta.get("queue_mode", "remote"),
+                              maxsize=cluster_meta.get("queue_depth", 64))
+            addr = mgr.start()
+
+            # 2. ports: one for the (unused-on-TPU) server slot, one that
+            #    process 0 will use as the jax.distributed coordinator.
+            port = util.get_free_port()
+            coordinator_port = util.get_free_port()
+
+            # 3. rendezvous
+            client = Client(cluster_meta["server_addr"],
+                            timeout=cluster_meta.get("reservation_timeout", 600),
+                            authkey=cluster_meta["authkey"])
+            client.register({
+                "executor_id": executor_id,
+                "host": host,
+                "job_name": job_name,
+                "task_index": task_index,
+                "port": port,
+                "coordinator_port": coordinator_port,
+                "addr": addr,
+                "authkey": cluster_meta["authkey"],
+            })
+            cluster_info = client.await_reservations()
+
+            # 4. context + user function
+            ctx = NodeContext(executor_id, job_name, task_index, cluster_info,
+                              default_fs=cluster_meta.get("default_fs", ""),
+                              working_dir=cluster_meta.get("working_dir"),
+                              mgr=mgr)
+            env = ctx.distributed_env()
+            os.environ["TFOS_COORDINATOR"] = env["coordinator_address"]
+            os.environ["TFOS_NUM_PROCESSES"] = str(env["num_processes"])
+            os.environ["TFOS_PROCESS_ID"] = str(env["process_id"])
+
+            logger.info("node %d starting map_fun as %s:%d", executor_id, job_name, task_index)
+            fn(tf_args, ctx)
+            mgr.kv_set("state", "finished")
+            logger.info("node %d map_fun finished", executor_id)
+        except Exception:
+            tb = traceback.format_exc()
+            logger.error("node %d failed:\n%s", executor_id, tb)
+            if crash_file:
+                try:
+                    with open(crash_file, "w") as f:
+                        f.write(tb)
+                except OSError:
+                    pass
+            if mgr is not None:
+                try:
+                    mgr.queue_put("error", tb, timeout=1)
+                    mgr.kv_set("state", "failed")
+                except Exception:
+                    pass
+            raise
+        finally:
+            if client is not None:
+                client.close()
+
+    return _mapfn
+
+
+def _role_for(cluster_template: dict[str, list[int]], executor_id: int) -> tuple[str, int]:
+    """Map an executor id to (job_name, task_index) via the driver's template.
+
+    Reference: the ``cluster_template`` built in ``TFCluster.py::run`` mapping
+    job names (ps/chief/master/worker/evaluator) to executor-index lists.
+    """
+    for job_name, ids in cluster_template.items():
+        if executor_id in ids:
+            return job_name, ids.index(executor_id)
+    raise ValueError(f"executor {executor_id} not in cluster template {cluster_template}")

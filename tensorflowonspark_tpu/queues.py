@@ -1,0 +1,257 @@
+"""Host-local data-plane queues served over TCP.
+
+Equivalent of the reference's ``tensorflowonspark/TFManager.py`` — the bridge
+between the feeding side (Spark tasks in the reference; the driver's feeder
+threads here) and the training process.  The reference uses a
+``multiprocessing.managers.BaseManager`` whose queue proxies pickle **every
+sample** across a TCP hop (``TFManager.py::start/connect`` — its documented
+throughput bottleneck, SURVEY.md §3.2).  This rebuild keeps the same surface
+(named queues ``input``/``output``/``error`` plus a kv-store holding
+``state``) but moves the wire granularity to **chunks of samples**: one
+pickled message per few hundred samples, so the Python/TCP boundary is off the
+per-sample hot path and the training process can slice chunks straight into
+device batches.
+
+Protocol (length-prefixed pickle, shared with ``reservation.MessageSocket``):
+
+    {"op": "put",   "q": name, "data": obj, "timeout": t} -> "OK" | ("FULL",)
+    {"op": "get",   "q": name, "timeout": t}              -> ("OK", obj) | ("EMPTY",)
+    {"op": "qsize", "q": name}                            -> int
+    {"op": "set",   "k": key, "v": val}                   -> "OK"
+    {"op": "getk",  "k": key}                             -> value | None
+    {"op": "stop"}                                        -> "OK"
+
+Auth: an ``authkey`` hello on connect, mirroring the reference's
+``multiprocessing`` authkey handshake.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as _queue
+import socket
+import threading
+
+from tensorflowonspark_tpu.reservation import MessageSocket
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_QUEUES = ("input", "output", "error")
+
+
+class QueueServer(MessageSocket):
+    """Serves named in-memory queues + a kv store over TCP.
+
+    Reference: ``TFManager.py::start`` (mode ``'local'`` binds loopback only,
+    ``'remote'`` binds all interfaces so other hosts' feed tasks can connect).
+    """
+
+    def __init__(self, authkey: bytes, qnames=DEFAULT_QUEUES, mode: str = "local",
+                 maxsize: int = 64):
+        self.authkey = bytes(authkey)
+        self.mode = mode
+        self.queues = {name: _queue.Queue(maxsize=maxsize) for name in qnames}
+        self.kv: dict = {"state": "running"}
+        self._kv_lock = threading.Lock()
+        self.done = threading.Event()
+        self._listener: socket.socket | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> tuple[str, int]:
+        host = "127.0.0.1" if self.mode == "local" else "0.0.0.0"
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, 0))
+        self._listener.listen(128)
+        self.port = self._listener.getsockname()[1]
+        threading.Thread(target=self._accept_loop, name="queue-server", daemon=True).start()
+        from tensorflowonspark_tpu.reservation import get_ip_address
+
+        self.addr = ("127.0.0.1" if self.mode == "local" else get_ip_address(), self.port)
+        return self.addr
+
+    def stop(self) -> None:
+        self.done.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:  # pragma: no cover
+                pass
+
+    # -- serving -----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self.done.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        import hmac
+
+        try:
+            # Raw-bytes hello compared before anything is unpickled — an
+            # unauthenticated peer never reaches pickle.loads.
+            hello = self.receive_raw(conn)
+            if not hmac.compare_digest(hello, self.authkey):
+                self.send(conn, ("ERR", "bad authkey"))
+                return
+            self.send(conn, "OK")
+            while not self.done.is_set():
+                msg = self.receive(conn)
+                try:
+                    self._handle(conn, msg)
+                except KeyError as e:
+                    self.send(conn, ("ERR", f"unknown queue {e}"))
+        except (EOFError, OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, conn: socket.socket, msg: dict) -> None:
+        op = msg.get("op")
+        if op == "put":
+            try:
+                self.queues[msg["q"]].put(msg["data"], block=True,
+                                          timeout=msg.get("timeout", 600))
+                self.send(conn, "OK")
+            except _queue.Full:
+                self.send(conn, ("FULL",))
+        elif op == "get":
+            try:
+                item = self.queues[msg["q"]].get(block=True, timeout=msg.get("timeout", 600))
+                self.queues[msg["q"]].task_done()
+                self.send(conn, ("OK", item))
+            except _queue.Empty:
+                self.send(conn, ("EMPTY",))
+        elif op == "qsize":
+            self.send(conn, self.queues[msg["q"]].qsize())
+        elif op == "set":
+            with self._kv_lock:
+                self.kv[msg["k"]] = msg["v"]
+            self.send(conn, "OK")
+        elif op == "getk":
+            with self._kv_lock:
+                self.send(conn, self.kv.get(msg["k"]))
+        elif op == "stop":
+            self.send(conn, "OK")
+            self.done.set()
+        else:
+            self.send(conn, ("ERR", f"unknown op {op!r}"))
+
+    # -- in-process access (training side, no TCP hop) ---------------------
+    def get_queue(self, qname: str) -> _queue.Queue:
+        """Direct queue handle for same-process consumers.
+
+        The reference's training process reads through manager proxies even
+        when co-located (``TFNode.py::DataFeed``); here the node runtime runs
+        ``map_fun`` in the *same* process as the queue server, so the hot
+        consumer path is a plain in-memory ``queue.Queue``.
+        """
+        return self.queues[qname]
+
+    def get(self, key: str):
+        with self._kv_lock:
+            return self.kv.get(key)
+
+    def set(self, key: str, value) -> None:
+        with self._kv_lock:
+            self.kv[key] = value
+
+    # Uniform interface shared with QueueClient so DataFeed works against
+    # either an in-process server (training side) or a TCP client (remote).
+    def queue_put(self, qname: str, item, timeout: float = 600.0) -> None:
+        self.queues[qname].put(item, block=True, timeout=timeout)
+
+    def queue_get(self, qname: str, timeout: float = 600.0):
+        item = self.queues[qname].get(block=True, timeout=timeout)
+        self.queues[qname].task_done()
+        return item
+
+    def queue_size(self, qname: str) -> int:
+        return self.queues[qname].qsize()
+
+    kv_get = get
+    kv_set = set
+
+
+class QueueClient(MessageSocket):
+    """TCP client used by feeders (driver side) and remote readers.
+
+    Reference: ``TFManager.py::connect`` + the queue proxies used inside
+    ``TFSparkNode.py::_train/_inference``.
+    """
+
+    def __init__(self, addr: tuple[str, int], authkey: bytes, timeout: float = 600.0):
+        self.addr = tuple(addr)
+        self.authkey = bytes(authkey)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        self._sock.connect(self.addr)
+        self._lock = threading.Lock()
+        self.send_raw(self._sock, self.authkey)
+        resp = self.receive(self._sock)
+        if resp != "OK":
+            raise ConnectionError(f"queue server rejected connection: {resp!r}")
+
+    def _request(self, msg):
+        with self._lock:
+            self.send(self._sock, msg)
+            return self.receive(self._sock)
+
+    @staticmethod
+    def _check_err(resp, qname: str):
+        if isinstance(resp, tuple) and resp and resp[0] == "ERR":
+            raise ValueError(f"queue server error for '{qname}': {resp[1]}")
+        return resp
+
+    def put(self, qname: str, data, timeout: float = 600.0) -> None:
+        resp = self._check_err(
+            self._request({"op": "put", "q": qname, "data": data, "timeout": timeout}),
+            qname)
+        if resp != "OK":
+            raise TimeoutError(f"queue '{qname}' full after {timeout}s (feed_timeout)")
+
+    def get(self, qname: str, timeout: float = 600.0):
+        resp = self._check_err(
+            self._request({"op": "get", "q": qname, "timeout": timeout}), qname)
+        if resp[0] != "OK":
+            raise TimeoutError(f"queue '{qname}' empty after {timeout}s")
+        return resp[1]
+
+    def try_get(self, qname: str, timeout: float = 0.1):
+        resp = self._check_err(
+            self._request({"op": "get", "q": qname, "timeout": timeout}), qname)
+        return resp[1] if resp[0] == "OK" else None
+
+    def qsize(self, qname: str) -> int:
+        return self._request({"op": "qsize", "q": qname})
+
+    def set(self, key: str, value) -> None:
+        self._request({"op": "set", "k": key, "v": value})
+
+    def get_key(self, key: str):
+        return self._request({"op": "getk", "k": key})
+
+    def stop_server(self) -> None:
+        try:
+            self._request({"op": "stop"})
+        except (EOFError, OSError):
+            pass
+
+    # Uniform interface (see QueueServer.queue_put/queue_get).
+    queue_put = put
+    queue_get = get
+    queue_size = qsize
+    kv_set = set
+    kv_get = get_key
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:  # pragma: no cover
+            pass

@@ -1,0 +1,291 @@
+"""Cluster bootstrap: a dependency-free TCP rendezvous.
+
+Equivalent of the reference's ``tensorflowonspark/reservation.py``
+(``Reservations``, ``MessageSocket``, ``Server``, ``Client``).  The driver
+starts a :class:`Server` expecting ``count`` registrations; every node runtime
+registers its ``{executor_id, host, job_name, task_index, port, addr,
+authkey}`` dict through a :class:`Client`, then polls until the full cluster
+spec is assembled.  On TPU this rendezvous additionally carries the
+coordinator address used for ``jax.distributed.initialize`` (the reference's
+analogue is building ``TF_CONFIG`` in ``TFSparkNode.py::run``).
+
+Wire format: 4-byte big-endian length prefix + pickled payload
+(:class:`MessageSocket`), matching the reference's framing strategy.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import select
+import socket
+import struct
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+BUFSIZE = 64 * 1024
+
+
+class Reservations:
+    """Thread-safe registry of node reservations.
+
+    Reference: ``reservation.py::Reservations`` (add/done/remaining).
+    """
+
+    def __init__(self, required: int):
+        self.required = required
+        self._lock = threading.RLock()
+        self._reservations: list[dict] = []
+
+    def add(self, meta: dict) -> None:
+        with self._lock:
+            self._reservations.append(meta)
+
+    def done(self) -> bool:
+        with self._lock:
+            return len(self._reservations) >= self.required
+
+    def get(self) -> list[dict]:
+        with self._lock:
+            return list(self._reservations)
+
+    def remaining(self) -> int:
+        with self._lock:
+            return self.required - len(self._reservations)
+
+
+class MessageSocket:
+    """Length-prefixed pickled messages over a TCP socket.
+
+    Reference: ``reservation.py::MessageSocket``.
+    """
+
+    def receive(self, sock: socket.socket):
+        header = self._recv_exact(sock, 4)
+        (length,) = struct.unpack(">I", header)
+        return pickle.loads(self._recv_exact(sock, length))
+
+    @staticmethod
+    def _recv_exact(sock: socket.socket, n: int) -> bytes:
+        chunks = []
+        got = 0
+        while got < n:
+            chunk = sock.recv(min(n - got, BUFSIZE))
+            if not chunk:
+                raise EOFError("socket closed while receiving message")
+            chunks.append(chunk)
+            got += len(chunk)
+        return b"".join(chunks)
+
+    def send(self, sock: socket.socket, msg) -> None:
+        data = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        sock.sendall(struct.pack(">I", len(data)) + data)
+
+    # Raw (non-pickle) frames, used for the pre-auth hello so that no
+    # attacker-controlled bytes are ever unpickled before authentication.
+    def receive_raw(self, sock: socket.socket, max_len: int = 1 << 16) -> bytes:
+        header = self._recv_exact(sock, 4)
+        (length,) = struct.unpack(">I", header)
+        if length > max_len:
+            raise ValueError(f"oversized pre-auth frame ({length} bytes)")
+        return self._recv_exact(sock, length)
+
+    def send_raw(self, sock: socket.socket, data: bytes) -> None:
+        sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+class Server(MessageSocket):
+    """Driver-side rendezvous listener.
+
+    Handles ``REG`` (register a node), ``QINFO`` (query done + cluster info),
+    ``QNUM`` (remaining count), and ``STOP`` messages — the reference's
+    register / query / get-cluster-info / stop protocol
+    (``reservation.py::Server``).
+    """
+
+    def __init__(self, count: int, authkey: bytes | None = None):
+        assert count > 0
+        self.reservations = Reservations(count)
+        self.authkey = authkey
+        self.done = threading.Event()
+        self._listener: socket.socket | None = None
+
+    def start(self) -> tuple[str, int]:
+        """Bind, spawn the accept loop thread, return ``(host, port)``."""
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", 0))
+        self._listener.listen(64)
+        port = self._listener.getsockname()[1]
+        addr = (get_ip_address(), port)
+
+        t = threading.Thread(target=self._serve, name="reservation-server", daemon=True)
+        t.start()
+        logger.info("reservation server listening at %s", addr)
+        self.addr = addr
+        return addr
+
+    def _serve(self) -> None:
+        import hmac
+
+        conns = [self._listener]
+        authed: set = set()
+        while not self.done.is_set():
+            try:
+                readable, _, _ = select.select(conns, [], [], 0.5)
+            except (OSError, ValueError):
+                break
+            for sock in readable:
+                if sock is self._listener:
+                    try:
+                        client, _ = self._listener.accept()
+                        conns.append(client)
+                    except OSError:
+                        break
+                elif self.authkey is not None and sock not in authed:
+                    # first frame must be the raw authkey hello; nothing is
+                    # unpickled from an unauthenticated peer.
+                    try:
+                        hello = self.receive_raw(sock)
+                        if not hmac.compare_digest(hello, self.authkey):
+                            raise PermissionError("bad authkey")
+                        authed.add(sock)
+                        self.send(sock, "OK")
+                    except (EOFError, OSError, ValueError, PermissionError):
+                        sock.close()
+                        conns.remove(sock)
+                else:
+                    try:
+                        msg = self.receive(sock)
+                        self._handle(sock, msg)
+                    except (EOFError, OSError, pickle.PickleError):
+                        sock.close()
+                        conns.remove(sock)
+                        authed.discard(sock)
+        for sock in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _handle(self, sock: socket.socket, msg: dict) -> None:
+        kind = msg.get("type")
+        if kind == "REG":
+            self.reservations.add(msg["data"])
+            self.send(sock, "OK")
+        elif kind == "QINFO":
+            done = self.reservations.done()
+            self.send(sock, (done, self.reservations.get() if done else None))
+        elif kind == "QNUM":
+            self.send(sock, self.reservations.remaining())
+        elif kind == "STOP":
+            self.send(sock, "OK")
+            self.done.set()
+        else:
+            self.send(sock, ("ERR", f"unknown message type {kind!r}"))
+
+    def await_reservations(self, timeout: float = 600.0, status: dict | None = None):
+        """Block until all nodes registered; raise on timeout.
+
+        Reference: ``reservation.py::Server.await_reservations`` — also
+        re-raises node failures surfaced through the ``status`` dict the way
+        the reference re-raises via the Spark job status.
+        """
+        deadline = time.monotonic() + timeout
+        while not self.reservations.done():
+            if status and status.get("error"):
+                raise RuntimeError(f"node failed during bootstrap: {status['error']}")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"timed out waiting for reservations: {self.reservations.remaining()}"
+                    f" of {self.reservations.required} still missing"
+                )
+            logger.debug("waiting for %d reservations", self.reservations.remaining())
+            time.sleep(0.1)
+        return self.reservations.get()
+
+    def stop(self) -> None:
+        self.done.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:  # pragma: no cover
+                pass
+
+
+class Client(MessageSocket):
+    """Node-side rendezvous client.  Reference: ``reservation.py::Client``."""
+
+    def __init__(self, server_addr: tuple[str, int], timeout: float = 600.0,
+                 authkey: bytes | None = None):
+        self.server_addr = tuple(server_addr)
+        self.timeout = timeout
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self._sock.connect(self.server_addr)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        self._lock = threading.Lock()
+        if authkey is not None:
+            self.send_raw(self._sock, authkey)
+            resp = self.receive(self._sock)
+            if resp != "OK":
+                raise PermissionError(f"reservation server rejected authkey: {resp!r}")
+
+    def _request(self, msg):
+        with self._lock:
+            self.send(self._sock, msg)
+            return self.receive(self._sock)
+
+    def register(self, info: dict) -> None:
+        resp = self._request({"type": "REG", "data": info})
+        if resp != "OK":
+            raise RuntimeError(f"registration rejected: {resp!r}")
+
+    def get_reservations(self) -> list[dict] | None:
+        done, info = self._request({"type": "QINFO"})
+        return info if done else None
+
+    def await_reservations(self, timeout: float | None = None) -> list[dict]:
+        """Poll until every node has registered (reference: 1 s poll loop)."""
+        deadline = time.monotonic() + (timeout or self.timeout)
+        while True:
+            info = self.get_reservations()
+            if info is not None:
+                return info
+            if time.monotonic() > deadline:
+                raise TimeoutError("timed out awaiting cluster reservations")
+            time.sleep(0.1)
+
+    def request_stop(self) -> None:
+        try:
+            self._request({"type": "STOP"})
+        except (EOFError, OSError):  # server may already be gone
+            pass
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:  # pragma: no cover
+            pass
+
+
+def get_ip_address() -> str:
+    """Best-effort routable IP of this host (loopback fallback for tests)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"

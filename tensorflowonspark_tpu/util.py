@@ -1,0 +1,75 @@
+"""Small utilities shared across the framework.
+
+Equivalent of the reference's ``tensorflowonspark/util.py``
+(``single_node_env``, executor-id port-file dedup, ``find_in_path``) plus the
+path-resolution helper that lives in ``TFNode.py::hdfs_path`` upstream.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def single_node_env(num_devices: int | None = None, platform: str | None = None) -> None:
+    """Configure env for a single-node (no-cluster) run.
+
+    Reference: ``util.py::single_node_env`` (sets ``CUDA_VISIBLE_DEVICES``
+    and clears cluster env).  TPU version: clear any stale coordination env
+    and optionally force a platform / virtual device count.
+    """
+    for var in ("TF_CONFIG", "TFOS_COORDINATOR", "TFOS_NUM_PROCESSES",
+                "TFOS_PROCESS_ID"):
+        os.environ.pop(var, None)
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+    if num_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={num_devices}"
+        if flag not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+
+
+def find_in_path(path: str, file_name: str) -> str | bool:
+    """Find a file within a search-path string.  Reference: ``util.py::find_in_path``."""
+    for p in path.split(os.pathsep):
+        candidate = os.path.join(p, file_name)
+        if os.path.exists(candidate) and os.path.isfile(candidate):
+            return candidate
+    return False
+
+
+def get_free_port(host: str = "") -> int:
+    """Reserve an ephemeral port (bind + close), as the reference's node
+    runtime does when pre-binding the TF server port (``TFSparkNode.py::run``)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def hdfs_path(ctx, path: str) -> str:
+    """Resolve a user path against the cluster's default FS / working dir.
+
+    Reference: ``TFNode.py::hdfs_path`` — absolute schemes pass through,
+    relative paths are joined against ``ctx.defaultFS`` + working dir.  On
+    TPU-VM clusters the default FS is typically ``gs://`` or a local/NFS dir.
+    """
+    if any(path.startswith(p) for p in ("hdfs://", "gs://", "viewfs://", "file://", "s3://")):
+        return path
+    if path.startswith("/"):
+        default_fs = getattr(ctx, "default_fs", "") or ""
+        if default_fs and not default_fs.startswith("file://"):
+            return default_fs.rstrip("/") + path
+        return path
+    # relative path
+    working_dir = getattr(ctx, "working_dir", None) or os.getcwd()
+    default_fs = getattr(ctx, "default_fs", "") or ""
+    if default_fs and not default_fs.startswith("file://"):
+        return f"{default_fs.rstrip('/')}/{working_dir.lstrip('/')}/{path}"
+    return os.path.join(working_dir, path)

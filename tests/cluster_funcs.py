@@ -1,0 +1,59 @@
+"""Top-level map_fun fixtures for cluster integration tests.
+
+Must live in an importable module so ``multiprocessing`` spawn can pickle
+them — the same constraint Spark puts on closures shipped to executors.
+Mirrors the reference's tiny inline map_funs (SURVEY.md §4: orchestration is
+tested with trivial functions, real models live in examples/).
+"""
+
+import os
+
+
+def fn_noop(args, ctx):
+    """Registers, does nothing, exits cleanly."""
+
+
+def fn_write_role(args, ctx):
+    """Record each node's role assignment for template assertions."""
+    path = os.path.join(ctx.working_dir, f"role.{ctx.executor_id}")
+    with open(path, "w") as f:
+        f.write(f"{ctx.job_name}:{ctx.task_index}:{int(ctx.is_chief)}:{ctx.num_workers}")
+
+
+def fn_sum_feed(args, ctx):
+    """Consume the feed, write the running sum (train-mode round trip)."""
+    feed = ctx.get_data_feed(train_mode=True)
+    total = 0
+    count = 0
+    while not feed.should_stop():
+        batch = feed.next_batch(args["batch_size"], timeout=30)
+        total += sum(batch)
+        count += len(batch)
+    with open(os.path.join(ctx.working_dir, f"sum.{ctx.executor_id}"), "w") as f:
+        f.write(f"{total}:{count}")
+
+
+def fn_square_inference(args, ctx):
+    """Echo x**2 for every sample (inference round trip)."""
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        batch = feed.next_batch(4, timeout=30)
+        if batch:
+            feed.batch_results([x * x for x in batch])
+
+
+def fn_crash(args, ctx):
+    raise ValueError("deliberate failure for error-propagation test")
+
+
+def fn_crash_before_register(args, ctx):  # pragma: no cover - not called
+    raise RuntimeError("unused")
+
+
+def fn_terminating_consumer(args, ctx):
+    """Read a few batches then terminate early (early-stop semantics)."""
+    feed = ctx.get_data_feed()
+    feed.next_batch(4, timeout=30)
+    feed.terminate(drain_secs=1.0)
+    with open(os.path.join(ctx.working_dir, f"term.{ctx.executor_id}"), "w") as f:
+        f.write("terminated")

@@ -1,0 +1,38 @@
+"""Test fixtures.
+
+Mirrors the reference's test backbone (SURVEY.md §4): the reference tests run
+against Spark's ``local-cluster[N, cores, mem]`` master — real multi-process
+distribution on one machine, fail-fast (``spark.task.maxFailures=1``).  Here
+the analogue is (a) an 8-device CPU-simulated mesh inside the test process
+(``--xla_force_host_platform_device_count=8``) for sharding tests, and (b)
+``LocalProcessBackend`` worker processes for orchestration tests.
+"""
+
+import os
+
+# Must happen before any jax import anywhere in the test session.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def jax_cpu_mesh_devices():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 simulated CPU devices, got {len(devices)}"
+    return devices
+
+
+@pytest.fixture()
+def worker_env(tmp_path):
+    """Env for spawned worker processes: force CPU, keep fail-fast."""
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }

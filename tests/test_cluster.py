@@ -1,0 +1,120 @@
+"""Cluster orchestration integration tests.
+
+Reference model: ``tests/test_TFCluster.py`` — run/train/inference/shutdown
+round trips with trivial map_funs on a local multi-process cluster, both
+input modes, error propagation (SURVEY.md §4).  Worker processes are real
+OS processes via LocalProcessBackend, the rebuild's ``local-cluster`` analogue.
+"""
+
+import os
+
+import pytest
+
+from tensorflowonspark_tpu.cluster import (InputMode, Partitioned, TPUCluster,
+                                           _build_cluster_template, _partition)
+from tests import cluster_funcs as funcs
+
+pytestmark = pytest.mark.integration
+
+
+def _run(map_fun, num_workers=2, tmp=None, **kw):
+    return TPUCluster.run(map_fun, kw.pop("tf_args", {}), num_workers,
+                          reservation_timeout=60, working_dir=str(tmp), **kw)
+
+
+def test_run_and_shutdown_noop(tmp_path):
+    cluster = _run(funcs.fn_noop, 2, tmp_path)
+    cluster.shutdown(timeout=60)
+
+
+def test_role_assignment_template(tmp_path):
+    cluster = _run(funcs.fn_write_role, 3, tmp_path, master_node="chief")
+    cluster.shutdown(timeout=60)
+    roles = {}
+    for i in range(3):
+        with open(os.path.join(str(tmp_path), f"role.{i}")) as f:
+            roles[i] = f.read()
+    assert roles[0].startswith("chief:0:1")     # chief is executor 0 and is_chief
+    assert roles[1].startswith("worker:0:0")
+    assert roles[2].startswith("worker:1:0")
+    assert all(r.endswith(":3") for r in roles.values())
+
+
+def test_train_feed_roundtrip(tmp_path):
+    cluster = _run(funcs.fn_sum_feed, 2, tmp_path, tf_args={"batch_size": 8})
+    cluster.train(list(range(100)), num_epochs=1)
+    cluster.shutdown(timeout=60)
+    total = count = 0
+    for i in range(2):
+        with open(os.path.join(str(tmp_path), f"sum.{i}")) as f:
+            t, c = f.read().split(":")
+            total += int(t)
+            count += int(c)
+    assert total == sum(range(100))
+    assert count == 100
+
+
+def test_train_multi_epoch(tmp_path):
+    cluster = _run(funcs.fn_sum_feed, 2, tmp_path, tf_args={"batch_size": 16})
+    cluster.train(list(range(10)), num_epochs=3)
+    cluster.shutdown(timeout=60)
+    total = count = 0
+    for i in range(2):
+        with open(os.path.join(str(tmp_path), f"sum.{i}")) as f:
+            t, c = f.read().split(":")
+            total += int(t)
+            count += int(c)
+    assert count == 30
+    assert total == 3 * sum(range(10))
+
+
+def test_inference_roundtrip(tmp_path):
+    cluster = _run(funcs.fn_square_inference, 2, tmp_path)
+    preds = cluster.inference(list(range(20)))
+    cluster.shutdown(timeout=60)
+    assert sorted(preds) == sorted(x * x for x in range(20))
+
+
+def test_inference_more_partitions_than_nodes(tmp_path):
+    # regression: multiple partitions routed to one node must be fed
+    # sequentially, not interleaved by concurrent feeder threads
+    cluster = _run(funcs.fn_square_inference, 2, tmp_path)
+    preds = cluster.inference(Partitioned([[1, 2], [3, 4], [5, 6], [7]]))
+    cluster.shutdown(timeout=60)
+    assert sorted(preds) == sorted(x * x for x in range(1, 8))
+
+
+def test_error_propagation_on_shutdown(tmp_path):
+    cluster = _run(funcs.fn_crash, 2, tmp_path, input_mode=InputMode.TENSORFLOW)
+    with pytest.raises(RuntimeError, match="deliberate failure"):
+        cluster.shutdown(timeout=60)
+
+
+def test_early_terminate_stops_feed(tmp_path):
+    cluster = _run(funcs.fn_terminating_consumer, 1, tmp_path)
+    # feed far more data than the consumer will read; must not hang
+    cluster.train(list(range(10000)), num_epochs=0, feed_timeout=30)
+    cluster.shutdown(timeout=60)
+    assert os.path.exists(os.path.join(str(tmp_path), "term.0"))
+
+
+# -- pure-function unit tests ----------------------------------------------
+
+def test_build_cluster_template_roles():
+    t = _build_cluster_template(5, num_ps=2, master_node="master", eval_node=True)
+    assert t == {"ps": [0, 1], "evaluator": [4], "master": [2], "worker": [3]}
+
+
+def test_build_cluster_template_workers_only():
+    assert _build_cluster_template(3, 0, None, False) == {"worker": [0, 1, 2]}
+
+
+def test_partition_even_split():
+    parts = _partition(list(range(10)), 3)
+    assert [len(p) for p in parts] == [4, 4, 2]
+    assert sum(parts, []) == list(range(10))
+
+
+def test_partition_explicit():
+    parts = _partition(Partitioned([[1, 2], [3]]), 99)
+    assert parts == [[1, 2], [3]]

@@ -1,0 +1,85 @@
+"""DataFeed batch semantics tests.
+
+Reference model: ``tests/test_TFNode.py`` — next_batch across EndPartition
+markers, should_stop, terminate (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.datafeed import DataFeed
+from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition
+from tensorflowonspark_tpu.queues import QueueServer
+
+AUTH = b"k"
+
+
+@pytest.fixture()
+def mgr():
+    s = QueueServer(authkey=AUTH, mode="local", maxsize=64)
+    s.start()
+    yield s
+    s.stop()
+
+
+def test_next_batch_reslices_chunks(mgr):
+    feed = DataFeed(mgr)
+    mgr.queue_put("input", [1, 2, 3])
+    mgr.queue_put("input", [4, 5, 6, 7])
+    mgr.queue_put("input", EndOfFeed())
+    assert feed.next_batch(5) == [1, 2, 3, 4, 5]
+    assert feed.next_batch(5) == [6, 7]  # buffer drains, then EndOfFeed
+    assert feed.should_stop()
+
+
+def test_partition_alignment(mgr):
+    feed = DataFeed(mgr)
+    mgr.queue_put("input", [1, 2, 3])
+    mgr.queue_put("input", EndPartition())
+    mgr.queue_put("input", [4, 5])
+    mgr.queue_put("input", EndOfFeed())
+    assert feed.next_batch(10) == [1, 2, 3]  # stops at partition edge
+    assert feed.next_batch(10) == [4, 5]     # stops at end of feed
+    assert feed.should_stop()
+    assert feed.next_batch(10) == []
+
+
+def test_empty_partition_skipped(mgr):
+    feed = DataFeed(mgr)
+    mgr.queue_put("input", EndPartition())
+    mgr.queue_put("input", [1])
+    mgr.queue_put("input", EndOfFeed())
+    assert feed.next_batch(4) == [1]
+
+
+def test_input_mapping_selects_columns(mgr):
+    feed = DataFeed(mgr, input_mapping={"image": "x", "label": "y"})
+    mgr.queue_put("input", [{"image": "img0", "label": 0, "junk": None}])
+    mgr.queue_put("input", EndOfFeed())
+    assert feed.next_batch(4) == [["img0", 0]]
+
+
+def test_next_batch_arrays_stacks_columns(mgr):
+    feed = DataFeed(mgr)
+    mgr.queue_put("input", [(np.ones(3), 1), (np.zeros(3), 0)])
+    mgr.queue_put("input", EndOfFeed())
+    xs, ys = feed.next_batch_arrays(2)
+    assert xs.shape == (2, 3)
+    np.testing.assert_array_equal(ys, [1, 0])
+    assert feed.next_batch_arrays(2) is None
+
+
+def test_batch_results_roundtrip(mgr):
+    feed = DataFeed(mgr, train_mode=False)
+    feed.batch_results(["a", "b"])
+    assert mgr.queue_get("output", timeout=5) == ["a", "b"]
+
+
+def test_terminate_sets_state_and_drains(mgr):
+    feed = DataFeed(mgr)
+    for i in range(5):
+        mgr.queue_put("input", [i])
+    feed.terminate(drain_secs=0.5)
+    assert mgr.get("state") == "terminating"
+    assert feed.should_stop()
+    assert mgr.queue_size("input") == 0

@@ -1,0 +1,84 @@
+"""Data-plane queue server/client tests (TFManager equivalent)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.queues import QueueClient, QueueServer
+
+AUTH = b"secret"
+
+
+@pytest.fixture()
+def server():
+    s = QueueServer(authkey=AUTH, mode="local", maxsize=4)
+    s.start()
+    yield s
+    s.stop()
+
+
+def test_put_get_roundtrip(server):
+    c = QueueClient(server.addr, AUTH)
+    chunk = [(np.arange(4), 1), (np.arange(4) + 1, 0)]
+    c.put("input", chunk)
+    got = server.get_queue("input").get(timeout=5)
+    np.testing.assert_array_equal(got[0][0], np.arange(4))
+    c.close()
+
+
+def test_bad_authkey_rejected(server):
+    with pytest.raises(ConnectionError):
+        QueueClient(server.addr, b"wrong")
+
+
+def test_kv_state(server):
+    c = QueueClient(server.addr, AUTH)
+    assert c.kv_get("state") == "running"
+    c.kv_set("state", "terminating")
+    assert server.get("state") == "terminating"
+    c.close()
+
+
+def test_backpressure_full_queue(server):
+    c = QueueClient(server.addr, AUTH)
+    for i in range(4):
+        c.put("input", [i], timeout=1)
+    with pytest.raises(TimeoutError):  # maxsize=4 → fifth put times out
+        c.put("input", [4], timeout=0.3)
+    c.close()
+
+
+def test_output_queue_from_training_side(server):
+    # training side pushes in-process, feeder reads over TCP
+    server.queue_put("output", ["pred1", "pred2"])
+    c = QueueClient(server.addr, AUTH)
+    assert c.queue_get("output", timeout=5) == ["pred1", "pred2"]
+    c.close()
+
+
+def test_unknown_queue_name_errors_cleanly(server):
+    c = QueueClient(server.addr, AUTH)
+    with pytest.raises(ValueError, match="unknown queue"):
+        c.put("nonexistent", [1])
+    c.put("input", ["still works"])  # connection survives the error
+    assert server.get_queue("input").get(timeout=5) == ["still works"]
+    c.close()
+
+
+def test_concurrent_feeders(server):
+    def _feed(tag):
+        c = QueueClient(server.addr, AUTH)
+        for i in range(8):
+            c.put("input", [f"{tag}-{i}"], timeout=10)
+        c.close()
+
+    threads = [threading.Thread(target=_feed, args=(t,)) for t in "ab"]
+    for t in threads:
+        t.start()
+    got = []
+    for _ in range(16):
+        got.extend(server.get_queue("input").get(timeout=10))
+    for t in threads:
+        t.join(5)
+    assert sorted(got) == sorted([f"{t}-{i}" for t in "ab" for i in range(8)])

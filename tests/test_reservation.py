@@ -1,0 +1,75 @@
+"""Rendezvous protocol tests.
+
+Reference model: ``tests/test_reservation.py`` — Server/Client
+register/await/stop over real localhost sockets, plus timeout behavior
+(SURVEY.md §4).
+"""
+
+import threading
+
+import pytest
+
+from tensorflowonspark_tpu.reservation import Client, Server
+
+
+def test_register_and_await():
+    server = Server(3)
+    addr = server.start()
+    infos = [{"executor_id": i, "host": "127.0.0.1", "job_name": "worker",
+              "task_index": i, "port": 4000 + i} for i in range(3)]
+
+    def _register(info):
+        c = Client(addr)
+        c.register(info)
+        got = c.await_reservations(timeout=10)
+        assert len(got) == 3
+        c.close()
+
+    threads = [threading.Thread(target=_register, args=(i,)) for i in infos]
+    for t in threads:
+        t.start()
+    result = server.await_reservations(timeout=10)
+    for t in threads:
+        t.join(10)
+    assert sorted(r["executor_id"] for r in result) == [0, 1, 2]
+    server.stop()
+
+
+def test_partial_reservations_not_done():
+    server = Server(2)
+    addr = server.start()
+    c = Client(addr)
+    c.register({"executor_id": 0})
+    assert c.get_reservations() is None  # not done yet
+    assert server.reservations.remaining() == 1
+    c.register({"executor_id": 1})
+    assert len(c.await_reservations(timeout=5)) == 2
+    c.close()
+    server.stop()
+
+
+def test_await_timeout():
+    server = Server(2)
+    server.start()
+    with pytest.raises(TimeoutError):
+        server.await_reservations(timeout=0.5)
+    server.stop()
+
+
+def test_client_request_stop():
+    server = Server(1)
+    addr = server.start()
+    c = Client(addr)
+    c.register({"executor_id": 0})
+    c.request_stop()
+    assert server.done.wait(5)
+    c.close()
+
+
+def test_bootstrap_error_via_status():
+    server = Server(2)
+    server.start()
+    status = {"error": "worker 1 crashed"}
+    with pytest.raises(RuntimeError, match="worker 1 crashed"):
+        server.await_reservations(timeout=5, status=status)
+    server.stop()

@@ -124,6 +124,7 @@ class TPUCluster:
         self.working_dir = working_dir
         self.queues = queues
         self._clients: dict[int, QueueClient] = {}
+        self._feed_qnames: set[str] = {"input"}
         self._shutdown_done = False
 
     # ------------------------------------------------------------------ run
@@ -215,6 +216,7 @@ class TPUCluster:
         """
         assert self.input_mode == InputMode.SPARK, \
             "train() feeds data only in InputMode.SPARK"
+        self._feed_qnames.add(qname)
         nodes = self._feedable_nodes()
         partitions = _partition(data, num_partitions or len(nodes))
 
@@ -258,8 +260,19 @@ class TPUCluster:
                 client = QueueClient(target["addr"], target["authkey"])
                 try:
                     for pidx, part in parts:
-                        _feed_partition(client, part, qname, chunk_size, feed_timeout)
+                        # Interleave feeding with result collection: with
+                        # bounded queues, pushing a whole large partition
+                        # before draining any results deadlocks once the
+                        # output queue fills (worker blocked on put, feeder
+                        # blocked on put).
                         got: list = []
+                        for start in range(0, len(part), chunk_size):
+                            client.put(qname, part[start:start + chunk_size],
+                                       timeout=feed_timeout)
+                            for _ in range(client.qsize(qname_out)):
+                                chunk = client.queue_get(qname_out, timeout=feed_timeout)
+                                got.extend(chunk if isinstance(chunk, list) else [chunk])
+                        client.put(qname, EndPartition(), timeout=feed_timeout)
                         while len(got) < len(part):
                             chunk = client.queue_get(qname_out, timeout=feed_timeout)
                             got.extend(chunk if isinstance(chunk, list) else [chunk])
@@ -299,10 +312,12 @@ class TPUCluster:
             time.sleep(grace_secs)
         if self.input_mode == InputMode.SPARK:
             for n in self._feedable_nodes():
-                try:
-                    self._client_for(n["executor_id"]).put("input", EndOfFeed(), timeout=5)
-                except Exception:
-                    logger.warning("could not send EndOfFeed to node %d", n["executor_id"])
+                for qn in self._feed_qnames:
+                    try:
+                        self._client_for(n["executor_id"]).put(qn, EndOfFeed(), timeout=5)
+                    except Exception:
+                        logger.warning("could not send EndOfFeed('%s') to node %d",
+                                       qn, n["executor_id"])
         finished = self.backend.join(timeout)
         if not finished:
             logger.warning("workers still alive after %.0fs; terminating", timeout)
@@ -377,8 +392,10 @@ def _feed_partition(client: QueueClient, part: list, qname: str,
     Reference hot loop: ``TFSparkNode.py::_train`` (per-item ``q.put`` with
     ``feed_timeout``; aborts on state ``'terminating'``) — here chunked.
     """
-    for start in range(0, len(part), chunk_size):
-        if client.kv_get("state") == "terminating":
+    for i, start in enumerate(range(0, len(part), chunk_size)):
+        # poll 'state' every 16 chunks, not per chunk — the kv round trip
+        # would otherwise double the driver's per-chunk latency
+        if i % 16 == 0 and client.kv_get("state") == "terminating":
             return
         client.put(qname, part[start:start + chunk_size], timeout=feed_timeout)
     client.put(qname, EndPartition(), timeout=feed_timeout)

@@ -1,0 +1,29 @@
+"""Parallelism layer: meshes, shardings, strategies, and long-context ops.
+
+This is the TPU-native replacement for the reference's distribution stack
+(SURVEY.md §2c).  Where the reference delegates to ``tf.distribute``
+strategies over NCCL/gRPC (``TFSparkNode.py::run`` only populates
+``TF_CONFIG``), here distribution is expressed as a ``jax.sharding.Mesh``
+with named axes and every collective is emitted by XLA over ICI/DCN:
+
+- ``dp``    data parallel (batch axis)
+- ``fsdp``  fully-sharded data parallel (batch axis + parameter sharding)
+- ``tp``    tensor parallel (hidden/heads axes)
+- ``sp``    sequence/context parallel (ring attention)
+- ``pp``    pipeline parallel (lax.scan over stages)
+- ``ep``    expert/embedding parallel (sharded tables; the reference's
+            ``num_ps`` reinterpretation)
+"""
+
+from tensorflowonspark_tpu.parallel.mesh import (AXES, MeshSpec, make_mesh,
+                                                 mesh_from_num_ps)  # noqa: F401
+from tensorflowonspark_tpu.parallel.sharding import (PartitionRules, batch_pspec,
+                                                     named_sharding, shard_batch,
+                                                     shard_params)  # noqa: F401
+from tensorflowonspark_tpu.parallel.strategy import (DataParallelStrategy,
+                                                     FSDPStrategy, MeshStrategy,
+                                                     MultiWorkerMirroredStrategy)  # noqa: F401
+from tensorflowonspark_tpu.parallel.embedding import (ShardedEmbedding,
+                                                      sharded_embedding_lookup)  # noqa: F401
+from tensorflowonspark_tpu.parallel.ring_attention import (ring_attention,
+                                                           ring_self_attention)  # noqa: F401

@@ -1,0 +1,86 @@
+"""Vocab-sharded embedding tables: the parameter-server replacement.
+
+The reference's async parameter-server mode exists chiefly to hold large
+sparse embedding tables across ``num_ps`` nodes
+(``TFCluster.run(num_ps=…)`` + ``tf.train.replica_device_setter``; exercised
+by the Wide&Deep/Criteo config — SURVEY.md §2c).  On TPU the idiomatic
+equivalent is a table sharded over a mesh axis with XLA-generated collective
+gathers, giving the same memory scaling with synchronous semantics.
+
+Two implementations:
+
+- :class:`ShardedEmbedding` — a flax module whose table carries a GSPMD
+  partitioning annotation; lookups are plain ``take`` and XLA plans the
+  collectives.  Use this by default.
+- :func:`sharded_embedding_lookup` — an explicit ``shard_map`` lookup
+  (each shard resolves the ids that fall in its vocab range, then ``psum``
+  combines).  Use when you want guaranteed comms shape (e.g. giant tables
+  where you must avoid an all-gather of the table) or as the building block
+  for custom expert routing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+
+
+class ShardedEmbedding(nn.Module):
+    """Embedding with the table sharded on the vocab dim over ``axis``.
+
+    ``features`` may instead be sharded over ``tp`` by passing
+    ``shard_features=True`` (useful when the embedding feeds tensor-parallel
+    layers directly).
+    """
+
+    num_embeddings: int
+    features: int
+    axis: str = "ep"
+    shard_features: bool = False
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids):
+        spec = (self.axis, "tp" if self.shard_features else None)
+        table = self.param(
+            "embedding",
+            nn.with_partitioning(nn.initializers.normal(stddev=0.02), spec),
+            (self.num_embeddings, self.features), self.param_dtype)
+        table = jnp.asarray(table, self.dtype)
+        return jnp.take(table, ids, axis=0)
+
+
+def sharded_embedding_lookup(table: jax.Array, ids: jax.Array, axis_name: str = "ep"):
+    """Explicit sharded lookup, to be called inside ``shard_map``.
+
+    ``table`` is this shard's slice ``[vocab/n, features]``; ``ids`` are
+    *global* ids replicated across the axis.  Each shard gathers the rows it
+    owns (zeros elsewhere) and a ``psum`` over the axis assembles full
+    embeddings — one small all-reduce of activations instead of gathering
+    the table (the gRPC pull of the reference's PS, as an ICI collective).
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    shard_vocab = table.shape[0]
+    lo = idx * shard_vocab
+    local = ids - lo
+    in_range = (local >= 0) & (local < shard_vocab)
+    safe = jnp.clip(local, 0, shard_vocab - 1)
+    gathered = jnp.take(table, safe, axis=0)
+    gathered = jnp.where(in_range[..., None], gathered, 0)
+    return jax.lax.psum(gathered, axis_name)
+
+
+def apply_sharded_lookup(mesh, table, ids, axis_name: str = "ep"):
+    """Convenience wrapper: run :func:`sharded_embedding_lookup` under
+    ``shard_map`` with the table vocab-sharded and ids replicated."""
+    fn = jax.shard_map(
+        lambda t, i: sharded_embedding_lookup(t, i, axis_name),
+        mesh=mesh,
+        in_specs=(P(axis_name, None), P()),
+        out_specs=P(),
+    )
+    return fn(table, ids)

@@ -1,0 +1,94 @@
+"""Device-mesh construction with the framework's canonical named axes.
+
+The reference has no mesh concept — its parallelism topology is the
+ps/worker role split plus whatever ``tf.distribute`` strategy the user picks
+(SURVEY.md §2c).  On TPU the topology is a single SPMD mesh; this module
+builds it, infers free axis sizes, and maps the reference's ``num_ps``
+argument onto the ``ep`` (embedding-shard) axis.
+
+Axis order matters for ICI locality: the innermost axes (``tp``, ``sp``)
+change fastest over ``jax.devices()``, which enumerates devices so that
+neighbours in the list are neighbours on the ICI torus — keeping
+high-traffic collectives (tensor-parallel all-reduce, ring-attention
+ppermute) on adjacent chips, while ``dp``/``pp`` (lower traffic per step)
+span the slower/farther links or DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+# Canonical axis order, outermost → innermost (least → most ICI-local).
+AXES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass
+class MeshSpec:
+    """Sizes for each named axis; ``-1`` on one axis means "infer from the
+    device count" (like a reshape free dimension)."""
+
+    pp: int = 1
+    dp: int = -1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def sizes(self) -> tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXES)
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = list(self.sizes())
+        free = [i for i, s in enumerate(sizes) if s == -1]
+        if len(free) > 1:
+            raise ValueError("at most one axis may be -1")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if free:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}")
+            sizes[free[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"axis sizes {dict(zip(AXES, sizes))} require {fixed} devices, "
+                f"have {n_devices}")
+        return MeshSpec(**dict(zip(AXES, sizes)))
+
+
+def make_mesh(spec: MeshSpec | None = None, devices=None, **axis_sizes):
+    """Build a ``jax.sharding.Mesh`` over ``devices`` (default: all).
+
+    Either pass a :class:`MeshSpec` or axis sizes as kwargs::
+
+        mesh = make_mesh(dp=2, tp=4)           # 8 devices
+        mesh = make_mesh(dp=-1, sp=2)          # dp inferred
+
+    All six canonical axes always exist (size 1 when unused) so model code
+    can annotate shardings unconditionally.
+    """
+    import jax
+
+    if spec is None:
+        spec = MeshSpec(**{**{"dp": -1}, **axis_sizes})
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    spec = spec.resolve(devices.size)
+    grid = devices.reshape(spec.sizes())
+    return jax.sharding.Mesh(grid, AXES)
+
+
+def mesh_from_num_ps(num_ps: int, devices=None, **axis_sizes):
+    """Reference-parity helper: interpret ``TFCluster.run(num_ps=N)`` as an
+    ``ep`` axis of size N (sharded embedding tables replace parameter
+    servers on TPU — SURVEY.md §2c)."""
+    return make_mesh(ep=max(1, num_ps), devices=devices, **axis_sizes)
+
+
+def local_mesh_devices(mesh) -> list:
+    """Devices of this process within a (possibly multi-host) mesh."""
+    import jax
+
+    local = set(jax.local_devices())
+    return [d for d in mesh.devices.flat if d in local]

@@ -1,0 +1,111 @@
+"""Sharding helpers: batch specs, parameter partition rules, placement.
+
+The reference's nearest analogues are ``tf.train.replica_device_setter``
+(greedy variable placement over ps nodes, SURVEY.md §2c) and the implicit
+variable mirroring of ``MultiWorkerMirroredStrategy``.  Here placement is
+declarative: regex rules over parameter tree paths → ``PartitionSpec``s,
+applied once and enforced by GSPMD.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+DATA_AXES = ("dp", "fsdp")  # batch dimension shards over both
+
+
+def batch_pspec(extra_leading: int = 0) -> P:
+    """PartitionSpec for a batch: leading dim over (dp, fsdp)."""
+    return P(*([None] * extra_leading), DATA_AXES)
+
+
+def named_sharding(mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_batch(mesh, batch):
+    """Place a host batch onto the mesh, sharded along dim 0 over dp×fsdp.
+
+    This is the rebuild's device boundary for InputMode.SPARK data: the
+    chunked host queue ends here with one ``device_put`` per batch
+    (reference: per-sample queue → ``tf.data.Dataset.from_generator``).
+    """
+    sharding = NamedSharding(mesh, batch_pspec())
+    return jax.tree.map(lambda x: jax.device_put(np.asarray(x), sharding), batch)
+
+
+def replicated(mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+class PartitionRules:
+    """Ordered (regex, PartitionSpec) rules mapping parameter paths to specs.
+
+    Example (transformer with TP + FSDP)::
+
+        rules = PartitionRules([
+            (r".*embedding.*", P("tp", None)),
+            (r".*attn/(query|key|value)/kernel", P("fsdp", "tp")),
+            (r".*attn/out/kernel", P("tp", "fsdp")),
+            (r".*mlp/up/kernel", P("fsdp", "tp")),
+            (r".*mlp/down/kernel", P("tp", "fsdp")),
+            (r".*", P()),                      # default: replicate
+        ])
+        shardings = rules.tree_shardings(mesh, params)
+    """
+
+    def __init__(self, rules: list[tuple[str, P]]):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str) -> P:
+        for pat, spec in self.rules:
+            if pat.fullmatch(path) or pat.match(path):
+                return spec
+        return P()
+
+    def tree_specs(self, params):
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        specs = []
+        for path, leaf in flat:
+            path_str = "/".join(_key_str(k) for k in path)
+            spec = self.spec_for(path_str)
+            specs.append(_clip_spec(spec, getattr(leaf, "ndim", 0)))
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+    def tree_shardings(self, mesh, params):
+        return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                            self.tree_specs(params),
+                            is_leaf=lambda x: isinstance(x, P))
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)
+
+
+def _clip_spec(spec: P, ndim: int) -> P:
+    """Trim a spec to a leaf's rank (scalars/1-D biases get fewer axes)."""
+    parts = tuple(spec)
+    if len(parts) > ndim:
+        parts = parts[:ndim]
+    return P(*parts)
+
+
+def shard_params(mesh, params, rules: PartitionRules | None = None):
+    """Place a parameter tree on the mesh according to ``rules``
+    (default: fully replicated — the MultiWorkerMirrored behavior)."""
+    if rules is None:
+        return jax.device_put(params, replicated(mesh))
+    return jax.device_put(params, rules.tree_shardings(mesh, params))
+
+
+def constrain(x, mesh, *spec):
+    """``lax.with_sharding_constraint`` shorthand for use inside jit."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))

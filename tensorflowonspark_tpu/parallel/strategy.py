@@ -1,0 +1,191 @@
+"""Distribution strategies: the ``tf.distribute`` surface, TPU-native.
+
+In the reference, a user's ``map_fun`` does::
+
+    strategy = tf.distribute.MultiWorkerMirroredStrategy()   # NCCL allreduce
+    with strategy.scope():
+        model = build_model()
+    model.fit(dataset)
+
+(TFoS's only role is having exported ``TF_CONFIG`` first —
+``TFSparkNode.py::run``.)  The TPU rebuild keeps the same shape::
+
+    strategy = MultiWorkerMirroredStrategy()        # = DataParallelStrategy
+    state = strategy.init_state(model, optimizer, sample_batch)
+    step = strategy.build_train_step(loss_fn)
+    state, metrics = step(state, strategy.shard_batch(batch))
+
+but the strategy is a thin veneer over a Mesh + jit shardings: gradients
+are averaged by XLA-inserted collectives over ICI, parameters live wherever
+the strategy's partition rules put them, and the same code runs on 1 chip or
+a multi-host pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tensorflowonspark_tpu.parallel import sharding as sh
+from tensorflowonspark_tpu.parallel.mesh import MeshSpec, make_mesh
+from tensorflowonspark_tpu.parallel.sharding import PartitionRules
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class TrainState:
+    """Minimal train state (params + opt state + step), pytree-registered."""
+
+    params: object
+    opt_state: object
+    step: jnp.ndarray
+    extras: dict = dataclasses.field(default_factory=dict)  # e.g. batch_stats
+
+
+jax.tree_util.register_dataclass(
+    TrainState, data_fields=["params", "opt_state", "step", "extras"], meta_fields=[])
+
+
+class MeshStrategy:
+    """Base strategy: explicit mesh + optional parameter partition rules."""
+
+    def __init__(self, mesh=None, rules: PartitionRules | None = None, **axis_sizes):
+        self.mesh = mesh if mesh is not None else make_mesh(**axis_sizes)
+        self.rules = rules
+
+    # -- state -------------------------------------------------------------
+    def init_state(self, init_fn, tx, *init_args) -> TrainState:
+        """Initialize params via ``init_fn(*init_args)`` and place them.
+
+        ``tx`` is an optax transform.  Parameters are placed according to
+        the strategy's rules (replicated by default); the optimizer state
+        inherits each parameter's sharding (optax states mirror the param
+        tree, so GSPMD propagates the placement).
+        """
+        params = init_fn(*init_args)
+        params = sh.shard_params(self.mesh, params, self.rules)
+        opt_state = tx.init(params)
+        self._tx = tx
+        return TrainState(params=params, opt_state=opt_state,
+                          step=jnp.zeros((), jnp.int32))
+
+    # -- data --------------------------------------------------------------
+    def shard_batch(self, batch):
+        return sh.shard_batch(self.mesh, batch)
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, sh.batch_pspec())
+
+    # -- step --------------------------------------------------------------
+    def build_train_step(self, loss_fn, tx=None, donate: bool = True):
+        """Compile ``state, batch -> state, metrics``.
+
+        ``loss_fn(params, batch) -> scalar`` or ``(scalar, aux)``.  Gradient
+        averaging across data shards is *not* written here — the batch is
+        sharded over dp/fsdp and the loss is a mean over the global batch,
+        so XLA inserts the reduce-scatter/all-reduce it needs (the NCCL
+        allreduce of ``MultiWorkerMirroredStrategy``, compiled).
+        """
+        tx = tx or getattr(self, "_tx", None)
+        assert tx is not None, "pass tx= or call init_state first"
+        has_aux = getattr(loss_fn, "has_aux", False)
+
+        def step(state: TrainState, batch):
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+            if has_aux:
+                (loss, aux), grads = grad_fn(state.params, batch)
+            else:
+                loss, grads = grad_fn(state.params, batch)
+                aux = {}
+            import optax
+
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(params=params, opt_state=opt_state,
+                                   step=state.step + 1, extras=state.extras)
+            metrics = {"loss": loss, **aux}
+            return new_state, metrics
+
+        donate_argnums = (0,) if donate else ()
+        return jax.jit(step, donate_argnums=donate_argnums)
+
+    def run(self, fn, *args):
+        """Execute ``fn`` under this strategy's mesh context (for explicit
+        ``PartitionSpec``-annotated code using ``shard_map`` / axis names)."""
+        with self.mesh:
+            return fn(*args)
+
+    @property
+    def num_replicas_in_sync(self) -> int:
+        """tf.distribute parity: total data-parallel degree."""
+        return (self.mesh.shape["dp"] * self.mesh.shape["fsdp"])
+
+
+class DataParallelStrategy(MeshStrategy):
+    """Pure sync data parallelism over every device (1 axis: dp).
+
+    The reference's ``MultiWorkerMirroredStrategy``/``MirroredStrategy``
+    equivalent (SURVEY.md §2c "Data parallel, sync all-reduce").
+    """
+
+    def __init__(self, devices=None):
+        super().__init__(mesh=make_mesh(MeshSpec(dp=-1), devices=devices))
+
+
+class FSDPStrategy(MeshStrategy):
+    """Data parallelism with parameters fully sharded over the same devices.
+
+    No reference analogue (TFoS mirrors variables); this is the TPU-idiomatic
+    way to fit models larger than one chip's HBM while keeping the
+    data-parallel programming model.  Parameters shard on their largest axis
+    over ``fsdp``; XLA all-gathers them per layer (and frees after use).
+    """
+
+    def __init__(self, devices=None, min_shard_size: int = 2 ** 12):
+        super().__init__(mesh=make_mesh(MeshSpec(dp=1, fsdp=-1), devices=devices))
+        self.min_shard_size = min_shard_size
+        self.rules = _fsdp_rules(self.mesh, min_shard_size)
+
+
+def _fsdp_rules(mesh, min_shard_size: int) -> PartitionRules:
+    """Shard every large-enough parameter on its first divisible axis."""
+
+    class _AutoFSDP(PartitionRules):
+        def __init__(self):
+            self.n = mesh.shape["fsdp"]
+
+        def tree_specs(self, params):
+            def spec_for_leaf(leaf):
+                if getattr(leaf, "size", 0) < min_shard_size:
+                    return P()
+                shape = getattr(leaf, "shape", ())
+                for dim, extent in enumerate(shape):
+                    if extent % self.n == 0 and extent >= self.n:
+                        parts = [None] * len(shape)
+                        parts[dim] = "fsdp"
+                        return P(*parts)
+                return P()
+
+            return jax.tree.map(spec_for_leaf, params)
+
+    return _AutoFSDP()
+
+
+# tf.distribute-parity alias: the strategy name reference users know.
+MultiWorkerMirroredStrategy = DataParallelStrategy
+
+
+def cross_replica_mean(x, axis_name: str = "dp"):
+    """``psum/size`` helper for code running under ``shard_map`` (the manual
+    analogue of NCCL allreduce-mean)."""
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_gather_batch(x, axis_name: str = "dp"):
+    return jax.lax.all_gather(x, axis_name, tiled=True)

@@ -189,6 +189,7 @@ class QueueClient(MessageSocket):
     def __init__(self, addr: tuple[str, int], authkey: bytes, timeout: float = 600.0):
         self.addr = tuple(addr)
         self.authkey = bytes(authkey)
+        self._default_timeout = timeout
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.settimeout(timeout)
         self._sock.connect(self.addr)
@@ -198,10 +199,19 @@ class QueueClient(MessageSocket):
         if resp != "OK":
             raise ConnectionError(f"queue server rejected connection: {resp!r}")
 
-    def _request(self, msg):
+    def _request(self, msg, op_timeout: float | None = None):
         with self._lock:
-            self.send(self._sock, msg)
-            return self.receive(self._sock)
+            if op_timeout is not None:
+                # the server may legitimately block up to the op's timeout
+                # before replying; keep the socket deadline past it so a slow
+                # (but correct) reply never desynchronizes the connection.
+                self._sock.settimeout(op_timeout + 30.0)
+            try:
+                self.send(self._sock, msg)
+                return self.receive(self._sock)
+            finally:
+                if op_timeout is not None:
+                    self._sock.settimeout(self._default_timeout)
 
     @staticmethod
     def _check_err(resp, qname: str):
@@ -211,21 +221,24 @@ class QueueClient(MessageSocket):
 
     def put(self, qname: str, data, timeout: float = 600.0) -> None:
         resp = self._check_err(
-            self._request({"op": "put", "q": qname, "data": data, "timeout": timeout}),
+            self._request({"op": "put", "q": qname, "data": data, "timeout": timeout},
+                          op_timeout=timeout),
             qname)
         if resp != "OK":
             raise TimeoutError(f"queue '{qname}' full after {timeout}s (feed_timeout)")
 
     def get(self, qname: str, timeout: float = 600.0):
         resp = self._check_err(
-            self._request({"op": "get", "q": qname, "timeout": timeout}), qname)
+            self._request({"op": "get", "q": qname, "timeout": timeout},
+                          op_timeout=timeout), qname)
         if resp[0] != "OK":
             raise TimeoutError(f"queue '{qname}' empty after {timeout}s")
         return resp[1]
 
     def try_get(self, qname: str, timeout: float = 0.1):
         resp = self._check_err(
-            self._request({"op": "get", "q": qname, "timeout": timeout}), qname)
+            self._request({"op": "get", "q": qname, "timeout": timeout},
+                          op_timeout=timeout), qname)
         return resp[1] if resp[0] == "OK" else None
 
     def qsize(self, qname: str) -> int:

@@ -1,0 +1,178 @@
+"""Parallel-layer tests on the 8-device CPU-simulated mesh (SURVEY.md §4's
+local-cluster analogue for sharding)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tensorflowonspark_tpu.parallel import (DataParallelStrategy, FSDPStrategy,
+                                            MeshSpec, PartitionRules,
+                                            ShardedEmbedding, make_mesh,
+                                            mesh_from_num_ps, ring_self_attention,
+                                            shard_batch)
+from tensorflowonspark_tpu.parallel.embedding import apply_sharded_lookup
+from tensorflowonspark_tpu.parallel.ring_attention import reference_attention
+
+
+@pytest.fixture(autouse=True)
+def _mesh_devices(jax_cpu_mesh_devices):
+    return jax_cpu_mesh_devices
+
+
+# -- mesh ------------------------------------------------------------------
+
+def test_make_mesh_infers_free_axis():
+    mesh = make_mesh(tp=4)
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 4
+    assert set(mesh.axis_names) == {"pp", "dp", "fsdp", "ep", "sp", "tp"}
+
+
+def test_make_mesh_rejects_bad_sizes():
+    with pytest.raises(ValueError):
+        make_mesh(MeshSpec(dp=3, tp=3))
+
+
+def test_mesh_from_num_ps_maps_to_ep():
+    mesh = mesh_from_num_ps(4)
+    assert mesh.shape["ep"] == 4 and mesh.shape["dp"] == 2
+
+
+# -- sharding --------------------------------------------------------------
+
+def test_shard_batch_partitions_dim0():
+    mesh = make_mesh(dp=8)
+    batch = {"x": np.arange(32, dtype=np.float32).reshape(16, 2)}
+    sharded = shard_batch(mesh, batch)
+    assert sharded["x"].sharding.spec == P(("dp", "fsdp"))
+    np.testing.assert_array_equal(np.asarray(sharded["x"]), batch["x"])
+
+
+def test_partition_rules_path_matching():
+    params = {"dense": {"kernel": jnp.ones((8, 16)), "bias": jnp.ones((16,))},
+              "emb": {"embedding": jnp.ones((32, 8))}}
+    rules = PartitionRules([
+        (r".*emb.*", P("tp", None)),
+        (r".*kernel", P(None, "tp")),
+        (r".*", P()),
+    ])
+    specs = rules.tree_specs(params)
+    assert specs["emb"]["embedding"] == P("tp", None)
+    assert specs["dense"]["kernel"] == P(None, "tp")
+    assert specs["dense"]["bias"] == P()
+
+
+# -- strategies ------------------------------------------------------------
+
+def _loss(params, batch):
+    pred = batch["x"] @ params["w"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def _init(key):
+    return {"w": jax.random.normal(key, (4, 1)) * 0.1, "b": jnp.zeros((1,))}
+
+
+def test_data_parallel_training_converges():
+    strat = DataParallelStrategy()
+    tx = optax.sgd(0.1)
+    state = strat.init_state(_init, tx, jax.random.key(0))
+    step = strat.build_train_step(_loss)
+    true_w = np.array([[1.0], [-2.0], [0.5], [3.0]])
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(60):
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        batch = strat.shard_batch({"x": x, "y": x @ true_w})
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < 0.01 * losses[0]
+    assert strat.num_replicas_in_sync == 8
+
+
+def test_fsdp_shards_large_params():
+    strat = FSDPStrategy(min_shard_size=16)
+    tx = optax.adam(1e-3)
+
+    def init(key):
+        return {"big": jax.random.normal(key, (64, 8)),
+                "tiny": jnp.zeros((3,))}
+
+    state = strat.init_state(init, tx, jax.random.key(0))
+    # big param sharded over fsdp on dim 0; tiny replicated
+    assert state.params["big"].sharding.spec == P("fsdp", None)
+    big_shard = state.params["big"].addressable_shards[0]
+    assert big_shard.data.shape == (8, 8)
+    assert state.params["tiny"].sharding.spec in (P(), P(None))
+
+
+def test_fsdp_train_step_matches_single_device():
+    strat = FSDPStrategy(min_shard_size=1)
+    tx = optax.sgd(0.05)
+    state = strat.init_state(_init, tx, jax.random.key(1))
+    step = strat.build_train_step(_loss)
+    x = np.ones((8, 4), np.float32)
+    batch = strat.shard_batch({"x": x, "y": np.full((8, 1), 2.0, np.float32)})
+    state1, m1 = step(state, batch)
+
+    # oracle: same math, no sharding
+    params = _init(jax.random.key(1))
+    g = jax.grad(_loss)(params, {"x": jnp.asarray(x), "y": jnp.full((8, 1), 2.0)})
+    expect_w = params["w"] - 0.05 * g["w"]
+    np.testing.assert_allclose(np.asarray(state1.params["w"]), np.asarray(expect_w),
+                               rtol=1e-5)
+
+
+# -- sharded embedding (num_ps replacement) --------------------------------
+
+def test_sharded_embedding_module_matches_dense():
+    mesh = make_mesh(ep=4, dp=2)
+    emb = ShardedEmbedding(num_embeddings=32, features=8, axis="ep")
+    ids = jnp.array([[0, 5, 31], [7, 2, 16]])
+    with mesh:
+        params = emb.init(jax.random.key(0), ids)
+        out = emb.apply(params, ids)
+    table = params["params"]["embedding"]
+    table = getattr(table, "value", table)  # unwrap nn.Partitioned
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jnp.take(table, ids, axis=0)),
+                               rtol=1e-6)
+
+
+def test_explicit_sharded_lookup_matches_take():
+    mesh = make_mesh(ep=8)
+    table = jax.random.normal(jax.random.key(2), (40, 16))
+    ids = jnp.array([0, 4, 39, 12, 5])
+    out = apply_sharded_lookup(mesh, table, ids, axis_name="ep")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jnp.take(table, ids, axis=0)),
+                               rtol=1e-5, atol=1e-6)
+
+
+# -- ring attention --------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    mesh = make_mesh(dp=2, sp=4)
+    key = jax.random.key(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    B, T, H, D = 4, 32, 2, 8
+    q = jax.random.normal(kq, (B, T, H, D))
+    k = jax.random.normal(kk, (B, T, H, D))
+    v = jax.random.normal(kv, (B, T, H, D))
+    out = ring_self_attention(mesh, q, k, v, causal=causal)
+    expect = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_jit_under_mesh():
+    mesh = make_mesh(sp=8)
+    B, T, H, D = 2, 64, 4, 16
+    qkv = [jax.random.normal(jax.random.key(i), (B, T, H, D)) for i in range(3)]
+
+    fn = jax.jit(lambda q, k, v: ring_self_attention(mesh, q, k, v, causal=True))
+    out = fn(*qkv)
+    expect = reference_attention(*qkv, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=2e-4, atol=2e-5)

@@ -1,0 +1,112 @@
+"""Benchmark: ResNet-50 training throughput (images/sec) on real hardware.
+
+The north-star metric from BASELINE.json: "ResNet-50 images/sec/chip".  The
+reference publishes no reproducible numbers (``"published": {}``), so
+``vs_baseline`` is reported as the ratio against the first value this repo
+ever recorded (stored in ``bench_baseline.json``) — i.e. the benchmark tracks
+our own regression/improvement, which is what "measured, not matched"
+(SURVEY.md §6) requires.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu.models import ResNet50
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    # Keep CPU fallback fast enough to finish; real runs use the TPU chip.
+    batch = 256 if on_accel else 16
+    image = 224 if on_accel else 64
+    steps = 20 if on_accel else 3
+    warmup = 3 if on_accel else 1
+    log(f"bench: platform={platform} batch={batch} image={image}")
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    x = jnp.ones((batch, image, image, 3), jnp.bfloat16)
+    y = jnp.zeros((batch,), jnp.int32)
+
+    def init_fn():
+        variables = model.init(jax.random.key(0), x, train=True)
+        return variables["params"], variables["batch_stats"], None
+
+    params, batch_stats, _ = init_fn()
+    opt_state = tx.init(params)
+
+    def loss_fn(params, batch_stats, x, y):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=True,
+            mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        return loss, updates["batch_stats"]
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, x, y):
+        (loss, batch_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, x, y)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, batch_stats, opt_state, loss
+
+    log("bench: compiling + warmup")
+    for _ in range(warmup):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, x, y)
+    _ = float(loss)  # value transfer: drains the pipeline even where
+    # block_until_ready is unreliable (axon relay)
+
+    log("bench: timing")
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, x, y)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    images_per_sec = batch * steps / dt
+    log(f"bench: {steps} steps in {dt:.2f}s, loss={final_loss:.3f}")
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "bench_baseline.json")
+    vs_baseline = 1.0
+    try:
+        if os.path.exists(baseline_path):
+            with open(baseline_path) as f:
+                recorded = json.load(f)
+            if recorded.get("platform") == platform and recorded.get("value"):
+                vs_baseline = images_per_sec / recorded["value"]
+        else:
+            with open(baseline_path, "w") as f:
+                json.dump({"platform": platform, "value": images_per_sec,
+                           "batch": batch, "image": image}, f)
+    except OSError:
+        pass
+
+    print(json.dumps({
+        "metric": f"resnet50_train_images_per_sec_per_chip[{platform} b{batch} {image}px bf16]",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -261,18 +261,20 @@ class TPUCluster:
                 try:
                     for pidx, part in parts:
                         # Interleave feeding with result collection: with
-                        # bounded queues, pushing a whole large partition
-                        # before draining any results deadlocks once the
-                        # output queue fills (worker blocked on put, feeder
-                        # blocked on put).
+                        # bounded queues, pushing a whole partition before
+                        # draining results deadlocks once the output queue
+                        # fills (worker blocked on put, feeder blocked on
+                        # put).  _feed_partition drains via the callback both
+                        # between chunk puts and *while* a put is blocked.
                         got: list = []
-                        for start in range(0, len(part), chunk_size):
-                            client.put(qname, part[start:start + chunk_size],
-                                       timeout=feed_timeout)
+
+                        def _drain():
                             for _ in range(client.qsize(qname_out)):
                                 chunk = client.queue_get(qname_out, timeout=feed_timeout)
                                 got.extend(chunk if isinstance(chunk, list) else [chunk])
-                        client.put(qname, EndPartition(), timeout=feed_timeout)
+
+                        _feed_partition(client, part, qname, chunk_size,
+                                        feed_timeout, on_progress=_drain)
                         while len(got) < len(part):
                             chunk = client.queue_get(qname_out, timeout=feed_timeout)
                             got.extend(chunk if isinstance(chunk, list) else [chunk])
@@ -386,19 +388,47 @@ class Partitioned:
 
 
 def _feed_partition(client: QueueClient, part: list, qname: str,
-                    chunk_size: int, feed_timeout: float) -> None:
+                    chunk_size: int, feed_timeout: float,
+                    on_progress=None) -> None:
     """Push one partition as chunks + EndPartition marker.
 
     Reference hot loop: ``TFSparkNode.py::_train`` (per-item ``q.put`` with
     ``feed_timeout``; aborts on state ``'terminating'``) — here chunked.
+    ``on_progress`` (used by inference) is invoked between chunks *and*
+    whenever a put is blocked on a full queue, so the caller can drain the
+    output queue instead of deadlocking against a blocked worker.
     """
     for i, start in enumerate(range(0, len(part), chunk_size)):
         # poll 'state' every 16 chunks, not per chunk — the kv round trip
         # would otherwise double the driver's per-chunk latency
         if i % 16 == 0 and client.kv_get("state") == "terminating":
             return
-        client.put(qname, part[start:start + chunk_size], timeout=feed_timeout)
-    client.put(qname, EndPartition(), timeout=feed_timeout)
+        _put_chunk(client, qname, part[start:start + chunk_size],
+                   feed_timeout, on_progress)
+        if on_progress is not None:
+            on_progress()
+    _put_chunk(client, qname, EndPartition(), feed_timeout, on_progress)
+
+
+def _put_chunk(client: QueueClient, qname: str, item, feed_timeout: float,
+               on_progress=None) -> None:
+    """Blocking put that keeps draining via ``on_progress`` while full."""
+    import time as _time
+
+    deadline = _time.monotonic() + feed_timeout
+    attempt_timeout = 2.0 if on_progress is not None else feed_timeout
+    while True:
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(f"queue '{qname}' full after {feed_timeout}s "
+                               "(feed_timeout)")
+        try:
+            client.put(qname, item, timeout=min(attempt_timeout, remaining))
+            return
+        except TimeoutError:
+            if on_progress is None:
+                raise
+            on_progress()  # free worker-side backpressure, then retry
 
 
 def _watch_for_crashes(backend, server: Server, status: dict) -> None:

@@ -1,0 +1,24 @@
+"""Model zoo mirroring the reference's example workloads (SURVEY.md §2d).
+
+| Reference example                       | Here                      |
+|-----------------------------------------|---------------------------|
+| ``examples/mnist/keras/mnist_*.py``     | :class:`MNISTNet`         |
+| ``examples/resnet`` (CIFAR-10 ResNet)   | :func:`ResNet` variants   |
+| ``examples/imagenet`` / ResNet-50       | :func:`ResNet50`          |
+| ``examples/segmentation`` (U-Net)       | :class:`UNet`             |
+| BERT-SQuAD pipeline (BASELINE configs)  | :class:`Bert`, heads      |
+| ``examples/wide_deep`` (Criteo)         | :class:`WideDeep`         |
+
+All models are flax modules with GSPMD sharding annotations on the axes
+that matter (tp on transformer kernels, ep on embedding tables) so the same
+module runs on one chip or a full mesh without code changes.
+"""
+
+from tensorflowonspark_tpu.models.mnist import MNISTNet  # noqa: F401
+from tensorflowonspark_tpu.models.resnet import (ResNet, ResNet18, ResNet34,
+                                                 ResNet50, CifarResNet)  # noqa: F401
+from tensorflowonspark_tpu.models.unet import UNet  # noqa: F401
+from tensorflowonspark_tpu.models.bert import (Bert, BertConfig,
+                                               BertForQuestionAnswering,
+                                               BertForSequenceClassification)  # noqa: F401
+from tensorflowonspark_tpu.models.wide_deep import WideDeep  # noqa: F401

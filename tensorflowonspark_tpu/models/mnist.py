@@ -1,0 +1,37 @@
+"""MNIST CNN — the reference's stock example workload.
+
+Reference: ``examples/mnist/keras/mnist_spark.py`` / ``mnist_tf.py`` build a
+small Keras CNN (Conv 32 → pool → Conv 64 → pool → Dense 128 → Dense 10)
+and train it under ``MultiWorkerMirroredStrategy``; ``BASELINE.json``
+configs[0] names this job as the end-to-end parity target.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MNISTNet(nn.Module):
+    """Conv-pool ×2 → dense, matching the reference example's topology."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        # x: [batch, 28, 28] or [batch, 28, 28, 1], values in [0, 1]
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,112 @@
+"""ResNet family (v1.5), bf16/MXU-friendly.
+
+Reference workloads: ``examples/resnet`` (Keras custom-training-loop CIFAR-10
+ResNet under MultiWorkerMirrored) and the ResNet-50 ImageNet north-star job
+(``BASELINE.json`` configs[2], metric "images/sec/chip").
+
+TPU-first choices: NHWC layout (XLA:TPU's native conv layout), bf16 compute
+with fp32 BatchNorm statistics and fp32 logits, 3×3 stem option for CIFAR,
+and ``axis_name``-aware BatchNorm for cross-replica statistics when desired
+(the ``SyncBatchNorm`` analogue — under ``pjit`` the default per-device
+stats are already the common practice).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+    norm: type = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        norm = partial(self.norm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)  # zero-init last BN
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            strides=(self.strides, self.strides))(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+    norm: type = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        norm = partial(self.norm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        # v1.5: stride lives on the 3x3, not the 1x1
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides, self.strides))(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet: ``stage_sizes`` blocks per stage."""
+
+    stage_sizes: Sequence[int]
+    block: type = Bottleneck
+    num_classes: int = 1000
+    num_filters: int = 64
+    cifar_stem: bool = False  # 3x3/1 stem, no maxpool (CIFAR-10 inputs)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = nn.Conv(self.num_filters, (3, 3), use_bias=False, dtype=self.dtype)(x)
+        else:
+            x = nn.Conv(self.num_filters, (7, 7), strides=(2, 2),
+                        padding=[(3, 3), (3, 3)], use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            for block_idx in range(num_blocks):
+                strides = 2 if stage > 0 and block_idx == 0 else 1
+                x = self.block(self.num_filters * 2 ** stage, strides=strides,
+                               dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block=Bottleneck)
+# The reference CIFAR-10 example's scale: ResNet-18-ish with a CIFAR stem.
+CifarResNet = partial(ResNet, stage_sizes=(2, 2, 2, 2), block=BasicBlock,
+                      num_classes=10, cifar_stem=True)

@@ -1,0 +1,49 @@
+"""U-Net for image segmentation.
+
+Reference workload: ``examples/segmentation`` (a U-Net over TFRecords with
+tf.data, SURVEY.md §2d).  Encoder/decoder with skip connections; bf16
+compute, fp32 logits.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBlock(nn.Module):
+    filters: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        for _ in range(2):
+            x = nn.Conv(self.filters, (3, 3), use_bias=False, dtype=self.dtype)(x)
+            x = nn.GroupNorm(num_groups=min(32, self.filters), dtype=jnp.float32)(x)
+            x = nn.relu(x)
+        return x
+
+
+class UNet(nn.Module):
+    """Classic U-Net; ``features`` sets the per-level channel counts."""
+
+    num_classes: int = 2
+    features: Sequence[int] = (64, 128, 256, 512)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        skips = []
+        for f in self.features[:-1]:
+            x = ConvBlock(f, dtype=self.dtype)(x, train=train)
+            skips.append(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = ConvBlock(self.features[-1], dtype=self.dtype)(x, train=train)
+        for f, skip in zip(reversed(self.features[:-1]), reversed(skips)):
+            x = nn.ConvTranspose(f, (2, 2), strides=(2, 2), dtype=self.dtype)(x)
+            x = jnp.concatenate([x, skip.astype(x.dtype)], axis=-1)
+            x = ConvBlock(f, dtype=self.dtype)(x, train=train)
+        return nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32)(x)

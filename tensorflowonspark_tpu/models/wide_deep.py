@@ -1,0 +1,58 @@
+"""Wide&Deep for Criteo-style CTR data with sharded sparse embeddings.
+
+Reference workload: ``examples/wide_deep`` trained in gRPC parameter-server
+mode — the PS nodes exist to hold the big sparse embedding tables
+(``BASELINE.json`` configs[4]; SURVEY.md §2c).  The TPU rebuild shards those
+tables over the ``ep`` mesh axis via :class:`ShardedEmbedding` — the
+``num_ps`` argument of ``TPUCluster.run``/``mesh_from_num_ps`` sets that
+axis — keeping the memory-scaling property of PS mode with synchronous
+SPMD semantics.
+
+Inputs: ``dense`` ``[batch, num_dense]`` float features and ``categorical``
+``[batch, num_categorical]`` integer ids (pre-hashed into each feature's
+vocab bucket range).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.parallel.embedding import ShardedEmbedding
+
+
+class WideDeep(nn.Module):
+    vocab_sizes: Sequence[int]          # per categorical feature
+    embed_dim: int = 16
+    mlp_dims: Sequence[int] = (256, 128, 64)
+    num_dense: int = 13
+    dtype: jnp.dtype = jnp.float32
+    embedding_axis: str = "ep"
+
+    @nn.compact
+    def __call__(self, dense, categorical, *, train: bool = False):
+        B = dense.shape[0]
+        dense = dense.astype(self.dtype)
+
+        # Wide: per-feature scalar weights (a linear model over one-hot
+        # categproducals) — table of shape [vocab, 1], sharded like the rest.
+        wide_logit = jnp.zeros((B,), jnp.float32)
+        deep_parts = [dense]
+        for i, vocab in enumerate(self.vocab_sizes):
+            ids = categorical[:, i]
+            wide = ShardedEmbedding(vocab, 1, axis=self.embedding_axis,
+                                    dtype=jnp.float32, name=f"wide_{i}")(ids)
+            wide_logit = wide_logit + wide[:, 0]
+            emb = ShardedEmbedding(vocab, self.embed_dim, axis=self.embedding_axis,
+                                   dtype=self.dtype, name=f"emb_{i}")(ids)
+            deep_parts.append(emb)
+
+        x = jnp.concatenate(deep_parts, axis=-1)
+        for d in self.mlp_dims:
+            x = nn.Dense(d, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        deep_logit = nn.Dense(1, dtype=jnp.float32)(x)[:, 0]
+        bias = self.param("bias", nn.initializers.zeros, (1,), jnp.float32)
+        return wide_logit + deep_logit + bias[0]  # pre-sigmoid CTR logit

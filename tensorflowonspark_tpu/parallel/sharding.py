@@ -63,7 +63,7 @@ class PartitionRules:
 
     def spec_for(self, path: str) -> P:
         for pat, spec in self.rules:
-            if pat.fullmatch(path) or pat.match(path):
+            if pat.fullmatch(path):
                 return spec
         return P()
 
@@ -109,3 +109,21 @@ def shard_params(mesh, params, rules: PartitionRules | None = None):
 def constrain(x, mesh, *spec):
     """``lax.with_sharding_constraint`` shorthand for use inside jit."""
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def flax_shardings(mesh, tree):
+    """Shardings for a (possibly abstract) flax variable tree whose params
+    carry ``nn.with_partitioning`` metadata.
+
+    Returns a tree of ``NamedSharding`` suitable for ``jax.jit``'s
+    ``in_shardings``/``out_shardings`` or ``jax.device_put`` — the canonical
+    "shard at init" pattern: ``jax.jit(init_fn, out_shardings=
+    flax_shardings(mesh, jax.eval_shape(init_fn)))``.
+    Unannotated leaves replicate.
+    """
+    import flax.linen as nn
+
+    specs = nn.get_partition_spec(tree)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s if isinstance(s, P) else P()),
+        specs, is_leaf=lambda x: isinstance(x, P))

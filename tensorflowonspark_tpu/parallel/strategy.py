@@ -61,16 +61,22 @@ class MeshStrategy:
 
     # -- state -------------------------------------------------------------
     def init_state(self, init_fn, tx, *init_args) -> TrainState:
-        """Initialize params via ``init_fn(*init_args)`` and place them.
+        """Initialize params via ``init_fn(*init_args)``, created sharded.
 
-        ``tx`` is an optax transform.  Parameters are placed according to
-        the strategy's rules (replicated by default); the optimizer state
-        inherits each parameter's sharding (optax states mirror the param
-        tree, so GSPMD propagates the placement).
+        ``tx`` is an optax transform.  Parameters are *born* on their target
+        shards — ``init_fn`` is jitted with ``out_shardings`` from the
+        strategy's rules, so the full tree is never materialized on one
+        device (critical for FSDP models bigger than one chip's HBM).  The
+        optimizer state mirrors the parameter tree, so its leaves inherit
+        each parameter's placement.
         """
-        params = init_fn(*init_args)
-        params = sh.shard_params(self.mesh, params, self.rules)
-        opt_state = tx.init(params)
+        abstract = jax.eval_shape(init_fn, *init_args)
+        if self.rules is None:
+            shardings = jax.tree.map(lambda _: sh.replicated(self.mesh), abstract)
+        else:
+            shardings = self.rules.tree_shardings(self.mesh, abstract)
+        params = jax.jit(init_fn, out_shardings=shardings)(*init_args)
+        opt_state = jax.jit(tx.init)(params)
         self._tx = tx
         return TrainState(params=params, opt_state=opt_state,
                           step=jnp.zeros((), jnp.int32))

@@ -42,6 +42,16 @@ def fn_square_inference(args, ctx):
             feed.batch_results([x * x for x in batch])
 
 
+def fn_tiny_batch_inference(args, ctx):
+    """Emit one result message per sample — maximal output-queue pressure
+    (regression: inference must drain results while its puts are blocked)."""
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        batch = feed.next_batch(1, timeout=30)
+        if batch:
+            feed.batch_results([x + 1000 for x in batch])
+
+
 def fn_crash(args, ctx):
     raise ValueError("deliberate failure for error-propagation test")
 

@@ -18,12 +18,16 @@ if _flag not in os.environ.get("XLA_FLAGS", ""):
 
 import pytest  # noqa: E402
 
+# Force the platform at conftest-import time (before any test module touches
+# jax): the axon TPU plugin registered by sitecustomize otherwise wins the
+# backend race and tests silently run on the real chip.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 
 @pytest.fixture(scope="session")
 def jax_cpu_mesh_devices():
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
     devices = jax.devices()
     assert len(devices) == 8, f"expected 8 simulated CPU devices, got {len(devices)}"
     return devices

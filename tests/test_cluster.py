@@ -84,6 +84,16 @@ def test_inference_more_partitions_than_nodes(tmp_path):
     assert sorted(preds) == sorted(x * x for x in range(1, 8))
 
 
+def test_inference_backpressure_tiny_output_batches(tmp_path):
+    # regression: worker emits 1 result message per sample; with queue_depth=4
+    # the output queue fills while the driver is still feeding — the feeder
+    # must drain results while its puts block instead of deadlocking
+    cluster = _run(funcs.fn_tiny_batch_inference, 1, tmp_path, queue_depth=4)
+    preds = cluster.inference(list(range(64)), chunk_size=8, feed_timeout=60)
+    cluster.shutdown(timeout=60)
+    assert sorted(preds) == [x + 1000 for x in range(64)]
+
+
 def test_error_propagation_on_shutdown(tmp_path):
     cluster = _run(funcs.fn_crash, 2, tmp_path, input_mode=InputMode.TENSORFLOW)
     with pytest.raises(RuntimeError, match="deliberate failure"):

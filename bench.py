@@ -84,19 +84,30 @@ def main() -> None:
     images_per_sec = batch * steps / dt
     log(f"bench: {steps} steps in {dt:.2f}s, loss={final_loss:.3f}")
 
+    # Baseline file holds one entry per platform: the first value ever
+    # recorded there.  vs_baseline = this run / that entry; a missing or
+    # corrupt file/entry is (re)written so the ratio is meaningful from the
+    # next run onward.
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "bench_baseline.json")
     vs_baseline = 1.0
     try:
-        if os.path.exists(baseline_path):
+        recorded = {}
+        try:
             with open(baseline_path) as f:
                 recorded = json.load(f)
-            if recorded.get("platform") == platform and recorded.get("value"):
-                vs_baseline = images_per_sec / recorded["value"]
+            if not isinstance(recorded, dict):
+                recorded = {}
+        except (OSError, ValueError):
+            recorded = {}
+        entry = recorded.get(platform)
+        if isinstance(entry, dict) and entry.get("value"):
+            vs_baseline = images_per_sec / entry["value"]
         else:
+            recorded[platform] = {"value": images_per_sec, "batch": batch,
+                                  "image": image}
             with open(baseline_path, "w") as f:
-                json.dump({"platform": platform, "value": images_per_sec,
-                           "batch": batch, "image": image}, f)
+                json.dump(recorded, f)
     except OSError:
         pass
 

@@ -26,6 +26,9 @@ __version__ = "0.1.0"
 from tensorflowonspark_tpu.cluster import InputMode, TPUCluster  # noqa: F401
 from tensorflowonspark_tpu.datafeed import DataFeed  # noqa: F401
 from tensorflowonspark_tpu.node import NodeContext  # noqa: F401
+from tensorflowonspark_tpu.checkpoint import (CheckpointManager, ExportedModel,  # noqa: F401
+                                              export_model, restore_checkpoint,
+                                              save_checkpoint)
 
 # Reference-compatible aliases (tensorflowonspark/TFCluster.py::TFCluster).
 TFCluster = TPUCluster

@@ -1,0 +1,399 @@
+"""Checkpointing and model export: the ``model_dir`` / ``export_dir`` contract.
+
+The reference adds **zero checkpoint logic of its own** — users pass
+``model_dir``/``export_dir`` and TF's machinery does the work
+(``MonitoredTrainingSession``/``ModelCheckpoint`` writes checkpoints;
+``compat.py::export_saved_model`` writes the final SavedModel on the chief,
+and ``pipeline.py::TFModel`` reloads it by ``export_dir`` + ``tag_set`` +
+``signature_def_key``).  This module provides the TPU-native equivalents
+(SURVEY.md §5 "Checkpoint / resume", §7 step 5):
+
+- :class:`CheckpointManager` / :func:`save_checkpoint` /
+  :func:`restore_checkpoint` — training-state checkpoints via
+  **orbax-checkpoint** (async, multi-host capable) behind the same
+  "pass a model_dir" UX.
+- :func:`export_model` / :class:`ExportedModel` — the **SavedModel
+  analogue**: a directory holding the model's serving functions as
+  serialized StableHLO (``jax.export``) plus an orbax copy of the
+  parameters.  Like a SavedModel it is loadable *without the Python model
+  code*, carries named **signatures** (``serving_default`` & friends) and
+  **tags**, and serves any batch size (the batch dimension is exported
+  shape-polymorphic).
+
+Layout of an export directory::
+
+    export_dir/
+      export_meta.json            # tags, signature specs, format version
+      variables/                  # orbax pytree (the parameters)
+      signatures/<name>.stablehlo # jax.export artifact per signature
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Callable, Mapping, Sequence
+
+import numpy as np
+
+# NOTE: jax/orbax are imported lazily inside functions — the package's
+# driver/feeder import path (cluster/queues/datafeed) stays importable in a
+# jax-free process, matching pyproject's numpy-only hard dependency.
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_SIGNATURE = "serving_default"   # tf.saved_model's default key
+DEFAULT_TAGS = ("serve",)               # tf.saved_model.SERVING
+_META_NAME = "export_meta.json"
+_VARIABLES_DIR = "variables"
+_SIGNATURES_DIR = "signatures"
+_FORMAT_VERSION = 1
+
+
+# --------------------------------------------------------------------------
+# Training checkpoints (orbax behind the reference's model_dir UX)
+# --------------------------------------------------------------------------
+
+class CheckpointManager:
+    """Periodic training checkpoints under ``model_dir``.
+
+    Equivalent of what reference users get from
+    ``tf.keras.callbacks.ModelCheckpoint`` / ``BackupAndRestore`` pointed at
+    ``args.model_dir`` (see SURVEY.md §5): keep the last N steps, restore the
+    latest on restart.  Backed by ``orbax.checkpoint.CheckpointManager``
+    (async by default, multi-host GCS capable).
+
+    In a multi-process cluster **every process must call** :meth:`save` /
+    :meth:`restore` (orbax coordinates the distributed write); gate nothing
+    on ``ctx.is_chief`` here — that gating is only for :func:`export_model`.
+    """
+
+    def __init__(self, model_dir: str, max_to_keep: int = 5,
+                 save_interval_steps: int = 1, async_save: bool = True):
+        import orbax.checkpoint as ocp
+
+        self.model_dir = os.path.abspath(model_dir)
+        os.makedirs(self.model_dir, exist_ok=True)
+        self._ocp = ocp
+        self._mngr = ocp.CheckpointManager(
+            self.model_dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=async_save,
+            ),
+            # register the handler up front so a *fresh* manager (the
+            # restore-after-restart path) can read item_metadata before any
+            # save has registered one
+            item_handlers=ocp.StandardCheckpointHandler(),
+        )
+
+    def save(self, step: int, state, force: bool = False) -> bool:
+        """Save ``state`` (any pytree) at ``step``; returns True if saved."""
+        return self._mngr.save(int(step), args=self._ocp.args.StandardSave(state),
+                               force=force)
+
+    def restore(self, step: int | None = None, target=None):
+        """Restore the checkpoint at ``step`` (default: latest).
+
+        ``target``: optional abstract pytree (e.g. from ``jax.eval_shape``,
+        with shardings attached) restored *in place of* plain numpy arrays —
+        this is how a resharded multi-host restore lands directly on the
+        mesh.  Without a target, leaves come back as **host numpy** values,
+        so a checkpoint written on one platform (CPU worker) restores on any
+        other (TPU driver).  Returns None if no checkpoint exists.
+        """
+        import jax
+
+        step = self.latest_step() if step is None else int(step)
+        if step is None:
+            return None
+        if target is not None:
+            return self._mngr.restore(step, args=self._ocp.args.StandardRestore(target))
+        # No target: build a host-numpy target from the saved metadata so the
+        # restore never re-commits to the (possibly absent) saving devices.
+        from orbax.checkpoint.metadata import ScalarMetadata
+
+        def _to_host_target(meta_leaf):
+            if isinstance(meta_leaf, ScalarMetadata):
+                kind = meta_leaf.dtype.kind if meta_leaf.dtype is not None else "i"
+                return {"f": 0.0, "b": False, "c": 0j}.get(kind, 0)
+            return np.zeros(meta_leaf.shape, meta_leaf.dtype)
+
+        meta = self._mngr.item_metadata(step).tree
+        host_target = jax.tree.map(_to_host_target, meta)
+        return self._mngr.restore(step, args=self._ocp.args.StandardRestore(host_target))
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def all_steps(self) -> Sequence[int]:
+        return sorted(self._mngr.all_steps())
+
+    def wait(self) -> None:
+        """Block until async saves are durable (call before process exit)."""
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        self._mngr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def save_checkpoint(model_dir: str, state, step: int = 0) -> None:
+    """One-shot synchronous checkpoint (convenience over CheckpointManager)."""
+    with CheckpointManager(model_dir, async_save=False) as mngr:
+        mngr.save(step, state, force=True)
+
+
+def restore_checkpoint(model_dir: str, target=None, step: int | None = None):
+    """Restore the latest (or given-step) checkpoint from ``model_dir``.
+
+    Returns None when the directory holds no checkpoints — callers use this
+    for the reference's restart-based recovery: try restore, else init fresh.
+    """
+    if not os.path.isdir(model_dir):
+        return None
+    with CheckpointManager(model_dir, async_save=False) as mngr:
+        return mngr.restore(step=step, target=target)
+
+
+# --------------------------------------------------------------------------
+# Model export (the SavedModel analogue)
+# --------------------------------------------------------------------------
+
+def _restore_host_tree(path: str):
+    """Restore an orbax pytree as host values (numpy / python scalars),
+    ignoring the devices/shardings it was saved with.  This is what makes
+    checkpoints and exports portable across platforms (a CPU-mesh worker's
+    save loads on the TPU driver and vice versa)."""
+    import jax
+    import orbax.checkpoint as ocp
+    from orbax.checkpoint.metadata import ScalarMetadata
+
+    def _args(meta_leaf):
+        # restore_type=None means "as saved" — for arrays that re-commits to
+        # the saved device, which may not exist here; force numpy instead.
+        if isinstance(meta_leaf, ScalarMetadata):
+            return ocp.RestoreArgs(restore_type=None)
+        return ocp.RestoreArgs(restore_type=np.ndarray)
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        meta = ckptr.metadata(path).item_metadata.tree
+        return ckptr.restore(path, restore_args=jax.tree.map(_args, meta))
+
+
+def _abstract(tree):
+    """Shape/dtype skeleton of a pytree without materializing leaves on host
+    (``np.asarray`` would device-to-host copy — or crash outright on
+    non-fully-addressable multi-host arrays)."""
+    import jax
+
+    def _leaf(a):
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is None or dtype is None:  # python/np scalars, lists
+            arr = np.asarray(a)
+            shape, dtype = arr.shape, arr.dtype
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    return jax.tree.map(_leaf, tree)
+
+
+def export_model(export_dir: str,
+                 fn: Callable,
+                 params,
+                 example_inputs: Sequence[Any],
+                 input_names: Sequence[str] | None = None,
+                 output_names: Sequence[str] | None = None,
+                 signature_name: str = DEFAULT_SIGNATURE,
+                 extra_signatures: Mapping[str, tuple[Callable, Sequence[Any]]] | None = None,
+                 tags: Sequence[str] = DEFAULT_TAGS,
+                 batch_polymorphic: bool = True,
+                 platforms: Sequence[str] = ("cpu", "tpu"),
+                 is_chief: bool = True) -> str | None:
+    """Write a self-contained serving export of ``fn(params, *inputs)``.
+
+    The reference's ``compat.py::export_saved_model(model, export_dir,
+    is_chief)``: only the chief writes (pass ``ctx.is_chief``), everyone else
+    returns None.  ``fn`` is traced once per signature with ``jax.export``
+    and stored as StableHLO — the loaded model needs **no Python model
+    code**, exactly like a SavedModel graph.
+
+    ``batch_polymorphic=True`` exports dimension 0 of every input as a
+    symbolic size so the serving signature accepts any batch size (the
+    SavedModel ``None`` batch dimension).  ``platforms`` defaults to both
+    cpu and tpu so an export written by a CPU-mesh worker serves on TPU
+    and vice versa.
+    """
+    if not is_chief:
+        return None
+    import jax
+    from jax import export as jax_export
+
+    export_dir = os.path.abspath(export_dir)
+    os.makedirs(os.path.join(export_dir, _SIGNATURES_DIR), exist_ok=True)
+
+    # parameters (orbax pytree) — loadable standalone
+    import orbax.checkpoint as ocp
+
+    vdir = os.path.join(export_dir, _VARIABLES_DIR)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(vdir, params, force=True)
+
+    signatures = {signature_name: (fn, example_inputs)}
+    signatures.update(extra_signatures or {})
+
+    meta: dict[str, Any] = {"format_version": _FORMAT_VERSION,
+                            "tags": sorted(tags), "signatures": {}}
+    abstract_params = _abstract(params)
+    for name, (sig_fn, sig_inputs) in signatures.items():
+        sig_inputs = list(sig_inputs)
+        # one symbolic scope per signature: every input's batch dim is the
+        # same symbol "_b" (mixing scopes across inputs is rejected by
+        # jax.export)
+        scope = jax_export.SymbolicScope() if batch_polymorphic else None
+        in_specs = []
+        poly = []  # whether each input actually got a polymorphic batch dim
+        for x in sig_inputs:
+            arr = np.asarray(x)
+            if batch_polymorphic and arr.ndim >= 1:
+                shape = jax_export.symbolic_shape(
+                    ",".join(["_b"] + [str(d) for d in arr.shape[1:]]),
+                    scope=scope)
+                poly.append(True)
+            else:
+                shape = arr.shape
+                poly.append(False)
+            in_specs.append(jax.ShapeDtypeStruct(shape, arr.dtype))
+        exported = jax_export.export(jax.jit(sig_fn), platforms=list(platforms))(
+            abstract_params, *in_specs)
+        with open(os.path.join(export_dir, _SIGNATURES_DIR, f"{name}.stablehlo"),
+                  "wb") as f:
+            f.write(exported.serialize())
+
+        # input/output names apply to the *main* signature only; extra
+        # signatures have their own arity and get positional defaults.
+        is_main = name == signature_name
+        names_in = (list(input_names) if is_main and input_names
+                    else [f"input_{i}" for i in range(len(sig_inputs))])
+        # outputs come straight from the export (no second trace); the
+        # params occupy the leading in_avals, outputs are out_avals.
+        flat_outs = list(exported.out_avals)
+        names_out = (list(output_names) if is_main and output_names
+                     else [f"output_{i}" for i in range(len(flat_outs))])
+        if len(names_in) != len(sig_inputs) or len(names_out) != len(flat_outs):
+            raise ValueError(
+                f"signature '{name}': {len(sig_inputs)} inputs/"
+                f"{len(flat_outs)} outputs but {len(names_in)}/"
+                f"{len(names_out)} names given")
+
+        def _shape_meta(shape) -> list:
+            # symbolic dims (the polymorphic batch) serialize as None
+            return [d if isinstance(d, int) else None for d in shape]
+
+        meta["signatures"][name] = {
+            "inputs": [
+                {"name": n,
+                 "dtype": str(np.asarray(x).dtype),
+                 "shape": ([None] + list(np.shape(x)[1:])) if p
+                          else list(np.shape(x))}
+                for n, x, p in zip(names_in, sig_inputs, poly)
+            ],
+            "outputs": [
+                {"name": n, "dtype": str(np.dtype(o.dtype)),
+                 "shape": _shape_meta(o.shape)}
+                for n, o in zip(names_out, flat_outs)
+            ],
+        }
+
+    with open(os.path.join(export_dir, _META_NAME), "w") as f:
+        json.dump(meta, f, indent=2)
+    logger.info("exported model to %s (signatures: %s, tags: %s)",
+                export_dir, sorted(signatures), sorted(tags))
+    return export_dir
+
+
+class Signature:
+    """One callable serving endpoint of an :class:`ExportedModel`."""
+
+    def __init__(self, name: str, exported, params, spec: dict):
+        self.name = name
+        self._exported = exported
+        self._params = params
+        self.input_names = [i["name"] for i in spec["inputs"]]
+        self.output_names = [o["name"] for o in spec["outputs"]]
+        self.spec = spec
+
+    def __call__(self, *inputs, **named_inputs):
+        """Run the signature.  Accepts positional arrays in signature order
+        or keyword arrays by input name; returns a dict keyed by output
+        name (the SavedModel ``signature(**tensors) -> dict`` shape)."""
+        if named_inputs:
+            if inputs:
+                raise TypeError("pass inputs positionally or by name, not both")
+            inputs = [named_inputs[n] for n in self.input_names]
+        import jax
+
+        outs = self._exported.call(self._params, *inputs)
+        flat, _ = jax.tree.flatten(outs)
+        return dict(zip(self.output_names, flat))
+
+
+class ExportedModel:
+    """Loaded export: ``ExportedModel.load(export_dir)`` →
+    ``model.signatures['serving_default'](x)``.
+
+    Reference analogue: ``tf.saved_model.load(export_dir, tags)`` as used in
+    ``pipeline.py::TFModel._run_model`` (per-executor singleton, signature
+    selected by ``signature_def_key``).
+    """
+
+    def __init__(self, export_dir: str, params, signatures: dict[str, Signature],
+                 tags: Sequence[str]):
+        self.export_dir = export_dir
+        self.params = params
+        self.signatures = signatures
+        self.tags = tuple(tags)
+
+    @classmethod
+    def load(cls, export_dir: str, tag_set: Sequence[str] | str | None = None
+             ) -> "ExportedModel":
+        """Load an export; ``tag_set`` (CSV string or list) must be a subset
+        of the export's tags, mirroring SavedModel tag matching."""
+        from jax import export as jax_export
+
+        export_dir = os.path.abspath(export_dir)
+        with open(os.path.join(export_dir, _META_NAME)) as f:
+            meta = json.load(f)
+        if tag_set:
+            want = set(tag_set.split(",") if isinstance(tag_set, str) else tag_set)
+            have = set(meta["tags"])
+            if not want.issubset(have):
+                raise ValueError(f"tag_set {sorted(want)} not found in export "
+                                 f"(has {sorted(have)})")
+
+        params = _restore_host_tree(os.path.join(export_dir, _VARIABLES_DIR))
+
+        signatures = {}
+        for name, spec in meta["signatures"].items():
+            path = os.path.join(export_dir, _SIGNATURES_DIR, f"{name}.stablehlo")
+            with open(path, "rb") as f:
+                exported = jax_export.deserialize(f.read())
+            signatures[name] = Signature(name, exported, params, spec)
+        return cls(export_dir, params, signatures, meta["tags"])
+
+    def signature(self, key: str = DEFAULT_SIGNATURE) -> Signature:
+        if key not in self.signatures:
+            raise KeyError(f"signature '{key}' not in export "
+                           f"(has {sorted(self.signatures)})")
+        return self.signatures[key]
+
+    def __call__(self, *inputs, **named):
+        return self.signature()(*inputs, **named)

@@ -413,12 +413,10 @@ def _feed_partition(client: QueueClient, part: list, qname: str,
 def _put_chunk(client: QueueClient, qname: str, item, feed_timeout: float,
                on_progress=None) -> None:
     """Blocking put that keeps draining via ``on_progress`` while full."""
-    import time as _time
-
-    deadline = _time.monotonic() + feed_timeout
+    deadline = time.monotonic() + feed_timeout
     attempt_timeout = 2.0 if on_progress is not None else feed_timeout
     while True:
-        remaining = deadline - _time.monotonic()
+        remaining = deadline - time.monotonic()
         if remaining <= 0:
             raise TimeoutError(f"queue '{qname}' full after {feed_timeout}s "
                                "(feed_timeout)")

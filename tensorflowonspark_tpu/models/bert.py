@@ -17,10 +17,27 @@ designed TPU-first:
 from __future__ import annotations
 
 import dataclasses
+import functools
+import logging
 from typing import Callable
 
 import flax.linen as nn
 import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+
+@functools.cache
+def _warn_no_attention_dropout() -> None:
+    """Custom attention kernels (ring/flash) compute softmax online inside
+    the loop and do not materialize attention probabilities, so the
+    attention-probability dropout of the dense path cannot be applied there
+    (post-attention and MLP dropout still are).  Warn once so the config
+    divergence is explicit rather than silent."""
+    logger.warning(
+        "BertConfig.dropout_rate > 0 with a custom attention_fn: "
+        "attention-probability dropout is not applied on this path "
+        "(residual/MLP dropout still is)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,7 +53,8 @@ class BertConfig:
     dtype: jnp.dtype = jnp.bfloat16
     # Optional global-array attention override, e.g.
     # ``partial(ring_self_attention, mesh, causal=False)``; signature
-    # ``(q, k, v) -> out`` with [batch, seq, heads, head_dim] arrays.
+    # ``(q, k, v, mask=None) -> out`` with [batch, seq, heads, head_dim]
+    # arrays and an optional [batch, seq] key-padding mask.
     attention_fn: Callable | None = None
     # PartitionSpec entries for embedding tables (vocab, features).  Default
     # shards vocab rows over tp; pass (("ep", "tp"), None) to also spread
@@ -69,7 +87,9 @@ class SelfAttention(nn.Module):
         v = _dense(H * D, qkv_spec, cfg.dtype, "value")(x).reshape(B, T, H, D)
 
         if cfg.attention_fn is not None:
-            ctx = cfg.attention_fn(q, k, v)
+            if train and cfg.dropout_rate > 0:
+                _warn_no_attention_dropout()
+            ctx = cfg.attention_fn(q, k, v, mask=mask)
         else:
             scale = D ** -0.5
             s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),

@@ -1,0 +1,168 @@
+"""Checkpoint/export tests.
+
+Reference analogue: the reference has no checkpoint tests of its own (it
+delegates to TF, SURVEY.md §5); these cover the rebuild's model_dir /
+export_dir contract used by pipeline and examples.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import checkpoint as ckpt
+
+
+@pytest.fixture
+def state():
+    return {
+        "params": {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+                   "b": jnp.ones((4,))},
+        "step": jnp.asarray(7, jnp.int32),
+    }
+
+
+class TestCheckpointManager:
+    def test_save_restore_roundtrip(self, tmp_path, state):
+        d = str(tmp_path / "model")
+        with ckpt.CheckpointManager(d, async_save=False) as mngr:
+            assert mngr.save(0, state)
+        restored = ckpt.restore_checkpoint(d)
+        np.testing.assert_array_equal(restored["params"]["w"], state["params"]["w"])
+        assert int(restored["step"]) == 7
+
+    def test_latest_and_max_to_keep(self, tmp_path, state):
+        d = str(tmp_path / "model")
+        with ckpt.CheckpointManager(d, max_to_keep=2, async_save=False) as mngr:
+            for s in (1, 2, 3):
+                mngr.save(s, state, force=True)
+            assert mngr.latest_step() == 3
+            assert list(mngr.all_steps()) == [2, 3]
+
+    def test_restore_missing_returns_none(self, tmp_path):
+        assert ckpt.restore_checkpoint(str(tmp_path / "nope")) is None
+
+    def test_restore_specific_step(self, tmp_path, state):
+        d = str(tmp_path / "model")
+        with ckpt.CheckpointManager(d, async_save=False) as mngr:
+            mngr.save(1, state, force=True)
+            state2 = dict(state, step=jnp.asarray(99, jnp.int32))
+            mngr.save(2, state2, force=True)
+        assert int(ckpt.restore_checkpoint(d, step=1)["step"]) == 7
+        assert int(ckpt.restore_checkpoint(d, step=2)["step"]) == 99
+
+
+def _linear(params, x):
+    return x @ params["w"] + params["b"]
+
+
+class TestExportedModel:
+    def test_export_load_call(self, tmp_path):
+        params = {"w": jnp.full((3, 2), 2.0), "b": jnp.ones((2,))}
+        x = np.ones((4, 3), np.float32)
+        d = ckpt.export_model(str(tmp_path / "export"), _linear, params, [x],
+                              input_names=["features"], output_names=["logits"])
+        model = ckpt.ExportedModel.load(d)
+        out = model.signature()(x)
+        np.testing.assert_allclose(out["logits"], np.full((4, 2), 7.0))
+
+    def test_batch_polymorphic(self, tmp_path):
+        params = {"w": jnp.ones((3, 2)), "b": jnp.zeros((2,))}
+        d = ckpt.export_model(str(tmp_path / "e"), _linear, params,
+                              [np.ones((4, 3), np.float32)])
+        model = ckpt.ExportedModel.load(d)
+        for batch in (1, 4, 17):
+            out = model(np.ones((batch, 3), np.float32))
+            assert out["output_0"].shape == (batch, 2)
+
+    def test_named_inputs_and_signature_key(self, tmp_path):
+        params = {"w": jnp.ones((3, 2)), "b": jnp.zeros((2,))}
+        d = ckpt.export_model(
+            str(tmp_path / "e"), _linear, params, [np.ones((2, 3), np.float32)],
+            input_names=["x"], signature_name="score",
+            extra_signatures={"double": (lambda p, x: 2 * _linear(p, x),
+                                         [np.ones((2, 3), np.float32)])})
+        model = ckpt.ExportedModel.load(d)
+        a = model.signature("score")(x=np.ones((2, 3), np.float32))["output_0"]
+        b = model.signature("double")(np.ones((2, 3), np.float32))["output_0"]
+        np.testing.assert_allclose(b, 2 * a)
+        with pytest.raises(KeyError):
+            model.signature("missing")
+
+    def test_tag_mismatch_raises(self, tmp_path):
+        params = {"w": jnp.ones((3, 2)), "b": jnp.zeros((2,))}
+        d = ckpt.export_model(str(tmp_path / "e"), _linear, params,
+                              [np.ones((2, 3), np.float32)], tags=("serve",))
+        ckpt.ExportedModel.load(d, tag_set="serve")  # ok
+        with pytest.raises(ValueError):
+            ckpt.ExportedModel.load(d, tag_set="serve,gpu")
+
+    def test_non_chief_skips(self, tmp_path):
+        out = ckpt.export_model(str(tmp_path / "e"), _linear, {}, [],
+                                is_chief=False)
+        assert out is None
+        assert not os.path.exists(str(tmp_path / "e"))
+
+    def test_multi_input_polymorphic(self, tmp_path):
+        """Two+ inputs must share one symbolic scope for the batch dim."""
+        params = {"w": jnp.ones((3, 2))}
+
+        def fn(p, x, mask):
+            return (x @ p["w"]) * mask
+
+        d = ckpt.export_model(str(tmp_path / "e"), fn, params,
+                              [np.ones((4, 3), np.float32),
+                               np.ones((4, 2), np.float32)],
+                              input_names=["x", "mask"])
+        model = ckpt.ExportedModel.load(d)
+        out = model(np.ones((9, 3), np.float32), np.ones((9, 2), np.float32))
+        assert out["output_0"].shape == (9, 2)
+
+    def test_extra_signature_different_arity(self, tmp_path):
+        """input_names apply to the main signature only; an extra signature
+        with different arity keeps correct positional metadata."""
+        params = {"w": jnp.ones((3, 2))}
+        d = ckpt.export_model(
+            str(tmp_path / "e"), lambda p, x: x @ p["w"], params,
+            [np.ones((2, 3), np.float32)], input_names=["features"],
+            extra_signatures={
+                "masked": (lambda p, x, m: (x @ p["w"]) * m,
+                           [np.ones((2, 3), np.float32),
+                            np.ones((2, 2), np.float32)])})
+        model = ckpt.ExportedModel.load(d)
+        sig = model.signature("masked")
+        assert sig.input_names == ["input_0", "input_1"]
+        out = sig(input_0=np.ones((5, 3), np.float32),
+                  input_1=np.zeros((5, 2), np.float32))
+        np.testing.assert_allclose(out["output_0"], np.zeros((5, 2)))
+
+    def test_scalar_output_shape_meta(self, tmp_path):
+        """A 0-d output must be recorded with shape [], not [None]."""
+        params = {"w": jnp.ones((3,))}
+        d = ckpt.export_model(str(tmp_path / "e"),
+                              lambda p, x: jnp.sum(x @ p["w"]), params,
+                              [np.ones((2, 3), np.float32)])
+        model = ckpt.ExportedModel.load(d)
+        spec = model.signature().spec
+        assert spec["outputs"][0]["shape"] == []
+        out = model(np.ones((4, 3), np.float32))
+        assert np.asarray(out["output_0"]).shape == ()
+
+    def test_name_arity_mismatch_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="names"):
+            ckpt.export_model(str(tmp_path / "e"), _linear,
+                              {"w": jnp.ones((3, 2)), "b": jnp.zeros((2,))},
+                              [np.ones((2, 3), np.float32)],
+                              input_names=["a", "b"])
+
+    def test_loads_without_model_code(self, tmp_path):
+        """The export must be runnable from meta + stablehlo + variables
+        alone (the SavedModel property) — no reference to _linear."""
+        params = {"w": jnp.eye(3), "b": jnp.zeros((3,))}
+        d = ckpt.export_model(str(tmp_path / "e"), _linear, params,
+                              [np.ones((2, 3), np.float32)])
+        model = ckpt.ExportedModel.load(d)
+        x = np.random.default_rng(0).normal(size=(5, 3)).astype(np.float32)
+        np.testing.assert_allclose(model(x)["output_0"], x, rtol=1e-6)

@@ -1,5 +1,7 @@
 """Model zoo tests: forward shapes + one optimization step each, at toy sizes."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -99,7 +101,7 @@ def test_bert_with_ring_attention(jax_cpu_mesh_devices):
                           max_position_embeddings=64, dtype=jnp.float32,
                           dropout_rate=0.0,
                           attention_fn=partial(ring_self_attention, mesh))
-    cfg_dense = dataclasses_replace(cfg_ring, attention_fn=None)
+    cfg_dense = dataclasses.replace(cfg_ring, attention_fn=None)
     ids = jnp.ones((2, 32), jnp.int32)
     model_ring = Bert(cfg_ring)
     model_dense = Bert(cfg_dense)
@@ -110,10 +112,31 @@ def test_bert_with_ring_attention(jax_cpu_mesh_devices):
                                rtol=2e-4, atol=2e-5)
 
 
-def dataclasses_replace(cfg, **kw):
-    import dataclasses
+def test_bert_ring_attention_respects_mask(jax_cpu_mesh_devices):
+    """Regression: the custom attention_fn path must consume the padding
+    mask (it was silently dropped before)."""
+    from functools import partial
 
-    return dataclasses.replace(cfg, **kw)
+    from tensorflowonspark_tpu.parallel import make_mesh, ring_self_attention
+
+    mesh = make_mesh(sp=4)
+    cfg_ring = BertConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                          num_heads=4, intermediate_size=64,
+                          max_position_embeddings=64, dtype=jnp.float32,
+                          dropout_rate=0.0,
+                          attention_fn=partial(ring_self_attention, mesh))
+    cfg_dense = dataclasses.replace(cfg_ring, attention_fn=None)
+    ids = jnp.ones((2, 32), jnp.int32)
+    mask = jnp.arange(32)[None, :] < 20
+    mask = jnp.broadcast_to(mask, (2, 32))
+    params = Bert(cfg_dense).init(jax.random.key(0), ids)
+    out_dense = Bert(cfg_dense).apply(params, ids, mask)
+    out_ring = Bert(cfg_ring).apply(params, ids, mask)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_dense),
+                               rtol=2e-4, atol=2e-5)
+    # and the mask must actually change the result
+    out_nomask = Bert(cfg_ring).apply(params, ids)
+    assert not np.allclose(np.asarray(out_ring), np.asarray(out_nomask))
 
 
 def test_wide_deep_forward_and_grad():

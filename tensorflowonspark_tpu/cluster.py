@@ -39,7 +39,7 @@ import tempfile
 import threading
 import time
 
-from tensorflowonspark_tpu import node as tpu_node
+from tensorflowonspark_tpu import node as tpu_node, util
 from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition
 from tensorflowonspark_tpu.queues import DEFAULT_QUEUES, QueueClient
 from tensorflowonspark_tpu.reservation import Server
@@ -374,10 +374,7 @@ def _partition(data, n: int) -> list[list]:
     """
     if isinstance(data, Partitioned):
         return [list(p) for p in data.partitions]
-    items = list(data)
-    n = max(1, min(n, len(items)) if items else 1)
-    size = (len(items) + n - 1) // n
-    return [items[i * size:(i + 1) * size] for i in range(n) if items[i * size:(i + 1) * size]]
+    return util.split_evenly(list(data), n)
 
 
 class Partitioned:

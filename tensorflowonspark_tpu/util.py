@@ -33,6 +33,18 @@ def single_node_env(num_devices: int | None = None, platform: str | None = None)
             os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
 
 
+def split_evenly(items: list, n: int) -> list[list]:
+    """Split ``items`` into at most ``n`` non-empty contiguous partitions.
+
+    Shared by the cluster feeder's RDD-partition stand-in and DataFrame
+    construction so both layers agree on partition shapes.
+    """
+    n = max(1, min(n, len(items)) if items else 1)
+    size = (len(items) + n - 1) // n
+    return [items[i * size:(i + 1) * size]
+            for i in range(n) if items[i * size:(i + 1) * size]]
+
+
 def find_in_path(path: str, file_name: str) -> str | bool:
     """Find a file within a search-path string.  Reference: ``util.py::find_in_path``."""
     for p in path.split(os.pathsep):

@@ -60,6 +60,48 @@ def fn_crash_before_register(args, ctx):  # pragma: no cover - not called
     raise RuntimeError("unused")
 
 
+def fn_train_linear_export(args, ctx):
+    """Train y ≈ w·x + b from the feed; chief exports a serving signature.
+
+    The pipeline-test workload (reference model: the small Keras model in
+    ``tests/test_pipeline.py`` upstream): real SGD on the fed data followed
+    by a chief-only export that TFModel.transform loads back.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    feed = ctx.get_data_feed(train_mode=True)
+    w = jnp.zeros(())
+    b = jnp.zeros(())
+    lr = args.lr
+
+    @jax.jit
+    def step(w, b, x, y):
+        def loss(w, b):
+            return jnp.mean((w * x + b - y) ** 2)
+
+        gw, gb = jax.grad(loss, argnums=(0, 1))(w, b)
+        return w - lr * gw, b - lr * gb
+
+    while not feed.should_stop():
+        batch = feed.next_batch_arrays(args.batch_size, timeout=30)
+        if batch is None:
+            break
+        x, y = batch
+        w, b = step(w, b, jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32))
+
+    if ctx.is_chief:
+        from tensorflowonspark_tpu.checkpoint import export_model
+
+        def serve(p, x):
+            return p["w"] * x + p["b"]
+
+        export_model(args.export_dir, serve, {"w": w, "b": b},
+                     [np.zeros((2,), np.float32)],
+                     input_names=["x"], output_names=["y"], is_chief=True)
+
+
 def fn_terminating_consumer(args, ctx):
     """Read a few batches then terminate early (early-stop semantics)."""
     feed = ctx.get_data_feed()

@@ -1,0 +1,71 @@
+"""DataFrame/Row stand-in tests (the pyspark.sql subset pipeline relies on)."""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.dataframe import DataFrame, Row
+
+
+def test_row_access_patterns():
+    r = Row(image=[1, 2], label=3)
+    assert r.image == [1, 2]
+    assert r["label"] == 3
+    assert r[0] == [1, 2]
+    assert "label" in r and "nope" not in r
+    assert list(r) == [[1, 2], 3]
+    assert r.asDict() == {"image": [1, 2], "label": 3}
+    with pytest.raises(AttributeError):
+        _ = r.missing
+
+
+def test_row_equality_with_arrays():
+    a = Row(x=np.arange(3), y=1)
+    b = Row(x=np.arange(3), y=1)
+    c = Row(x=np.arange(4), y=1)
+    assert a == b
+    assert a != c
+
+
+def test_dataframe_partitioning_and_collect():
+    rows = [Row(a=i, b=i * 2) for i in range(10)]
+    df = DataFrame(rows, num_partitions=3)
+    assert df.columns == ["a", "b"]
+    assert df.count() == 10
+    assert df.num_partitions == 3
+    assert [r.a for r in df.collect()] == list(range(10))
+
+
+def test_dataframe_from_columns_and_to_columns():
+    df = DataFrame.from_columns({"x": np.arange(6), "y": np.arange(6) * 10},
+                                num_partitions=2)
+    cols = df.to_columns()
+    np.testing.assert_array_equal(cols["x"], np.arange(6))
+    np.testing.assert_array_equal(cols["y"], np.arange(6) * 10)
+
+
+def test_dataframe_select_and_map_partitions():
+    df = DataFrame([Row(a=i, b=-i, c=0) for i in range(4)], num_partitions=2)
+    sel = df.select("b", "a")
+    assert sel.columns == ["b", "a"]
+    assert list(sel.collect()[1]) == [-1, 1]
+    sums = df.map_partitions(lambda p: [sum(r.a for r in p)])
+    assert sums == [0 + 1, 2 + 3]
+
+
+def test_dataframe_to_lists_matches_rdd_map_list():
+    df = DataFrame([Row(img=[i], lbl=i) for i in range(4)], num_partitions=2)
+    assert df.to_lists() == [[[[0], 0], [[1], 1]], [[[2], 2], [[3], 3]]]
+
+
+def test_dataframe_schema_mismatch_rejected():
+    with pytest.raises(ValueError):
+        DataFrame([Row(a=1), Row(b=2)])
+
+
+def test_dataframe_rows_from_dicts_and_lists():
+    df = DataFrame([{"a": 1, "b": 2}, {"a": 3, "b": 4}])
+    assert df.columns == ["a", "b"]
+    df2 = DataFrame([[1, 2], [3, 4]], columns=["a", "b"])
+    assert df2.collect()[1].b == 4
+    df3 = df.repartition(2)
+    assert df3.num_partitions == 2

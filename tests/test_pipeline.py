@@ -1,0 +1,223 @@
+"""Pipeline-layer tests.
+
+Reference model: ``tests/test_pipeline.py`` upstream — TFEstimator.fit →
+TFModel.transform end-to-end on a small model, input/output mapping,
+signature selection (SURVEY.md §4) — plus unit coverage of the Param
+machinery the reference inherits from pyspark.ml.
+"""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.dataframe import DataFrame, Row
+from tensorflowonspark_tpu import pipeline as pl
+from tests import cluster_funcs as funcs
+
+
+# -- Param machinery --------------------------------------------------------
+
+def test_mixin_accessors_and_defaults():
+    est = pl.TFEstimator(lambda a, c: None, pl.Namespace())
+    assert est.getBatchSize() == 100          # default
+    est.setBatchSize(32).setClusterSize(4).setEpochs(2)
+    assert est.getBatchSize() == 32
+    assert est.getClusterSize() == 4
+    assert est.getOrDefault("num_ps") == 0    # every mixin default registered
+    assert est.getTagSet() == "serve"
+    assert est.getSignatureDefKey() == "serving_default"
+    assert "batch_size" in est.explainParams()
+
+
+def test_setparams_rejects_unknown():
+    est = pl.TFEstimator(lambda a, c: None)
+    with pytest.raises(ValueError, match="no param"):
+        est.setParams(nonexistent=1)
+
+
+def test_tfparams_merge_args_params():
+    args = pl.Namespace(lr=0.5, batch_size=7)
+    est = pl.TFEstimator(lambda a, c: None, args)
+    est.setBatchSize(64)
+    merged = est.merge_args_params()
+    assert merged.lr == 0.5
+    assert merged.batch_size == 64            # set param wins over tf_args
+    assert merged.epochs == 1                 # defaults flow in too
+
+
+def test_params_copy_is_isolated():
+    est = pl.TFEstimator(lambda a, c: None)
+    est.setBatchSize(8)
+    clone = est.copy({"batch_size": 16})
+    assert est.getBatchSize() == 8
+    assert clone.getBatchSize() == 16
+    clone2 = est.copy({est.getParam("epochs"): 5})
+    assert clone2.getEpochs() == 5
+
+
+def test_param_grid_builder():
+    est = pl.TFEstimator(lambda a, c: None)
+    grid = (pl.ParamGridBuilder()
+            .addGrid(est.getParam("batch_size"), [8, 16])
+            .addGrid(est.getParam("epochs"), [1, 2, 3])
+            .build())
+    assert len(grid) == 6
+    assert {frozenset((p.name, v) for p, v in g.items()) for g in grid} == {
+        frozenset({("batch_size", b), ("epochs", e)})
+        for b in (8, 16) for e in (1, 2, 3)}
+
+
+# -- Pipeline / grid search over a dummy estimator --------------------------
+
+_HasShift = pl._mixin("shift", "test shift", 0.0)
+
+
+class _MeanEstimator(pl.Estimator, _HasShift):
+    """Predict mean(y) + shift — tiny estimator for grid-search tests."""
+
+    def _fit(self, df):
+        mean = float(np.mean([r.y for r in df.collect()]))
+        model = _MeanModel()
+        model._mean = mean + self.getShift()
+        return model
+
+
+class _MeanModel(pl.Transformer):
+    def _transform(self, df):
+        return DataFrame([Row(y=r.y, pred=self._mean) for r in df.collect()],
+                         num_partitions=df.num_partitions)
+
+
+def test_pipeline_chains_stages():
+    df = DataFrame([Row(y=float(i)) for i in range(8)])
+    model = pl.Pipeline([_MeanEstimator()]).fit(df)
+    assert isinstance(model, pl.PipelineModel)
+    out = model.transform(df)
+    assert out.columns == ["y", "pred"]
+    assert out.collect()[0].pred == pytest.approx(3.5)
+
+
+def test_train_validation_split_picks_best():
+    df = DataFrame([Row(y=1.0) for _ in range(20)])
+    est = _MeanEstimator()
+    grid = pl.ParamGridBuilder().addGrid(est.getParam("shift"), [-1.0, 0.0, 2.0]).build()
+
+    def evaluator(out):  # higher is better
+        return -float(np.mean([(r.pred - r.y) ** 2 for r in out.collect()]))
+
+    tvs = pl.TrainValidationSplit(est, evaluator, grid, trainRatio=0.5)
+    best = tvs.fit(df)
+    assert np.argmax(best.validationMetrics) == 1     # shift=0 wins
+    assert best.transform(df).collect()[0].pred == pytest.approx(1.0)
+
+
+# -- TFModel.transform against a real export --------------------------------
+
+@pytest.fixture()
+def linear_export(tmp_path):
+    """Export y = 3x - 1 as a serving signature (in-process, CPU)."""
+    from tensorflowonspark_tpu.checkpoint import export_model
+
+    def serve(p, x):
+        return p["w"] * x + p["b"]
+
+    export_dir = str(tmp_path / "export")
+    export_model(export_dir, serve, {"w": np.float32(3.0), "b": np.float32(-1.0)},
+                 [np.zeros((2,), np.float32)],
+                 input_names=["x"], output_names=["y"], is_chief=True)
+    return export_dir
+
+
+def test_tfmodel_transform_with_mappings(linear_export):
+    df = DataFrame([Row(feature=np.float32(i), other="junk") for i in range(10)],
+                   num_partitions=3)
+    model = pl.TFModel()
+    model.setExportDir(linear_export).setBatchSize(4)
+    model.setInputMapping({"feature": "x"}).setOutputMapping({"y": "prediction"})
+    out = model.transform(df)
+    assert out.columns == ["prediction"]
+    preds = [float(r.prediction) for r in out.collect()]
+    assert preds == pytest.approx([3.0 * i - 1.0 for i in range(10)])
+    assert out.num_partitions == 3
+
+
+def test_tfmodel_bad_signature_and_missing_export(linear_export):
+    model = pl.TFModel()
+    model.setExportDir(linear_export).setInputMapping({"x": "x"})
+    model.setSignatureDefKey("nope")
+    with pytest.raises(KeyError, match="nope"):
+        model.transform(DataFrame([Row(x=np.float32(0))]))
+    with pytest.raises(ValueError, match="export_dir"):
+        pl.TFModel().transform(DataFrame([Row(x=np.float32(0))]))
+
+
+def test_model_cache_is_singleton(linear_export):
+    a = pl._load_model_cached(linear_export, "serve")
+    b = pl._load_model_cached(linear_export, "serve")
+    assert a is b
+
+
+def test_model_cache_invalidated_on_reexport(linear_export):
+    # regression: grid search re-exports every point to the same dir — the
+    # cache must serve the new weights, not the first fit's
+    import os
+    import time
+
+    from tensorflowonspark_tpu.checkpoint import export_model
+
+    model = pl.TFModel()
+    model.setExportDir(linear_export).setInputMapping({"x": "x"})
+    df = DataFrame([Row(x=np.float32(1.0))])
+    assert float(model.transform(df).collect()[0].y) == pytest.approx(2.0)  # 3x-1
+
+    time.sleep(0.01)
+    export_model(linear_export, lambda p, x: p["w"] * x + p["b"],
+                 {"w": np.float32(10.0), "b": np.float32(0.0)},
+                 [np.zeros((2,), np.float32)],
+                 input_names=["x"], output_names=["y"], is_chief=True)
+    os.utime(os.path.join(linear_export, "export_meta.json"))
+    assert float(model.transform(df).collect()[0].y) == pytest.approx(10.0)
+
+
+def test_train_validation_split_empty_grid_raises():
+    tvs = pl.TrainValidationSplit(_MeanEstimator(), lambda d: 0.0, [])
+    with pytest.raises(ValueError, match="empty"):
+        tvs.fit(DataFrame([Row(y=1.0)]))
+
+
+def test_train_validation_split_shuffles_sorted_input():
+    # rows sorted by y: a prefix cut would train only on low values
+    df = DataFrame([Row(y=float(i)) for i in range(100)])
+    est = _MeanEstimator()
+    grid = pl.ParamGridBuilder().addGrid(est.getParam("shift"), [0.0]).build()
+
+    def evaluator(out):
+        return -float(np.mean([(r.pred - r.y) ** 2 for r in out.collect()]))
+
+    best = tvs_fit = pl.TrainValidationSplit(est, evaluator, grid,
+                                             trainRatio=0.5).fit(df)
+    # with a random split, train mean ≈ global mean (49.5), not prefix mean (24.5)
+    pred = best.transform(df).collect()[0].pred
+    assert abs(pred - 49.5) < 8.0
+
+
+# -- end-to-end: fit on a real cluster, transform the export -----------------
+
+@pytest.mark.integration
+def test_estimator_fit_then_transform(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-1, 1, 256).astype(np.float32)
+    y = (2.0 * x).astype(np.float32)
+    df = DataFrame([Row(x=float(a), y=float(b)) for a, b in zip(x, y)],
+                   num_partitions=4)
+
+    export_dir = str(tmp_path / "export")
+    args = pl.Namespace(lr=0.5, export_dir=export_dir)
+    est = pl.TFEstimator(funcs.fn_train_linear_export, args)
+    (est.setClusterSize(1).setEpochs(4).setBatchSize(32)
+        .setInputMapping({"x": "x"}).setOutputMapping({"y": "pred"}))
+
+    model = est.fit(df)
+    assert isinstance(model, pl.TFModel)
+    out = model.transform(df.select("x"))
+    preds = np.array([float(r.pred) for r in out.collect()])
+    np.testing.assert_allclose(preds, 2.0 * x, atol=0.15)

@@ -152,7 +152,11 @@ def _mixin(name: str, doc: str, default=None, has_default: bool = True):
     surface identical (``setBatchSize``/``getBatchSize``) without 400 lines
     of boilerplate.
     """
-    cap = "".join(part[0].upper() + part[1:] for part in name.split("_") if part)
+    # acronyms the reference capitalizes in accessor names (setNumPS,
+    # setDriverPSNodes, setTFRecordDir — pipeline.py::Has* upstream)
+    acronyms = {"ps": "PS", "tfrecord": "TFRecord"}
+    cap = "".join(acronyms.get(part, part[0].upper() + part[1:])
+                  for part in name.split("_") if part)
 
     def setter(self, value):
         return self.set(name, value)
@@ -400,11 +404,22 @@ class TFEstimator(TFParams, Estimator,
             master_node=self.getOrDefault("master_node"),
             driver_ps_nodes=self.getOrDefault("driver_ps_nodes"),
             backend=backend, worker_env=self.worker_env)
-        if input_mode == InputMode.SPARK:
-            # rows are fed as positional lists, one feed-partition per df
-            # partition — the reference's `df.rdd.map(list)` (SURVEY §3.4)
-            cluster.train(Partitioned(df.to_lists()),
-                          num_epochs=self.getOrDefault("epochs"))
+        try:
+            if input_mode == InputMode.SPARK:
+                # rows are fed as positional lists, one feed-partition per df
+                # partition — the reference's `df.rdd.map(list)` (SURVEY §3.4)
+                cluster.train(Partitioned(df.to_lists()),
+                              num_epochs=self.getOrDefault("epochs"))
+        except BaseException:
+            # a failed feed must not leak the worker cluster (each failed
+            # grid point would otherwise strand a full process group)
+            for cleanup in (cluster.backend.terminate, cluster.server.stop):
+                try:
+                    cleanup()
+                except Exception:
+                    logger.warning("cluster cleanup after failed train() also "
+                                   "failed in %s", cleanup.__name__, exc_info=True)
+            raise
         cluster.shutdown(grace_secs=self.getOrDefault("grace_secs"))
         if self.export_fn is not None:
             self.export_fn(args)
@@ -428,7 +443,9 @@ def _load_model_cached(export_dir: str, tag_set):
 
     from tensorflowonspark_tpu.checkpoint import ExportedModel
 
-    meta_path = os.path.join(export_dir, "export_meta.json")
+    from tensorflowonspark_tpu.checkpoint import _META_NAME
+
+    meta_path = os.path.join(export_dir, _META_NAME)
     version = os.path.getmtime(meta_path) if os.path.exists(meta_path) else -1.0
     key = (export_dir,
            tuple(tag_set.split(",")) if isinstance(tag_set, str)

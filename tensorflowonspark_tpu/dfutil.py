@@ -64,7 +64,11 @@ def fromTFExample(serialized: bytes, binary_features: Sequence[str] = (),
             values = [v.decode("utf-8") for v in values]
         is_list = (schema[name].endswith("[]") if schema and name in schema
                    else len(values) != 1)
-        out[name] = list(values) if is_list else values[0]
+        if is_list:
+            out[name] = list(values)
+        else:
+            # an empty feature in a scalar-typed column → null, not a crash
+            out[name] = values[0] if values else None
     return Row(**out)
 
 
@@ -128,21 +132,43 @@ def loadTFRecords(input_dir: str, binary_features: Sequence[str] = (),
     if not files:
         raise FileNotFoundError(f"no TFRecord part files under {input_dir}")
 
-    # two passes over the schema question, one over the data: the schema is
-    # the union of per-record inference (a column is a list if ANY record has
-    # >1 value), then applied to every row so list columns are never ragged
-    partitions: list[list[bytes]] = []
-    schema: dict[str, str] = {}
+    # Decode each record ONCE; derive both the schema union and the Rows from
+    # the same decoded dicts (the per-byte varint decode dominates load cost).
+    # A column is a list if ANY record has ≠1 values (>1, or an empty feature
+    # — an empty feature carries no type, so it must not force scalar/string);
+    # its kind comes from the first non-empty occurrence.
+    decoded_parts: list[list[dict]] = []
+    kinds: dict[str, str] = {}
+    multi: set[str] = set()
     for path in files:
-        serialized_rows = list(tfrecord.read_records(path, verify=verify))
-        for serialized in serialized_rows:
-            for name, kind in infer_schema(serialized, binary_features).items():
-                if kind.endswith("[]") or name not in schema:
-                    schema[name] = kind
-        partitions.append(serialized_rows)
+        part = [example_proto.decode_example(s)
+                for s in tfrecord.read_records(path, verify=verify)]
+        for rec in part:
+            for name, (kind, values) in rec.items():
+                if values and name not in kinds:
+                    if kind == "bytes":
+                        kind = "bytes" if name in binary_features else "string"
+                    kinds[name] = kind
+                if len(values) != 1:
+                    multi.add(name)
+        decoded_parts.append(part)
+    schema = {name: kinds.get(name, "string") + ("[]" if name in multi else "")
+              for name in set(kinds) | multi}
+
+    def _to_row(rec: dict) -> Row:
+        out = {}
+        for name in sorted(rec):
+            kind, values = rec[name]
+            if kind == "bytes" and name not in binary_features:
+                values = [v.decode("utf-8") for v in values]
+            if schema[name].endswith("[]"):
+                out[name] = list(values)
+            else:
+                out[name] = values[0] if values else None
+        return Row(**out)
+
     df = DataFrame.from_partitions(
-        [[fromTFExample(s, binary_features, schema) for s in part]
-         for part in partitions])
+        [[_to_row(rec) for rec in part] for part in decoded_parts])
     logger.info("loaded %d records from %s (schema: %s)",
                 df.count(), input_dir, schema)
     return df

@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The reference ships no kernels of its own (its compute layer is the TF
+C++/CUDA runtime, SURVEY.md §2b); the rebuild's analogue of that native
+layer is XLA:TPU plus the hand-written Pallas kernels here for the ops
+where fusion beyond XLA's pays: attention (the O(T²) memory hog) first.
+"""
+
+from tensorflowonspark_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -441,9 +441,7 @@ _MODEL_CACHE: dict[tuple, Any] = {}
 def _load_model_cached(export_dir: str, tag_set):
     import os
 
-    from tensorflowonspark_tpu.checkpoint import ExportedModel
-
-    from tensorflowonspark_tpu.checkpoint import _META_NAME
+    from tensorflowonspark_tpu.checkpoint import ExportedModel, _META_NAME
 
     meta_path = os.path.join(export_dir, _META_NAME)
     version = os.path.getmtime(meta_path) if os.path.exists(meta_path) else -1.0

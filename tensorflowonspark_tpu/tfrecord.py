@@ -32,22 +32,45 @@ _lib = None          # ctypes CDLL once loaded
 _lib_failed = False  # don't retry a failed build every call
 
 
+def _trusted_so(so_path: str) -> bool:
+    """Only dlopen a cached .so owned by us (or root) and not writable by
+    anyone else — the cache dir lives under a world-writable tmpdir, so an
+    unchecked path would let another local user plant a library."""
+    try:
+        st = os.lstat(so_path)
+    except OSError:
+        return False
+    import stat as _stat
+
+    return (_stat.S_ISREG(st.st_mode)
+            and st.st_uid in (os.getuid(), 0)
+            and not (st.st_mode & 0o022))
+
+
 def _build_library() -> str | None:
     """Compile native/tfrecord.cc → libtfrecord.so (cached beside the source,
     falling back to a per-user cache dir when the package is read-only)."""
+    try:
+        source_mtime = os.path.getmtime(_SOURCE)
+    except OSError:
+        source_mtime = None  # source not shipped: accept any valid prebuilt
     for target_dir in (_NATIVE_DIR,
                        os.path.join(tempfile.gettempdir(),
                                     f"tfos_tpu_native_{os.getuid()}")):
         so_path = os.path.join(target_dir, "libtfrecord.so")
-        if os.path.exists(so_path) and (
-                os.path.getmtime(so_path) >= os.path.getmtime(_SOURCE)):
+        if (os.path.exists(so_path) and _trusted_so(so_path)
+                and (source_mtime is None
+                     or os.path.getmtime(so_path) >= source_mtime)):
             return so_path
+        if source_mtime is None:
+            continue  # nothing to build from
         try:
-            os.makedirs(target_dir, exist_ok=True)
+            os.makedirs(target_dir, mode=0o700, exist_ok=True)
             tmp = so_path + f".tmp.{os.getpid()}"
             subprocess.run(
                 ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", _SOURCE, "-o", tmp],
                 check=True, capture_output=True, timeout=120)
+            os.chmod(tmp, 0o755 if target_dir == _NATIVE_DIR else 0o700)
             os.replace(tmp, so_path)  # atomic: concurrent builders both succeed
             logger.info("built native TFRecord codec: %s", so_path)
             return so_path
@@ -67,17 +90,23 @@ def _native():
                        "using pure-Python CRC32C")
         _lib_failed = True
         return None
-    lib = ctypes.CDLL(so_path)
-    lib.tfr_masked_crc.restype = ctypes.c_uint32
-    lib.tfr_masked_crc.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
-    lib.tfr_crc32c.restype = ctypes.c_uint32
-    lib.tfr_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
-    lib.tfr_frame.restype = ctypes.c_size_t
-    lib.tfr_frame.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
-    lib.tfr_next.restype = ctypes.c_int64
-    lib.tfr_next.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
-                             ctypes.POINTER(ctypes.c_size_t),
-                             ctypes.POINTER(ctypes.c_size_t), ctypes.c_int]
+    try:
+        lib = ctypes.CDLL(so_path)
+        lib.tfr_masked_crc.restype = ctypes.c_uint32
+        lib.tfr_masked_crc.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.tfr_crc32c.restype = ctypes.c_uint32
+        lib.tfr_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.tfr_frame.restype = ctypes.c_size_t
+        lib.tfr_frame.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+        lib.tfr_next.restype = ctypes.c_int64
+        lib.tfr_next.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
+                                 ctypes.POINTER(ctypes.c_size_t),
+                                 ctypes.POINTER(ctypes.c_size_t), ctypes.c_int]
+    except (OSError, AttributeError) as e:  # stale/corrupt/wrong-arch cache
+        logger.warning("native TFRecord codec failed to load (%s); "
+                       "using pure-Python CRC32C", e)
+        _lib_failed = True
+        return None
     _lib = lib
     return _lib
 

@@ -1,0 +1,133 @@
+"""Pallas flash-attention kernel vs the dense oracle (interpret mode on CPU).
+
+Mirrors the reference's test posture of exercising real code paths without
+special hardware (SURVEY.md §4: `local-cluster` on one machine); here the
+kernels run under the Pallas interpreter so CI needs no TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.ops import flash_attention
+from tensorflowonspark_tpu.parallel.ring_attention import reference_attention
+
+
+def _rand(key, *shape, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype=dtype)
+
+
+def _qkv(seed, B, T, H, D, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return (_rand(ks[0], B, T, H, D, dtype=dtype),
+            _rand(ks[1], B, T, H, D, dtype=dtype),
+            _rand(ks[2], B, T, H, D, dtype=dtype))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_dense(causal):
+    q, k, v = _qkv(0, 2, 64, 4, 16)
+    got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    want = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_key_padding_mask():
+    q, k, v = _qkv(1, 2, 48, 2, 8)
+    mask = jnp.arange(48)[None, :] < jnp.array([[30], [48]])
+    got = flash_attention(q, k, v, mask=mask, block_q=16, block_k=16)
+    want = reference_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_ragged_seq_len_padded_internally():
+    # 50 is not a block multiple → exercises the padding path.
+    q, k, v = _qkv(2, 1, 50, 2, 8)
+    got = flash_attention(q, k, v, block_q=16, block_k=16)
+    want = reference_attention(q, k, v)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_cross_attention_lengths():
+    B, H, D = 2, 2, 8
+    ks = jax.random.split(jax.random.key(3), 3)
+    q = _rand(ks[0], B, 24, H, D)
+    k = _rand(ks[1], B, 40, H, D)
+    v = _rand(ks[2], B, 40, H, D)
+    got = flash_attention(q, k, v, block_q=16, block_k=16)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    p = jax.nn.softmax(s, axis=-1)
+    want = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_forward_close():
+    q, k, v = _qkv(4, 1, 32, 2, 16, dtype=jnp.bfloat16)
+    got = flash_attention(q, k, v, block_q=16, block_k=16)
+    assert got.dtype == jnp.bfloat16
+    want = reference_attention(q, k, v)
+    np.testing.assert_allclose(got.astype(np.float32),
+                               want.astype(np.float32), atol=3e-2, rtol=3e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_dense(causal):
+    q, k, v = _qkv(5, 2, 32, 2, 8)
+    mask = jnp.arange(32)[None, :] < jnp.array([[32], [20]])
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, mask=mask, causal=causal,
+                            block_q=16, block_k=16)
+        return jnp.sum(jnp.sin(o))  # non-trivial cotangent
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.sin(reference_attention(q, k, v, mask=mask,
+                                                   causal=causal)))
+
+    g_got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g_got, g_want, "qkv"):
+        np.testing.assert_allclose(got, want, atol=5e-5, rtol=5e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_gradients_ragged_padding():
+    q, k, v = _qkv(6, 1, 20, 2, 8)  # padded to 24 internally
+
+    f = jax.grad(lambda q: jnp.sum(
+        flash_attention(q, k, v, block_q=8, block_k=8) ** 2))
+    d = jax.grad(lambda q: jnp.sum(reference_attention(q, k, v) ** 2))
+    np.testing.assert_allclose(f(q), d(q), atol=5e-5, rtol=5e-4)
+    assert np.all(np.isfinite(f(q)))
+
+
+def test_jit_and_vjp_compile_once():
+    q, k, v = _qkv(7, 1, 32, 2, 8)
+    step = jax.jit(jax.grad(lambda q: flash_attention(
+        q, k, v, causal=True, block_q=16, block_k=16).sum()))
+    assert np.all(np.isfinite(step(q)))
+
+
+def test_as_bert_attention_fn():
+    """flash_attention plugs into BertConfig.attention_fn unchanged."""
+    import functools
+    from tensorflowonspark_tpu.models import Bert, BertConfig
+
+    cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                     num_heads=2, intermediate_size=64,
+                     max_position_embeddings=32, dropout_rate=0.0,
+                     dtype=jnp.float32,
+                     attention_fn=functools.partial(
+                         flash_attention, block_q=16, block_k=16))
+    ids = jnp.ones((2, 16), jnp.int32)
+    mask = jnp.arange(16)[None, :] < jnp.array([[16], [9]])
+    params = Bert(cfg).init(jax.random.key(0), ids, mask)
+    out = Bert(cfg).apply(params, ids, mask)
+    assert out.shape == (2, 16, 32)
+    assert np.all(np.isfinite(out))
+
+    dense = BertConfig(**{**cfg.__dict__, "attention_fn": None})
+    want = Bert(dense).apply(params, ids, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)

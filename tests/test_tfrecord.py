@@ -234,3 +234,30 @@ def test_dfutil_tf_interop(tmp_path):
     }) for r in ds]
     assert [int(p["idx"]) for p in parsed] == list(range(10))
     assert parsed[4]["tag"].numpy() == b"t4"
+
+
+def test_empty_feature_roundtrip(tmp_path):
+    """A record with an empty-list cell must not crash the load path
+    (regression: IndexError in fromTFExample on len-0 features)."""
+    from tensorflowonspark_tpu import dfutil
+    from tensorflowonspark_tpu.dataframe import DataFrame, Row
+
+    df = DataFrame([Row(v=[1.0, 2.0]), Row(v=[]), Row(v=[3.0])])
+    out = str(tmp_path / "tfr")
+    dfutil.saveAsTFRecords(df, out)
+    back = dfutil.loadTFRecords(out)
+    vals = sorted((r.v for r in back.collect()), key=len)
+    assert vals == [[], [1.0, 2.0], [3.0]] or vals == [[], [3.0], [1.0, 2.0]]
+
+
+def test_empty_feature_scalar_schema_yields_null(tmp_path):
+    """All-len-1 plus one empty feature: the empty cell must come back as a
+    list cell (empty features force list typing), never crash."""
+    from tensorflowonspark_tpu import dfutil
+    from tensorflowonspark_tpu.dataframe import DataFrame, Row
+
+    df = DataFrame([Row(x=[7.0]), Row(x=[])])
+    out = str(tmp_path / "tfr2")
+    dfutil.saveAsTFRecords(df, out)
+    back = dfutil.loadTFRecords(out)
+    assert sorted(r.x for r in back.collect()) == [[], [7.0]]

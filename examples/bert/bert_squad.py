@@ -1,0 +1,144 @@
+"""BERT SQuAD-style span fine-tuning through the TFEstimator pipeline.
+
+Reference workload: "BERT-base SQuAD fine-tune via Spark ML TFEstimator
+pipeline" (``BASELINE.json`` configs[3]).  The DataFrame holds tokenized
+(input_ids, start_position, end_position) rows; ``TFEstimator.fit`` feeds
+them into a cluster training :class:`BertForQuestionAnswering`;
+``TFModel.transform`` scores contexts and emits predicted span bounds.
+
+Uses the Pallas flash-attention kernel on TPU (``--flash``), tiny config by
+default so it runs anywhere:
+
+    python examples/bert/bert_squad.py --cpu --cluster_size 1 --steps 5
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def train_fn(args, ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.checkpoint import export_model
+    from tensorflowonspark_tpu.models import BertConfig, BertForQuestionAnswering
+    from tensorflowonspark_tpu.parallel.strategy import MultiWorkerMirroredStrategy
+
+    attention_fn = None
+    if args.flash:
+        from tensorflowonspark_tpu.ops import flash_attention
+        attention_fn = flash_attention
+
+    cfg = BertConfig(vocab_size=args.vocab_size, hidden_size=args.hidden_size,
+                     num_layers=args.num_layers, num_heads=args.num_heads,
+                     intermediate_size=args.hidden_size * 4,
+                     max_position_embeddings=args.seq_len,
+                     dropout_rate=0.0,
+                     dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+                     attention_fn=attention_fn)
+    model = BertForQuestionAnswering(cfg)
+    tx = optax.adamw(args.lr, weight_decay=0.01)
+    strategy = MultiWorkerMirroredStrategy()
+
+    ids0 = jnp.ones((args.batch_size, args.seq_len), jnp.int32)
+    state = strategy.init_state(
+        lambda: model.init(jax.random.key(0), ids0)["params"], tx)
+
+    def loss_fn(params, batch):
+        ids, starts, ends, w = batch
+        s_logits, e_logits = model.apply({"params": params}, ids)
+        ce = (optax.softmax_cross_entropy_with_integer_labels(s_logits, starts)
+              + optax.softmax_cross_entropy_with_integer_labels(e_logits, ends))
+        return (ce * w).sum() / jnp.maximum(w.sum(), 1.0) / 2.0
+
+    step = strategy.build_train_step(loss_fn)
+    feed = ctx.get_data_feed(train_mode=True)
+    steps = 0
+    while not feed.should_stop() and (args.steps == 0 or steps < args.steps):
+        batch = feed.next_batch_arrays(args.batch_size, timeout=60)
+        if batch is None:
+            break
+        ids, starts, ends = batch
+        n = len(ids)
+        pad = args.batch_size - n
+        ids = np.concatenate([np.asarray(ids, np.int32),
+                              np.zeros((pad, args.seq_len), np.int32)])
+        starts = np.concatenate([np.asarray(starts, np.int64), np.zeros(pad, np.int64)])
+        ends = np.concatenate([np.asarray(ends, np.int64), np.zeros(pad, np.int64)])
+        w = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+        state, metrics = step(state, strategy.shard_batch((ids, starts, ends, w)))
+        steps += 1
+        if steps % 5 == 0:
+            print(f"node {ctx.executor_id}: step {steps} "
+                  f"loss {float(metrics['loss']):.4f}", flush=True)
+    if steps >= args.steps > 0:
+        feed.terminate()
+
+    if ctx.is_chief:
+        def serve(params, input_ids):
+            s, e = model.apply({"params": params}, input_ids)
+            return s.argmax(-1), e.argmax(-1)
+
+        export_model(args.export_dir, serve, state.params,
+                     [np.zeros((1, args.seq_len), np.int32)],
+                     input_names=["input_ids"],
+                     output_names=["start", "end"], is_chief=True)
+        print(f"chief: exported {args.export_dir}", flush=True)
+
+
+if __name__ == "__main__":
+    import numpy as np
+
+    from tensorflowonspark_tpu import pipeline as pl
+    from tensorflowonspark_tpu.dataframe import DataFrame, Row
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=1)
+    p.add_argument("--batch_size", type=int, default=8)
+    p.add_argument("--steps", type=int, default=0)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--lr", type=float, default=3e-5)
+    p.add_argument("--num_samples", type=int, default=128)
+    p.add_argument("--seq_len", type=int, default=64)
+    p.add_argument("--vocab_size", type=int, default=1000)
+    p.add_argument("--hidden_size", type=int, default=64)
+    p.add_argument("--num_layers", type=int, default=2)
+    p.add_argument("--num_heads", type=int, default=4)
+    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--flash", action="store_true",
+                   help="Pallas flash attention (use on TPU)")
+    p.add_argument("--export_dir", default="/tmp/bert_squad_export")
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    rng = np.random.default_rng(0)
+    rows = []
+    for _ in range(args.num_samples):
+        ids = rng.integers(1, args.vocab_size, size=args.seq_len)
+        start = int(rng.integers(0, args.seq_len - 1))
+        end = int(rng.integers(start, args.seq_len))
+        rows.append(Row(input_ids=ids.tolist(), start_position=start,
+                        end_position=end))
+    df = DataFrame(rows, num_partitions=max(2, args.cluster_size))
+
+    worker_env = {"JAX_PLATFORMS": "cpu"} if args.cpu else None
+    estimator = (pl.TFEstimator(train_fn, args, worker_env=worker_env)
+                 .setClusterSize(args.cluster_size)
+                 .setBatchSize(args.batch_size)
+                 .setEpochs(args.epochs)
+                 .setExportDir(args.export_dir)
+                 .setInputMapping({"input_ids": "input_ids"})
+                 .setOutputMapping({"start": "pred_start", "end": "pred_end"}))
+    model = estimator.fit(df)
+
+    sample = DataFrame(df.collect()[:4]).select("input_ids")
+    preds = model.transform(sample)
+    for row in preds.collect():
+        print(f"pred span: [{int(row.pred_start)}, {int(row.pred_end)}]")
+    print("bert_squad: done")

@@ -1,0 +1,153 @@
+"""CIFAR-10 ResNet with a custom training loop, checkpoint/resume, and eval.
+
+Reference: ``examples/resnet`` — the TF model-garden CIFAR ResNet ported to a
+Keras custom training loop under MultiWorkerMirroredStrategy, with
+``BackupAndRestore``-style checkpointing (``BASELINE.json`` configs[1],
+InputMode.TENSORFLOW).  Here: :class:`CifarResNet` (BasicBlock stack, CIFAR
+stem), host-local data shards, cosine LR, restart-safe via
+``CheckpointManager.restore``.
+
+Run:
+
+    python examples/resnet/resnet_cifar.py --cpu --cluster_size 1 \
+        --steps 10 --batch_size 32 --model_dir /tmp/cifar_ckpt
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def _shard(args, ctx):
+    """Synthetic CIFAR-10 shard (32×32×3); swap for real data via --data_dir."""
+    import numpy as np
+
+    if args.data_dir:
+        from tensorflowonspark_tpu import dfutil
+
+        rows = dfutil.loadTFRecords(args.data_dir).collect()
+        rows = rows[ctx.executor_id::ctx.num_workers]
+        x = np.stack([np.asarray(r.image, np.float32).reshape(32, 32, 3)
+                      for r in rows])
+        y = np.asarray([int(r.label) for r in rows])
+        return x, y
+    rng = np.random.default_rng(99 + ctx.executor_id)
+    n = args.num_samples // ctx.num_workers
+    return (rng.random((n, 32, 32, 3), np.float32),
+            rng.integers(0, 10, size=n))
+
+
+def main_fun(args, ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.checkpoint import CheckpointManager
+    from tensorflowonspark_tpu.models import CifarResNet
+    from tensorflowonspark_tpu.parallel.strategy import (
+        MultiWorkerMirroredStrategy, TrainState)
+
+    if jax.default_backend() == "tpu" and ctx.num_workers > 1:
+        ctx.initialize_distributed()
+
+    images, labels = _shard(args, ctx)
+    model = CifarResNet()
+    sched = optax.cosine_decay_schedule(args.lr, max(args.steps, 1))
+    tx = optax.sgd(sched, momentum=0.9)
+    strategy = MultiWorkerMirroredStrategy()
+
+    sample = jnp.zeros((args.batch_size, 32, 32, 3), jnp.float32)
+
+    def init_fn():
+        variables = model.init(jax.random.key(0), sample, train=True)
+        return variables["params"]
+
+    state = strategy.init_state(init_fn, tx)
+    # BatchNorm statistics ride in state.extras (mutable collections don't
+    # fit the pure params/grads pattern of build_train_step's closure).
+    state.extras["batch_stats"] = model.init(
+        jax.random.key(0), sample, train=True)["batch_stats"]
+
+    def loss_fn(params, batch, extras):
+        x, y = batch
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": extras["batch_stats"]}, x,
+            train=True, mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        return loss, {"extras": {"batch_stats": updates["batch_stats"]},
+                      "acc": (logits.argmax(-1) == y).mean()}
+    loss_fn.has_aux = True
+
+    step = strategy.build_train_step(loss_fn)
+
+    ckpt = CheckpointManager(args.model_dir) if args.model_dir and ctx.is_chief \
+        else None
+    start_step = 0
+    if ckpt is not None and ckpt.latest_step() is not None:
+        # restore against the freshly-built state's structure so optimizer
+        # namedtuples (and shardings) survive the round trip
+        state = ckpt.restore(target=jax.eval_shape(lambda: state))
+        start_step = int(np.asarray(state.step))
+        print(f"chief: resumed from step {start_step}", flush=True)
+
+    rng = np.random.default_rng(ctx.executor_id)
+    for s in range(start_step, args.steps):
+        idx = rng.integers(0, len(images), size=args.batch_size)
+        state, metrics = step(state, strategy.shard_batch(
+            (images[idx], labels[idx])))
+        if (s + 1) % 10 == 0:
+            print(f"node {ctx.executor_id}: step {s + 1} "
+                  f"loss {float(metrics['loss']):.4f} "
+                  f"acc {float(metrics['acc']):.3f}", flush=True)
+        if ckpt is not None and args.ckpt_every and (s + 1) % args.ckpt_every == 0:
+            ckpt.save(s + 1, state)
+
+    # eval: running-average BN stats, train=False
+    if ctx.is_chief:
+        @jax.jit
+        def eval_logits(params, batch_stats, x):
+            return model.apply({"params": params, "batch_stats": batch_stats},
+                               x, train=False)
+
+        n_eval = min(len(images), 4 * args.batch_size)
+        correct = 0
+        for start in range(0, n_eval, args.batch_size):
+            x = images[start:start + args.batch_size]
+            y = labels[start:start + args.batch_size]
+            if len(x) < args.batch_size:
+                break
+            logits = eval_logits(state.params, state.extras["batch_stats"], x)
+            correct += int((np.asarray(logits).argmax(-1) == y).sum())
+        print(f"chief: eval acc {correct / max(n_eval, 1):.3f} "
+              f"({n_eval} samples)", flush=True)
+        if ckpt is not None:
+            if ckpt.latest_step() != args.steps:
+                ckpt.save(args.steps, state, force=True)
+            ckpt.close()
+
+
+if __name__ == "__main__":
+    from tensorflowonspark_tpu import InputMode, TPUCluster
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=1)
+    p.add_argument("--batch_size", type=int, default=128)
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--ckpt_every", type=int, default=0)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--num_samples", type=int, default=2048)
+    p.add_argument("--data_dir", default="")
+    p.add_argument("--model_dir", default="")
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    worker_env = {"JAX_PLATFORMS": "cpu"} if args.cpu else None
+    cluster = TPUCluster.run(main_fun, args, args.cluster_size,
+                             input_mode=InputMode.TENSORFLOW,
+                             worker_env=worker_env, reservation_timeout=60)
+    cluster.shutdown(timeout=1800)
+    print("resnet_cifar: done")

@@ -1,0 +1,129 @@
+"""Wide&Deep CTR on Criteo-style data — the PS-mode parity workload.
+
+Reference: ``examples/wide_deep`` trained with gRPC parameter servers whose
+whole job is holding the big sparse embedding tables (``BASELINE.json``
+configs[4]; SURVEY.md §2c).  TPU-native replacement: ``num_ps`` becomes the
+size of the ``ep`` mesh axis and the tables shard over it
+(:class:`ShardedEmbedding`), keeping PS-mode's memory scaling with
+synchronous SPMD semantics — there is no parameter server to run.
+
+Run (2 "ps" shards simulated on an 8-device CPU mesh):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/wide_deep/wide_deep_criteo.py --cpu --num_ps 2 --steps 20
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+NUM_DENSE = 13
+NUM_CATEGORICAL = 26
+
+
+def _batch(rng, vocab_sizes, batch_size):
+    import numpy as np
+
+    dense = rng.random((batch_size, NUM_DENSE), np.float32)
+    cat = np.stack([rng.integers(0, v, size=batch_size) for v in vocab_sizes],
+                   axis=1)
+    # synthetic click rule so learning is measurable: dense[0] high + feature
+    # 0 in its low vocab range → click
+    label = ((dense[:, 0] > 0.6) & (cat[:, 0] < vocab_sizes[0] // 3)).astype(
+        np.float32)
+    return dense, cat, label
+
+
+def main_fun(args, ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.models import WideDeep
+    from tensorflowonspark_tpu.parallel import make_mesh
+    from tensorflowonspark_tpu.parallel.mesh import mesh_from_num_ps
+    from tensorflowonspark_tpu.parallel.sharding import flax_shardings
+
+    vocab_sizes = [args.vocab_size] * NUM_CATEGORICAL
+    # num_ps → ep axis size; remaining devices become dp (SURVEY.md §2c).
+    mesh = mesh_from_num_ps(args.num_ps)
+    print(f"node {ctx.executor_id}: mesh {dict(mesh.shape)}", flush=True)
+
+    model = WideDeep(vocab_sizes=vocab_sizes, embed_dim=args.embed_dim)
+    tx = optax.adagrad(args.lr)  # the reference example's optimizer family
+    rng = np.random.default_rng(17 + ctx.executor_id)
+    dense, cat, label = _batch(rng, vocab_sizes, args.batch_size)
+
+    with mesh:
+        def init_fn():
+            params = model.init(jax.random.key(0), jnp.asarray(dense),
+                                jnp.asarray(cat))["params"]
+            return params, tx.init(params)
+
+        abstract = jax.eval_shape(init_fn)
+        shardings = flax_shardings(mesh, abstract)
+        params, opt_state = jax.jit(init_fn, out_shardings=shardings)()
+
+        data_sharding = NamedSharding(mesh, P(("dp", "fsdp"), None))
+        label_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+
+        def loss_fn(params, dense, cat, label):
+            logit = model.apply({"params": params}, dense, cat)
+            return optax.sigmoid_binary_cross_entropy(logit, label).mean()
+
+        @jax.jit
+        def step(params, opt_state, dense, cat, label):
+            loss, grads = jax.value_and_grad(loss_fn)(params, dense, cat, label)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        for s in range(args.steps):
+            dense, cat, label = _batch(rng, vocab_sizes, args.batch_size)
+            d = jax.device_put(jnp.asarray(dense), data_sharding)
+            c = jax.device_put(jnp.asarray(cat), data_sharding)
+            y = jax.device_put(jnp.asarray(label), label_sharding)
+            params, opt_state, loss = step(params, opt_state, d, c, y)
+            if (s + 1) % 10 == 0:
+                print(f"node {ctx.executor_id}: step {s + 1} "
+                      f"logloss {float(loss):.4f}", flush=True)
+
+    if ctx.is_chief and args.model_dir:
+        from tensorflowonspark_tpu.checkpoint import save_checkpoint
+
+        save_checkpoint(args.model_dir, {"params": params}, step=args.steps)
+        print(f"chief: saved {args.model_dir}", flush=True)
+
+
+if __name__ == "__main__":
+    from tensorflowonspark_tpu import InputMode, TPUCluster
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=1)
+    p.add_argument("--num_ps", type=int, default=2,
+                   help="embedding-shard count (the reference's PS count)")
+    p.add_argument("--batch_size", type=int, default=256)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--vocab_size", type=int, default=1000)
+    p.add_argument("--embed_dim", type=int, default=16)
+    p.add_argument("--model_dir", default="")
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    worker_env = None
+    if args.cpu:
+        # simulate enough CPU devices for the ep axis (+ some dp on top)
+        worker_env = {"JAX_PLATFORMS": "cpu",
+                      "XLA_FLAGS": "--xla_force_host_platform_device_count="
+                                   f"{max(8, args.num_ps)}"}
+    cluster = TPUCluster.run(main_fun, args, args.cluster_size,
+                             num_ps=0,  # roles stay workers; num_ps shapes the mesh
+                             input_mode=InputMode.TENSORFLOW,
+                             worker_env=worker_env, reservation_timeout=60)
+    cluster.shutdown(timeout=1800)
+    print("wide_deep_criteo: done")

@@ -240,6 +240,15 @@ def export_model(export_dir: str,
     export_dir = os.path.abspath(export_dir)
     os.makedirs(os.path.join(export_dir, _SIGNATURES_DIR), exist_ok=True)
 
+    # strip flax Partitioned/etc. metadata boxes — sharding annotations are
+    # training-time concerns; jax.export can't serialize the box pytreedefs
+    try:
+        from flax.core import meta as _flax_meta
+
+        params = _flax_meta.unbox(params)
+    except ImportError:
+        pass
+
     # parameters (orbax pytree) — loadable standalone
     import orbax.checkpoint as ocp
 

@@ -63,6 +63,16 @@ def _worker_entry(executor_id: int, env: dict, fn, tf_args, cluster_meta: dict,
     task process executes ``TFSparkNode._mapfn``.
     """
     os.environ.update({k: str(v) for k, v in env.items()})
+    if "JAX_PLATFORMS" in env:
+        # A sitecustomize may import jax at interpreter startup (e.g. to
+        # register a PJRT plugin), freezing the platform choice before this
+        # function runs; the config update wins over the frozen env read.
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", str(env["JAX_PLATFORMS"]))
+        except ImportError:
+            pass
     import logging as _logging
 
     _logging.basicConfig(level=_logging.INFO,

@@ -37,7 +37,7 @@ class WideDeep(nn.Module):
         dense = dense.astype(self.dtype)
 
         # Wide: per-feature scalar weights (a linear model over one-hot
-        # categproducals) — table of shape [vocab, 1], sharded like the rest.
+        # categoricals) — table of shape [vocab, 1], sharded like the rest.
         wide_logit = jnp.zeros((B,), jnp.float32)
         deep_parts = [dense]
         for i, vocab in enumerate(self.vocab_sizes):

@@ -305,9 +305,8 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    block_q = min(block_q, _round_up(Tq, 8))
-    block_k = min(block_k, _round_up(Tk, 8))
-    Tq_p, Tk_p = _round_up(Tq, block_q), _round_up(Tk, block_k)
+    block_q, Tq_p = _pick_block(Tq, block_q)
+    block_k, Tk_p = _pick_block(Tk, block_k)
     if Tq_p != Tq:
         qt = jnp.pad(qt, ((0, 0), (0, 0), (0, Tq_p - Tq), (0, 0)))
     if Tk_p != Tk:
@@ -332,3 +331,21 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
 
 def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
+
+
+def _pick_block(T: int, requested: int) -> tuple[int, int]:
+    """Choose ``(block, padded_T)`` bounding pad waste to one 128-tile.
+
+    Padding straight to a multiple of a large block nearly doubles compute
+    for lengths just past a block boundary (T=520 → 1024 with 512-blocks);
+    instead pad T to the next 128 multiple and take the largest block ≤
+    ``requested`` that divides it.
+    """
+    if T <= 128 or requested <= 128:
+        block = min(requested, _round_up(T, 8))
+        return block, _round_up(T, block)
+    T_p = _round_up(T, 128)
+    for block in (requested, 512, 256, 128):
+        if block <= requested and T_p % block == 0:
+            return block, T_p
+    return 128, T_p  # T_p is always a 128 multiple

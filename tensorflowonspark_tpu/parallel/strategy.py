@@ -92,29 +92,54 @@ class MeshStrategy:
     def build_train_step(self, loss_fn, tx=None, donate: bool = True):
         """Compile ``state, batch -> state, metrics``.
 
-        ``loss_fn(params, batch) -> scalar`` or ``(scalar, aux)``.  Gradient
-        averaging across data shards is *not* written here — the batch is
-        sharded over dp/fsdp and the loss is a mean over the global batch,
-        so XLA inserts the reduce-scatter/all-reduce it needs (the NCCL
-        allreduce of ``MultiWorkerMirroredStrategy``, compiled).
+        ``loss_fn(params, batch) -> scalar`` or ``(scalar, aux)``.  A
+        three-argument ``loss_fn(params, batch, extras)`` also receives
+        ``state.extras`` (mutable collections like BatchNorm statistics);
+        returning an ``"extras"`` key in ``aux`` stores it back into the next
+        state — the ``mutable=["batch_stats"]`` pattern without threading the
+        stats through the batch (which would alias donated buffers).
+
+        Gradient averaging across data shards is *not* written here — the
+        batch is sharded over dp/fsdp and the loss is a mean over the global
+        batch, so XLA inserts the reduce-scatter/all-reduce it needs (the
+        NCCL allreduce of ``MultiWorkerMirroredStrategy``, compiled).
         """
         tx = tx or getattr(self, "_tx", None)
         assert tx is not None, "pass tx= or call init_state first"
         has_aux = getattr(loss_fn, "has_aux", False)
+        takes_extras = getattr(loss_fn, "takes_extras", None)
+        if takes_extras is None:
+            # infer only from an explicit third *positional* param named
+            # 'extras' — a bare arg-count check would misroute state.extras
+            # into **kwargs or a defaulted third arg (e.g. rng=...)
+            import inspect
+
+            try:
+                params = list(inspect.signature(loss_fn).parameters.values())
+            except (TypeError, ValueError):
+                params = []
+            takes_extras = (
+                len(params) >= 3 and params[2].name == "extras"
+                and params[2].kind in (inspect.Parameter.POSITIONAL_ONLY,
+                                       inspect.Parameter.POSITIONAL_OR_KEYWORD))
 
         def step(state: TrainState, batch):
             grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+            args = (state.params, batch, state.extras) if takes_extras \
+                else (state.params, batch)
             if has_aux:
-                (loss, aux), grads = grad_fn(state.params, batch)
+                (loss, aux), grads = grad_fn(*args)
             else:
-                loss, grads = grad_fn(state.params, batch)
+                loss, grads = grad_fn(*args)
                 aux = {}
             import optax
 
+            extras = aux.pop("extras", state.extras) if isinstance(aux, dict) \
+                else state.extras
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             new_state = TrainState(params=params, opt_state=opt_state,
-                                   step=state.step + 1, extras=state.extras)
+                                   step=state.step + 1, extras=extras)
             metrics = {"loss": loss, **aux}
             return new_state, metrics
 

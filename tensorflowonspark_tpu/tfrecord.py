@@ -47,6 +47,24 @@ def _trusted_so(so_path: str) -> bool:
             and not (st.st_mode & 0o022))
 
 
+def _trusted_dir(target_dir: str, private: bool) -> bool:
+    """The directory itself must be ours too: an attacker-owned pre-existing
+    cache dir could swap the .so between build and dlopen regardless of the
+    file check.  ``private`` additionally demands mode 0700 (tmpdir caches);
+    the in-package dir may be root-owned/world-readable like the package."""
+    import stat as _stat
+
+    try:
+        st = os.lstat(target_dir)
+    except OSError:
+        return False
+    if not _stat.S_ISDIR(st.st_mode):
+        return False
+    if private:
+        return st.st_uid == os.getuid() and not (st.st_mode & 0o077)
+    return st.st_uid in (os.getuid(), 0) and not (st.st_mode & 0o022)
+
+
 def _build_library() -> str | None:
     """Compile native/tfrecord.cc → libtfrecord.so (cached beside the source,
     falling back to a per-user cache dir when the package is read-only)."""
@@ -54,10 +72,18 @@ def _build_library() -> str | None:
         source_mtime = os.path.getmtime(_SOURCE)
     except OSError:
         source_mtime = None  # source not shipped: accept any valid prebuilt
-    for target_dir in (_NATIVE_DIR,
-                       os.path.join(tempfile.gettempdir(),
-                                    f"tfos_tpu_native_{os.getuid()}")):
+    user_cache = os.path.join(tempfile.gettempdir(),
+                              f"tfos_tpu_native_{os.getuid()}")
+    for target_dir in (_NATIVE_DIR, user_cache):
+        private = target_dir == user_cache
         so_path = os.path.join(target_dir, "libtfrecord.so")
+        try:
+            os.makedirs(target_dir, mode=0o700, exist_ok=True)
+        except OSError:
+            continue
+        if not _trusted_dir(target_dir, private):
+            logger.debug("cache dir %s not trusted; skipping", target_dir)
+            continue
         if (os.path.exists(so_path) and _trusted_so(so_path)
                 and (source_mtime is None
                      or os.path.getmtime(so_path) >= source_mtime)):
@@ -65,12 +91,14 @@ def _build_library() -> str | None:
         if source_mtime is None:
             continue  # nothing to build from
         try:
-            os.makedirs(target_dir, mode=0o700, exist_ok=True)
-            tmp = so_path + f".tmp.{os.getpid()}"
+            # unpredictable temp name (mkstemp) → no symlink-clobber window
+            fd, tmp = tempfile.mkstemp(prefix=".libtfrecord.", suffix=".so",
+                                       dir=target_dir)
+            os.close(fd)
             subprocess.run(
                 ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", _SOURCE, "-o", tmp],
                 check=True, capture_output=True, timeout=120)
-            os.chmod(tmp, 0o755 if target_dir == _NATIVE_DIR else 0o700)
+            os.chmod(tmp, 0o755 if not private else 0o700)
             os.replace(tmp, so_path)  # atomic: concurrent builders both succeed
             logger.info("built native TFRecord codec: %s", so_path)
             return so_path

@@ -131,3 +131,26 @@ def test_as_bert_attention_fn():
     want = Bert(dense).apply(params, ids, mask)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_pick_block_bounds_padding_waste():
+    from tensorflowonspark_tpu.ops.flash_attention import _pick_block
+
+    # just past a 512 boundary: pad to one extra 128-tile, not a full 512
+    block, padded = _pick_block(520, 512)
+    assert padded == 640 and block == 128
+    # exact multiples keep the big block
+    assert _pick_block(4096, 512) == (512, 4096)
+    assert _pick_block(2048, 512) == (512, 2048)
+    # tiny sequences stay tiny
+    assert _pick_block(48, 16) == (16, 48)
+    b, p = _pick_block(20, 512)
+    assert p >= 20 and p % b == 0 and p - 20 < 8
+
+
+def test_flash_odd_length_past_block_boundary():
+    """T just past the block size must stay correct through _pick_block."""
+    q, k, v = _qkv(8, 1, 136, 2, 8)  # 136 = 128 + 8
+    got = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)

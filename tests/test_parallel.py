@@ -176,3 +176,42 @@ def test_ring_attention_jit_under_mesh():
     expect = reference_attention(*qkv, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_build_train_step_extras_routing():
+    """Three-arg loss_fn named 'extras' gets state.extras; a defaulted third
+    arg must NOT (regression: arg-count-only inference misrouted extras)."""
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu.parallel.strategy import DataParallelStrategy
+
+    strategy = DataParallelStrategy()
+    tx = optax.sgd(0.1)
+    state = strategy.init_state(lambda: {"w": jnp.zeros(())}, tx)
+    state.extras["scale"] = jnp.asarray(3.0)
+
+    def loss_extras(params, batch, extras):
+        return ((params["w"] * extras["scale"] - batch) ** 2).mean(), \
+            {"extras": {"scale": extras["scale"] + 1}}
+    loss_extras.has_aux = True
+
+    step = strategy.build_train_step(loss_extras)
+    state, _ = step(state, jnp.ones((8,)))
+    assert float(state.extras["scale"]) == 4.0
+
+    def loss_default(params, batch, rng=None):
+        assert rng is None  # extras must not land here
+        return ((params["w"] - batch) ** 2).mean()
+
+    state2 = strategy.init_state(lambda: {"w": jnp.zeros(())}, tx)
+    step2 = strategy.build_train_step(loss_default)
+    step2(state2, jnp.ones((8,)))
+
+    def loss_kwargs(params, batch, **kw):
+        assert not kw
+        return ((params["w"] - batch) ** 2).mean()
+
+    state3 = strategy.init_state(lambda: {"w": jnp.zeros(())}, tx)
+    step3 = strategy.build_train_step(loss_kwargs)
+    step3(state3, jnp.ones((8,)))

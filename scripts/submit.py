@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Cluster submission helper — the rebuild's ``spark-submit`` stand-in.
+
+The reference's ``scripts/`` are YARN/Standalone submission wrappers around
+``spark-submit --num-executors N ... your_driver.py``; without Spark the
+equivalent is launching a driver that boots the worker backend itself.  This
+CLI runs a user training function (dotted path ``module:function``, same
+``(args, ctx)`` contract as ``map_fun``) on a local process cluster:
+
+    python scripts/submit.py --num_workers 2 --cpu \\
+        examples.mnist.mnist_tf:main_fun -- --steps 20 --batch_size 32
+
+Everything after ``--`` is parsed into an ``argparse.Namespace`` by pairing
+``--flag value`` tokens (ints/floats auto-coerced) and handed to the
+function as ``args``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _load(dotted: str):
+    mod_name, _, fn_name = dotted.partition(":")
+    mod = importlib.import_module(mod_name)
+    try:
+        return getattr(mod, fn_name or "main_fun")
+    except AttributeError:
+        raise SystemExit(f"{mod_name} has no function '{fn_name or 'main_fun'}'")
+
+
+def _coerce(value: str):
+    for cast in (int, float):
+        try:
+            return cast(value)
+        except ValueError:
+            continue
+    return value
+
+
+def _parse_user_args(tokens: list[str]):
+    from tensorflowonspark_tpu.pipeline import Namespace
+
+    out: dict = {}
+    key = None
+    for tok in tokens:
+        if tok.startswith("--"):
+            if key is not None:
+                out[key] = True  # bare flag
+            key = tok[2:].replace("-", "_")
+        elif key is not None:
+            out[key] = _coerce(tok)
+            key = None
+        else:
+            raise SystemExit(f"unexpected user arg '{tok}' (expected --flag)")
+    if key is not None:
+        out[key] = True
+    return Namespace(**out)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="Run a map_fun on a local worker cluster")
+    parser.add_argument("target", help="module:function with (args, ctx) signature")
+    parser.add_argument("--num_workers", type=int, default=1)
+    parser.add_argument("--num_ps", type=int, default=0)
+    parser.add_argument("--input_mode", choices=["spark", "tensorflow"],
+                        default="tensorflow")
+    parser.add_argument("--tensorboard", action="store_true")
+    parser.add_argument("--master_node", default=None)
+    parser.add_argument("--reservation_timeout", type=float, default=120.0)
+    parser.add_argument("--cpu", action="store_true",
+                        help="pin workers to the CPU backend")
+    parser.add_argument("--cpu_devices", type=int, default=0,
+                        help="simulate N CPU devices per worker")
+    argv = sys.argv[1:] if argv is None else argv
+    if "--" in argv:
+        split = argv.index("--")
+        argv, user = argv[:split], argv[split + 1:]
+    else:
+        user = []
+    opts = parser.parse_args(argv)
+
+    from tensorflowonspark_tpu import InputMode, TPUCluster
+    from tensorflowonspark_tpu.device_info import visibility_env
+
+    fn = _load(opts.target)
+    args = _parse_user_args(user)
+
+    worker_env = visibility_env(
+        platform="cpu" if opts.cpu else None,
+        host_device_count=opts.cpu_devices or None)
+    cluster = TPUCluster.run(
+        fn, args, opts.num_workers, num_ps=opts.num_ps,
+        tensorboard=opts.tensorboard,
+        input_mode=(InputMode.SPARK if opts.input_mode == "spark"
+                    else InputMode.TENSORFLOW),
+        master_node=opts.master_node,
+        reservation_timeout=opts.reservation_timeout,
+        worker_env=worker_env or None)
+    if opts.tensorboard:
+        print(f"tensorboard: {cluster.tensorboard_url()}", flush=True)
+    cluster.shutdown(timeout=86400)
+    print("submit: job finished")
+
+
+if __name__ == "__main__":
+    main()

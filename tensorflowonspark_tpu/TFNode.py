@@ -1,0 +1,24 @@
+"""Reference-named façade: ``tensorflowonspark.TFNode`` → this module.
+
+The in-graph user API a reference ``map_fun`` imports
+(``TFNode.py::DataFeed/hdfs_path/start_cluster_server``), re-exported over
+the rebuild's implementations so user functions port without edits::
+
+    from tensorflowonspark_tpu import TFNode
+    def map_fun(args, ctx):
+        tf_feed = TFNode.DataFeed(ctx.mgr, train_mode=True)
+        path = TFNode.hdfs_path(ctx, args.model_dir)
+"""
+
+from __future__ import annotations
+
+from tensorflowonspark_tpu.datafeed import DataFeed  # noqa: F401
+from tensorflowonspark_tpu.node import start_cluster_server  # noqa: F401
+from tensorflowonspark_tpu.util import hdfs_path  # noqa: F401
+from tensorflowonspark_tpu.compat import export_saved_model  # noqa: F401
+
+
+def batch_results(mgr, results, qname: str = "output") -> None:
+    """TF1-era module-level helper (``TFNode.py::batch_results``); the
+    DataFeed method is the modern path."""
+    mgr.queue_put(qname, list(results))

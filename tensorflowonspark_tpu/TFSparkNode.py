@@ -1,0 +1,16 @@
+"""Reference-named façade: ``tensorflowonspark.TFSparkNode`` → this module.
+
+The executor-side node runtime (``TFSparkNode.py::run/train/inference``)
+lives in :mod:`~tensorflowonspark_tpu.node`; the driver-side feed closures
+the reference kept here are methods on
+:class:`~tensorflowonspark_tpu.cluster.TPUCluster` (train/inference feed
+over TCP instead of returning RDD closures).  Re-exported for import parity.
+"""
+
+from __future__ import annotations
+
+from tensorflowonspark_tpu.node import (NodeContext, run,  # noqa: F401
+                                        start_cluster_server)
+
+TFNodeContext = NodeContext  # reference class name
+TFSparkNode = NodeContext    # module-level alias some user code touches

@@ -23,7 +23,14 @@ Inside ``map_fun(args, ctx)`` the user pulls data with ``ctx.get_data_feed()``
 
 __version__ = "0.1.0"
 
-from tensorflowonspark_tpu.cluster import InputMode, TPUCluster  # noqa: F401
+from tensorflowonspark_tpu.util import apply_jax_platforms_env as _apply_env
+
+# A sitecustomize may import jax at interpreter startup, freezing the
+# platform choice before user code runs; re-apply JAX_PLATFORMS so env-var
+# platform selection keeps working for every entry point that imports us.
+_apply_env()
+
+from tensorflowonspark_tpu.cluster import InputMode, TPUCluster  # noqa: F401,E402
 from tensorflowonspark_tpu.datafeed import DataFeed  # noqa: F401
 from tensorflowonspark_tpu.node import NodeContext  # noqa: F401
 from tensorflowonspark_tpu.checkpoint import (CheckpointManager, ExportedModel,  # noqa: F401
@@ -35,5 +42,10 @@ from tensorflowonspark_tpu.pipeline import (Namespace, Pipeline,  # noqa: F401
                                             ParamGridBuilder, TFEstimator,
                                             TFModel, TrainValidationSplit)
 
-# Reference-compatible aliases (tensorflowonspark/TFCluster.py::TFCluster).
-TFCluster = TPUCluster
+# Reference-named façade modules: a reference user's
+# ``from tensorflowonspark import TFCluster, TFNode`` maps 1:1 onto
+# ``from tensorflowonspark_tpu import TFCluster, TFNode`` (module objects
+# with the reference's entry points — TFCluster.run(sc, ...),
+# TFNode.DataFeed, TFManager.start/connect, gpu_info.get_gpus, compat.*).
+from tensorflowonspark_tpu import (TFCluster, TFManager, TFNode,  # noqa: F401,E402
+                                   TFSparkNode, compat, gpu_info)

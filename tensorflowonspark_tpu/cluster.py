@@ -30,6 +30,7 @@ error re-raise on shutdown, and ``tensorboard_url``.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import logging
 import multiprocessing as mp
@@ -63,16 +64,7 @@ def _worker_entry(executor_id: int, env: dict, fn, tf_args, cluster_meta: dict,
     task process executes ``TFSparkNode._mapfn``.
     """
     os.environ.update({k: str(v) for k, v in env.items()})
-    if "JAX_PLATFORMS" in env:
-        # A sitecustomize may import jax at interpreter startup (e.g. to
-        # register a PJRT plugin), freezing the platform choice before this
-        # function runs; the config update wins over the frozen env read.
-        try:
-            import jax
-
-            jax.config.update("jax_platforms", str(env["JAX_PLATFORMS"]))
-        except ImportError:
-            pass
+    util.apply_jax_platforms_env()
     import logging as _logging
 
     _logging.basicConfig(level=_logging.INFO,
@@ -145,7 +137,8 @@ class TPUCluster:
             driver_ps_nodes: bool = False, reservation_timeout: float = 600.0,
             queues=DEFAULT_QUEUES, backend=None, worker_env: dict | None = None,
             working_dir: str | None = None, queue_depth: int = 64,
-            default_fs: str = "") -> "TPUCluster":
+            default_fs: str = "",
+            tensorboard_logdir: str | None = None) -> "TPUCluster":
         """Boot the cluster and block until every node has registered.
 
         Mirrors ``TFCluster.py::run``'s signature and behavior: build the
@@ -161,6 +154,9 @@ class TPUCluster:
         logger.info("cluster template: %s", cluster_template)
 
         working_dir = working_dir or tempfile.mkdtemp(prefix="tfos_tpu_")
+        for i in range(num_workers):  # stale crash files from a reused dir
+            with contextlib.suppress(OSError):
+                os.remove(os.path.join(working_dir, f"error.{i}"))
         authkey = secrets.token_bytes(16)
         server = Server(num_workers, authkey=authkey)
         server_addr = server.start()
@@ -177,6 +173,7 @@ class TPUCluster:
             "queue_depth": queue_depth,
             "reservation_timeout": reservation_timeout,
             "tensorboard": tensorboard,
+            "tensorboard_logdir": tensorboard_logdir,
         }
 
         backend = backend or LocalProcessBackend(worker_env=worker_env)
@@ -191,6 +188,7 @@ class TPUCluster:
                 timeout=reservation_timeout, status=status)
         except Exception:
             backend.terminate()
+            _kill_registered_tensorboards(server.reservations.get())
             server.stop()
             _raise_worker_errors(working_dir, num_workers)
             raise
@@ -231,15 +229,20 @@ class TPUCluster:
         partitions = _partition(data, num_partitions or len(nodes))
 
         epoch_iter = itertools.count() if num_epochs == 0 else range(num_epochs)
-        for epoch in epoch_iter:
-            for pidx, part in enumerate(partitions):
-                target = nodes[pidx % len(nodes)]
-                client = self._client_for(target["executor_id"])
-                if client.kv_get("state") == "terminating":
-                    logger.info("feed: node requested termination; stopping")
-                    return
-                _feed_partition(client, part, qname, chunk_size, feed_timeout)
-            logger.info("feed: epoch %d delivered", epoch)
+        try:
+            for epoch in epoch_iter:
+                for pidx, part in enumerate(partitions):
+                    target = nodes[pidx % len(nodes)]
+                    client = self._client_for(target["executor_id"])
+                    if client.kv_get("state") == "terminating":
+                        logger.info("feed: node requested termination; stopping")
+                        return
+                    _feed_partition(client, part, qname, chunk_size, feed_timeout)
+                logger.info("feed: epoch %d delivered", epoch)
+        except (ConnectionError, EOFError, OSError) as e:
+            if isinstance(e, TimeoutError):  # a full queue, not a dead worker
+                raise
+            self._reraise_worker_error(e)
 
     def inference(self, data, qname: str = "input", qname_out: str = "output",
                   feed_timeout: float = 600.0, chunk_size: int = 256) -> list:
@@ -303,11 +306,32 @@ class TPUCluster:
         for t in threads:
             t.join()
         if errors:
-            raise errors[0]
+            e = errors[0]
+            if (isinstance(e, (ConnectionError, EOFError, OSError))
+                    and not isinstance(e, TimeoutError)):
+                self._reraise_worker_error(e)
+            raise e
         out: list = []
         for _, got in sorted(results, key=lambda r: r[0]):
             out.extend(got)
         return out
+
+    def _reraise_worker_error(self, exc: BaseException) -> None:
+        """A feeder-side socket failure usually means the worker died; prefer
+        its traceback over the raw connection error (reference: the feed
+        closure's failure is superseded by the ``'error'``-queue content).
+        Polls briefly because the crash file is written by the dying worker
+        concurrently with the connection reset."""
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                _raise_worker_errors(self.working_dir,
+                                     self.cluster_meta["num_workers"])
+            except Exception as worker_err:
+                raise worker_err from exc
+            if time.monotonic() >= deadline:
+                raise exc
+            time.sleep(0.25)
 
     # ------------------------------------------------------------ shutdown
     def shutdown(self, grace_secs: float = 0.0, timeout: float = 259200.0) -> None:
@@ -334,6 +358,9 @@ class TPUCluster:
         if not finished:
             logger.warning("workers still alive after %.0fs; terminating", timeout)
             self.backend.terminate()
+            # SIGTERMed workers never run their finally block, and their
+            # TensorBoard child lives in its own session — kill it from here
+            _kill_registered_tensorboards(self.cluster_info)
         for c in self._clients.values():
             c.close()
         self.server.stop()
@@ -343,13 +370,29 @@ class TPUCluster:
 
     def tensorboard_url(self) -> str | None:
         """Reference: ``TFCluster.py::tensorboard_url``."""
-        for n in self.cluster_info:
-            if n.get("tb_port"):
-                return f"http://{n['host']}:{n['tb_port']}"
-        return None
+        from tensorflowonspark_tpu import observability
+
+        return observability.tensorboard_url(self.cluster_info)
 
 
 # -- helpers ---------------------------------------------------------------
+
+def _kill_registered_tensorboards(cluster_info) -> None:
+    """Kill TensorBoards via the reservation's ``tb_pid`` (reference parity:
+    ``TFCluster.py::shutdown`` kills TB from the driver).  Needed when a
+    worker is terminated: SIGTERM skips its ``finally`` and the TB child is
+    in its own session.  Only pids registered by nodes on *this* host are
+    touched — a remote node's pid is meaningless here."""
+    import signal
+
+    from tensorflowonspark_tpu.reservation import get_ip_address
+
+    local_hosts = {"127.0.0.1", "localhost", get_ip_address()}
+    for n in cluster_info or []:
+        if n.get("tb_pid") and n.get("host") in local_hosts:
+            with contextlib.suppress(OSError):
+                os.kill(n["tb_pid"], signal.SIGTERM)
+
 
 def _build_cluster_template(num_workers: int, num_ps: int,
                             master_node: str | None, eval_node: bool) -> dict:

@@ -1,0 +1,50 @@
+"""TF-version compatibility shims, reinterpreted for the TPU stack.
+
+Equivalent of the reference's ``tensorflowonspark/compat.py`` (~60 LoC),
+which papered over TF 2.x API churn with ``export_saved_model``,
+``disable_auto_shard`` and ``is_gpu_available``.  The rebuild keeps the same
+three names so reference-era user code imports cleanly, mapping each to its
+TPU-native meaning.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def export_saved_model(model, export_dir: str, is_chief: bool = False):
+    """Reference: ``compat.py::export_saved_model(model, dir, is_chief)``.
+
+    ``model`` here is either a ``(fn, params, example_inputs)`` triple or a
+    dict with those keys; delegates to :func:`checkpoint.export_model`
+    (StableHLO export, the SavedModel equivalent).  Chief-only, like the
+    reference.
+    """
+    from tensorflowonspark_tpu.checkpoint import export_model
+
+    if isinstance(model, dict):
+        fn, params, inputs = model["fn"], model["params"], model["example_inputs"]
+    else:
+        fn, params, inputs = model
+    return export_model(export_dir, fn, params, inputs, is_chief=is_chief)
+
+
+def disable_auto_shard(options) -> None:
+    """Reference: ``compat.py::disable_auto_shard(options)`` — turned off
+    tf.data auto-sharding under MultiWorkerMirrored.  SPMD JAX input
+    pipelines shard explicitly (``ctx.executor_id`` / ``shard_batch``), so
+    there is nothing to disable; kept as a no-op for source compatibility."""
+    logger.debug("disable_auto_shard: no-op on the TPU stack")
+
+
+def is_gpu_available() -> bool:
+    """Reference: ``compat.py::is_gpu_available()``.  Interpreted as "is an
+    accelerator available" — true for TPU or GPU backends."""
+    import jax
+
+    try:
+        return jax.devices()[0].platform != "cpu"
+    except RuntimeError:
+        return False

@@ -1,0 +1,9 @@
+"""Reference-named façade: ``tensorflowonspark.gpu_info`` → this module.
+
+``gpu_info.py::get_gpus`` picked free GPUs via ``nvidia-smi``; on TPU the
+host's chips belong to one process and JAX enumerates them, so the shim in
+:mod:`~tensorflowonspark_tpu.device_info` returns local device ids instead.
+"""
+
+from tensorflowonspark_tpu.device_info import (MAX_RETRIES, get_gpus,  # noqa: F401
+                                               num_local_devices)

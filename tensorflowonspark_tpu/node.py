@@ -50,7 +50,8 @@ class NodeContext:
 
     def __init__(self, executor_id: int, job_name: str, task_index: int,
                  cluster_info: list[dict], default_fs: str = "",
-                 working_dir: str | None = None, mgr: QueueServer | None = None):
+                 working_dir: str | None = None, mgr: QueueServer | None = None,
+                 tensorboard_logdir: str | None = None):
         self.executor_id = self.worker_num = executor_id
         self.job_name = job_name
         self.task_index = task_index
@@ -59,6 +60,8 @@ class NodeContext:
         self.working_dir = working_dir or os.getcwd()
         self.mgr = mgr
         self.num_workers = len(cluster_info)
+        self.tensorboard_logdir = tensorboard_logdir or os.path.join(
+            self.working_dir, "tensorboard")
 
     # -- cluster spec ------------------------------------------------------
     @property
@@ -131,6 +134,22 @@ class NodeContext:
         """The reference's ``TFNode.hdfs_path(ctx, path)``."""
         return util.hdfs_path(self, path)
 
+    def tensorboard_url(self) -> str | None:
+        """URL of the cluster's TensorBoard, if one was spawned
+        (reference: ``TFCluster.tensorboard_url`` — same data, node side)."""
+        from tensorflowonspark_tpu import observability
+
+        return observability.tensorboard_url(self.cluster_info)
+
+    def profile_trace(self, logdir: str | None = None):
+        """Profiler trace context for a block of this node's training
+        (``jax.profiler.trace`` into the cluster's tensorboard logdir by
+        default, so the spawned TensorBoard's profile plugin sees it)."""
+        from tensorflowonspark_tpu import observability
+
+        logdir = logdir or self.tensorboard_logdir
+        return observability.profile_trace(logdir)
+
     def export_dir(self, subdir: str = "export") -> str:
         return self.absolute_path(subdir)
 
@@ -164,6 +183,7 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
             crash_file = os.path.join(cluster_meta["working_dir"], f"error.{executor_id}")
         mgr = None
         client = None
+        tb_proc = None
         try:
             job_name, task_index = _role_for(cluster_meta["cluster_template"], executor_id)
             host = get_ip_address()
@@ -180,6 +200,25 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
             port = util.get_free_port()
             coordinator_port = util.get_free_port()
 
+            # 2b. tensorboard on the chief-designate, like the reference's
+            #     worker:0/chief spawn in TFSparkNode.py::run; (tb_pid,
+            #     tb_port) travel in the reservation → tensorboard_url().
+            tb_proc, tb_port = None, 0
+            chief_designate = job_name in ("chief", "master") or (
+                job_name == "worker" and task_index == 0
+                and not any(j in ("chief", "master")
+                            for j in cluster_meta["cluster_template"]))
+            if cluster_meta.get("tensorboard") and chief_designate:
+                from tensorflowonspark_tpu import observability
+
+                logdir = cluster_meta.get("tensorboard_logdir") or os.path.join(
+                    cluster_meta.get("working_dir") or os.getcwd(), "tensorboard")
+                # wait_secs>0: don't broadcast a tb_port for a process that
+                # died at boot (port collision etc.) — the URL must work
+                tb = observability.start_tensorboard(logdir, wait_secs=2.0)
+                if tb is not None:
+                    tb_proc, tb_port = tb
+
             # 3. rendezvous
             client = Client(cluster_meta["server_addr"],
                             timeout=cluster_meta.get("reservation_timeout", 600),
@@ -193,6 +232,11 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
                 "coordinator_port": coordinator_port,
                 "addr": addr,
                 "authkey": cluster_meta["authkey"],
+                # the owning node stops TB in its finally; the driver also
+                # kills via tb_pid when it terminates workers (reference:
+                # TFCluster.py::shutdown kills TB from the driver).
+                "tb_pid": tb_proc.pid if tb_proc else 0,
+                "tb_port": tb_port,
             })
             cluster_info = client.await_reservations()
 
@@ -200,7 +244,8 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
             ctx = NodeContext(executor_id, job_name, task_index, cluster_info,
                               default_fs=cluster_meta.get("default_fs", ""),
                               working_dir=cluster_meta.get("working_dir"),
-                              mgr=mgr)
+                              mgr=mgr,
+                              tensorboard_logdir=cluster_meta.get("tensorboard_logdir"))
             env = ctx.distributed_env()
             os.environ["TFOS_COORDINATOR"] = env["coordinator_address"]
             os.environ["TFOS_NUM_PROCESSES"] = str(env["num_processes"])
@@ -227,6 +272,10 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
                     pass
             raise
         finally:
+            if tb_proc is not None:
+                from tensorflowonspark_tpu import observability
+
+                observability.stop_tensorboard(tb_proc)
             if client is not None:
                 client.close()
 

@@ -1,0 +1,192 @@
+"""Observability: TensorBoard, the JAX profiler, and goodput accounting.
+
+The reference's entire observability story (SURVEY.md §5) is: spawn a
+``tensorboard`` subprocess on one node when ``tensorboard=True``
+(``TFSparkNode.py::run``), register ``(tb_pid, tb_port)`` in the
+reservation, surface it via ``TFCluster.tensorboard_url()``, and leave
+profiling to whatever the user's TF callbacks emit.  This module keeps that
+surface and adds the TPU-era equivalents:
+
+- :func:`start_tensorboard` — the subprocess spawn (module-invoked, so no
+  PATH dependency), returning ``(proc, port)``; the reservation carries
+  ``(tb_pid, tb_port)``;
+- :func:`start_profiler_server` / :func:`profile_trace` — ``jax.profiler``
+  wiring (xprof traces viewable in TensorBoard's profile plugin, the
+  TPU-native replacement for tf.profiler callbacks);
+- :class:`GoodputRecorder` — badput accounting in the spirit of
+  ``ml-goodput-measurement``: wall time split into productive step time vs
+  init/compile/checkpoint/idle, because on large TPU fleets *goodput* (not
+  step speed) is the capacity metric.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+from collections import defaultdict
+
+from tensorflowonspark_tpu import util
+
+logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------- tensorboard
+
+def start_tensorboard(logdir: str, port: int | None = None,
+                      wait_secs: float = 0.0):
+    """Spawn TensorBoard on ``logdir``; returns ``(proc, port)`` or ``None``.
+
+    Reference: the ``tensorboard`` subprocess spawned for worker:0/chief in
+    ``TFSparkNode.py::run``.  Spawned as ``python -m tensorboard.main`` so it
+    works without a console-script on PATH; returns None (never raises) when
+    tensorboard isn't importable — observability must not kill training.
+    """
+    try:
+        import tensorboard  # noqa: F401 — availability probe
+    except ImportError:
+        logger.warning("tensorboard=True but tensorboard is not installed")
+        return None
+    port = port or util.get_free_port()
+    os.makedirs(logdir, exist_ok=True)
+    env = os.environ.copy()
+    try:
+        import pkg_resources  # noqa: F401 — removed in setuptools>=81
+    except ImportError:
+        shim = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_shims")
+        env["PYTHONPATH"] = shim + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tensorboard.main", "--logdir", logdir,
+             "--port", str(port), "--host", "0.0.0.0", "--load_fast", "false"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=env, start_new_session=True)
+    except OSError as e:
+        logger.warning("could not spawn tensorboard: %s", e)
+        return None
+    if wait_secs:
+        time.sleep(wait_secs)
+        if proc.poll() is not None:
+            logger.warning("tensorboard exited immediately (code %s)",
+                           proc.returncode)
+            return None
+    logger.info("tensorboard pid %d serving %s on port %d",
+                proc.pid, logdir, port)
+    return proc, port
+
+
+def stop_tensorboard(proc) -> None:
+    if proc is None:
+        return
+    with contextlib.suppress(OSError):
+        proc.terminate()
+        try:
+            proc.wait(5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(5)  # reap — a kill without wait leaves a zombie
+
+
+def tensorboard_url(cluster_info) -> str | None:
+    """URL of the cluster's TensorBoard from the reservation records
+    (``tb_port`` registered by the chief-designate node)."""
+    for n in cluster_info:
+        if n.get("tb_port"):
+            return f"http://{n['host']}:{n['tb_port']}"
+    return None
+
+
+# ---------------------------------------------------------------- profiler
+
+def start_profiler_server(port: int | None = None) -> int:
+    """Start the in-process profiler RPC server (``jax.profiler``); a
+    TensorBoard profile plugin (or ``xprof``) can then capture live traces
+    from ``host:port``."""
+    import jax
+
+    port = port or util.get_free_port()
+    jax.profiler.start_server(port)
+    logger.info("jax profiler server on port %d", port)
+    return port
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str):
+    """Trace the enclosed block into ``logdir`` (viewable in TensorBoard →
+    Profile).  The reference had no in-framework tracer; this is the
+    one-liner the TPU stack makes possible."""
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    with jax.profiler.trace(logdir):
+        yield
+
+
+def annotate(name: str):
+    """Named sub-trace for the profiler timeline (``TraceAnnotation``)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+# ----------------------------------------------------------------- goodput
+
+class GoodputRecorder:
+    """Wall-clock accounting: productive step time vs everything else.
+
+    Categories follow the badput taxonomy: ``init`` (bootstrap + compile),
+    ``checkpoint`` (save/restore stalls), ``data`` (feed waits), ``step``
+    (productive compute).  Unattributed wall time counts as ``idle``.
+
+        rec = GoodputRecorder()
+        with rec.time("init"): state = make_state()
+        while ...:
+            with rec.time("data"): batch = feed.next_batch(...)
+            with rec.time("step"): state, _ = train_step(state, batch)
+        rec.summary()  # {'goodput': 0.87, 'wall_secs': ..., 'secs': {...}}
+    """
+
+    PRODUCTIVE = ("step",)
+
+    def __init__(self):
+        self._t0 = time.monotonic()
+        self._secs: dict[str, float] = defaultdict(float)
+        self._counts: dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def time(self, category: str):
+        start = time.monotonic()
+        try:
+            yield
+        finally:
+            self._secs[category] += time.monotonic() - start
+            self._counts[category] += 1
+
+    def record(self, category: str, secs: float) -> None:
+        self._secs[category] += secs
+        self._counts[category] += 1
+
+    def summary(self) -> dict:
+        wall = time.monotonic() - self._t0
+        attributed = sum(self._secs.values())
+        secs = dict(self._secs)
+        secs["idle"] = max(0.0, wall - attributed)
+        productive = sum(self._secs[c] for c in self.PRODUCTIVE)
+        return {
+            "wall_secs": wall,
+            "goodput": productive / wall if wall > 0 else 0.0,
+            "secs": secs,
+            "counts": dict(self._counts),
+        }
+
+    def write(self, path: str) -> dict:
+        """Write the summary as one JSON file (per-host goodput roll-up)."""
+        s = self.summary()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(s, f, indent=2)
+        return s

@@ -9,9 +9,27 @@ from __future__ import annotations
 
 import os
 import socket
+import sys
 import logging
 
 logger = logging.getLogger(__name__)
+
+
+def apply_jax_platforms_env() -> None:
+    """Re-apply ``JAX_PLATFORMS`` when a sitecustomize imported jax at
+    interpreter startup (e.g. to register a PJRT plugin), freezing the
+    platform choice before user code ran.  No-op when jax was never imported
+    — the env var is then honored naturally on first import — so calling
+    this never *causes* a jax import."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms or "jax" not in sys.modules:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+    except Exception:  # pragma: no cover - config frozen past backend init
+        pass
 
 
 def single_node_env(num_devices: int | None = None, platform: str | None = None) -> None:

@@ -29,7 +29,9 @@ def main() -> None:
     import optax
 
     from tensorflowonspark_tpu.models import ResNet50
+    from tensorflowonspark_tpu.util import apply_jax_platforms_env
 
+    apply_jax_platforms_env()
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
     # Keep CPU fallback fast enough to finish; real runs use the TPU chip.
@@ -119,5 +121,39 @@ def main() -> None:
     }))
 
 
+def _run_with_watchdog() -> int:
+    """Re-exec the benchmark as a watchdogged subprocess.
+
+    The accelerator connection can wedge at any point (client create,
+    compile, transfer) in a way that blocks in C and cannot be interrupted
+    in-process; a benchmark that hangs produces no number at all.  So: try
+    the default backend under a hard timeout, and on hang/failure retry
+    pinned to CPU so the driver always gets its one JSON line.
+    """
+    import subprocess
+
+    for attempt, extra_env in (("default", {}), ("cpu", {"JAX_PLATFORMS": "cpu"})):
+        env = {**os.environ, _CHILD_ENV: "1", **extra_env}
+        try:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               timeout=600, env=env, stdout=subprocess.PIPE)
+        except subprocess.TimeoutExpired:
+            log(f"bench: {attempt}-backend attempt hung (>600s); "
+                "retrying pinned to CPU")
+            continue
+        if r.returncode == 0 and r.stdout.strip():
+            sys.stdout.buffer.write(r.stdout)
+            return 0
+        log(f"bench: {attempt}-backend attempt failed (rc={r.returncode})")
+    return 1
+
+
+_CHILD_ENV = "TFOS_BENCH_CHILD"
+
 if __name__ == "__main__":
-    main()
+    # With an explicit platform (or as the watchdog's child) run directly;
+    # otherwise supervise a child so a wedged accelerator can't hang us.
+    if os.environ.get(_CHILD_ENV) or os.environ.get("JAX_PLATFORMS"):
+        main()
+    else:
+        sys.exit(_run_with_watchdog())

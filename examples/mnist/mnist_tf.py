@@ -22,17 +22,21 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _local_shard(args, ctx):
-    """This worker's (images, labels) shard — the host-local loader."""
+    """This worker's (images, labels) shard — the host-local loader,
+    streamed through ``data.Dataset`` (the tf.data-equivalent pipeline)."""
     import numpy as np
 
-    if args.data_dir:
-        from tensorflowonspark_tpu import dfutil
+    from tensorflowonspark_tpu.data import Dataset
 
-        df = dfutil.loadTFRecords(args.data_dir)
-        rows = df.collect()[ctx.executor_id::ctx.num_workers]
-        images = np.stack([np.asarray(r.image, np.float32).reshape(28, 28)
-                           for r in rows])
-        labels = np.asarray([int(r.label) for r in rows])
+    if args.data_dir:
+        ds = (Dataset.from_examples(os.path.join(args.data_dir, "part-*"),
+                                    shard=(ctx.num_workers, ctx.executor_id))
+              .map(lambda d: (np.asarray(d["image"], np.float32).reshape(28, 28),
+                              np.int64(d["label"])),
+                   num_parallel=4))
+        pairs = ds.as_numpy()
+        images = np.stack([p[0] for p in pairs])
+        labels = np.asarray([p[1] for p in pairs])
         return images, labels
     rng = np.random.default_rng(1234 + ctx.executor_id)
     n = args.num_samples // ctx.num_workers

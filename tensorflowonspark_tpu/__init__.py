@@ -37,6 +37,7 @@ from tensorflowonspark_tpu.checkpoint import (CheckpointManager, ExportedModel, 
                                               export_model, restore_checkpoint,
                                               save_checkpoint)
 
+from tensorflowonspark_tpu.data import Dataset, device_prefetch  # noqa: F401
 from tensorflowonspark_tpu.dataframe import DataFrame, Row  # noqa: F401
 from tensorflowonspark_tpu.pipeline import (Namespace, Pipeline,  # noqa: F401
                                             ParamGridBuilder, TFEstimator,

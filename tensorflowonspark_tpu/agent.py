@@ -1,0 +1,347 @@
+"""Multi-host worker backend: one host agent per TPU-VM host.
+
+The reference gets its multi-host muscle from Spark: YARN/Standalone place
+one executor JVM per machine and ``sc.parallelize(...).foreachPartition``
+fans the node bootstrap out to them (``TFCluster.py::run``).  Without Spark,
+this module is that muscle (SURVEY.md §2b: "own driver/host-agent runtime
+... mapping 'executors' 1:1 to TPU-VM hosts; this is the largest
+from-scratch piece"):
+
+- :class:`HostAgent` — a daemon started once per host (``python -m
+  tensorflowonspark_tpu.agent --port 9999 --authkey-hex ...``).  It accepts
+  authenticated driver connections and launches/monitors/terminates worker
+  processes on its host.  Each worker runs the same node harness
+  (``cluster._worker_entry`` → ``node.run``) a local worker would.
+- :class:`AgentBackend` — the driver-side counterpart, a drop-in for
+  ``LocalProcessBackend``:
+
+      backend = AgentBackend([("host-a", 9999), ("host-b", 9999)],
+                             authkey=key)
+      cluster = TPUCluster.run(map_fun, args, num_workers=2, backend=backend)
+
+Executor ids are assigned round-robin over agents, so ``num_workers ==
+len(agents)`` gives the reference's one-executor-per-host shape, and
+``num_workers == n * len(agents)`` oversubscribes evenly (multiple Spark
+executors per machine).
+
+Wire protocol: the rendezvous framing (``reservation.MessageSocket``,
+4-byte length + pickle) with the same raw-frame authkey hello before any
+unpickling, then ``LAUNCH`` / ``STATUS`` / ``TERMINATE`` / ``PING`` /
+``STOP`` request-response messages.  The user ``map_fun`` travels pickled
+inside ``LAUNCH`` — like the reference, functions must be importable
+top-level callables on the worker side.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hmac
+import logging
+import multiprocessing as mp
+import os
+import pickle
+import select
+import socket
+import threading
+import time
+
+from tensorflowonspark_tpu.reservation import MessageSocket, get_ip_address
+
+logger = logging.getLogger(__name__)
+
+AUTHKEY_ENV = "TFOS_AGENT_AUTHKEY"  # hex-encoded pre-shared key
+
+
+class HostAgent(MessageSocket):
+    """Per-host worker launcher (the Spark-executor stand-in)."""
+
+    def __init__(self, port: int = 0, authkey: bytes | None = None,
+                 max_workers: int = 64):
+        self.port = port
+        self.authkey = authkey
+        self.max_workers = max_workers
+        self.done = threading.Event()
+        self._listener: socket.socket | None = None
+        self._procs: dict[int, mp.Process] = {}
+        self._lock = threading.Lock()
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> tuple[str, int]:
+        """Bind and serve in a background thread; returns ``(host, port)``."""
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", self.port))
+        self._listener.listen(16)
+        port = self._listener.getsockname()[1]
+        self.addr = (get_ip_address(), port)
+        t = threading.Thread(target=self._serve, name="host-agent", daemon=True)
+        t.start()
+        logger.info("host agent listening at %s", self.addr)
+        return self.addr
+
+    def serve_forever(self) -> None:
+        """Foreground variant for the CLI entry point."""
+        if self._listener is None:
+            self.start()
+        self.done.wait()
+
+    def stop(self) -> None:
+        self.done.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        self._terminate_workers()
+
+    # -------------------------------------------------------------- server
+    def _serve(self) -> None:
+        conns = [self._listener]
+        authed: set = set()
+        while not self.done.is_set():
+            try:
+                readable, _, _ = select.select(conns, [], [], 0.5)
+            except (OSError, ValueError):
+                break
+            for sock in readable:
+                if sock is self._listener:
+                    try:
+                        client, _ = self._listener.accept()
+                        conns.append(client)
+                    except OSError:
+                        break
+                elif self.authkey is not None and sock not in authed:
+                    # raw-frame hello first: never unpickle unauthenticated
+                    # bytes (same posture as reservation.Server._serve)
+                    try:
+                        hello = self.receive_raw(sock)
+                        if not hmac.compare_digest(hello, self.authkey):
+                            raise PermissionError("bad authkey")
+                        authed.add(sock)
+                        self.send(sock, "OK")
+                    except (EOFError, OSError, ValueError, PermissionError):
+                        sock.close()
+                        conns.remove(sock)
+                else:
+                    try:
+                        msg = self.receive(sock)
+                        self._handle(sock, msg)
+                    except (EOFError, OSError, pickle.PickleError):
+                        sock.close()
+                        conns.remove(sock)
+                        authed.discard(sock)
+        for sock in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _handle(self, sock: socket.socket, msg: dict) -> None:
+        kind = msg.get("type")
+        try:
+            if kind == "PING":
+                self.send(sock, {"ok": True, "host": self.addr[0],
+                                 "workers": sorted(self._procs)})
+            elif kind == "LAUNCH":
+                self._launch(msg)
+                self.send(sock, "OK")
+            elif kind == "STATUS":
+                self.send(sock, self._status())
+            elif kind == "TERMINATE":
+                self._terminate_workers()
+                self.send(sock, "OK")
+            elif kind == "STOP":
+                self.send(sock, "OK")
+                self.done.set()
+            else:
+                self.send(sock, ("ERR", f"unknown message type {kind!r}"))
+        except Exception as e:  # reply instead of killing the serve loop
+            logger.exception("agent: %s failed", kind)
+            try:
+                self.send(sock, ("ERR", f"{type(e).__name__}: {e}"))
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- workers
+    def _launch(self, msg: dict) -> None:
+        from tensorflowonspark_tpu.cluster import _worker_entry
+
+        executor_id = int(msg["executor_id"])
+        with self._lock:
+            old = self._procs.get(executor_id)
+            if old is not None and old.is_alive():
+                raise RuntimeError(f"executor {executor_id} already running")
+            if len(self._procs) >= self.max_workers:
+                raise RuntimeError(f"agent at max_workers={self.max_workers}")
+            ctx = mp.get_context("spawn")  # fork is unsafe after jax/XLA init
+            p = ctx.Process(
+                target=_worker_entry,
+                args=(executor_id, dict(msg.get("env") or {}), msg["fn"],
+                      msg["tf_args"], msg["cluster_meta"], msg["queues"]),
+                name=f"tfos-node-{executor_id}", daemon=False)
+            p.start()
+            self._procs[executor_id] = p
+        logger.info("agent: launched executor %d (pid %d)", executor_id, p.pid)
+
+    def _status(self) -> dict[int, dict]:
+        with self._lock:
+            return {eid: {"alive": p.is_alive(), "exitcode": p.exitcode}
+                    for eid, p in self._procs.items()}
+
+    def _terminate_workers(self) -> None:
+        with self._lock:
+            procs = list(self._procs.values())
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        for p in procs:
+            p.join(5)
+
+
+class _AgentConn(MessageSocket):
+    """One authenticated driver→agent connection (request-response)."""
+
+    def __init__(self, addr: tuple[str, int], authkey: bytes | None,
+                 timeout: float = 30.0):
+        self.addr = tuple(addr)
+        self._sock = socket.create_connection(self.addr, timeout=timeout)
+        self._sock.settimeout(timeout)
+        self._lock = threading.Lock()
+        if authkey is not None:
+            self.send_raw(self._sock, authkey)
+            if self.receive(self._sock) != "OK":
+                raise PermissionError(f"agent {self.addr} rejected authkey")
+
+    def request(self, msg: dict):
+        with self._lock:
+            self.send(self._sock, msg)
+            resp = self.receive(self._sock)
+        if isinstance(resp, tuple) and resp and resp[0] == "ERR":
+            raise RuntimeError(f"agent {self.addr}: {resp[1]}")
+        return resp
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class AgentBackend:
+    """Driver-side backend running workers on remote :class:`HostAgent` s.
+
+    Drop-in for ``LocalProcessBackend`` (same ``start/alive/failed/join/
+    terminate`` surface consumed by ``TPUCluster``); executor ids are
+    assigned round-robin over ``agents``.
+    """
+
+    def __init__(self, agents: list[tuple[str, int]],
+                 authkey: bytes | None = None,
+                 worker_env: dict | None = None, connect_timeout: float = 30.0):
+        assert agents, "need at least one agent address"
+        self.agent_addrs = [tuple(a) for a in agents]
+        self.authkey = authkey
+        self.worker_env = worker_env or {}
+        self.connect_timeout = connect_timeout
+        self._conns: list[_AgentConn] = []
+        self._assignment: dict[int, _AgentConn] = {}
+
+    def start(self, num_workers: int, fn, tf_args, cluster_meta: dict,
+              queues) -> None:
+        self._conns = [_AgentConn(a, self.authkey, self.connect_timeout)
+                       for a in self.agent_addrs]
+        for i in range(num_workers):
+            conn = self._conns[i % len(self._conns)]
+            conn.request({
+                "type": "LAUNCH", "executor_id": i, "env": self.worker_env,
+                "fn": fn, "tf_args": tf_args, "cluster_meta": cluster_meta,
+                "queues": queues,
+            })
+            self._assignment[i] = conn
+
+    def _statuses(self) -> dict[int, dict]:
+        merged: dict[int, dict] = {}
+        for conn in self._conns:
+            try:
+                merged.update(conn.request({"type": "STATUS"}))
+            except (OSError, EOFError, RuntimeError):
+                # an unreachable agent counts its workers as failed
+                for eid, c in self._assignment.items():
+                    if c is conn:
+                        merged[eid] = {"alive": False, "exitcode": -1}
+        return merged
+
+    def alive(self) -> list[bool]:
+        st = self._statuses()
+        return [st.get(i, {}).get("alive", False)
+                for i in sorted(self._assignment)]
+
+    def failed(self) -> list[int]:
+        st = self._statuses()
+        return [i for i in sorted(self._assignment)
+                if not st.get(i, {}).get("alive", False)
+                and st.get(i, {}).get("exitcode") not in (0, None)]
+
+    def join(self, timeout: float | None = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if not any(self.alive()):
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.5)
+
+    def terminate(self) -> None:
+        for conn in self._conns:
+            try:
+                conn.request({"type": "TERMINATE"})
+            except (OSError, EOFError, RuntimeError):
+                pass
+
+    def close(self, stop_agents: bool = False) -> None:
+        """Drop connections; with ``stop_agents`` also shut the daemons down
+        (tests / single-job fleets — production agents outlive jobs)."""
+        for conn in self._conns:
+            if stop_agents:
+                try:
+                    conn.request({"type": "STOP"})
+                except (OSError, EOFError, RuntimeError):
+                    pass
+            conn.close()
+        self._conns = []
+
+
+# ------------------------------------------------------------------- CLI
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(
+        description="tensorflowonspark_tpu host agent (one per TPU-VM host)")
+    p.add_argument("--port", type=int, default=9999,
+                   help="listen port (0 = ephemeral, printed on stdout)")
+    p.add_argument("--authkey-hex", default=None,
+                   help=f"pre-shared key (hex); default ${AUTHKEY_ENV}")
+    p.add_argument("--max-workers", type=int, default=64)
+    args = p.parse_args(argv)
+
+    key_hex = args.authkey_hex or os.environ.get(AUTHKEY_ENV)
+    authkey = bytes.fromhex(key_hex) if key_hex else None
+    if authkey is None:
+        logger.warning("host agent running WITHOUT an authkey — anyone who "
+                       "can reach the port can run code as this user; pass "
+                       f"--authkey-hex or set ${AUTHKEY_ENV}")
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s [agent] %(message)s")
+    agent = HostAgent(port=args.port, authkey=authkey,
+                      max_workers=args.max_workers)
+    host, port = agent.start()
+    # machine-readable line for launchers that scrape the address
+    print(f"AGENT {host}:{port}", flush=True)
+    try:
+        agent.serve_forever()
+    except KeyboardInterrupt:
+        agent.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -11,9 +11,10 @@ backends (SURVEY.md §2b "largest from-scratch piece"):
   reference's ``local-cluster[N,...]`` pattern, SURVEY.md §4) and the
   correct shape for a single TPU host, where all chips belong to one
   process.
-- an agent backend for multi-host pods (one host-agent per TPU-VM host
-  connecting to the driver's agent port) plugs in through the same
-  ``backend=`` parameter; see ``agent.py`` once present.
+- :class:`~tensorflowonspark_tpu.agent.AgentBackend` — multi-host pods:
+  one :class:`~tensorflowonspark_tpu.agent.HostAgent` daemon per TPU-VM
+  host launches/monitors the workers; plugs in through the same
+  ``backend=`` parameter.
 
 The user-facing contract matches the reference exactly:
 

@@ -1,0 +1,335 @@
+"""Host-local input pipelines: the ``InputMode.TENSORFLOW`` data layer.
+
+The reference's TENSORFLOW input mode has each worker build its own
+``tf.data`` pipeline over its shard of HDFS/GCS TFRecords
+(``examples/mnist/keras/mnist_tf.py``: ``Dataset.shard(num_workers,
+worker_num).map(parse).shuffle(...).batch(...)``); the framework itself
+ships no reader and leans on tf.data + the tensorflow-hadoop connector
+(SURVEY.md §2b).  The TPU rebuild owes a functional equivalent with no TF
+dependency — this module is it:
+
+- :class:`Dataset` — a lazily-evaluated, composable pipeline
+  (``from_tfrecords`` / ``from_examples`` / ``from_tensor_slices`` /
+  ``from_generator`` sources; ``shard``, ``map``, ``filter``, ``shuffle``,
+  ``repeat``, ``batch``, ``prefetch``, ``take``, ``skip`` transforms).
+  Iterating re-runs the pipeline from the source, so ``repeat`` +
+  re-iteration behave like tf.data.
+- :func:`device_prefetch` — wraps any iterator in a depth-``k`` buffer of
+  ``jax.device_put`` transfers so host→HBM copies overlap the previous
+  step's compute (the double-buffered infeed, SURVEY.md §7 step 3).
+
+Typical worker usage::
+
+    def map_fun(args, ctx):
+        ds = (Dataset.from_tfrecords(args.data_dir + "/part-*")
+                .shard(ctx.num_workers, ctx.executor_id)
+                .map(parse_example_fn)
+                .shuffle(10_000, seed=ctx.executor_id)
+                .batch(args.batch_size, drop_remainder=True)
+                .prefetch(4))
+        for batch in device_prefetch(iter(ds), sharding=data_sharding):
+            state, loss = train_step(state, batch)
+
+Threading model: ``map(num_parallel=N)`` keeps N worker threads busy while
+preserving element order; ``prefetch(k)`` decouples the producer with a
+bounded background queue.  Exceptions raised anywhere in the pipeline
+surface at the consuming ``next()`` call.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob as globlib
+import queue
+import random
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import numpy as np
+
+__all__ = ["Dataset", "device_prefetch"]
+
+
+class Dataset:
+    """Composable host-local input pipeline (the tf.data equivalent)."""
+
+    def __init__(self, make_iter: Callable[[], Iterator]):
+        self._make = make_iter
+
+    # ---------------------------------------------------------------- sources
+    @staticmethod
+    def from_tfrecords(paths: str | Sequence[str], verify: bool = True,
+                       shard: tuple[int, int] | None = None) -> "Dataset":
+        """Raw records from TFRecord files (glob pattern or explicit list).
+
+        ``shard=(n, i)`` shards at *file* granularity when there are at
+        least ``n`` files (each worker opens only its own files — the cheap
+        kind of sharding); with fewer files it falls back to an element
+        stride over the full stream, which reads everything but keeps the
+        partition exact, like ``tf.data.Dataset.shard``.
+        """
+        from tensorflowonspark_tpu.tfrecord import read_records
+
+        files = sorted(globlib.glob(paths)) if isinstance(paths, str) else list(paths)
+        if isinstance(paths, str) and not files:
+            raise FileNotFoundError(f"no TFRecord files match {paths!r}")
+
+        stride_shard = None
+        if shard is not None:
+            n, i = shard
+            assert 0 <= i < n, f"bad shard ({n}, {i})"
+            if len(files) >= n:
+                files = files[i::n]
+            else:
+                stride_shard = (n, i)
+
+        def make():
+            it = (rec for f in files for rec in read_records(f, verify=verify))
+            if stride_shard is not None:
+                n, i = stride_shard
+                it = (rec for j, rec in enumerate(it) if j % n == i)
+            return it
+
+        return Dataset(make)
+
+    @staticmethod
+    def from_examples(paths: str | Sequence[str],
+                      binary_features: Sequence[str] = (),
+                      shard: tuple[int, int] | None = None) -> "Dataset":
+        """Parsed ``tf.train.Example`` dicts (feature name → numpy value)
+        from TFRecord files — ``from_tfrecords`` + the wire-format decoder
+        (``example_proto.decode_example``), squeezing single-element
+        features to scalars the way ``dfutil.fromTFExample`` does."""
+        from tensorflowonspark_tpu.example_proto import decode_example
+
+        base = Dataset.from_tfrecords(paths, shard=shard)
+        binary = set(binary_features)
+
+        def parse(rec: bytes):
+            out: dict[str, Any] = {}
+            for name, (kind, values) in decode_example(rec).items():
+                if kind == "bytes" and name not in binary:
+                    values = [v.decode("utf-8", "replace") for v in values]
+                arr = (values[0] if len(values) == 1 else
+                       np.asarray(values))
+                out[name] = arr
+            return out
+
+        return base.map(parse)
+
+    @staticmethod
+    def from_tensor_slices(data) -> "Dataset":
+        """Elements along axis 0 of an array, tuple of arrays, or dict of
+        arrays (matching ``tf.data.Dataset.from_tensor_slices``)."""
+        if isinstance(data, dict):
+            keys = list(data)
+            arrays = [np.asarray(data[k]) for k in keys]
+            n = len(arrays[0])
+            assert all(len(a) == n for a in arrays), "ragged dict arrays"
+            return Dataset(lambda: ({k: a[j] for k, a in zip(keys, arrays)}
+                                    for j in range(n)))
+        if isinstance(data, tuple):  # tuple = structure, list = tensor (tf.data)
+            arrays = [np.asarray(a) for a in data]
+            n = len(arrays[0])
+            assert all(len(a) == n for a in arrays), "ragged tuple arrays"
+            return Dataset(lambda: (tuple(a[j] for a in arrays)
+                                    for j in range(n)))
+        arr = np.asarray(data)
+        return Dataset(lambda: iter(arr))
+
+    @staticmethod
+    def from_generator(fn: Callable[[], Iterable]) -> "Dataset":
+        """A re-invocable generator factory (called once per iteration)."""
+        return Dataset(lambda: iter(fn()))
+
+    # ------------------------------------------------------------- transforms
+    def shard(self, num_shards: int, index: int) -> "Dataset":
+        """Element-stride partition ``index`` of ``num_shards`` (exact and
+        order-stable; reference: ``tf.data.Dataset.shard(num, worker_num)``
+        in the TENSORFLOW-mode examples)."""
+        assert 0 <= index < num_shards, f"bad shard ({num_shards}, {index})"
+        src = self._make
+        return Dataset(lambda: (x for j, x in enumerate(src())
+                                if j % num_shards == index))
+
+    def map(self, fn: Callable, num_parallel: int = 0) -> "Dataset":
+        """Apply ``fn`` per element; ``num_parallel`` > 1 uses a thread pool
+        that keeps that many elements in flight while preserving order."""
+        src = self._make
+        if num_parallel <= 1:
+            return Dataset(lambda: (fn(x) for x in src()))
+
+        def make():
+            def gen():
+                with ThreadPoolExecutor(max_workers=num_parallel) as pool:
+                    pending: collections.deque = collections.deque()
+                    it = src()
+                    for x in it:
+                        pending.append(pool.submit(fn, x))
+                        if len(pending) >= num_parallel * 2:
+                            yield pending.popleft().result()
+                    while pending:
+                        yield pending.popleft().result()
+            return gen()
+
+        return Dataset(make)
+
+    def filter(self, pred: Callable[[Any], bool]) -> "Dataset":
+        src = self._make
+        return Dataset(lambda: (x for x in src() if pred(x)))
+
+    def shuffle(self, buffer_size: int, seed: int | None = None) -> "Dataset":
+        """Streaming buffer shuffle (tf.data semantics: uniform within a
+        ``buffer_size`` window, not a global permutation)."""
+        assert buffer_size > 0
+        src = self._make
+
+        def make():
+            rng = random.Random(seed)
+
+            def gen():
+                buf: list = []
+                for x in src():
+                    buf.append(x)
+                    if len(buf) >= buffer_size:
+                        j = rng.randrange(len(buf))
+                        buf[j], buf[-1] = buf[-1], buf[j]
+                        yield buf.pop()
+                rng.shuffle(buf)
+                yield from buf
+            return gen()
+
+        return Dataset(make)
+
+    def repeat(self, count: int | None = None) -> "Dataset":
+        """Repeat the source ``count`` times (``None`` = forever)."""
+        src = self._make
+
+        def make():
+            def gen():
+                n = 0
+                while count is None or n < count:
+                    yield from src()
+                    n += 1
+            return gen()
+
+        return Dataset(make)
+
+    def take(self, n: int) -> "Dataset":
+        src = self._make
+
+        def make():
+            def gen():
+                for j, x in enumerate(src()):
+                    if j >= n:
+                        return
+                    yield x
+            return gen()
+
+        return Dataset(make)
+
+    def skip(self, n: int) -> "Dataset":
+        src = self._make
+        return Dataset(lambda: (x for j, x in enumerate(src()) if j >= n))
+
+    def batch(self, batch_size: int, drop_remainder: bool = False) -> "Dataset":
+        """Stack ``batch_size`` consecutive elements: arrays → a leading
+        batch axis; dicts/tuples → per-key/per-position stacking."""
+        assert batch_size > 0
+        src = self._make
+
+        def make():
+            def gen():
+                buf: list = []
+                for x in src():
+                    buf.append(x)
+                    if len(buf) == batch_size:
+                        yield _stack(buf)
+                        buf = []
+                if buf and not drop_remainder:
+                    yield _stack(buf)
+            return gen()
+
+        return Dataset(make)
+
+    def prefetch(self, depth: int = 2) -> "Dataset":
+        """Produce elements in a background thread, ``depth`` ahead."""
+        assert depth > 0
+        src = self._make
+
+        def make():
+            q: queue.Queue = queue.Queue(maxsize=depth)
+            stop = threading.Event()
+            END, ERR = object(), object()
+
+            def producer():
+                try:
+                    for x in src():
+                        while not stop.is_set():
+                            try:
+                                q.put(x, timeout=0.5)
+                                break
+                            except queue.Full:
+                                continue
+                        if stop.is_set():
+                            return
+                    q.put(END)
+                except BaseException as e:  # surface at the consumer
+                    try:
+                        q.put((ERR, e), timeout=5)
+                    except queue.Full:
+                        pass
+
+            t = threading.Thread(target=producer, daemon=True,
+                                 name="dataset-prefetch")
+            t.start()
+
+            def gen():
+                try:
+                    while True:
+                        item = q.get()
+                        if item is END:
+                            return
+                        if isinstance(item, tuple) and len(item) == 2 \
+                                and item[0] is ERR:
+                            raise item[1]
+                        yield item
+                finally:
+                    stop.set()
+            return gen()
+
+        return Dataset(make)
+
+    # -------------------------------------------------------------- consumers
+    def __iter__(self) -> Iterator:
+        return self._make()
+
+    def as_numpy(self) -> list:
+        return list(self._make())
+
+
+def _stack(items: list):
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: _stack([it[k] for it in items]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return tuple(_stack([it[j] for it in items]) for j in range(len(first)))
+    return np.stack([np.asarray(x) for x in items])
+
+
+def device_prefetch(it: Iterator, depth: int = 2, sharding=None):
+    """Yield items from ``it`` with ``depth`` ``jax.device_put`` transfers in
+    flight — host→device copy of batch k+1 overlaps compute on batch k
+    (device_put is async; the deque holds uncommitted arrays)."""
+    import jax
+
+    assert depth > 0
+    buf: collections.deque = collections.deque()
+    for item in it:
+        buf.append(jax.device_put(item, sharding)
+                   if sharding is not None else jax.device_put(item))
+        if len(buf) >= depth:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()

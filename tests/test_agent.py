@@ -1,0 +1,140 @@
+"""Multi-host agent backend: real agent daemons on localhost.
+
+The reference's multi-host path is exercised through Spark's
+``local-cluster[N, ...]`` master — real separate executor processes on one
+machine (SURVEY.md §4).  The analogue here: spawn real ``HostAgent`` daemons
+as subprocesses, then run the full ``TPUCluster`` contract through
+``AgentBackend`` against them.
+"""
+
+import os
+import secrets
+import subprocess
+import sys
+
+import pytest
+
+from tensorflowonspark_tpu.agent import AgentBackend, _AgentConn
+from tests import cluster_funcs as funcs
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def agent_fleet():
+    """Two real host-agent daemons on localhost with a shared authkey."""
+    key = secrets.token_bytes(16)
+    procs, addrs = [], []
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    try:
+        for _ in range(2):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "tensorflowonspark_tpu.agent",
+                 "--port", "0", "--authkey-hex", key.hex()],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, cwd=ROOT, env=env)
+            procs.append(p)
+            line = p.stdout.readline().strip()  # "AGENT host:port"
+            assert line.startswith("AGENT "), f"unexpected agent banner {line!r}"
+            host, port = line.split(" ", 1)[1].rsplit(":", 1)
+            addrs.append((host, int(port)))
+        yield key, addrs
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_agent_train_roundtrip(agent_fleet, tmp_path):
+    from tensorflowonspark_tpu import TPUCluster
+
+    key, addrs = agent_fleet
+    backend = AgentBackend(addrs, authkey=key,
+                           worker_env={"JAX_PLATFORMS": "cpu"})
+    cluster = TPUCluster.run(
+        funcs.fn_sum_feed, {"batch_size": 8}, num_workers=2,
+        backend=backend, reservation_timeout=60,
+        working_dir=str(tmp_path))
+    try:
+        cluster.train(list(range(100)), num_epochs=1)
+    finally:
+        cluster.shutdown(timeout=120)
+    sums = []
+    for i in range(2):
+        with open(tmp_path / f"sum.{i}") as f:
+            total, count = map(int, f.read().split(":"))
+        sums.append((total, count))
+    assert sum(t for t, _ in sums) == sum(range(100))
+    assert sum(c for _, c in sums) == 100
+    # round-robin assignment: both agents hosted one worker each
+    assert all(c > 0 for _, c in sums)
+
+
+def test_agent_inference_roundtrip(agent_fleet, tmp_path):
+    from tensorflowonspark_tpu import TPUCluster
+
+    key, addrs = agent_fleet
+    backend = AgentBackend(addrs, authkey=key,
+                           worker_env={"JAX_PLATFORMS": "cpu"})
+    cluster = TPUCluster.run(
+        funcs.fn_square_inference, {}, num_workers=2, backend=backend,
+        reservation_timeout=60, working_dir=str(tmp_path))
+    try:
+        preds = cluster.inference(list(range(24)))
+        assert sorted(preds) == sorted(x * x for x in range(24))
+    finally:
+        cluster.shutdown(timeout=120)
+
+
+def test_agent_error_propagation(agent_fleet, tmp_path):
+    from tensorflowonspark_tpu import TPUCluster
+
+    key, addrs = agent_fleet
+    backend = AgentBackend(addrs[:1], authkey=key,
+                           worker_env={"JAX_PLATFORMS": "cpu"})
+    cluster = TPUCluster.run(
+        funcs.fn_crash, {}, num_workers=1, backend=backend,
+        reservation_timeout=60, working_dir=str(tmp_path))
+    with pytest.raises(RuntimeError, match="deliberate failure"):
+        cluster.shutdown(timeout=120)
+
+
+def test_agent_rejects_bad_authkey(agent_fleet):
+    _, addrs = agent_fleet
+    with pytest.raises((PermissionError, EOFError, ConnectionError, OSError)):
+        conn = _AgentConn(addrs[0], authkey=b"wrong-key-entirely", timeout=5)
+        conn.request({"type": "PING"})
+
+
+def test_agent_ping_and_status(agent_fleet):
+    key, addrs = agent_fleet
+    conn = _AgentConn(addrs[0], authkey=key)
+    try:
+        pong = conn.request({"type": "PING"})
+        assert pong["ok"] and pong["workers"] == []
+        assert conn.request({"type": "STATUS"}) == {}
+    finally:
+        conn.close()
+
+
+def test_agent_oversubscription(agent_fleet, tmp_path):
+    """4 workers over 2 agents — the multiple-executors-per-host shape."""
+    from tensorflowonspark_tpu import TPUCluster
+
+    key, addrs = agent_fleet
+    backend = AgentBackend(addrs, authkey=key,
+                           worker_env={"JAX_PLATFORMS": "cpu"})
+    cluster = TPUCluster.run(
+        funcs.fn_write_role, {}, num_workers=4, backend=backend,
+        reservation_timeout=60, working_dir=str(tmp_path))
+    cluster.shutdown(timeout=120)
+    roles = []
+    for i in range(4):
+        with open(tmp_path / f"role.{i}") as f:
+            roles.append(f.read())
+    assert len(roles) == 4
+    assert sum(1 for r in roles if r.split(":")[2] == "1") == 1  # one chief

@@ -62,15 +62,16 @@ def main_fun(args, ctx):
 
     sample = jnp.zeros((args.batch_size, 32, 32, 3), jnp.float32)
 
-    def init_fn():
-        variables = model.init(jax.random.key(0), sample, train=True)
-        return variables["params"]
+    # one full init; init_state's jit then only reshards the captured params
+    variables = model.init(jax.random.key(0), sample, train=True)
 
-    state = strategy.init_state(init_fn, tx)
+    state = strategy.init_state(lambda: variables["params"], tx)
     # BatchNorm statistics ride in state.extras (mutable collections don't
-    # fit the pure params/grads pattern of build_train_step's closure).
-    state.extras["batch_stats"] = model.init(
-        jax.random.key(0), sample, train=True)["batch_stats"]
+    # fit the pure params/grads pattern of build_train_step's closure);
+    # replicated on the mesh so step 1's output shardings match step 0's.
+    from tensorflowonspark_tpu.parallel import sharding as _sh
+    state.extras["batch_stats"] = jax.device_put(
+        variables["batch_stats"], _sh.replicated(strategy.mesh))
 
     def loss_fn(params, batch, extras):
         x, y = batch
@@ -84,15 +85,19 @@ def main_fun(args, ctx):
 
     step = strategy.build_train_step(loss_fn)
 
-    ckpt = CheckpointManager(args.model_dir) if args.model_dir and ctx.is_chief \
-        else None
+    # EVERY worker opens the manager and restores (orbax restore is
+    # multi-host-capable); restoring only on the chief would resume it at
+    # the saved step while the others restart from 0 — divergent replicas.
+    # Saves below stay chief-gated, matching mnist_spark's multi-host note.
+    ckpt = CheckpointManager(args.model_dir) if args.model_dir else None
     start_step = 0
     if ckpt is not None and ckpt.latest_step() is not None:
         # restore against the freshly-built state's structure so optimizer
         # namedtuples (and shardings) survive the round trip
         state = ckpt.restore(target=jax.eval_shape(lambda: state))
         start_step = int(np.asarray(state.step))
-        print(f"chief: resumed from step {start_step}", flush=True)
+        print(f"node {ctx.executor_id}: resumed from step {start_step}",
+              flush=True)
 
     rng = np.random.default_rng(ctx.executor_id)
     for s in range(start_step, args.steps):
@@ -103,7 +108,8 @@ def main_fun(args, ctx):
             print(f"node {ctx.executor_id}: step {s + 1} "
                   f"loss {float(metrics['loss']):.4f} "
                   f"acc {float(metrics['acc']):.3f}", flush=True)
-        if ckpt is not None and args.ckpt_every and (s + 1) % args.ckpt_every == 0:
+        if ckpt is not None and ctx.is_chief and args.ckpt_every \
+                and (s + 1) % args.ckpt_every == 0:
             ckpt.save(s + 1, state)
 
     # eval: running-average BN stats, train=False
@@ -128,6 +134,8 @@ def main_fun(args, ctx):
             if ckpt.latest_step() != args.steps:
                 ckpt.save(args.steps, state, force=True)
             ckpt.close()
+    elif ckpt is not None:  # non-chief: restored above, nothing to save
+        ckpt.close()
 
 
 if __name__ == "__main__":

@@ -35,7 +35,6 @@ top-level callables on the worker side.
 from __future__ import annotations
 
 import argparse
-import hmac
 import logging
 import multiprocessing as mp
 import os
@@ -56,10 +55,17 @@ class HostAgent(MessageSocket):
     """Per-host worker launcher (the Spark-executor stand-in)."""
 
     def __init__(self, port: int = 0, authkey: bytes | None = None,
-                 max_workers: int = 64):
+                 max_workers: int = 64, bind_host: str | None = None):
         self.port = port
         self.authkey = authkey
         self.max_workers = max_workers
+        # A keyless agent is an arbitrary-code-execution endpoint; it must
+        # never be reachable off-host.  Default bind: loopback without a
+        # key, all interfaces with one.  An explicit bind_host overrides
+        # (the CLI gates the keyless+non-local combination on --insecure).
+        if bind_host is None:
+            bind_host = "0.0.0.0" if authkey is not None else "127.0.0.1"
+        self.bind_host = bind_host
         self.done = threading.Event()
         self._listener: socket.socket | None = None
         self._procs: dict[int, mp.Process] = {}
@@ -70,10 +76,12 @@ class HostAgent(MessageSocket):
         """Bind and serve in a background thread; returns ``(host, port)``."""
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind(("0.0.0.0", self.port))
+        self._listener.bind((self.bind_host, self.port))
         self._listener.listen(16)
         port = self._listener.getsockname()[1]
-        self.addr = (get_ip_address(), port)
+        host = self.bind_host if self.bind_host not in ("0.0.0.0", "") \
+            else get_ip_address()
+        self.addr = (host, port)
         t = threading.Thread(target=self._serve, name="host-agent", daemon=True)
         t.start()
         logger.info("host agent listening at %s", self.addr)
@@ -97,7 +105,7 @@ class HostAgent(MessageSocket):
     # -------------------------------------------------------------- server
     def _serve(self) -> None:
         conns = [self._listener]
-        authed: set = set()
+        pending: dict = {}  # unauthenticated sock -> challenge nonce
         while not self.done.is_set():
             try:
                 readable, _, _ = select.select(conns, [], [], 0.5)
@@ -108,18 +116,24 @@ class HostAgent(MessageSocket):
                     try:
                         client, _ = self._listener.accept()
                         conns.append(client)
+                        if self.authkey is not None:
+                            # HMAC challenge-response (reservation.py): the
+                            # key never crosses the wire, and nothing from
+                            # an unauthenticated peer is ever unpickled.
+                            try:
+                                pending[client] = self.auth_challenge(client)
+                            except OSError:
+                                client.close()
+                                conns.remove(client)
                     except OSError:
                         break
-                elif self.authkey is not None and sock not in authed:
-                    # raw-frame hello first: never unpickle unauthenticated
-                    # bytes (same posture as reservation.Server._serve)
+                elif sock in pending:
                     try:
-                        hello = self.receive_raw(sock)
-                        if not hmac.compare_digest(hello, self.authkey):
+                        if not self.auth_verify(sock, self.authkey,
+                                                pending.pop(sock)):
                             raise PermissionError("bad authkey")
-                        authed.add(sock)
-                        self.send(sock, "OK")
                     except (EOFError, OSError, ValueError, PermissionError):
+                        pending.pop(sock, None)
                         sock.close()
                         conns.remove(sock)
                 else:
@@ -129,7 +143,6 @@ class HostAgent(MessageSocket):
                     except (EOFError, OSError, pickle.PickleError):
                         sock.close()
                         conns.remove(sock)
-                        authed.discard(sock)
         for sock in conns:
             try:
                 sock.close()
@@ -208,9 +221,7 @@ class _AgentConn(MessageSocket):
         self._sock.settimeout(timeout)
         self._lock = threading.Lock()
         if authkey is not None:
-            self.send_raw(self._sock, authkey)
-            if self.receive(self._sock) != "OK":
-                raise PermissionError(f"agent {self.addr} rejected authkey")
+            self.auth_respond(self._sock, authkey)
 
     def request(self, msg: dict):
         with self._lock:
@@ -320,20 +331,36 @@ def main(argv: list[str] | None = None) -> None:
                    help="listen port (0 = ephemeral, printed on stdout)")
     p.add_argument("--authkey-hex", default=None,
                    help=f"pre-shared key (hex); default ${AUTHKEY_ENV}")
+    p.add_argument("--bind", default=None,
+                   help="bind address (default: 0.0.0.0 with an authkey, "
+                        "127.0.0.1 without one)")
+    p.add_argument("--insecure", action="store_true",
+                   help="allow a KEYLESS agent to bind a non-loopback "
+                        "address (anyone reaching the port can then run "
+                        "arbitrary code as this user)")
     p.add_argument("--max-workers", type=int, default=64)
     args = p.parse_args(argv)
 
     key_hex = args.authkey_hex or os.environ.get(AUTHKEY_ENV)
     authkey = bytes.fromhex(key_hex) if key_hex else None
     if authkey is None:
-        logger.warning("host agent running WITHOUT an authkey — anyone who "
-                       "can reach the port can run code as this user; pass "
-                       f"--authkey-hex or set ${AUTHKEY_ENV}")
+        if args.bind not in (None, "127.0.0.1", "localhost", "::1") \
+                and not args.insecure:
+            p.error(
+                "refusing to expose a KEYLESS agent on a non-loopback "
+                f"address ({args.bind}): a peer that reaches the port can "
+                "execute arbitrary code.  Pass --authkey-hex / set "
+                f"${AUTHKEY_ENV}, or accept the risk with --insecure.")
+        exposed = args.bind not in (None, "127.0.0.1", "localhost", "::1")
+        logger.warning("host agent running WITHOUT an authkey (%s) — pass "
+                       "--authkey-hex or set $%s for multi-host use",
+                       f"EXPOSED on {args.bind} via --insecure" if exposed
+                       else "loopback only", AUTHKEY_ENV)
 
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s [agent] %(message)s")
     agent = HostAgent(port=args.port, authkey=authkey,
-                      max_workers=args.max_workers)
+                      max_workers=args.max_workers, bind_host=args.bind)
     host, port = agent.start()
     # machine-readable line for launchers that scrape the address
     print(f"AGENT {host}:{port}", flush=True)

@@ -274,12 +274,22 @@ class Dataset:
                                 continue
                         if stop.is_set():
                             return
-                    q.put(END)
+                    # same stop-aware timed put as for data items: if the
+                    # consumer abandoned us with the queue full, exit
+                    # instead of blocking this thread forever
+                    while not stop.is_set():
+                        try:
+                            q.put(END, timeout=0.5)
+                            break
+                        except queue.Full:
+                            continue
                 except BaseException as e:  # surface at the consumer
-                    try:
-                        q.put((ERR, e), timeout=5)
-                    except queue.Full:
-                        pass
+                    while not stop.is_set():
+                        try:
+                            q.put((ERR, e), timeout=0.5)
+                            break
+                        except queue.Full:
+                            continue
 
             t = threading.Thread(target=producer, daemon=True,
                                  name="dataset-prefetch")

@@ -85,9 +85,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     o, m, l = lax.fori_loop(0, nk, body, (o0, m0, l0))
-    l = jnp.maximum(l, _EPS)                  # fully-masked rows → 0, not nan
-    o_ref[0, 0] = (o / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l)
+    # A row whose keys are ALL masked keeps m pinned at NEG_INF (any real
+    # score sits far above NEG_INF/2): without this check the online softmax
+    # degenerates to p=exp(0)=1 on the masked scores and the row silently
+    # returns the mean of V.  Emit zeros instead, and push the row's lse to
+    # -NEG_INF so the backward's exp(s - lse) underflows to exact zeros
+    # (delta is also 0 there since out==0, so dq/dk/dv get no garbage).
+    valid = m > NEG_INF * 0.5
+    l = jnp.maximum(l, _EPS)
+    o_ref[0, 0] = jnp.where(valid, o / l, 0.0).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.where(valid, m + jnp.log(l), -NEG_INF)
 
 
 def _fwd_impl(q, k, v, bias, causal, scale, block_q, block_k, interpret):
@@ -288,12 +295,15 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
 
     Args:
       q, k, v: ``[B, T, H, D]`` (q's T may differ from k/v's).
-      mask: optional ``[B, Tk]`` bool key-padding mask (True = attend).
+      mask: optional ``[B, Tk]`` bool key-padding mask (True = attend).  A
+        row with *no* True keys yields zeros (and zero gradients), matching
+        the "fully padded row" convention.
       causal: causal masking by absolute position.
       scale: score scale, default ``1/sqrt(D)``.
-      block_q, block_k: kernel tile sizes (clamped to the padded seq len;
-        the 512 default measured fastest on v5e at T=2k–8k — 2.3× XLA's
-        dense attention at T=4096, and runs T=8192 where dense OOMs).
+      block_q, block_k: kernel tile sizes (clamped to the padded seq len).
+        Measured speedups vs XLA dense attention live in
+        ``bench_artifacts/flash_attention.json`` (produced by ``bench.py``
+        on the real chip).
       interpret: force Pallas interpreter mode; default auto (on ≠ TPU).
     """
     B, Tq, H, D = q.shape

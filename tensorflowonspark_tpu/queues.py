@@ -88,16 +88,13 @@ class QueueServer(MessageSocket):
             threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        import hmac
-
         try:
-            # Raw-bytes hello compared before anything is unpickled — an
-            # unauthenticated peer never reaches pickle.loads.
-            hello = self.receive_raw(conn)
-            if not hmac.compare_digest(hello, self.authkey):
-                self.send(conn, ("ERR", "bad authkey"))
+            # Mutual HMAC challenge-response (reservation.MessageSocket):
+            # the key never crosses the wire and an unauthenticated peer
+            # never reaches pickle.loads.
+            nonce = self.auth_challenge(conn)
+            if not self.auth_verify(conn, self.authkey, nonce):
                 return
-            self.send(conn, "OK")
             while not self.done.is_set():
                 msg = self.receive(conn)
                 try:
@@ -194,10 +191,11 @@ class QueueClient(MessageSocket):
         self._sock.settimeout(timeout)
         self._sock.connect(self.addr)
         self._lock = threading.Lock()
-        self.send_raw(self._sock, self.authkey)
-        resp = self.receive(self._sock)
-        if resp != "OK":
-            raise ConnectionError(f"queue server rejected connection: {resp!r}")
+        try:
+            self.auth_respond(self._sock, self.authkey)
+        except (PermissionError, EOFError, OSError) as e:
+            # a bad key shows up as the server silently closing on us
+            raise ConnectionError(f"queue server rejected connection: {e!r}")
 
     def _request(self, msg, op_timeout: float | None = None):
         with self._lock:

@@ -15,7 +15,9 @@ Wire format: 4-byte big-endian length prefix + pickled payload
 
 from __future__ import annotations
 
+import hmac as _hmac
 import logging
+import os
 import pickle
 import select
 import socket
@@ -26,6 +28,10 @@ import time
 logger = logging.getLogger(__name__)
 
 BUFSIZE = 64 * 1024
+
+# Challenge-frame magic for the mutual HMAC authkey handshake (below).
+AUTH_MAGIC = b"TFOSAUTH1"
+_NONCE_LEN = 32
 
 
 class Reservations:
@@ -95,6 +101,43 @@ class MessageSocket:
     def send_raw(self, sock: socket.socket, data: bytes) -> None:
         sock.sendall(struct.pack(">I", len(data)) + data)
 
+    # -- authkey handshake (mutual HMAC-SHA256 challenge-response) --------
+    # The pre-shared key itself never crosses the wire (a raw-key hello is
+    # sniffable and replayable); each side proves possession by MACing a
+    # fresh server nonce, so captured traffic authenticates nothing.
+
+    def auth_challenge(self, sock: socket.socket) -> bytes:
+        """Server, step 1: send a fresh nonce; returns it for later verify."""
+        nonce = os.urandom(_NONCE_LEN)
+        self.send_raw(sock, AUTH_MAGIC + nonce)
+        return nonce
+
+    def auth_verify(self, sock: socket.socket, authkey: bytes,
+                    nonce: bytes) -> bool:
+        """Server, step 2: check the client's MAC over ``nonce``; on success
+        send back our own MAC so the client can authenticate us too."""
+        digest = self.receive_raw(sock, max_len=64)
+        ok = _hmac.compare_digest(
+            digest, _hmac.new(authkey, b"client" + nonce, "sha256").digest())
+        if ok:
+            self.send_raw(
+                sock, _hmac.new(authkey, b"server" + nonce, "sha256").digest())
+        return ok
+
+    def auth_respond(self, sock: socket.socket, authkey: bytes) -> None:
+        """Client: answer the server's challenge, then verify its proof."""
+        frame = self.receive_raw(sock, max_len=64)
+        if not frame.startswith(AUTH_MAGIC) or \
+                len(frame) != len(AUTH_MAGIC) + _NONCE_LEN:
+            raise PermissionError("bad auth challenge from server")
+        nonce = frame[len(AUTH_MAGIC):]
+        self.send_raw(
+            sock, _hmac.new(authkey, b"client" + nonce, "sha256").digest())
+        proof = self.receive_raw(sock, max_len=64)
+        if not _hmac.compare_digest(
+                proof, _hmac.new(authkey, b"server" + nonce, "sha256").digest()):
+            raise PermissionError("server failed to prove authkey possession")
+
 
 class Server(MessageSocket):
     """Driver-side rendezvous listener.
@@ -128,10 +171,8 @@ class Server(MessageSocket):
         return addr
 
     def _serve(self) -> None:
-        import hmac
-
         conns = [self._listener]
-        authed: set = set()
+        pending: dict = {}  # unauthenticated sock -> challenge nonce
         while not self.done.is_set():
             try:
                 readable, _, _ = select.select(conns, [], [], 0.5)
@@ -142,18 +183,23 @@ class Server(MessageSocket):
                     try:
                         client, _ = self._listener.accept()
                         conns.append(client)
+                        if self.authkey is not None:
+                            # challenge immediately; nothing is unpickled
+                            # from a peer that has not answered it.
+                            try:
+                                pending[client] = self.auth_challenge(client)
+                            except OSError:
+                                client.close()
+                                conns.remove(client)
                     except OSError:
                         break
-                elif self.authkey is not None and sock not in authed:
-                    # first frame must be the raw authkey hello; nothing is
-                    # unpickled from an unauthenticated peer.
+                elif sock in pending:
                     try:
-                        hello = self.receive_raw(sock)
-                        if not hmac.compare_digest(hello, self.authkey):
+                        if not self.auth_verify(sock, self.authkey,
+                                                pending.pop(sock)):
                             raise PermissionError("bad authkey")
-                        authed.add(sock)
-                        self.send(sock, "OK")
                     except (EOFError, OSError, ValueError, PermissionError):
+                        pending.pop(sock, None)
                         sock.close()
                         conns.remove(sock)
                 else:
@@ -163,7 +209,6 @@ class Server(MessageSocket):
                     except (EOFError, OSError, pickle.PickleError):
                         sock.close()
                         conns.remove(sock)
-                        authed.discard(sock)
         for sock in conns:
             try:
                 sock.close()
@@ -235,10 +280,7 @@ class Client(MessageSocket):
                 time.sleep(0.2)
         self._lock = threading.Lock()
         if authkey is not None:
-            self.send_raw(self._sock, authkey)
-            resp = self.receive(self._sock)
-            if resp != "OK":
-                raise PermissionError(f"reservation server rejected authkey: {resp!r}")
+            self.auth_respond(self._sock, authkey)
 
     def _request(self, msg):
         with self._lock:

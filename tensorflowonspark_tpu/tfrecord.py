@@ -90,6 +90,7 @@ def _build_library() -> str | None:
             return so_path
         if source_mtime is None:
             continue  # nothing to build from
+        tmp = None
         try:
             # unpredictable temp name (mkstemp) → no symlink-clobber window
             fd, tmp = tempfile.mkstemp(prefix=".libtfrecord.", suffix=".so",
@@ -100,10 +101,17 @@ def _build_library() -> str | None:
                 check=True, capture_output=True, timeout=120)
             os.chmod(tmp, 0o755 if not private else 0o700)
             os.replace(tmp, so_path)  # atomic: concurrent builders both succeed
+            tmp = None
             logger.info("built native TFRecord codec: %s", so_path)
             return so_path
         except (OSError, subprocess.SubprocessError) as e:
             logger.debug("native build in %s failed: %s", target_dir, e)
+        finally:
+            if tmp is not None:  # failed build: don't litter the cache dir
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
     return None
 
 

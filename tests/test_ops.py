@@ -154,3 +154,31 @@ def test_flash_odd_length_past_block_boundary():
     got = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
     want = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_fully_masked_rows_yield_zeros():
+    """A batch row whose key-padding mask is all-False must produce zeros
+    (and zero gradients), not the mean of V (the online-softmax degenerate
+    case ADVICE.md round 1 flagged)."""
+    q, k, v = _qkv(9, 2, 16, 2, 8)
+    mask = np.ones((2, 16), bool)
+    mask[1, :] = False  # batch row 1: every key masked
+
+    out = flash_attention(q, k, v, mask=jnp.asarray(mask),
+                          block_q=16, block_k=16)
+    out = np.asarray(out)
+    assert np.all(out[1] == 0.0), "fully-masked row must be exactly zero"
+    # row 0 unchanged vs dense
+    want = reference_attention(q[:1], k[:1], v[:1])
+    np.testing.assert_allclose(out[:1], np.asarray(want), atol=2e-5, rtol=2e-5)
+
+    # gradients: masked row contributes exactly nothing
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, mask=jnp.asarray(mask),
+                                block_q=16, block_k=16) ** 2).sum()
+
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in (dq, dk, dv):
+        g = np.asarray(g)
+        assert np.all(np.isfinite(g))
+        assert np.all(g[1] == 0.0), "masked batch row must get zero grads"

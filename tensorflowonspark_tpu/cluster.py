@@ -150,6 +150,19 @@ class TPUCluster:
         (SURVEY.md §2c — PS is an anti-pattern on TPU).
         """
         assert num_workers > 0, "need at least one worker"
+        if driver_ps_nodes:
+            # Reference semantics (TFCluster.py::run): host the gRPC ps
+            # servers in the DRIVER's JVM instead of executors.  There is no
+            # gRPC parameter server on TPU at all — 'ps' roles are SPMD
+            # embedding-shard owners (SURVEY.md §2c), so there is nothing to
+            # move onto the driver.  Reject rather than silently ignore.
+            raise ValueError(
+                "driver_ps_nodes=True has no TPU equivalent: parameter "
+                "servers are replaced by sharded embeddings running inside "
+                "the SPMD workers (num_ps maps to the 'ep' mesh axis), so "
+                "ps processes cannot be hosted on the driver.  Drop the "
+                "flag, or see parallel.embedding.ShardedEmbedding for the "
+                "PS-workload migration path.")
         cluster_template = _build_cluster_template(
             num_workers, num_ps, master_node, eval_node)
         logger.info("cluster template: %s", cluster_template)

@@ -39,7 +39,6 @@ surface at the consuming ``next()`` call.
 from __future__ import annotations
 
 import collections
-import glob as globlib
 import queue
 import random
 import threading
@@ -68,10 +67,15 @@ class Dataset:
         kind of sharding); with fewer files it falls back to an element
         stride over the full stream, which reads everything but keeps the
         partition exact, like ``tf.data.Dataset.shard``.
+
+        Paths may be local or any fsspec scheme (``gs://data/part-*`` on a
+        TPU pod reads straight from GCS).
         """
+        from tensorflowonspark_tpu import filesystem as fsutil
         from tensorflowonspark_tpu.tfrecord import read_records
 
-        files = sorted(globlib.glob(paths)) if isinstance(paths, str) else list(paths)
+        files = fsutil.expand_glob(paths) if isinstance(paths, str) \
+            else list(paths)
         if isinstance(paths, str) and not files:
             raise FileNotFoundError(f"no TFRecord files match {paths!r}")
 

@@ -17,13 +17,13 @@ interchangeable with the reference's.
 from __future__ import annotations
 
 import logging
-import os
 import re
 from typing import Sequence
 
 import numpy as np
 
 from tensorflowonspark_tpu import example_proto, tfrecord
+from tensorflowonspark_tpu import filesystem as fsutil
 from tensorflowonspark_tpu.dataframe import DataFrame, Row
 
 logger = logging.getLogger(__name__)
@@ -103,13 +103,13 @@ def saveAsTFRecords(df: DataFrame, output_dir: str,
     partition (Hadoop ``part-r-NNNNN`` naming), plus ``_SUCCESS`` on
     completion like the Hadoop committer.  Returns the record count.
     """
-    os.makedirs(output_dir, exist_ok=True)
+    fsutil.makedirs(output_dir)
     total = 0
     for i, part in enumerate(df.partitions):
-        path = os.path.join(output_dir, f"part-r-{i:05d}")
+        path = fsutil.join(output_dir, f"part-r-{i:05d}")
         total += tfrecord.write_records(
             path, (toTFExample(r, columns) for r in part))
-    with open(os.path.join(output_dir, "_SUCCESS"), "w"):
+    with fsutil.open_output(fsutil.join(output_dir, "_SUCCESS"), "wb"):
         pass
     logger.info("wrote %d records to %s (%d part files)",
                 total, output_dir, df.num_partitions)
@@ -123,11 +123,11 @@ def loadTFRecords(input_dir: str, binary_features: Sequence[str] = (),
     Reference: ``dfutil.py::loadTFRecords`` — ``newAPIHadoopFile`` + schema
     inference from a sample Example.  Each part file becomes one partition.
     """
-    if os.path.isfile(input_dir):
+    if fsutil.isfile(input_dir):
         files = [input_dir]
     else:
         files = sorted(
-            os.path.join(input_dir, f) for f in os.listdir(input_dir)
+            fsutil.join(input_dir, f) for f in fsutil.listdir(input_dir)
             if _PART_RE.match(f) or f.endswith(".tfrecord") or f.endswith(".tfrecords"))
     if not files:
         raise FileNotFoundError(f"no TFRecord part files under {input_dir}")

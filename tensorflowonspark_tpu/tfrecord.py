@@ -250,12 +250,18 @@ def iter_records(buf: bytes, verify: bool = True) -> Iterator[bytes]:
 # -- file API ---------------------------------------------------------------
 
 class TFRecordWriter:
-    """Write framed records to a file (tf.io.TFRecordWriter analogue)."""
+    """Write framed records to a file (tf.io.TFRecordWriter analogue).
+
+    ``path`` may be local or any fsspec scheme (``gs://``, ``memory://``,
+    ...) — the HDFS-write capability the reference gets from the
+    tensorflow-hadoop JAR (``dfutil.py::saveAsTFRecords``).
+    """
 
     def __init__(self, path: str):
+        from tensorflowonspark_tpu import filesystem as fsutil
+
         self.path = path
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        self._f = open(path, "wb")
+        self._f = fsutil.open_output(path, "wb")
 
     def write(self, record: bytes) -> None:
         self._f.write(frame_record(record))
@@ -280,8 +286,11 @@ def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
     True streaming (header, then exact-size payload read) — multi-GB part
     files are never slurped whole, matching ``tf.data.TFRecordDataset``'s
     memory profile.  CRCs still run natively via :func:`masked_crc`.
+    ``path`` may be local or any fsspec scheme (``gs://`` on TPU pods).
     """
-    with open(path, "rb") as f:
+    from tensorflowonspark_tpu import filesystem as fsutil
+
+    with fsutil.open_file(path, "rb") as f:
         off = 0
         while True:
             header = f.read(12)

@@ -109,3 +109,56 @@ def fn_terminating_consumer(args, ctx):
     feed.terminate(drain_secs=1.0)
     with open(os.path.join(ctx.working_dir, f"term.{ctx.executor_id}"), "w") as f:
         f.write("terminated")
+
+
+def fn_distributed_pjit_train(args, ctx):
+    """Cross-process SPMD training: ``ctx.initialize_distributed()`` over
+    loopback (CPU backend, gloo collectives) + one jitted train step whose
+    mesh spans BOTH worker processes.
+
+    Exercises the composed path SURVEY.md §4 calls the "local-cluster
+    pattern": agents/local procs + coordination service + cross-process
+    collectives (reference analogue: TF_CONFIG + MultiWorkerMirrored over
+    two Spark executors).  Writes ``dist.<id>`` with the final loss/weights
+    so the driver can compare against the single-process value.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    ctx.initialize_distributed()
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == ctx.num_workers, jax.process_count()
+    devs = jax.devices()  # global device list, across processes
+    mesh = Mesh(np.array(devs), ("dp",))
+    rep = NamedSharding(mesh, P())
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((8, 4)).astype(np.float32)
+    y = (X @ np.arange(1.0, 5.0, dtype=np.float32)).astype(np.float32)
+    xsh = NamedSharding(mesh, P("dp"))
+    Xg = jax.make_array_from_callback(X.shape, xsh, lambda i: X[i])
+    yg = jax.make_array_from_callback(y.shape, xsh, lambda i: y[i])
+
+    lr = 0.1
+
+    @jax.jit
+    def train_step(w, X, y):
+        def loss_fn(w):
+            return jnp.mean((X @ w - y) ** 2)  # mean over the GLOBAL batch
+
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        return w - lr * g, loss
+
+    w = jax.device_put(jnp.zeros((4,), jnp.float32), rep)
+    for _ in range(int(args.get("steps", 3))):
+        w, loss = train_step(w, Xg, yg)
+
+    path = os.path.join(ctx.working_dir, f"dist.{ctx.executor_id}")
+    w_host = np.asarray(jax.device_get(w))
+    with open(path, "w") as f:
+        f.write(f"{jax.process_count()}:{len(devs)}:{float(loss):.8f}:"
+                + ",".join(f"{v:.8f}" for v in w_host))

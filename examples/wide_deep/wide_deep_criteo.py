@@ -69,6 +69,14 @@ def main_fun(args, ctx):
         shardings = flax_shardings(mesh, abstract)
         params, opt_state = jax.jit(init_fn, out_shardings=shardings)()
 
+        # report how many tables actually landed on the ep axis — the whole
+        # point of PS-mode parity (and what the smoke test asserts)
+        ep_tables = sum(
+            1 for leaf in jax.tree.leaves(params)
+            if "ep" in str(getattr(getattr(leaf, "sharding", None), "spec", "")))
+        print(f"node {ctx.executor_id}: ep-sharded tables: {ep_tables}",
+              flush=True)
+
         data_sharding = NamedSharding(mesh, P(("dp", "fsdp"), None))
         label_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
 

@@ -41,6 +41,7 @@ import os
 import pickle
 import select
 import socket
+import tempfile
 import threading
 import time
 
@@ -55,10 +56,18 @@ class HostAgent(MessageSocket):
     """Per-host worker launcher (the Spark-executor stand-in)."""
 
     def __init__(self, port: int = 0, authkey: bytes | None = None,
-                 max_workers: int = 64, bind_host: str | None = None):
+                 max_workers: int = 64, bind_host: str | None = None,
+                 log_dir: str | None = None):
         self.port = port
         self.authkey = authkey
         self.max_workers = max_workers
+        # Per-executor stdout/stderr capture on the AGENT's host: Spark gave
+        # the reference executor logs/UI for free; without it a remote
+        # failure beyond the crash-file traceback is invisible from the
+        # driver (SURVEY.md §7 hard part 3).  Served back via LOGS.
+        self.log_dir = log_dir or os.path.join(
+            tempfile.gettempdir(), f"tfos_agent_logs_{os.getuid()}_{os.getpid()}")
+        self._log_paths: dict[int, str] = {}
         # A keyless agent is an arbitrary-code-execution endpoint; it must
         # never be reachable off-host.  Default bind: loopback without a
         # key, all interfaces with one.  An explicit bind_host overrides
@@ -160,6 +169,9 @@ class HostAgent(MessageSocket):
                 self.send(sock, "OK")
             elif kind == "STATUS":
                 self.send(sock, self._status())
+            elif kind == "LOGS":
+                self.send(sock, self._logs(msg.get("executor_ids"),
+                                           int(msg.get("tail", 16384))))
             elif kind == "TERMINATE":
                 self._terminate_workers()
                 self.send(sock, "OK")
@@ -186,20 +198,45 @@ class HostAgent(MessageSocket):
                 raise RuntimeError(f"executor {executor_id} already running")
             if len(self._procs) >= self.max_workers:
                 raise RuntimeError(f"agent at max_workers={self.max_workers}")
+            os.makedirs(self.log_dir, exist_ok=True)
+            log_path = os.path.join(self.log_dir, f"executor-{executor_id}.log")
+            with open(log_path, "wb"):  # truncate any previous run's log
+                pass
+            env = dict(msg.get("env") or {})
+            env["TFOS_WORKER_LOG"] = log_path  # fd-level capture, see _worker_entry
             ctx = mp.get_context("spawn")  # fork is unsafe after jax/XLA init
             p = ctx.Process(
                 target=_worker_entry,
-                args=(executor_id, dict(msg.get("env") or {}), msg["fn"],
+                args=(executor_id, env, msg["fn"],
                       msg["tf_args"], msg["cluster_meta"], msg["queues"]),
                 name=f"tfos-node-{executor_id}", daemon=False)
             p.start()
             self._procs[executor_id] = p
+            self._log_paths[executor_id] = log_path
         logger.info("agent: launched executor %d (pid %d)", executor_id, p.pid)
 
     def _status(self) -> dict[int, dict]:
         with self._lock:
             return {eid: {"alive": p.is_alive(), "exitcode": p.exitcode}
                     for eid, p in self._procs.items()}
+
+    def _logs(self, executor_ids=None, tail: int = 16384) -> dict[int, str]:
+        """Last ``tail`` bytes of each requested executor's captured log."""
+        with self._lock:
+            paths = dict(self._log_paths)
+        ids = sorted(paths) if executor_ids is None else \
+            [int(i) for i in executor_ids]
+        out: dict[int, str] = {}
+        for eid in ids:
+            path = paths.get(eid)
+            if not path or not os.path.exists(path):
+                continue
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail))
+                out[eid] = f.read().decode("utf-8", "replace")
+        return out
 
     def _terminate_workers(self) -> None:
         with self._lock:
@@ -259,6 +296,9 @@ class AgentBackend:
 
     def start(self, num_workers: int, fn, tf_args, cluster_meta: dict,
               queues) -> None:
+        for conn in self._conns:  # restartable: don't leak prior attempts
+            conn.close()
+        self._assignment = {}
         self._conns = [_AgentConn(a, self.authkey, self.connect_timeout)
                        for a in self.agent_addrs]
         for i in range(num_workers):
@@ -301,6 +341,28 @@ class AgentBackend:
             if deadline is not None and time.monotonic() >= deadline:
                 return False
             time.sleep(0.5)
+
+    def fetch_logs(self, executor_ids=None, tail: int = 16384) -> dict[int, str]:
+        """Tail of each executor's captured stdout/stderr, fetched over the
+        agent protocol — works without a shared filesystem (the crash-file
+        path does not).  ``TPUCluster.shutdown`` uses this to surface failed
+        remote workers' logs in the raised error."""
+        ids = None if executor_ids is None else {int(i) for i in executor_ids}
+        merged: dict[int, str] = {}
+        for conn in self._conns:
+            want = None
+            if ids is not None:
+                want = [i for i in ids if self._assignment.get(i) is conn]
+                if not want:
+                    continue
+            try:
+                got = conn.request({"type": "LOGS", "executor_ids": want,
+                                    "tail": tail})
+            except (OSError, EOFError, RuntimeError):
+                continue
+            merged.update({int(k): v for k, v in got.items()
+                           if ids is None or int(k) in ids})
+        return merged
 
     def terminate(self) -> None:
         for conn in self._conns:

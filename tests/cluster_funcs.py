@@ -162,3 +162,40 @@ def fn_distributed_pjit_train(args, ctx):
     with open(path, "w") as f:
         f.write(f"{jax.process_count()}:{len(devs)}:{float(loss):.8f}:"
                 + ",".join(f"{v:.8f}" for v in w_host))
+
+
+def fn_train_checkpoint_crash_once(args, ctx):
+    """Deterministic 'training' with orbax checkpoints; injects ONE chief
+    crash mid-run on the first attempt (sentinel file) so
+    ``run_with_recovery``'s relaunch-then-resume path is exercised.
+
+    Appends each attempt's start step to ``resume.<id>`` — the test asserts
+    the relaunch resumed from the checkpoint, not step 0.
+    """
+    import numpy as np
+
+    from tensorflowonspark_tpu.checkpoint import CheckpointManager
+
+    total, crash_at = args["total_steps"], args["crash_at"]
+    ckpt = CheckpointManager(args["model_dir"])
+    start, w = 0, np.zeros(())
+    latest = ckpt.latest_step()
+    if latest is not None:
+        state = ckpt.restore()
+        start, w = int(state["step"]), np.asarray(state["w"])
+    with open(os.path.join(ctx.working_dir, f"resume.{ctx.executor_id}"), "a") as f:
+        f.write(f"{start}\n")
+
+    sentinel = os.path.join(ctx.working_dir, "crash-injected")
+    for s in range(start, total):
+        w = w + 1.0
+        step = s + 1
+        if ctx.is_chief and step == crash_at and not os.path.exists(sentinel):
+            ckpt.save(step, {"step": np.asarray(step), "w": w}, force=True)
+            ckpt.wait()
+            with open(sentinel, "w"):
+                pass
+            raise RuntimeError("injected preemption")
+    if ctx.is_chief:
+        ckpt.save(total, {"step": np.asarray(total), "w": w}, force=True)
+        ckpt.close()

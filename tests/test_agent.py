@@ -14,7 +14,7 @@ import sys
 
 import pytest
 
-from tensorflowonspark_tpu.agent import AgentBackend, _AgentConn
+from tensorflowonspark_tpu.agent import AgentBackend, HostAgent, _AgentConn
 from tests import cluster_funcs as funcs
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -138,3 +138,41 @@ def test_agent_oversubscription(agent_fleet, tmp_path):
             roles.append(f.read())
     assert len(roles) == 4
     assert sum(1 for r in roles if r.split(":")[2] == "1") == 1  # one chief
+
+
+def test_failed_worker_logs_reach_driver_via_agent(tmp_path):
+    """A remote-path worker's stack trace must reach the driver THROUGH THE
+    AGENT (LOGS protocol), not the shared filesystem: the crash files are
+    deleted before shutdown to simulate a no-shared-FS pod (VERDICT r1
+    missing #4 / SURVEY.md §7 hard part 3)."""
+    import glob
+    import os
+
+    from tensorflowonspark_tpu.cluster import TPUCluster
+
+    key = b"\x02" * 16
+    agent = HostAgent(port=0, authkey=key, log_dir=str(tmp_path / "agentlogs"))
+    addr = agent.start()
+    try:
+        backend = AgentBackend([addr], authkey=key,
+                               worker_env={"JAX_PLATFORMS": "cpu"})
+        cluster = TPUCluster.run(funcs.fn_crash, {}, num_workers=1,
+                                 working_dir=str(tmp_path), backend=backend,
+                                 reservation_timeout=60)
+        backend.join(timeout=60)  # let the worker crash
+        # simulate remote host: the driver cannot see the crash files
+        for f in glob.glob(os.path.join(str(tmp_path), "error.*")):
+            os.remove(f)
+
+        with pytest.raises(RuntimeError) as ei:
+            cluster.shutdown(timeout=60)
+        msg = str(ei.value)
+        assert "deliberate failure" in msg, msg  # the actual traceback text
+        assert "executor 0 log tail" in msg
+
+        # the LOGS call is also available directly
+        logs = backend.fetch_logs([0])
+        assert "deliberate failure" in logs[0]
+        backend.close()
+    finally:
+        agent.stop()

@@ -128,3 +128,69 @@ def test_partition_even_split():
 def test_partition_explicit():
     parts = _partition(Partitioned([[1, 2], [3]]), 99)
     assert parts == [[1, 2], [3]]
+
+
+def test_driver_side_streaming_stop(tmp_path):
+    """An unbounded feed (num_epochs=0) must be stoppable from the DRIVER
+    via stop_feed(), without worker-side DataFeed.terminate() (reference:
+    TFCluster.py::shutdown's Spark-Streaming background path)."""
+    import threading
+    import time as _time
+
+    cluster = _run(funcs.fn_sum_feed, 2, tmp_path, tf_args={"batch_size": 8})
+    feeder = threading.Thread(
+        target=cluster.train,
+        args=(list(range(40)),), kwargs={"num_epochs": 0, "chunk_size": 8},
+        daemon=True)
+    feeder.start()
+    _time.sleep(1.5)             # let several epochs stream
+    assert feeder.is_alive(), "unbounded feed should still be streaming"
+
+    cluster.stop_feed()
+    feeder.join(timeout=30)
+    assert not feeder.is_alive(), "stop_feed() must unblock the feeder thread"
+
+    cluster.shutdown(timeout=60)  # delivers EndOfFeed; workers drain + exit
+    consumed = 0
+    for i in range(2):
+        with open(os.path.join(str(tmp_path), f"sum.{i}")) as f:
+            consumed += int(f.read().split(":")[1])
+    assert consumed > 0, "workers should have consumed streamed data"
+
+
+def test_run_with_recovery_resumes_from_checkpoint(tmp_path):
+    """One injected chief crash mid-training: run_with_recovery must
+    relaunch the cluster and the job must complete with the step count
+    preserved (resume from orbax, not restart from 0) — SURVEY.md §5
+    'recovery = whole-job restart + resume'."""
+    from tensorflowonspark_tpu.checkpoint import CheckpointManager
+    from tensorflowonspark_tpu.cluster import run_with_recovery
+
+    model_dir = str(tmp_path / "ckpt")
+    run_with_recovery(
+        funcs.fn_train_checkpoint_crash_once,
+        {"total_steps": 7, "crash_at": 3, "model_dir": model_dir},
+        num_workers=2, max_restarts=2,
+        working_dir=str(tmp_path), worker_env={"JAX_PLATFORMS": "cpu"},
+        reservation_timeout=60, shutdown_timeout=120)
+
+    ckpt = CheckpointManager(model_dir)
+    assert ckpt.latest_step() == 7
+    state = ckpt.restore()
+    assert float(state["w"]) == 7.0  # 3 pre-crash steps + 4 resumed, not 7+3
+    ckpt.close()
+
+    with open(tmp_path / "resume.0") as f:
+        starts = f.read().split()
+    assert starts[0] == "0", starts
+    assert "3" in starts[1:], f"chief must resume from step 3, got {starts}"
+
+
+def test_run_with_recovery_gives_up_after_max_restarts(tmp_path):
+    from tensorflowonspark_tpu.cluster import run_with_recovery
+
+    with pytest.raises(RuntimeError, match="deliberate failure"):
+        run_with_recovery(
+            funcs.fn_crash, {}, num_workers=1, max_restarts=1,
+            working_dir=str(tmp_path), worker_env={"JAX_PLATFORMS": "cpu"},
+            reservation_timeout=60, shutdown_timeout=60)

@@ -9,9 +9,13 @@ requires.
 
 What is measured (unlike round 1's raw ``jax.jit`` loop):
   - the *framework* path — ``DataParallelStrategy.init_state`` /
-    ``build_train_step`` + ``Dataset`` + ``device_prefetch`` — i.e. the code a
-    user of this package actually runs (SURVEY.md §3.2's "move the boundary
-    … with prefetch" promise), and
+    ``build_train_step`` + ``Dataset.cache_on_device`` — i.e. the code a
+    user of this package actually runs, with the input pipeline replaying
+    HBM-resident batches (the compute-bound regime; MLPerf-style), and
+  - the host→device *streaming* path (``Dataset.prefetch`` +
+    ``device_prefetch``) plus the raw link bandwidth (``h2d_MBps``), so the
+    data plane is a measured artifact too — on the axon tunnel the link is
+    ~25 MB/s, which bounds the streamed number far below the chip's, and
   - a raw ``jax.jit`` loop over the identical step, so the framework overhead
     is itself a reported number (``raw_images_per_sec``), and
   - MFU: XLA's own ``cost_analysis()`` FLOPs per step ÷ step time ÷ chip
@@ -140,24 +144,50 @@ def bench_resnet() -> dict:
     step = strategy.build_train_step(loss_fn)
     sharding = strategy.batch_sharding()
 
-    def run_framework(n: int) -> float:
-        ds = Dataset.from_generator(
-            lambda: ({"x": x_np, "y": y_np} for _ in range(n))).prefetch(2)
+    def run_framework(n: int, cached_ds=None) -> float:
+        """Time n framework steps.  With ``cached_ds`` (a device-cached
+        Dataset) the input pipeline replays HBM-resident batches — the
+        compute-bound number real hardware approaches; without it, every
+        batch streams host→device (bounded here by the tunnel's bandwidth,
+        reported separately as h2d_MBps)."""
         nonlocal state
+        if cached_ds is not None:
+            it = iter(cached_ds.repeat(n))
+        else:
+            ds = Dataset.from_generator(
+                lambda: ({"x": x_np, "y": y_np} for _ in range(n))).prefetch(2)
+            it = device_prefetch(iter(ds), depth=2, sharding=sharding)
         t0 = time.perf_counter()
         last = None
-        for b in device_prefetch(iter(ds), depth=2, sharding=sharding):
+        for b in it:
             state, last = step(state, b)
         _ = float(last["loss"])  # drain the pipeline
         return time.perf_counter() - t0
 
+    # Headline: framework strategy path with the input pipeline device-cached
+    # (Dataset.cache_on_device — one element, replayed each step).
+    cached = Dataset.from_generator(
+        lambda: iter([{"x": x_np, "y": y_np}])).cache_on_device(sharding)
     log("bench: compiling framework step + warmup")
-    run_framework(warmup)
-    log("bench: timing framework path")
-    dt = run_framework(steps)
+    run_framework(warmup, cached_ds=cached)
+    log("bench: timing framework path (device-cached input)")
+    dt = run_framework(steps, cached_ds=cached)
     images_per_sec = batch * steps / dt
-    log(f"bench: framework {steps} steps in {dt:.2f}s "
+    log(f"bench: framework cached {steps} steps in {dt:.2f}s "
         f"-> {images_per_sec:.1f} img/s")
+
+    # Secondary: host->device streaming path + raw link bandwidth, so the
+    # data-plane cost is itself a measured artifact (on this axon tunnel the
+    # link is ~MB/s; a real TPU-VM's PCIe/DMA is GB/s).
+    stream_steps = max(3, steps // 4)
+    stream_dt = run_framework(stream_steps)
+    streamed_images_per_sec = batch * stream_steps / stream_dt
+    bytes_per_batch = x_np.nbytes + y_np.nbytes
+    t0 = time.perf_counter()
+    jax.block_until_ready(jax.device_put({"x": x_np, "y": y_np}, sharding))
+    h2d_mbps = bytes_per_batch / (time.perf_counter() - t0) / 1e6
+    log(f"bench: streamed {streamed_images_per_sec:.1f} img/s, "
+        f"h2d {h2d_mbps:.1f} MB/s")
 
     # ---- MFU from the compiled step ----
     example_batch = {"x": jnp.asarray(x_np), "y": jnp.asarray(y_np)}
@@ -202,11 +232,13 @@ def bench_resnet() -> dict:
 
     out = {
         "metric": (f"resnet50_train_images_per_sec_per_chip"
-                   f"[{platform} b{batch} {image}px bf16]"),
+                   f"[{platform} b{batch} {image}px bf16 device-cached-input]"),
         "value": round(images_per_sec / max(1, len(jax.devices())), 2),
         "unit": "images/sec",
         "platform": platform,
         "images_per_sec_total": round(images_per_sec, 2),
+        "streamed_images_per_sec": round(streamed_images_per_sec, 2),
+        "h2d_MBps": round(h2d_mbps, 1),
     }
     if mfu is not None:
         out["mfu"] = round(mfu, 4)

@@ -27,3 +27,6 @@ from tensorflowonspark_tpu.parallel.embedding import (ShardedEmbedding,
                                                       sharded_embedding_lookup)  # noqa: F401
 from tensorflowonspark_tpu.parallel.ring_attention import (ring_attention,
                                                            ring_self_attention)  # noqa: F401
+from tensorflowonspark_tpu.parallel.pipeline import (PipelineStrategy,
+                                                     pipeline_apply,
+                                                     stack_stage_params)  # noqa: F401

@@ -1,0 +1,213 @@
+"""Pipeline parallelism: GPipe-style microbatched execution over the ``pp`` axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2c: "Pipeline parallel
+(PP): No"); its nearest notion of model distribution is variable placement
+over parameter servers.  On TPU, pipelining is how a model taller than one
+chip's HBM (or one ICI domain) scales across slices: each ``pp`` mesh shard
+holds a contiguous block of layers ("stage"), microbatches stream through the
+stages, and stage-to-stage activation transfer is a single neighbour
+``ppermute`` riding ICI/DCN — never host memory.
+
+Design (TPU-first, not a port of any GPU schedule runner):
+
+- The model's repeated trunk is expressed as ONE ``stage_fn(params, x) -> y``
+  plus a *stacked* parameter tree whose leading axis is the stage index.
+  This is the same "scan over layers" layout XLA already favours for big
+  models; stacking is what lets a single SPMD program hold every stage.
+- :func:`pipeline_apply` wraps the schedule in ``shard_map`` over ``pp``:
+  each device slices out its own stage's parameters, runs the classic GPipe
+  fill/steady/drain loop as a ``lax.scan`` over ``num_microbatches +
+  num_stages - 1`` ticks, and rotates activations with a circular
+  ``ppermute``.  Everything is compiled — no host-side scheduler process,
+  no per-microbatch Python (contrast: GPU frameworks' runtime schedulers).
+- The wrapped function is **differentiable**: ``jax.grad`` through
+  ``shard_map``/``ppermute``/``scan`` yields exactly the reverse schedule
+  (activation grads ppermute backwards through the stages), so the strategy
+  layer reuses the ordinary ``value_and_grad`` + optax train step.  Each
+  device materialises gradients only for its own stage block.
+- Composes with data parallelism outside the ``shard_map``: the batch stays
+  sharded over ``dp``/``fsdp`` and XLA inserts the gradient all-reduce for
+  the mean loss as usual (GSPMD resumes at the shard_map boundary).
+
+Bubble fraction is the GPipe bound (S-1)/(M+S-1); pick
+``num_microbatches >= 4 * num_stages`` to keep it under ~20%.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tensorflowonspark_tpu.parallel import sharding as sh
+from tensorflowonspark_tpu.parallel.mesh import MeshSpec, make_mesh
+from tensorflowonspark_tpu.parallel.strategy import MeshStrategy, TrainState
+
+
+def stack_stage_params(param_list):
+    """Stack per-stage parameter trees into one tree with a leading stage axis.
+
+    ``param_list`` is a list of identically-structured pytrees (one per
+    stage); the result's every leaf gains dim 0 of size ``num_stages`` — the
+    axis :func:`pipeline_apply` shards over ``pp``.
+    """
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)
+
+
+def pipeline_spec(tree) -> object:
+    """PartitionSpecs sharding every leaf's leading (stage) axis over ``pp``."""
+    return jax.tree.map(lambda leaf: P("pp", *([None] * (leaf.ndim - 1))), tree)
+
+
+def pipeline_apply(mesh, stage_fn, stage_params, x, *,
+                   num_microbatches: int, axis_name: str = "pp",
+                   remat: bool = True):
+    """Run ``x`` through all pipeline stages; returns the final activations.
+
+    Args:
+      mesh: a mesh whose ``axis_name`` dimension is the stage count ``S``.
+      stage_fn: ``(params, x) -> y`` for ONE stage, with ``y.shape ==
+        x.shape`` (stages are homogeneous, as in a transformer trunk).
+        Runs *inside* ``shard_map`` — any tensor parallelism within the
+        stage must use explicit collectives over other mesh axes.
+      stage_params: pytree whose leaves have leading axis ``S``
+        (see :func:`stack_stage_params`).
+      x: batch ``[B, ...]``; ``B`` must divide by ``num_microbatches``.
+      remat: rematerialise each stage application on the backward pass
+        (GPipe's per-microbatch checkpointing; memory ~O(M·act) → O(M·act)
+        for boundaries only, stage internals recomputed).
+
+    Differentiable; grads of ``stage_params`` come back with the same
+    stacked layout.
+    """
+    n_stages = mesh.shape[axis_name]
+    if num_microbatches < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got {num_microbatches}")
+    batch = x.shape[0]
+    data_shards = 1
+    for ax in sh.DATA_AXES:
+        data_shards *= mesh.shape.get(ax, 1)
+    if batch % (num_microbatches * data_shards):
+        raise ValueError(
+            f"global batch {batch} must divide by num_microbatches "
+            f"({num_microbatches}) x data shards ({data_shards}); each "
+            f"dp/fsdp shard pipelines its own microbatches")
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    params_spec = pipeline_spec(stage_params)
+    # Batch stays sharded over the data axes and replicated over pp: every
+    # stage sees the full (local) batch but only stage 0 reads it.
+    x_spec = P(sh.DATA_AXES, *([None] * (x.ndim - 1)))
+
+    def schedule(block, x_local):
+        # block: this device's [1, ...] slice of the stacked params.
+        my_params = jax.tree.map(lambda p: jnp.squeeze(p, 0), block)
+        stage = jax.lax.axis_index(axis_name)
+        mb = x_local.shape[0] // num_microbatches
+        x_mb = x_local.reshape((num_microbatches, mb) + x_local.shape[1:])
+        n_ticks = num_microbatches + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            act, out = carry
+            # Stage 0 injects microbatch t (clamped: ticks past the last
+            # injection feed garbage that drains before the collect window).
+            inject = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.minimum(t, num_microbatches - 1), 0, keepdims=False)
+            inp = jnp.where(stage == 0, inject, act)
+            y = fn(my_params, inp)
+            # Last stage collects: tick t completes microbatch t-(S-1).
+            out_idx = jnp.clip(t - (n_stages - 1), 0, num_microbatches - 1)
+            valid = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
+            written = jax.lax.dynamic_update_index_in_dim(out, y, out_idx, 0)
+            out = jnp.where(valid, written, out)
+            # Rotate activations one stage forward (stage 0's incoming value
+            # is drain garbage, overwritten by the next inject).
+            act = jax.lax.ppermute(y, axis_name, perm)
+            return (act, out), None
+
+        # Initial carries derive from x (device-varying over the data axes)
+        # and are marked pp-varying explicitly: each stage's carry holds
+        # different values, and shard_map's varying-axes check (vma) requires
+        # the scan carry to declare that up front.
+        act0 = jax.lax.pcast(jnp.zeros_like(x_mb[0]), (axis_name,), to="varying")
+        out0 = jax.lax.pcast(jnp.zeros_like(x_mb), (axis_name,), to="varying")
+        (_, out), _ = jax.lax.scan(tick, (act0, out0), jnp.arange(n_ticks))
+        # Only the last stage holds real outputs; broadcast over pp so the
+        # result is well-defined on every shard (and GSPMD can resume).
+        out = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out)),
+            axis_name)
+        return out.reshape(x_local.shape)
+
+    mapped = jax.shard_map(
+        schedule, mesh=mesh,
+        in_specs=(params_spec, x_spec), out_specs=x_spec)
+    return mapped(stage_params, x)
+
+
+class _PipelineRules:
+    """Partition rules: leaves under the ``stages`` subtree shard their
+    leading (stage) axis over ``pp``; everything else replicates."""
+
+    def tree_specs(self, params):
+        def spec(path, leaf):
+            keys = [getattr(k, "key", getattr(k, "idx", None)) for k in path]
+            if "stages" in keys and getattr(leaf, "ndim", 0) >= 1:
+                return P("pp", *([None] * (leaf.ndim - 1)))
+            return P()
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        return jax.tree_util.tree_unflatten(
+            treedef, [spec(p, l) for p, l in flat])
+
+    def tree_shardings(self, mesh, params):
+        return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                            self.tree_specs(params),
+                            is_leaf=lambda x: isinstance(x, P))
+
+
+class PipelineStrategy(MeshStrategy):
+    """Train a stage-stacked model with GPipe pipelining (+ optional DP).
+
+    Usage::
+
+        strat = PipelineStrategy(stage_fn, num_stages=4, num_microbatches=16)
+        state = strat.init_state(init_fn, tx)     # init_fn returns
+                                                  # {"stages": stacked, ...}
+        step = strat.build_train_step(loss_fn)    # loss_fn uses strat.apply
+
+    ``init_fn`` must return a dict with a ``"stages"`` entry holding the
+    stacked per-stage parameters (leading axis = ``num_stages``); any other
+    entries (embedders, heads) are replicated.  Inside ``loss_fn``, run the
+    trunk with ``strategy.apply(params["stages"], x)``.
+
+    Reference parity note: this is net-new capability (SURVEY.md §2c reserves
+    the ``pp`` axis); the API mirrors the other strategies so it slots into
+    the same ``map_fun`` contract.
+    """
+
+    def __init__(self, stage_fn, *, num_stages: int, num_microbatches: int | None = None,
+                 devices=None, remat: bool = True, **axis_sizes):
+        if "pp" in axis_sizes:
+            raise ValueError("pass num_stages=, not pp= (they are the same axis)")
+        axis_sizes.setdefault("dp", -1)
+        mesh = make_mesh(MeshSpec(**{"pp": num_stages, **axis_sizes}),
+                         devices=devices)
+        super().__init__(mesh=mesh, rules=_PipelineRules())
+        self.stage_fn = stage_fn
+        self.num_stages = num_stages
+        self.num_microbatches = (num_microbatches if num_microbatches is not None
+                                 else 4 * num_stages)
+        self.remat = remat
+
+    def apply(self, stage_params, x):
+        return pipeline_apply(self.mesh, self.stage_fn, stage_params, x,
+                              num_microbatches=self.num_microbatches,
+                              remat=self.remat)
+
+    @property
+    def bubble_fraction(self) -> float:
+        """GPipe idle fraction: (S-1)/(M+S-1)."""
+        s, m = self.num_stages, self.num_microbatches
+        return (s - 1) / (m + s - 1)

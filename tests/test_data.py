@@ -134,6 +134,50 @@ def test_device_prefetch_roundtrip():
     assert np.array_equal(np.concatenate(out), np.arange(12))
 
 
+def test_cache_on_device_replays_device_arrays():
+    import jax
+
+    calls = [0]
+
+    def gen():
+        calls[0] += 1
+        yield from (np.full((2,), i, np.float32) for i in range(3))
+
+    ds = Dataset.from_generator(gen).cache_on_device()
+    first = list(ds)
+    second = list(ds)
+    assert calls[0] == 1, "source must be consumed exactly once"
+    assert all(isinstance(b, jax.Array) for b in first)
+    # replay yields the SAME device buffers (no re-transfer)
+    assert all(a is b for a, b in zip(first, second))
+    assert np.array_equal(np.stack(second), [[0, 0], [1, 1], [2, 2]])
+
+    # epochs via .repeat() on top of the cache reuse the device arrays too
+    ds2 = Dataset.from_generator(gen).cache_on_device().repeat(2)
+    out = list(ds2)
+    assert len(out) == 6 and calls[0] == 2
+
+
+def test_cache_on_device_discards_partial_first_pass():
+    ds = Dataset.from_tensor_slices(np.arange(4, dtype=np.float32)) \
+        .batch(1).cache_on_device()
+    it = iter(ds)
+    next(it)  # abandon after one element
+    full = list(ds)
+    assert len(full) == 4, "partial pass must not be replayed as complete"
+
+
+def test_cache_on_device_stale_iterator_cannot_corrupt_cache():
+    ds = Dataset.from_tensor_slices(np.arange(4, dtype=np.float32)) \
+        .batch(1).cache_on_device()
+    stale = iter(ds)
+    next(stale)                      # first pass, abandoned mid-way
+    assert len(list(ds)) == 4       # second pass completes the cache
+    list(stale)                     # stale iterator resumes and finishes
+    replay = list(ds)               # replay must still be the clean 4
+    assert [float(b[0]) for b in replay] == [0.0, 1.0, 2.0, 3.0]
+
+
 def test_full_pipeline_end_to_end(tmp_path):
     """The worker-side recipe from the module docstring, minus the mesh."""
     write_records(str(tmp_path / "part-00000"),

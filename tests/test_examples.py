@@ -18,7 +18,11 @@ EX = os.path.join(ROOT, "examples")
 
 
 def _run(script, *argv, timeout=300, cpu_flag=True):
-    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    # Deterministic device count for the example subprocess: the conftest's
+    # 8-device XLA_FLAGS would otherwise leak in and break examples whose
+    # tiny test batch isn't divisible by dp=8.
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
     cmd = [sys.executable, os.path.join(EX, script)]
     if cpu_flag:
         cmd.append("--cpu")

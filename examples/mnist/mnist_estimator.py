@@ -1,0 +1,114 @@
+"""MNIST, estimator-style — ``train_and_evaluate`` under the cluster.
+
+Reference: ``examples/mnist/estimator/`` (SURVEY.md §2d "MNIST /
+Estimator"): a ``tf.estimator.Estimator`` driven by
+``tf.estimator.train_and_evaluate(TrainSpec, EvalSpec)`` under ``TF_CONFIG``
+— model_dir-centric, periodically evaluating, resumable from the latest
+checkpoint.  Here the same contract runs TPU-native
+(:mod:`tensorflowonspark_tpu.estimator`): the model is the
+(init_fn, loss_fn, tx) triple, training goes through a mesh strategy, and
+orbax provides checkpoint/resume behind ``model_dir``.
+
+Run:
+
+    python examples/mnist/mnist_estimator.py --cpu --cluster_size 2 \
+        --max_steps 40 --model_dir /tmp/mnist_est
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def main_fun(args, ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.estimator import (Estimator, EvalSpec,
+                                                 TrainSpec, train_and_evaluate)
+    from tensorflowonspark_tpu.models import MNISTNet
+
+    if jax.default_backend() == "tpu" and ctx.num_workers > 1:
+        ctx.initialize_distributed()
+
+    # synthetic shard per worker (same scheme as mnist_tf.py)
+    rng = np.random.default_rng(1234 + ctx.executor_id)
+    n = args.num_samples // ctx.num_workers
+    images = rng.random((n, 28, 28, 1), np.float32)
+    labels = rng.integers(0, 10, size=n)
+    n_eval = max(args.batch_size, n // 10)
+
+    def train_input_fn():
+        order = np.random.default_rng(ctx.executor_id).permutation(n - n_eval)
+        for i in range(0, len(order) - args.batch_size + 1, args.batch_size):
+            idx = order[i:i + args.batch_size]
+            yield {"x": images[idx], "y": labels[idx]}
+
+    def eval_input_fn():
+        for i in range(n - n_eval, n - args.batch_size + 1, args.batch_size):
+            yield {"x": images[i:i + args.batch_size],
+                   "y": labels[i:i + args.batch_size]}
+
+    model = MNISTNet()
+    sample = jnp.zeros((args.batch_size, 28, 28, 1), jnp.float32)
+
+    def init_fn():
+        return model.init(jax.random.key(0), sample)["params"]
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    def metrics_fn(params, batch):
+        logits = model.apply({"params": params}, batch["x"])
+        return {"loss": optax.softmax_cross_entropy_with_integer_labels(
+                    logits, batch["y"]).mean(),
+                "accuracy": (logits.argmax(-1) == batch["y"]).mean()}
+
+    # per-worker model_dir on the CPU test topology (independent replicas);
+    # one shared dir on a real pod (single SPMD program, chief-coordinated)
+    model_dir = args.model_dir
+    if model_dir and not (jax.default_backend() == "tpu"):
+        model_dir = os.path.join(model_dir, f"worker{ctx.executor_id}")
+
+    with Estimator(init_fn, loss_fn, optax.adam(args.lr), model_dir,
+                   eval_metrics_fn=metrics_fn,
+                   save_every_steps=args.save_every) as est:
+        final = train_and_evaluate(
+            est,
+            TrainSpec(input_fn=train_input_fn, max_steps=args.max_steps),
+            EvalSpec(input_fn=eval_input_fn, steps=2,
+                     throttle_steps=args.throttle_steps))
+        print(f"node {ctx.executor_id}: final eval "
+              f"step={final['global_step']} "
+              f"loss={final['loss']:.4f} acc={final['accuracy']:.3f}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    from tensorflowonspark_tpu import InputMode, TPUCluster
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=2)
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--max_steps", type=int, default=40)
+    p.add_argument("--throttle_steps", type=int, default=20)
+    p.add_argument("--save_every", type=int, default=20)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--num_samples", type=int, default=1024)
+    p.add_argument("--model_dir", default="/tmp/mnist_estimator")
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    worker_env = {"JAX_PLATFORMS": "cpu"} if args.cpu else None
+    cluster = TPUCluster.run(main_fun, args, args.cluster_size,
+                             input_mode=InputMode.TENSORFLOW,
+                             worker_env=worker_env, reservation_timeout=60)
+    cluster.shutdown(timeout=600)
+    print("mnist_estimator: done")

@@ -90,8 +90,18 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state, force: bool = False) -> bool:
-        """Save ``state`` (any pytree) at ``step``; returns True if saved."""
-        return self._mngr.save(int(step), args=self._ocp.args.StandardSave(state),
+        """Save ``state`` (any pytree) at ``step``; returns True if saved.
+
+        A step that already exists on disk is skipped (a restart or
+        train/eval interleave may revisit its boundary step) — unless
+        ``force=True``, which also bypasses ``save_interval_steps`` and
+        REPLACES the existing step (delete + rewrite)."""
+        step = int(step)
+        if step in self._mngr.all_steps():
+            if not force:
+                return False
+            self._mngr.delete(step)
+        return self._mngr.save(step, args=self._ocp.args.StandardSave(state),
                                force=force)
 
     def restore(self, step: int | None = None, target=None):

@@ -1,0 +1,202 @@
+"""Estimator-style training: the ``tf.estimator.train_and_evaluate`` surface.
+
+The reference's estimator example (``examples/mnist/estimator/``,
+SURVEY.md §2d) wraps a model in ``tf.estimator.Estimator`` and drives it
+with ``tf.estimator.train_and_evaluate(est, TrainSpec, EvalSpec)`` under
+``TF_CONFIG``: a ``model_dir``-centric loop that trains, periodically
+evaluates, checkpoints, and resumes from the latest checkpoint on restart.
+
+This module rebuilds that contract TPU-native:
+
+- the model is (``init_fn``, ``loss_fn``, optax ``tx``) — the same triple
+  every strategy in :mod:`.parallel.strategy` consumes, so one definition
+  serves both the estimator and the lower-level APIs;
+- training runs through a :class:`~.parallel.strategy.MeshStrategy` train
+  step (jit + shardings; collectives by XLA);
+- checkpoint/resume is orbax behind ``model_dir``
+  (:class:`~.checkpoint.CheckpointManager`), restored on construction
+  exactly like ``tf.estimator`` warm-starts from ``model_dir``;
+- ``train_and_evaluate`` interleaves train and eval by step budget
+  (``EvalSpec.throttle_steps`` ~ the reference's throttle_secs, expressed
+  in steps — deterministic, the TPU-friendly unit).
+
+Usage::
+
+    est = Estimator(init_fn, loss_fn, tx, model_dir="/tmp/m",
+                    eval_metrics_fn=metrics_fn)
+    final = train_and_evaluate(
+        est,
+        TrainSpec(input_fn=lambda: train_ds, max_steps=1000),
+        EvalSpec(input_fn=lambda: eval_ds, steps=10, throttle_steps=200))
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class TrainSpec:
+    """What to train on.  ``input_fn() -> iterable of batches`` (a
+    :class:`~.data.Dataset` or any iterable; re-invoked per epoch when the
+    iterable is exhausted before ``max_steps``)."""
+
+    input_fn: Callable[[], object]
+    max_steps: int
+
+
+@dataclasses.dataclass
+class EvalSpec:
+    """How to evaluate.  ``steps`` batches from ``input_fn`` per round;
+    a round runs every ``throttle_steps`` train steps (and once at the
+    end)."""
+
+    input_fn: Callable[[], object]
+    steps: int = 10
+    throttle_steps: int = 100
+
+
+class Estimator:
+    """``model_dir``-centric trainer (reference:
+    ``tf.estimator.Estimator`` in ``examples/mnist/estimator/``).
+
+    Args:
+      init_fn: ``() -> params`` (sharded-at-init through the strategy).
+      loss_fn: ``(params, batch) -> scalar`` (or ``(scalar, aux)`` with
+        ``loss_fn.has_aux = True``) — same contract as
+        ``MeshStrategy.build_train_step``.
+      tx: optax gradient transform.
+      model_dir: checkpoint directory; if it holds a checkpoint, training
+        resumes from it (the tf.estimator restart contract).
+      strategy: a :class:`~.parallel.strategy.MeshStrategy`; default
+        ``DataParallelStrategy`` over all local devices.
+      eval_metrics_fn: optional ``(params, batch) -> dict`` of scalar
+        metrics; defaults to reporting eval loss.
+      save_every_steps: checkpoint cadence during ``train``.
+    """
+
+    def __init__(self, init_fn, loss_fn, tx, model_dir: str, *,
+                 strategy=None, eval_metrics_fn: Optional[Callable] = None,
+                 save_every_steps: int = 100, max_to_keep: int = 5):
+        from tensorflowonspark_tpu.checkpoint import CheckpointManager
+        from tensorflowonspark_tpu.parallel.strategy import DataParallelStrategy
+
+        self.strategy = strategy or DataParallelStrategy()
+        self.loss_fn = loss_fn
+        self.eval_metrics_fn = eval_metrics_fn
+        self.model_dir = model_dir
+        self.save_every_steps = save_every_steps
+        self._ckpt = CheckpointManager(model_dir, max_to_keep=max_to_keep)
+        self._state = self.strategy.init_state(init_fn, tx)
+        latest = self._ckpt.latest_step()
+        if latest is not None:
+            self._state = self._ckpt.restore(latest, target=self._state)
+            logger.info("estimator: resumed from %s step %d", model_dir, latest)
+        # Host-side mirror of state.step: reading the device scalar every
+        # loop iteration would block on the in-flight step and kill JAX's
+        # async dispatch; the mirror advances with each dispatched step.
+        self._host_step = int(self._state.step)
+        self._train_step = None
+        self._eval_step = None
+
+    # ------------------------------------------------------------------
+    @property
+    def global_step(self) -> int:
+        return self._host_step
+
+    @property
+    def params(self):
+        return self._state.params
+
+    def train(self, input_fn, max_steps: int) -> int:
+        """Train until ``global_step == max_steps`` (tf.estimator's
+        ``max_steps`` semantics: a budget on the TOTAL step count, so a
+        resumed job does only the remainder)."""
+        from tensorflowonspark_tpu.data import device_prefetch
+
+        if self._train_step is None:
+            self._train_step = self.strategy.build_train_step(self.loss_fn)
+        sharding = self.strategy.batch_sharding()
+        while self._host_step < max_steps:
+            made_progress = False
+            # device_prefetch keeps transfers ahead of compute — the same
+            # host/device overlap the data plane provides everywhere else
+            for b in device_prefetch(iter(input_fn()), depth=2,
+                                     sharding=sharding):
+                if self._host_step >= max_steps:
+                    break
+                self._state, metrics = self._train_step(self._state, b)
+                self._host_step += 1
+                made_progress = True
+                if self._host_step % self.save_every_steps == 0:
+                    self._ckpt.save(self._host_step, self._state)
+            if not made_progress:
+                raise ValueError("input_fn yielded no batches")
+        self._ckpt.save(self._host_step, self._state)
+        self._ckpt.wait()
+        return self._host_step
+
+    def evaluate(self, input_fn, steps: int | None = None) -> dict:
+        """Mean metrics over ``steps`` batches (all batches when None)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._eval_step is None:
+            metrics_fn = self.eval_metrics_fn
+            if metrics_fn is None:
+                def metrics_fn(params, batch):
+                    out = self.loss_fn(params, batch)
+                    loss = out[0] if isinstance(out, tuple) else out
+                    return {"loss": loss}
+            self._eval_step = jax.jit(metrics_fn)
+        sharding = self.strategy.batch_sharding()
+        totals: dict = {}
+        n = 0
+        for batch in input_fn():
+            if steps is not None and n >= steps:
+                break
+            m = self._eval_step(self._state.params,
+                                jax.device_put(batch, sharding))
+            for k, v in m.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+            n += 1
+        if n == 0:
+            raise ValueError("eval input_fn yielded no batches")
+        out = {k: v / n for k, v in totals.items()}
+        out["global_step"] = self.global_step
+        return out
+
+    def close(self) -> None:
+        self._ckpt.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def train_and_evaluate(estimator: Estimator, train_spec: TrainSpec,
+                       eval_spec: EvalSpec) -> dict:
+    """Interleaved train/eval loop (reference:
+    ``tf.estimator.train_and_evaluate``): train ``throttle_steps``, eval,
+    repeat until ``max_steps``, with a final eval.  Returns the last eval
+    metrics.  Restart-safe: a relaunched job resumes from ``model_dir``'s
+    latest checkpoint and completes only the remaining budget."""
+    metrics: dict = {}
+    while estimator.global_step < train_spec.max_steps:
+        target = min(estimator.global_step + eval_spec.throttle_steps,
+                     train_spec.max_steps)
+        estimator.train(train_spec.input_fn, target)
+        metrics = estimator.evaluate(eval_spec.input_fn, eval_spec.steps)
+        logger.info("estimator: step %d eval %s", estimator.global_step,
+                    {k: round(v, 4) for k, v in metrics.items()})
+    if not metrics:
+        # resumed already at (or past) max_steps: the promised final eval
+        # still happens
+        metrics = estimator.evaluate(eval_spec.input_fn, eval_spec.steps)
+    return metrics

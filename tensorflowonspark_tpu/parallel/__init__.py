@@ -30,3 +30,4 @@ from tensorflowonspark_tpu.parallel.ring_attention import (ring_attention,
 from tensorflowonspark_tpu.parallel.pipeline import (PipelineStrategy,
                                                      pipeline_apply,
                                                      stack_stage_params)  # noqa: F401
+from tensorflowonspark_tpu.parallel.transformer import make_transformer_stage  # noqa: F401

@@ -61,7 +61,7 @@ def pipeline_spec(tree) -> object:
 
 def pipeline_apply(mesh, stage_fn, stage_params, x, *,
                    num_microbatches: int, axis_name: str = "pp",
-                   remat: bool = True):
+                   remat: bool = True, param_specs=None, data_spec=None):
     """Run ``x`` through all pipeline stages; returns the final activations.
 
     Args:
@@ -76,9 +76,18 @@ def pipeline_apply(mesh, stage_fn, stage_params, x, *,
       remat: rematerialise each stage application on the backward pass
         (GPipe's per-microbatch checkpointing; memory ~O(M·act) → O(M·act)
         for boundaries only, stage internals recomputed).
+      param_specs: optional pytree of ``PartitionSpec`` matching
+        ``stage_params`` *without* the leading stage axis — how each leaf
+        shards over the non-pp mesh axes inside a stage (e.g. Megatron
+        ``P(None, "tp")`` column sharding; :mod:`.transformer` provides a
+        ready-made stage).  Default: replicated within the stage.
+      data_spec: optional ``PartitionSpec`` for ``x``'s non-batch dims,
+        e.g. ``P(("dp","fsdp"), "sp", None)`` to keep the sequence sharded
+        over ``sp`` through the pipeline (ring attention inside the stage).
+        Default: batch over dp/fsdp, rest replicated.
 
     Differentiable; grads of ``stage_params`` come back with the same
-    stacked layout.
+    stacked layout (and the same within-stage sharding).
     """
     n_stages = mesh.shape[axis_name]
     if num_microbatches < 1:
@@ -94,10 +103,16 @@ def pipeline_apply(mesh, stage_fn, stage_params, x, *,
             f"dp/fsdp shard pipelines its own microbatches")
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
-    params_spec = pipeline_spec(stage_params)
+    if param_specs is None:
+        params_spec = pipeline_spec(stage_params)
+    else:
+        # prepend the stage axis to each within-stage spec
+        params_spec = jax.tree.map(lambda s: P(axis_name, *s), param_specs,
+                                   is_leaf=lambda s: isinstance(s, P))
     # Batch stays sharded over the data axes and replicated over pp: every
     # stage sees the full (local) batch but only stage 0 reads it.
-    x_spec = P(sh.DATA_AXES, *([None] * (x.ndim - 1)))
+    x_spec = data_spec if data_spec is not None \
+        else P(sh.DATA_AXES, *([None] * (x.ndim - 1)))
 
     def schedule(block, x_local):
         # block: this device's [1, ...] slice of the stacked params.

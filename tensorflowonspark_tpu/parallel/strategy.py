@@ -78,8 +78,10 @@ class MeshStrategy:
         params = jax.jit(init_fn, out_shardings=shardings)(*init_args)
         opt_state = jax.jit(tx.init)(params)
         self._tx = tx
-        return TrainState(params=params, opt_state=opt_state,
-                          step=jnp.zeros((), jnp.int32))
+        # step lives on the mesh too: a committed single-device scalar would
+        # conflict with mesh-committed params after a checkpoint restore
+        step = jax.device_put(jnp.zeros((), jnp.int32), sh.replicated(self.mesh))
+        return TrainState(params=params, opt_state=opt_state, step=step)
 
     # -- data --------------------------------------------------------------
     def shard_batch(self, batch):

@@ -1,0 +1,120 @@
+"""Manual-SPMD transformer stage: Megatron tensor parallelism + ring-attention
+sequence parallelism, built for the pipeline schedule.
+
+No reference analogue (the reference's models are MNIST/ResNet-class and its
+only model-distribution notion is PS variable placement, SURVEY.md §2c);
+this is the TPU-first composition the mesh design reserves axes for: one
+``shard_map`` program where
+
+- ``pp`` pipelines stages (:func:`..pipeline.pipeline_apply`),
+- ``tp`` shards attention heads and MLP hidden units Megatron-style —
+  column-parallel in, row-parallel out, ONE ``psum`` per sublayer riding
+  the innermost (fastest-ICI) axis,
+- ``sp`` shards the sequence, with K/V blocks rotating via
+  :func:`..ring_attention.ring_attention`'s neighbour ``ppermute``,
+- ``dp``/``fsdp`` shard the batch (gradient reduction inserted by AD at the
+  ``shard_map`` boundary).
+
+Everything here is a pure function of a parameter dict — the stage runs
+under ``jax.checkpoint`` per microbatch, and its grads inherit the exact
+input shardings.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tensorflowonspark_tpu.parallel.ring_attention import ring_attention
+
+
+def _layer_norm(x, scale, bias, eps: float = 1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def make_transformer_stage(hidden: int, num_heads: int, ffn: int, *,
+                           tp: int = 1, head_dim: int | None = None,
+                           causal: bool = False, tp_axis: str = "tp",
+                           sp_axis: str = "sp", dtype=jnp.float32):
+    """Build a pipeline-ready transformer stage (pre-LN attention + MLP).
+
+    Returns ``(stage_fn, init_fn, param_specs)``:
+
+    - ``stage_fn(params, x)`` — runs INSIDE ``shard_map``; ``x`` is the
+      local block ``[batch_local, seq_local, hidden]``.  Attention heads and
+      MLP units are computed on ``1/tp`` shards with a single ``psum`` per
+      sublayer; attention over the full (sp-sharded) sequence uses the ring
+      construction.
+    - ``init_fn(key)`` — one stage's params, FULL (unsharded) shapes; use
+      with :func:`..pipeline.stack_stage_params` and let ``jit``'s
+      ``out_shardings`` (from ``param_specs``) place the tp shards.
+    - ``param_specs`` — within-stage ``PartitionSpec`` tree for
+      :func:`..pipeline.pipeline_apply`'s ``param_specs`` argument
+      (column-parallel weights ``P(None, "tp")``, row-parallel
+      ``P("tp", None)``, norms replicated).
+
+    ``num_heads`` must divide by ``tp`` (each tp rank owns whole heads).
+    """
+    head_dim = head_dim or hidden // num_heads
+    if num_heads % tp:
+        raise ValueError(f"num_heads {num_heads} must divide by tp {tp}")
+    if ffn % tp:
+        raise ValueError(f"ffn {ffn} must divide by tp {tp}")
+
+    def init_fn(key):
+        ks = jax.random.split(key, 4)
+        sd = 1.0 / math.sqrt(hidden)
+        return {
+            "ln1": {"scale": jnp.ones((hidden,), jnp.float32),
+                    "bias": jnp.zeros((hidden,), jnp.float32)},
+            # explicit [hidden, 3, heads, head_dim] so the HEAD axis shards
+            # over tp (a fused [hidden, 3·H·D] matrix sharded on its last
+            # dim would split across the q/k/v boundary instead)
+            "wqkv": (jax.random.normal(ks[0], (hidden, 3, num_heads, head_dim))
+                     * sd).astype(dtype),
+            "wo": (jax.random.normal(ks[1], (num_heads, head_dim, hidden))
+                   * sd).astype(dtype),
+            "ln2": {"scale": jnp.ones((hidden,), jnp.float32),
+                    "bias": jnp.zeros((hidden,), jnp.float32)},
+            "wup": (jax.random.normal(ks[2], (hidden, ffn)) * sd).astype(dtype),
+            "wdown": (jax.random.normal(ks[3], (ffn, hidden))
+                      * (1.0 / math.sqrt(ffn))).astype(dtype),
+        }
+
+    param_specs = {
+        "ln1": {"scale": P(), "bias": P()},
+        # column-parallel: each tp rank computes its own heads / its slice
+        # of the MLP hidden; row-parallel weights contract the sharded dim
+        # and psum the partial products.
+        "wqkv": P(None, None, tp_axis, None),
+        "wo": P(tp_axis, None, None),
+        "ln2": {"scale": P(), "bias": P()},
+        "wup": P(None, tp_axis),
+        "wdown": P(tp_axis, None),
+    }
+
+    def stage_fn(params, x):
+        # ---- attention sublayer (pre-LN, residual) ----
+        h = _layer_norm(x, **params["ln1"])
+        # wqkv local block: [hidden, 3, heads/tp, head_dim]
+        qkv = jnp.einsum("bth,hkjd->btkjd", h, params["wqkv"])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = ring_attention(q, k, v, axis_name=sp_axis, causal=causal)
+        attn = jnp.einsum("btjd,jdm->btm", o, params["wo"])  # partial over tp
+        attn = lax.psum(attn, tp_axis)                 # Megatron reduce #1
+        x = x + attn.astype(x.dtype)
+        # ---- MLP sublayer ----
+        h = _layer_norm(x, **params["ln2"])
+        up = jax.nn.gelu(h @ params["wup"])            # [b, t, ffn/tp] local
+        down = lax.psum(up @ params["wdown"], tp_axis)  # Megatron reduce #2
+        return x + down.astype(x.dtype)
+
+    return stage_fn, init_fn, param_specs

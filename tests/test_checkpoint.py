@@ -44,6 +44,17 @@ class TestCheckpointManager:
     def test_restore_missing_returns_none(self, tmp_path):
         assert ckpt.restore_checkpoint(str(tmp_path / "nope")) is None
 
+    def test_existing_step_skipped_unless_forced(self, tmp_path, state):
+        d = str(tmp_path / "model")
+        with ckpt.CheckpointManager(d, async_save=False) as mngr:
+            assert mngr.save(5, state)
+            # same step again: idempotent skip
+            assert not mngr.save(5, state)
+            # force=True REPLACES the step's contents
+            changed = {**state, "step": jnp.asarray(99, jnp.int32)}
+            assert mngr.save(5, changed, force=True)
+        assert int(ckpt.restore_checkpoint(d)["step"]) == 99
+
     def test_restore_specific_step(self, tmp_path, state):
         d = str(tmp_path / "model")
         with ckpt.CheckpointManager(d, async_save=False) as mngr:

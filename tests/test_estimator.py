@@ -1,0 +1,93 @@
+"""Estimator surface: train_and_evaluate, max_steps semantics, resume."""
+
+import numpy as np
+import optax
+import pytest
+
+from tensorflowonspark_tpu.estimator import (Estimator, EvalSpec, TrainSpec,
+                                             train_and_evaluate)
+
+
+def _linreg_problem(seed=0, n=64, d=4):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(d, 1)).astype(np.float32)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = x @ w_true
+    return x, y
+
+
+def _make_estimator(model_dir, save_every=10):
+    import jax.numpy as jnp
+
+    def init_fn():
+        return {"w": jnp.zeros((4, 1))}
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def metrics_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return {"mse": jnp.mean((pred - batch["y"]) ** 2),
+                "mae": jnp.mean(jnp.abs(pred - batch["y"]))}
+
+    return Estimator(init_fn, loss_fn, optax.sgd(0.1), str(model_dir),
+                     eval_metrics_fn=metrics_fn, save_every_steps=save_every)
+
+
+def _batches(x, y, bs=16):
+    def input_fn():
+        for i in range(0, len(x), bs):
+            yield {"x": x[i:i + bs], "y": y[i:i + bs]}
+    return input_fn
+
+
+def test_train_and_evaluate_learns_and_reports(tmp_path):
+    x, y = _linreg_problem()
+    with _make_estimator(tmp_path / "m") as est:
+        baseline = est.evaluate(_batches(x, y), steps=2)["mse"]
+        final = train_and_evaluate(
+            est,
+            TrainSpec(input_fn=_batches(x, y), max_steps=40),
+            EvalSpec(input_fn=_batches(x, y), steps=4, throttle_steps=15))
+        assert final["global_step"] == 40
+        assert final["mse"] < baseline * 0.1, (baseline, final)
+        assert "mae" in final
+
+
+def test_max_steps_is_total_budget_and_resume_works(tmp_path):
+    x, y = _linreg_problem()
+    with _make_estimator(tmp_path / "m") as est:
+        est.train(_batches(x, y), max_steps=12)
+        assert est.global_step == 12
+        w_after = np.asarray(est.params["w"])
+
+    # "restart": a fresh Estimator on the same model_dir resumes at step 12
+    with _make_estimator(tmp_path / "m") as est2:
+        assert est2.global_step == 12
+        np.testing.assert_allclose(np.asarray(est2.params["w"]), w_after)
+        est2.train(_batches(x, y), max_steps=20)  # only the remaining 8
+        assert est2.global_step == 20
+
+
+def test_resume_at_max_steps_still_runs_final_eval(tmp_path):
+    x, y = _linreg_problem()
+    with _make_estimator(tmp_path / "m") as est:
+        est.train(_batches(x, y), max_steps=10)
+    # relaunch with the SAME budget: no training remains, but
+    # train_and_evaluate must still deliver the final eval metrics
+    with _make_estimator(tmp_path / "m") as est2:
+        final = train_and_evaluate(
+            est2,
+            TrainSpec(input_fn=_batches(x, y), max_steps=10),
+            EvalSpec(input_fn=_batches(x, y), steps=2, throttle_steps=5))
+        assert final["global_step"] == 10
+        assert "mse" in final
+
+
+def test_empty_input_fn_raises(tmp_path):
+    with _make_estimator(tmp_path / "m") as est:
+        with pytest.raises(ValueError, match="no batches"):
+            est.train(lambda: iter(()), max_steps=5)
+        with pytest.raises(ValueError, match="no batches"):
+            est.evaluate(lambda: iter(()), steps=2)

@@ -90,6 +90,15 @@ def test_wide_deep_criteo_ep_sharding():
     assert "'ep': 2" in out, "mesh must have ep=2 (num_ps=2)"
 
 
+def test_mnist_estimator(tmp_path):
+    out = _run("mnist/mnist_estimator.py", "--cluster_size", "2",
+               "--max_steps", "8", "--throttle_steps", "4",
+               "--batch_size", "16", "--num_samples", "256",
+               "--model_dir", str(tmp_path / "est"))
+    assert "mnist_estimator: done" in out
+    assert "final eval step=8" in out
+
+
 def test_bert_squad(tmp_path):
     out = _run("bert/bert_squad.py", "--cluster_size", "1",
                "--batch_size", "4", "--steps", "3", "--num_samples", "16",

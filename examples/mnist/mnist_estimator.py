@@ -43,8 +43,12 @@ def main_fun(args, ctx):
     labels = rng.integers(0, 10, size=n)
     n_eval = max(args.batch_size, n // 10)
 
+    epoch = [0]  # fresh shuffle per invocation, not a replay of the same order
+
     def train_input_fn():
-        order = np.random.default_rng(ctx.executor_id).permutation(n - n_eval)
+        epoch[0] += 1
+        order = np.random.default_rng(
+            (ctx.executor_id, epoch[0])).permutation(n - n_eval)
         for i in range(0, len(order) - args.batch_size + 1, args.batch_size):
             idx = order[i:i + args.batch_size]
             yield {"x": images[idx], "y": labels[idx]}

@@ -59,6 +59,12 @@ class EvalSpec:
     steps: int = 10
     throttle_steps: int = 100
 
+    def __post_init__(self):
+        if self.throttle_steps < 1:
+            raise ValueError(
+                f"throttle_steps must be >= 1, got {self.throttle_steps} "
+                "(0 would make train_and_evaluate spin forever)")
+
 
 class Estimator:
     """``model_dir``-centric trainer (reference:

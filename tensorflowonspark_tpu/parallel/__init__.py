@@ -31,3 +31,4 @@ from tensorflowonspark_tpu.parallel.pipeline import (PipelineStrategy,
                                                      pipeline_apply,
                                                      stack_stage_params)  # noqa: F401
 from tensorflowonspark_tpu.parallel.transformer import make_transformer_stage  # noqa: F401
+from tensorflowonspark_tpu.parallel.moe import make_moe_layer, moe_apply  # noqa: F401

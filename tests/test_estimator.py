@@ -85,6 +85,11 @@ def test_resume_at_max_steps_still_runs_final_eval(tmp_path):
         assert "mse" in final
 
 
+def test_throttle_steps_must_be_positive():
+    with pytest.raises(ValueError, match="throttle_steps"):
+        EvalSpec(input_fn=lambda: iter(()), throttle_steps=0)
+
+
 def test_empty_input_fn_raises(tmp_path):
     with _make_estimator(tmp_path / "m") as est:
         with pytest.raises(ValueError, match="no batches"):

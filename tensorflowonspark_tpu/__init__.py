@@ -39,6 +39,9 @@ from tensorflowonspark_tpu.checkpoint import (CheckpointManager, ExportedModel, 
 
 from tensorflowonspark_tpu.data import Dataset, device_prefetch  # noqa: F401
 from tensorflowonspark_tpu.dataframe import DataFrame, Row  # noqa: F401
+from tensorflowonspark_tpu.estimator import (Estimator, EvalSpec,  # noqa: F401
+                                             TrainSpec, train_and_evaluate)
+from tensorflowonspark_tpu.preemption import PreemptionGuard  # noqa: F401
 from tensorflowonspark_tpu.pipeline import (Namespace, Pipeline,  # noqa: F401
                                             ParamGridBuilder, TFEstimator,
                                             TFModel, TrainValidationSplit)

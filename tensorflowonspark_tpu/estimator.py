@@ -83,11 +83,16 @@ class Estimator:
       eval_metrics_fn: optional ``(params, batch) -> dict`` of scalar
         metrics; defaults to reporting eval loss.
       save_every_steps: checkpoint cadence during ``train``.
+      handle_preemption: install a :class:`~.preemption.PreemptionGuard`
+        around training (default True): SIGTERM — the spot/preemptible
+        TPU-VM reclaim warning — finishes the in-flight step, writes a
+        final checkpoint, and returns early instead of dying mid-step.
     """
 
     def __init__(self, init_fn, loss_fn, tx, model_dir: str, *,
                  strategy=None, eval_metrics_fn: Optional[Callable] = None,
-                 save_every_steps: int = 100, max_to_keep: int = 5):
+                 save_every_steps: int = 100, max_to_keep: int = 5,
+                 handle_preemption: bool = True):
         from tensorflowonspark_tpu.checkpoint import CheckpointManager
         from tensorflowonspark_tpu.parallel.strategy import DataParallelStrategy
 
@@ -108,6 +113,7 @@ class Estimator:
         self._host_step = int(self._state.step)
         self._train_step = None
         self._eval_step = None
+        self._handle_preemption = handle_preemption
 
     # ------------------------------------------------------------------
     @property
@@ -121,27 +127,43 @@ class Estimator:
     def train(self, input_fn, max_steps: int) -> int:
         """Train until ``global_step == max_steps`` (tf.estimator's
         ``max_steps`` semantics: a budget on the TOTAL step count, so a
-        resumed job does only the remainder)."""
+        resumed job does only the remainder).
+
+        With ``handle_preemption`` (default), SIGTERM — the spot/preemptible
+        TPU-VM reclaim warning — finishes the in-flight step, writes a final
+        checkpoint, and returns early; a relaunched job resumes from it.
+        """
+        import contextlib
+
         from tensorflowonspark_tpu.data import device_prefetch
+        from tensorflowonspark_tpu.preemption import PreemptionGuard
 
         if self._train_step is None:
             self._train_step = self.strategy.build_train_step(self.loss_fn)
         sharding = self.strategy.batch_sharding()
-        while self._host_step < max_steps:
-            made_progress = False
-            # device_prefetch keeps transfers ahead of compute — the same
-            # host/device overlap the data plane provides everywhere else
-            for b in device_prefetch(iter(input_fn()), depth=2,
-                                     sharding=sharding):
-                if self._host_step >= max_steps:
+        guard = PreemptionGuard() if self._handle_preemption else None
+        with guard if guard is not None else contextlib.nullcontext():
+            while self._host_step < max_steps:
+                made_progress = False
+                # device_prefetch keeps transfers ahead of compute — the
+                # same host/device overlap the data plane provides
+                # everywhere else
+                for b in device_prefetch(iter(input_fn()), depth=2,
+                                         sharding=sharding):
+                    if self._host_step >= max_steps or \
+                            (guard is not None and guard.preempted):
+                        break
+                    self._state, metrics = self._train_step(self._state, b)
+                    self._host_step += 1
+                    made_progress = True
+                    if self._host_step % self.save_every_steps == 0:
+                        self._ckpt.save(self._host_step, self._state)
+                if guard is not None and guard.preempted:
+                    logger.warning("estimator: preempted at step %d; saving "
+                                   "and stopping", self._host_step)
                     break
-                self._state, metrics = self._train_step(self._state, b)
-                self._host_step += 1
-                made_progress = True
-                if self._host_step % self.save_every_steps == 0:
-                    self._ckpt.save(self._host_step, self._state)
-            if not made_progress:
-                raise ValueError("input_fn yielded no batches")
+                if not made_progress:
+                    raise ValueError("input_fn yielded no batches")
         self._ckpt.save(self._host_step, self._state)
         self._ckpt.wait()
         return self._host_step
@@ -193,16 +215,31 @@ def train_and_evaluate(estimator: Estimator, train_spec: TrainSpec,
     repeat until ``max_steps``, with a final eval.  Returns the last eval
     metrics.  Restart-safe: a relaunched job resumes from ``model_dir``'s
     latest checkpoint and completes only the remaining budget."""
+    import contextlib
+
+    from tensorflowonspark_tpu import preemption
+    from tensorflowonspark_tpu.preemption import PreemptionGuard
+
+    # Guard the WHOLE loop, not just train(): a SIGTERM landing during an
+    # eval round must latch too, not hit the default handler and kill us.
+    guard = PreemptionGuard() if estimator._handle_preemption else None
     metrics: dict = {}
-    while estimator.global_step < train_spec.max_steps:
-        target = min(estimator.global_step + eval_spec.throttle_steps,
-                     train_spec.max_steps)
-        estimator.train(train_spec.input_fn, target)
-        metrics = estimator.evaluate(eval_spec.input_fn, eval_spec.steps)
-        logger.info("estimator: step %d eval %s", estimator.global_step,
-                    {k: round(v, 4) for k, v in metrics.items()})
-    if not metrics:
-        # resumed already at (or past) max_steps: the promised final eval
-        # still happens
-        metrics = estimator.evaluate(eval_spec.input_fn, eval_spec.steps)
+    with guard if guard is not None else contextlib.nullcontext():
+        while estimator.global_step < train_spec.max_steps:
+            target = min(estimator.global_step + eval_spec.throttle_steps,
+                         train_spec.max_steps)
+            estimator.train(train_spec.input_fn, target)
+            if preemption.is_preempted():
+                # checkpoint is written; the grace window is for exiting,
+                # not for one more eval round
+                logger.warning("estimator: preempted; skipping further "
+                               "train/eval rounds")
+                return metrics
+            metrics = estimator.evaluate(eval_spec.input_fn, eval_spec.steps)
+            logger.info("estimator: step %d eval %s", estimator.global_step,
+                        {k: round(v, 4) for k, v in metrics.items()})
+        if not metrics:
+            # resumed already at (or past) max_steps: the promised final
+            # eval still happens
+            metrics = estimator.evaluate(eval_spec.input_fn, eval_spec.steps)
     return metrics

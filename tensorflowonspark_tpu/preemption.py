@@ -1,0 +1,98 @@
+"""Preemption handling: turn SIGTERM into a checkpoint + graceful stop.
+
+The reference's recovery model is whole-job restart + resume from
+checkpoints (SURVEY.md §5 "no elasticity"), and it relies on Spark/YARN to
+notice dead executors.  On TPU the dominant failure is *planned*: preemptible
+/ spot TPU VMs get a SIGTERM with a grace window before the slice is
+reclaimed.  Catching it and writing one final checkpoint converts "lose the
+work since the last save" into "lose nothing" — the restart path
+(``cluster.run_with_recovery`` or a scheduler relaunch) then resumes from
+that step via the normal ``model_dir`` contract.
+
+:class:`PreemptionGuard` is a context manager that latches the signal
+instead of dying mid-step; pollers (``Estimator.train``, or any user
+``map_fun`` loop via ``guard.preempted``) finish the in-flight step, save,
+and return cleanly.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+logger = logging.getLogger(__name__)
+
+# Process-wide latch: preemption is a fact about the PROCESS, not about one
+# guard instance — a training loop that re-enters train() after the signal
+# must still see it (the OS will follow up with SIGKILL).
+_PREEMPTED = threading.Event()
+
+
+def is_preempted() -> bool:
+    """True once any PreemptionGuard in this process has seen its signal."""
+    return _PREEMPTED.is_set()
+
+
+def reset() -> None:
+    """Clear the process-wide latch (tests / deliberate in-process restart)."""
+    _PREEMPTED.clear()
+
+
+class PreemptionGuard:
+    """Latches termination signals while active.
+
+    Usage::
+
+        with PreemptionGuard() as guard:
+            for batch in data:
+                state, _ = step(state, batch)
+                if guard.preempted:
+                    ckpt.save(step_no, state, force=True)
+                    break
+
+    Only the main thread can install signal handlers; constructed off the
+    main thread (e.g. inside a worker's feeder thread) the guard degrades
+    to an inert flag that is never set, rather than raising.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._signals = tuple(signals)
+        self._event = threading.Event()
+        self._previous: dict = {}
+        self._active = False
+
+    # -- context manager ------------------------------------------------
+    def __enter__(self) -> "PreemptionGuard":
+        if threading.current_thread() is not threading.main_thread():
+            logger.warning("PreemptionGuard: not on the main thread; "
+                           "signals will not be intercepted")
+            return self
+        for sig in self._signals:
+            self._previous[sig] = signal.signal(sig, self._handle)
+        self._active = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._active:
+            for sig, prev in self._previous.items():
+                signal.signal(sig, prev)
+            self._previous.clear()
+            self._active = False
+
+    # -- signal path ----------------------------------------------------
+    def _handle(self, signum, frame):
+        logger.warning("PreemptionGuard: received signal %d; requesting "
+                       "graceful stop", signum)
+        self._event.set()
+        _PREEMPTED.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self._event.is_set() or _PREEMPTED.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        # wait on the process-wide latch: every handler sets both events,
+        # and a latch set by an EARLIER guard must not leave a fresh
+        # guard's wait() sleeping through the reclaim grace window
+        return _PREEMPTED.wait(timeout) or self._event.is_set()

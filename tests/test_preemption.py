@@ -1,0 +1,97 @@
+"""Preemption: SIGTERM mid-training checkpoints and stops gracefully."""
+
+import os
+import signal
+
+import numpy as np
+import optax
+import pytest
+
+from tensorflowonspark_tpu import preemption
+from tensorflowonspark_tpu.estimator import (Estimator, EvalSpec, TrainSpec,
+                                             train_and_evaluate)
+from tensorflowonspark_tpu.preemption import PreemptionGuard
+
+
+@pytest.fixture(autouse=True)
+def _clear_latch():
+    preemption.reset()
+    yield
+    preemption.reset()
+
+
+def test_guard_latches_sigterm_and_restores_handler():
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as guard:
+        assert not guard.preempted
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.wait(5)
+        assert guard.preempted and preemption.is_preempted()
+    assert signal.getsignal(signal.SIGTERM) is prev
+    # the process-wide latch survives the guard's exit
+    assert preemption.is_preempted()
+
+
+def _make_estimator(model_dir):
+    import jax.numpy as jnp
+
+    def init_fn():
+        return {"w": jnp.zeros((4, 1))}
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+    return Estimator(init_fn, loss_fn, optax.sgd(0.1), str(model_dir),
+                     save_every_steps=1000)
+
+
+def test_sigterm_mid_training_saves_and_stops(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = rng.normal(size=(16, 1)).astype(np.float32)
+
+    def input_fn():
+        # fire the "preemption" after the third batch of the stream
+        for i in range(1000):
+            if i == 3:
+                os.kill(os.getpid(), signal.SIGTERM)
+            yield {"x": x, "y": y}
+
+    with _make_estimator(tmp_path / "m") as est:
+        final = est.train(input_fn, max_steps=1000)
+    # stopped early (well before the 1000-step budget), without dying; the
+    # prefetch lookahead means the signal (fired while producing batch 3)
+    # lands a step or two before the consumer reaches it
+    assert 1 <= final < 1000
+
+    # the checkpoint at the stop step exists and a relaunch resumes there
+    preemption.reset()
+    with _make_estimator(tmp_path / "m") as est2:
+        assert est2.global_step == final
+
+
+def test_train_and_evaluate_stops_after_preemption(tmp_path):
+    x = np.ones((8, 4), np.float32)
+    y = np.ones((8, 1), np.float32)
+
+    def input_fn():
+        while True:
+            yield {"x": x, "y": y}
+
+    calls = [0]
+
+    def eval_input_fn():
+        calls[0] += 1
+        yield {"x": x, "y": y}
+
+    with _make_estimator(tmp_path / "m") as est:
+        # set the process-wide latch directly: no guard is installed yet, so
+        # a real SIGTERM here would kill pytest; the semantics under test
+        # are the loop's reaction, and signal delivery is covered above
+        preemption._PREEMPTED.set()
+        train_and_evaluate(
+            est,
+            TrainSpec(input_fn=input_fn, max_steps=50),
+            EvalSpec(input_fn=eval_input_fn, steps=1, throttle_steps=10))
+    assert est.global_step < 50
+    assert calls[0] == 0, "no eval round after preemption"

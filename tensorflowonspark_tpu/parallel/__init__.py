@@ -32,3 +32,5 @@ from tensorflowonspark_tpu.parallel.pipeline import (PipelineStrategy,
                                                      stack_stage_params)  # noqa: F401
 from tensorflowonspark_tpu.parallel.transformer import make_transformer_stage  # noqa: F401
 from tensorflowonspark_tpu.parallel.moe import make_moe_layer, moe_apply  # noqa: F401
+from tensorflowonspark_tpu.parallel.ulysses import (ulysses_attention,
+                                                    ulysses_self_attention)  # noqa: F401

@@ -43,7 +43,8 @@ def _layer_norm(x, scale, bias, eps: float = 1e-6):
 def make_transformer_stage(hidden: int, num_heads: int, ffn: int, *,
                            tp: int = 1, head_dim: int | None = None,
                            causal: bool = False, tp_axis: str = "tp",
-                           sp_axis: str = "sp", dtype=jnp.float32):
+                           sp_axis: str = "sp", sp_impl: str = "ring",
+                           dtype=jnp.float32):
     """Build a pipeline-ready transformer stage (pre-LN attention + MLP).
 
     Returns ``(stage_fn, init_fn, param_specs)``:
@@ -62,12 +63,27 @@ def make_transformer_stage(hidden: int, num_heads: int, ffn: int, *,
       ``P("tp", None)``, norms replicated).
 
     ``num_heads`` must divide by ``tp`` (each tp rank owns whole heads).
+    ``sp_impl`` picks the sequence-parallel attention: ``"ring"`` (K/V
+    rotation, T scales with devices) or ``"ulysses"`` (all_to_all head
+    exchange — needs ``num_heads/tp`` divisible by the ``sp`` size).
     """
     head_dim = head_dim or hidden // num_heads
     if num_heads % tp:
         raise ValueError(f"num_heads {num_heads} must divide by tp {tp}")
     if ffn % tp:
         raise ValueError(f"ffn {ffn} must divide by tp {tp}")
+    if sp_impl == "ring":
+        def sp_attn(q, k, v):
+            return ring_attention(q, k, v, axis_name=sp_axis, causal=causal)
+    elif sp_impl == "ulysses":
+        from tensorflowonspark_tpu.parallel.ulysses import ulysses_attention
+
+        def sp_attn(q, k, v):
+            return ulysses_attention(q, k, v, axis_name=sp_axis,
+                                     causal=causal)
+    else:
+        raise ValueError(f"unknown sp_impl {sp_impl!r} "
+                         "(expected 'ring' or 'ulysses')")
 
     def init_fn(key):
         ks = jax.random.split(key, 4)
@@ -107,7 +123,7 @@ def make_transformer_stage(hidden: int, num_heads: int, ffn: int, *,
         # wqkv local block: [hidden, 3, heads/tp, head_dim]
         qkv = jnp.einsum("bth,hkjd->btkjd", h, params["wqkv"])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        o = ring_attention(q, k, v, axis_name=sp_axis, causal=causal)
+        o = sp_attn(q, k, v)
         attn = jnp.einsum("btjd,jdm->btm", o, params["wo"])  # partial over tp
         attn = lax.psum(attn, tp_axis)                 # Megatron reduce #1
         x = x + attn.astype(x.dtype)

@@ -1,0 +1,111 @@
+"""Ulysses-style sequence parallelism: all_to_all head/sequence exchange.
+
+The second of the two long-context constructions (SURVEY.md §5 has neither;
+``ring_attention`` is the first).  Where the ring rotates K/V blocks around
+``sp`` and computes attention blockwise, Ulysses re-shards: an
+``all_to_all`` turns sequence-sharded ``[B, T/sp, H, D]`` into head-sharded
+``[B, T, H/sp, D]``, each device runs FULL-sequence attention for its head
+subset, and a second ``all_to_all`` restores sequence sharding.
+
+Trade-offs vs the ring (why both exist):
+
+- Ulysses does 2 all_to_alls of the qkv/out tensors total, independent of
+  sequence length — cheaper communication than the ring's (sp-1) K/V
+  rotations when ``sp`` is large and heads are plentiful;
+- each device sees the ENTIRE sequence, so the single-chip
+  :func:`~tensorflowonspark_tpu.ops.flash_attention` Pallas kernel drops
+  in unchanged (the ring needs its own online-softmax accumulation);
+- but it requires ``num_heads % sp == 0`` and per-device memory O(T) for
+  its head slice — the ring scales T linearly with devices, Ulysses
+  scales heads.  Long-and-thin models ring; wide models Ulysses.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tensorflowonspark_tpu.parallel.ring_attention import reference_attention
+
+
+def ulysses_attention(q, k, v, mask=None, axis_name: str = "sp",
+                      causal: bool = False, attn_fn=None):
+    """Attention over a sequence sharded on ``axis_name`` via all_to_all.
+
+    Call inside ``shard_map`` (or use :func:`ulysses_self_attention`).
+
+    Args:
+      q, k, v: local blocks ``[batch, seq_local, heads, head_dim]``;
+        ``heads`` must divide by the ``sp`` axis size.
+      mask: optional LOCAL key-padding mask block ``[batch, seq_local]``
+        (True = attend); all-gathered so every head shard masks the full
+        sequence.
+      causal: causal masking (positions are global — each shard holds the
+        whole sequence after the exchange).
+      attn_fn: full-sequence attention kernel
+        ``(q, k, v, mask=, causal=) -> out`` on ``[B, T, h_local, D]``;
+        default is the dense reference (pass
+        ``ops.flash_attention`` on TPU).
+    Returns:
+      ``[batch, seq_local, heads, head_dim]`` — this device's output block.
+    """
+    # Distinguish "outside shard_map" (single-device testing: fall back to
+    # dense attention) from "inside shard_map with a misspelled/unbound
+    # axis_name" (must fail loudly — a silent n=1 would compute local-only
+    # attention with correct shapes and wrong numerics).  Inputs carrying
+    # varying manual axes are definitely inside a shard_map.
+    try:
+        vma = tuple(jax.typeof(q).vma)
+    except AttributeError:
+        vma = ()
+    if vma:
+        n = lax.axis_size(axis_name)  # NameError here = real misuse
+    else:
+        try:
+            n = lax.axis_size(axis_name)
+        except NameError:
+            n = 1
+    attn = attn_fn or reference_attention
+    if n == 1:
+        return attn(q, k, v, mask=mask, causal=causal)
+    heads = q.shape[2]
+    if heads % n:
+        raise ValueError(f"num_heads {heads} must divide by {axis_name}={n}")
+
+    # seq-sharded -> head-sharded: split heads over ranks, gather sequence.
+    # q/k/v ride ONE stacked all_to_all (axes shift by 1 for the stack dim).
+    qkv = lax.all_to_all(jnp.stack([q, k, v]), axis_name,
+                         split_axis=3, concat_axis=2, tiled=True)
+    qh, kh, vh = qkv[0], qkv[1], qkv[2]                  # [B, T, H/n, D]
+    full_mask = None
+    if mask is not None:
+        full_mask = lax.all_gather(mask, axis_name, axis=1, tiled=True)
+    out = attn(qh, kh, vh, mask=full_mask, causal=causal)
+    # head-sharded -> seq-sharded
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_self_attention(mesh, q, k, v, mask=None, causal: bool = False,
+                           sp_axis: str = "sp", batch_axes=("dp", "fsdp"),
+                           attn_fn=None):
+    """Global-array entry point: shards sequence over ``sp_axis`` (batch
+    over ``batch_axes``) and runs :func:`ulysses_attention` under
+    ``shard_map``.  Same signature as
+    :func:`~.ring_attention.ring_self_attention` — the two are drop-in
+    alternatives."""
+    spec = P(batch_axes, sp_axis, None, None)
+    kernel = functools.partial(ulysses_attention, axis_name=sp_axis,
+                               causal=causal, attn_fn=attn_fn)
+    if mask is None:
+        fn = jax.shard_map(kernel, mesh=mesh,
+                           in_specs=(spec, spec, spec), out_specs=spec)
+        return fn(q, k, v)
+    mask_spec = P(batch_axes, sp_axis)
+    fn = jax.shard_map(kernel, mesh=mesh,
+                       in_specs=(spec, spec, spec, mask_spec), out_specs=spec)
+    return fn(q, k, v, mask)

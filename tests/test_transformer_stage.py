@@ -37,17 +37,20 @@ def _oracle(stacked, x, causal):
     return x
 
 
-@pytest.mark.parametrize("pp,dp,tp,sp,causal", [
-    (2, 2, 2, 1, False),
-    (2, 1, 2, 2, True),
-    (2, 2, 1, 2, False),
-    (4, 1, 2, 1, True),
+@pytest.mark.parametrize("pp,dp,tp,sp,causal,sp_impl", [
+    (2, 2, 2, 1, False, "ring"),
+    (2, 1, 2, 2, True, "ring"),
+    (2, 2, 1, 2, False, "ring"),
+    (4, 1, 2, 1, True, "ring"),
+    (2, 1, 2, 2, True, "ulysses"),
+    (2, 2, 1, 2, False, "ulysses"),
 ])
-def test_pipelined_tp_sp_transformer_matches_oracle(pp, dp, tp, sp, causal):
+def test_pipelined_tp_sp_transformer_matches_oracle(pp, dp, tp, sp, causal,
+                                                    sp_impl):
     mesh = make_mesh(MeshSpec(pp=pp, dp=dp, tp=tp, sp=sp),
                      devices=jax.devices()[:pp * dp * tp * sp])
     stage_fn, init_fn, param_specs = make_transformer_stage(
-        HID, HEADS, FFN, tp=tp, causal=causal)
+        HID, HEADS, FFN, tp=tp, causal=causal, sp_impl=sp_impl)
     stacked = stack_stage_params(
         [init_fn(k) for k in jax.random.split(jax.random.key(0), pp)])
     num_mb = 2

@@ -99,6 +99,13 @@ def test_mnist_estimator(tmp_path):
     assert "final eval step=8" in out
 
 
+def test_switch_lm_moe(tmp_path):
+    out = _run("moe/switch_lm.py", "--ep", "2", "--max_steps", "10",
+               "--model_dir", str(tmp_path / "moe"))
+    assert "switch_lm: done" in out
+    assert "'ep': 2" in out, "mesh must actually have ep=2"
+
+
 def test_bert_squad(tmp_path):
     out = _run("bert/bert_squad.py", "--cluster_size", "1",
                "--batch_size", "4", "--steps", "3", "--num_samples", "16",

@@ -87,12 +87,20 @@ class Estimator:
         around training (default True): SIGTERM — the spot/preemptible
         TPU-VM reclaim warning — finishes the in-flight step, writes a
         final checkpoint, and returns early instead of dying mid-step.
+      summary_dir: TensorBoard event-file directory (default
+        ``model_dir/tensorboard`` when ``model_dir`` is set; pass "" to
+        disable).  Train metrics land under ``train/`` every
+        ``log_every_steps`` steps, eval metrics under ``eval/``.
     """
 
     def __init__(self, init_fn, loss_fn, tx, model_dir: str, *,
                  strategy=None, eval_metrics_fn: Optional[Callable] = None,
                  save_every_steps: int = 100, max_to_keep: int = 5,
-                 handle_preemption: bool = True):
+                 handle_preemption: bool = True,
+                 summary_dir: Optional[str] = None,
+                 log_every_steps: int = 10):
+        import os
+
         from tensorflowonspark_tpu.checkpoint import CheckpointManager
         from tensorflowonspark_tpu.parallel.strategy import DataParallelStrategy
 
@@ -114,6 +122,22 @@ class Estimator:
         self._train_step = None
         self._eval_step = None
         self._handle_preemption = handle_preemption
+        self.log_every_steps = max(1, log_every_steps)
+        # TensorBoard scalars, tf.estimator style (events under model_dir —
+        # in a subdir so orbax's step scan never sees foreign files).
+        # Chief-only: in a multi-process run every process computes the same
+        # SPMD metrics, and N writers would superimpose N duplicate curves.
+        if summary_dir is None and model_dir:
+            summary_dir = os.path.join(model_dir, "tensorboard")
+        self._summary = None
+        self._pending_log = None  # (step, metrics) written one round late
+        if summary_dir:
+            import jax
+
+            if jax.process_index() == 0:
+                from tensorflowonspark_tpu.observability import SummaryWriter
+
+                self._summary = SummaryWriter(summary_dir)
 
     # ------------------------------------------------------------------
     @property
@@ -158,12 +182,23 @@ class Estimator:
                     made_progress = True
                     if self._host_step % self.save_every_steps == 0:
                         self._ckpt.save(self._host_step, self._state)
+                    if self._summary is not None and \
+                            self._host_step % self.log_every_steps == 0:
+                        # write the PREVIOUS boundary's metrics (long since
+                        # computed — no sync) and stash this one; float()ing
+                        # the just-dispatched step would stall the pipeline
+                        if self._pending_log is not None:
+                            self._write_scalars("train", *self._pending_log)
+                        self._pending_log = (metrics, self._host_step)
                 if guard is not None and guard.preempted:
                     logger.warning("estimator: preempted at step %d; saving "
                                    "and stopping", self._host_step)
                     break
                 if not made_progress:
                     raise ValueError("input_fn yielded no batches")
+        if self._pending_log is not None:
+            self._write_scalars("train", *self._pending_log)
+            self._pending_log = None
         self._ckpt.save(self._host_step, self._state)
         self._ckpt.wait()
         return self._host_step
@@ -195,10 +230,26 @@ class Estimator:
         if n == 0:
             raise ValueError("eval input_fn yielded no batches")
         out = {k: v / n for k, v in totals.items()}
+        if self._summary is not None:
+            self._write_scalars("eval", out)
         out["global_step"] = self.global_step
         return out
 
+    def _write_scalars(self, prefix: str, metrics: dict,
+                       step: int | None = None) -> None:
+        scalars = {}
+        for k, v in metrics.items():
+            try:
+                scalars[f"{prefix}/{k}"] = float(v)
+            except (TypeError, ValueError):
+                continue  # non-scalar aux (arrays etc.) aren't curve data
+        if scalars:
+            self._summary.scalars(
+                scalars, self._host_step if step is None else step)
+
     def close(self) -> None:
+        if self._summary is not None:
+            self._summary.close()
         self._ckpt.close()
 
     def __enter__(self):

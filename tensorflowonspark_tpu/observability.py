@@ -135,6 +135,102 @@ def annotate(name: str):
 
 # ----------------------------------------------------------------- goodput
 
+# ------------------------------------------------------- summary writing
+
+class SummaryWriter:
+    """TensorBoard scalar writer with zero TF dependency.
+
+    TensorBoard event files are TFRecord streams of ``Event`` protos; this
+    writer hand-encodes the ``Event``/``Summary`` wire format (the same
+    approach as :mod:`.example_proto`) and frames records with the
+    package's own :class:`~.tfrecord.TFRecordWriter` (CRC32C via the C++
+    codec).  Byte-compatibility with TensorBoard's reader is pinned by
+    test against the TF event parser.
+
+    The reference delegated training curves to Keras/TF summary callbacks
+    (SURVEY.md §5); here the estimator writes them natively::
+
+        with SummaryWriter(logdir) as w:
+            w.scalar("loss", 0.5, step=10)
+            w.scalars({"loss": 0.4, "acc": 0.9}, step=20)
+    """
+
+    _FILE_VERSION = "brain.Event:2"
+
+    def __init__(self, logdir: str, filename_suffix: str = ""):
+        import socket
+
+        from tensorflowonspark_tpu import filesystem as fsutil
+        from tensorflowonspark_tpu.tfrecord import TFRecordWriter
+
+        # scheme-aware: logdir may be gs:// etc., like the checkpoint dir
+        fsutil.makedirs(logdir)
+        name = (f"events.out.tfevents.{time.time():.6f}."
+                f"{socket.gethostname()}{filename_suffix}")
+        self.path = fsutil.join(logdir, name)
+        self._w = TFRecordWriter(self.path)
+        self._w.write(self._encode_event(file_version=self._FILE_VERSION))
+
+    @staticmethod
+    def _encode_event(step: int | None = None, summary: bytes | None = None,
+                      file_version: str | None = None) -> bytes:
+        import struct
+
+        from tensorflowonspark_tpu.example_proto import (_tag, _write_len_field,
+                                                         _write_varint)
+
+        out = bytearray()
+        _write_varint(out, _tag(1, 1))                 # wall_time: double
+        out.extend(struct.pack("<d", time.time()))
+        if step is not None:
+            _write_varint(out, _tag(2, 0))             # step: int64
+            _write_varint(out, int(step))
+        if file_version is not None:
+            _write_len_field(out, 3, file_version.encode())
+        if summary is not None:
+            _write_len_field(out, 5, summary)
+        return bytes(out)
+
+    @staticmethod
+    def _encode_summary(metrics: dict) -> bytes:
+        import struct
+
+        from tensorflowonspark_tpu.example_proto import (_tag, _write_len_field,
+                                                         _write_varint)
+
+        out = bytearray()
+        for tag_name, value in metrics.items():
+            val = bytearray()
+            _write_len_field(val, 1, str(tag_name).encode())  # Value.tag
+            _write_varint(val, _tag(2, 5))                    # simple_value
+            val.extend(struct.pack("<f", float(value)))
+            _write_len_field(out, 1, bytes(val))              # Summary.value
+        return bytes(out)
+
+    def scalar(self, tag: str, value: float, step: int) -> None:
+        self.scalars({tag: value}, step)
+
+    def scalars(self, metrics: dict, step: int) -> None:
+        """Write a dict of scalars as one event at ``step`` and flush —
+        a live TensorBoard should see the point now, and a preempted
+        process must not lose its buffered curves."""
+        self._w.write(self._encode_event(
+            step=step, summary=self._encode_summary(metrics)))
+        self._w.flush()
+
+    def flush(self) -> None:
+        self._w.flush()
+
+    def close(self) -> None:
+        self._w.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 class GoodputRecorder:
     """Wall-clock accounting: productive step time vs everything else.
 

@@ -130,7 +130,7 @@ class Estimator:
         if summary_dir is None and model_dir:
             summary_dir = os.path.join(model_dir, "tensorboard")
         self._summary = None
-        self._pending_log = None  # (step, metrics) written one round late
+        self._pending_log = None  # (metrics, step) written one round late
         if summary_dir:
             import jax
 

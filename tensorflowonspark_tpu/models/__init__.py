@@ -8,6 +8,7 @@
 | ``examples/segmentation`` (U-Net)       | :class:`UNet`             |
 | BERT-SQuAD pipeline (BASELINE configs)  | :class:`Bert`, heads      |
 | ``examples/wide_deep`` (Criteo)         | :class:`WideDeep`         |
+| — (beyond reference: decoder family)    | :class:`GPT` + compiled KV-cache decoding |
 
 All models are flax modules with GSPMD sharding annotations on the axes
 that matter (tp on transformer kernels, ep on embedding tables) so the same
@@ -22,3 +23,5 @@ from tensorflowonspark_tpu.models.bert import (Bert, BertConfig,
                                                BertForQuestionAnswering,
                                                BertForSequenceClassification)  # noqa: F401
 from tensorflowonspark_tpu.models.wide_deep import WideDeep  # noqa: F401
+from tensorflowonspark_tpu.models.gpt import (GPT, GPTConfig,  # noqa: F401
+                                              greedy_generate, init_cache)

@@ -1,0 +1,211 @@
+"""GPT-style causal decoder with mesh-aware sharding and compiled decoding.
+
+The reference has no decoder family at all (its workloads are
+MNIST/ResNet/U-Net/BERT-class, SURVEY.md §2d) — this extends the model zoo
+the direction modern users expect, TPU-first:
+
+- same Megatron GSPMD annotations as :mod:`.bert` (QKV/up shard output dim
+  over ``tp``, out/down shard input dim; one XLA all-reduce per block);
+- pre-LN blocks, bf16 activations, fp32 layernorm/softmax/logits, weight-
+  tied LM head;
+- pluggable attention (``ops.flash_attention`` with ``causal=True`` on
+  TPU, ring/ulysses for sequence parallelism);
+- **autoregressive decoding is a single compiled program**: a static-shape
+  KV cache lives in a flax ``cache`` collection and
+  :func:`greedy_generate` rolls the model with ``lax.scan`` — no
+  per-token Python, no dynamic shapes, exactly what the XLA compilation
+  model wants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.models.bert import _dense
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+    # Optional attention override for the full-sequence TRAINING path
+    # (``decode=False``), signature ``(q, k, v, mask=None, causal=...) ->
+    # out``.  The decode path — including prefill through ``decode=True``
+    # — always uses dense attention over the static cache (the cache
+    # update and masked read are one fused program there).
+    attention_fn: Callable | None = None
+    emb_spec: tuple = ("tp", None)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: GPTConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        cfg = self.cfg
+        B, T, _ = x.shape
+        H, D = cfg.num_heads, cfg.head_dim
+        q = _dense(H * D, (None, "tp"), cfg.dtype, "query")(x).reshape(B, T, H, D)
+        k = _dense(H * D, (None, "tp"), cfg.dtype, "key")(x).reshape(B, T, H, D)
+        v = _dense(H * D, (None, "tp"), cfg.dtype, "value")(x).reshape(B, T, H, D)
+
+        if self.decode:
+            # Static-shape KV cache: [B, max_len, H, D] per layer; `index`
+            # is the write position.  T==1 per decode step.
+            ck = self.variable("cache", "k", jnp.zeros,
+                               (B, cfg.max_position_embeddings, H, D), cfg.dtype)
+            cv = self.variable("cache", "v", jnp.zeros,
+                               (B, cfg.max_position_embeddings, H, D), cfg.dtype)
+            ci = self.variable("cache", "index",
+                               lambda: jnp.zeros((), jnp.int32))
+            idx = ci.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            ci.value = idx + T
+            k_all, v_all = ck.value, cv.value
+            # attend only to written positions (<= current index)
+            k_pos = jnp.arange(cfg.max_position_embeddings)
+            visible = k_pos[None, :] <= (idx + jnp.arange(T))[:, None]  # [T, L]
+            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                           k_all.astype(jnp.float32)) * (D ** -0.5)
+            s = jnp.where(visible[None, None], s, -1e30)
+            p = nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", p, v_all.astype(jnp.float32))
+        elif cfg.attention_fn is not None:
+            ctx = cfg.attention_fn(q, k, v, causal=True)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                           k.astype(jnp.float32)) * (D ** -0.5)
+            pos = jnp.arange(T)
+            causal = pos[:, None] >= pos[None, :]
+            s = jnp.where(causal[None, None], s, -1e30)
+            p = nn.softmax(s, axis=-1)
+            p = nn.Dropout(cfg.dropout_rate, deterministic=not train)(p)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        ctx = ctx.astype(cfg.dtype).reshape(B, T, H * D)
+        return _dense(cfg.hidden_size, ("tp", None), cfg.dtype, "out")(ctx)
+
+
+class DecoderBlock(nn.Module):
+    cfg: GPTConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        cfg = self.cfg
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(cfg.dtype)
+        y = CausalSelfAttention(cfg, self.decode, name="attn")(y, train=train)
+        y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
+        y = _dense(cfg.intermediate_size, (None, "tp"), cfg.dtype, "mlp_up")(y)
+        y = nn.gelu(y)
+        y = _dense(cfg.hidden_size, ("tp", None), cfg.dtype, "mlp_down")(y)
+        y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
+        return x + y
+
+
+class GPT(nn.Module):
+    """Causal LM: ``input_ids [B, T] -> logits [B, T, V]`` (tied head).
+
+    ``decode=True`` builds the incremental path: each call consumes the
+    next token(s), reads/writes the ``cache`` collection, and positions
+    continue from the cache index.
+    """
+
+    cfg: GPTConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, input_ids, *, train: bool = False):
+        cfg = self.cfg
+        B, T = input_ids.shape
+        tok = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="tok_emb",
+                       dtype=cfg.dtype,
+                       embedding_init=nn.with_partitioning(
+                           nn.initializers.normal(0.02), cfg.emb_spec))
+        if self.decode:
+            start = self.variable("cache", "pos",
+                                  lambda: jnp.zeros((), jnp.int32))
+            positions = start.value + jnp.arange(T)
+            start.value = start.value + T
+        else:
+            positions = jnp.arange(T)
+        pos_emb = self.param(
+            "pos_emb",
+            nn.with_partitioning(nn.initializers.normal(0.02), (None, None)),
+            (cfg.max_position_embeddings, cfg.hidden_size))
+        x = tok(input_ids) + pos_emb[positions].astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout_rate, deterministic=not train)(x)
+        for i in range(cfg.num_layers):
+            x = DecoderBlock(cfg, self.decode, name=f"layer_{i}")(x, train=train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        table = tok.variables["params"]["embedding"]
+        table = getattr(table, "value", table)  # unbox partitioned param
+        return jnp.einsum("bth,vh->btv", x.astype(jnp.float32),
+                          table.astype(jnp.float32))
+
+
+def init_cache(cfg: GPTConfig, params, batch: int):
+    """Allocate the static KV cache by tracing one dummy decode step."""
+    model = GPT(cfg, decode=True)
+    _, vars_ = model.apply(
+        {"params": params}, jnp.zeros((batch, 1), jnp.int32),
+        mutable=["cache"])
+    return jax.tree.map(jnp.zeros_like, vars_["cache"])
+
+
+def greedy_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int):
+    """Greedy decode as ONE compiled program.
+
+    Prefill runs the full-sequence path once; then a ``lax.scan`` rolls
+    single-token decode steps against the KV cache.  Returns
+    ``[B, prompt_len + max_new_tokens]`` token ids.
+    """
+    B, T0 = prompt_ids.shape
+    if max_new_tokens <= 0:
+        return prompt_ids
+    total = T0 + max_new_tokens
+    if total > cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) = {total} "
+            f"exceeds max_position_embeddings ({cfg.max_position_embeddings});"
+            " the static cache/position table cannot hold the sequence")
+    model = GPT(cfg, decode=True)
+
+    def prefill(params, ids, cache):
+        logits, vars_ = model.apply({"params": params, "cache": cache},
+                                    ids, mutable=["cache"])
+        return jnp.argmax(logits[:, -1], axis=-1), vars_["cache"]
+
+    def step(carry, _):
+        tok, cache = carry
+        logits, vars_ = model.apply({"params": params, "cache": cache},
+                                    tok[:, None], mutable=["cache"])
+        nxt = jnp.argmax(logits[:, -1], axis=-1)
+        return (nxt, vars_["cache"]), nxt
+
+    cache = init_cache(cfg, params, B)
+    first, cache = prefill(params, prompt_ids, cache)
+    (_, _), rest = jax.lax.scan(step, (first, cache), None,
+                                length=max_new_tokens - 1)
+    generated = jnp.concatenate([first[:, None], rest.T], axis=1)
+    return jnp.concatenate([prompt_ids, generated], axis=1)

@@ -48,7 +48,6 @@ def test_estimator_writes_training_curves(tmp_path):
     import optax
 
     from tensorflowonspark_tpu.estimator import Estimator
-    from tensorflowonspark_tpu.example_proto import _read_varint  # noqa: F401
 
     def init_fn():
         return {"w": jnp.zeros((4, 1))}

@@ -99,6 +99,15 @@ def test_mnist_estimator(tmp_path):
     assert "final eval step=8" in out
 
 
+def test_gpt_tiny(tmp_path):
+    out = _run("gpt/gpt_tiny.py", "--max_steps", "40",
+               "--model_dir", str(tmp_path / "gpt"), timeout=600)
+    assert "gpt_tiny: done" in out
+    import re
+    m = re.search(r"continuation accuracy (\d\.\d+)", out)
+    assert m and float(m.group(1)) >= 0.5, out
+
+
 def test_switch_lm_moe(tmp_path):
     out = _run("moe/switch_lm.py", "--ep", "2", "--max_steps", "10",
                "--model_dir", str(tmp_path / "moe"))

@@ -109,12 +109,17 @@ class Estimator:
         self.eval_metrics_fn = eval_metrics_fn
         self.model_dir = model_dir
         self.save_every_steps = save_every_steps
-        self._ckpt = CheckpointManager(model_dir, max_to_keep=max_to_keep)
-        self._state = self.strategy.init_state(init_fn, tx)
-        latest = self._ckpt.latest_step()
-        if latest is not None:
-            self._state = self._ckpt.restore(latest, target=self._state)
-            logger.info("estimator: resumed from %s step %d", model_dir, latest)
+        from tensorflowonspark_tpu.observability import GoodputRecorder
+
+        self._goodput = GoodputRecorder()
+        with self._goodput.time("init"):
+            self._ckpt = CheckpointManager(model_dir, max_to_keep=max_to_keep)
+            self._state = self.strategy.init_state(init_fn, tx)
+            latest = self._ckpt.latest_step()
+            if latest is not None:
+                self._state = self._ckpt.restore(latest, target=self._state)
+                logger.info("estimator: resumed from %s step %d",
+                            model_dir, latest)
         # Host-side mirror of state.step: reading the device scalar every
         # loop iteration would block on the in-flight step and kill JAX's
         # async dispatch; the mirror advances with each dispatched step.
@@ -166,22 +171,40 @@ class Estimator:
             self._train_step = self.strategy.build_train_step(self.loss_fn)
         sharding = self.strategy.batch_sharding()
         guard = PreemptionGuard() if self._handle_preemption else None
+        import jax
+
+        _END = object()
+        prev_metrics = None  # blocked on one step late: see "step" timing
         with guard if guard is not None else contextlib.nullcontext():
             while self._host_step < max_steps:
                 made_progress = False
                 # device_prefetch keeps transfers ahead of compute — the
                 # same host/device overlap the data plane provides
-                # everywhere else
-                for b in device_prefetch(iter(input_fn()), depth=2,
-                                         sharding=sharding):
-                    if self._host_step >= max_steps or \
+                # everywhere else.  Epoch setup (input_fn itself) is data
+                # badput too.
+                with self._goodput.time("data"):
+                    it = device_prefetch(iter(input_fn()), depth=2,
+                                         sharding=sharding)
+                while True:
+                    with self._goodput.time("data"):
+                        b = next(it, _END)
+                    if b is _END or self._host_step >= max_steps or \
                             (guard is not None and guard.preempted):
                         break
-                    self._state, metrics = self._train_step(self._state, b)
+                    with self._goodput.time("step"):
+                        # dispatch step k, then block on step k-1's output:
+                        # device time lands in "step" (dispatch alone is
+                        # microseconds) while one step of pipelining — and
+                        # the prefetch overlap — survives
+                        self._state, metrics = self._train_step(self._state, b)
+                        if prev_metrics is not None:
+                            jax.block_until_ready(prev_metrics)
+                        prev_metrics = metrics
                     self._host_step += 1
                     made_progress = True
                     if self._host_step % self.save_every_steps == 0:
-                        self._ckpt.save(self._host_step, self._state)
+                        with self._goodput.time("checkpoint"):
+                            self._ckpt.save(self._host_step, self._state)
                     if self._summary is not None and \
                             self._host_step % self.log_every_steps == 0:
                         # write the PREVIOUS boundary's metrics (long since
@@ -196,11 +219,19 @@ class Estimator:
                     break
                 if not made_progress:
                     raise ValueError("input_fn yielded no batches")
+        if prev_metrics is not None:
+            import time as _time
+
+            t0 = _time.monotonic()
+            jax.block_until_ready(prev_metrics)  # drain the pipeline
+            # the drain is the LAST step's device time, not an extra step
+            self._goodput.record("step", _time.monotonic() - t0, count=False)
         if self._pending_log is not None:
             self._write_scalars("train", *self._pending_log)
             self._pending_log = None
-        self._ckpt.save(self._host_step, self._state)
-        self._ckpt.wait()
+        with self._goodput.time("checkpoint"):
+            self._ckpt.save(self._host_step, self._state)
+            self._ckpt.wait()
         return self._host_step
 
     def evaluate(self, input_fn, steps: int | None = None) -> dict:
@@ -234,6 +265,12 @@ class Estimator:
             self._write_scalars("eval", out)
         out["global_step"] = self.global_step
         return out
+
+    def goodput(self) -> dict:
+        """Badput accounting for this estimator's lifetime (SURVEY.md §5's
+        ml-goodput-measurement role): wall time split into init/compile,
+        data waits, productive step time, checkpoint stalls, and idle."""
+        return self._goodput.summary()
 
     def _write_scalars(self, prefix: str, metrics: dict,
                        step: int | None = None) -> None:

@@ -262,9 +262,10 @@ class GoodputRecorder:
             self._secs[category] += time.monotonic() - start
             self._counts[category] += 1
 
-    def record(self, category: str, secs: float) -> None:
+    def record(self, category: str, secs: float, count: bool = True) -> None:
         self._secs[category] += secs
-        self._counts[category] += 1
+        if count:
+            self._counts[category] += 1
 
     def summary(self) -> dict:
         wall = time.monotonic() - self._t0

@@ -85,6 +85,17 @@ def test_resume_at_max_steps_still_runs_final_eval(tmp_path):
         assert "mse" in final
 
 
+def test_goodput_accounting(tmp_path):
+    x, y = _linreg_problem()
+    with _make_estimator(tmp_path / "m") as est:
+        est.train(_batches(x, y), max_steps=8)
+        g = est.goodput()
+    assert g["counts"]["step"] == 8
+    assert 0.0 < g["goodput"] <= 1.0
+    for cat in ("init", "data", "step", "checkpoint"):
+        assert g["secs"].get(cat, 0) >= 0
+
+
 def test_throttle_steps_must_be_positive():
     with pytest.raises(ValueError, match="throttle_steps"):
         EvalSpec(input_fn=lambda: iter(()), throttle_steps=0)

@@ -11,7 +11,8 @@ dependency — this module is it:
 - :class:`Dataset` — a lazily-evaluated, composable pipeline
   (``from_tfrecords`` / ``from_examples`` / ``from_tensor_slices`` /
   ``from_generator`` sources; ``shard``, ``map``, ``filter``, ``shuffle``,
-  ``repeat``, ``batch``, ``prefetch``, ``take``, ``skip`` transforms).
+  ``repeat``, ``interleave``, ``batch``, ``padded_batch``, ``prefetch``,
+  ``take``, ``skip``, ``cache``, ``cache_on_device`` transforms).
   Iterating re-runs the pipeline from the source, so ``repeat`` +
   re-iteration behave like tf.data.
 - :func:`device_prefetch` — wraps any iterator in a depth-``k`` buffer of
@@ -237,9 +238,112 @@ class Dataset:
         src = self._make
         return Dataset(lambda: (x for j, x in enumerate(src()) if j >= n))
 
+    def interleave(self, fn: Callable[[Any], "Dataset | Iterable"],
+                   cycle_length: int = 4, block_length: int = 1) -> "Dataset":
+        """Map each element to a sub-dataset and interleave their elements
+        round-robin (``tf.data.Dataset.interleave`` semantics): up to
+        ``cycle_length`` sub-iterators open at once, ``block_length``
+        consecutive elements pulled from each before rotating.  The
+        sharded-file reading pattern — ``Dataset.from_tensor_slices(paths)
+        .interleave(Dataset.from_tfrecords)`` — mixes records across files
+        instead of reading them end to end."""
+        assert cycle_length > 0 and block_length > 0
+        src = self._make
+
+        def make():
+            def gen():
+                inputs = src()
+                active: collections.deque = collections.deque()
+
+                def open_next():
+                    for x in inputs:
+                        sub = fn(x)
+                        active.append(iter(sub))
+                        return True
+                    return False
+
+                while len(active) < cycle_length and open_next():
+                    pass
+                while active:
+                    it = active.popleft()
+                    alive = True
+                    for _ in range(block_length):
+                        try:
+                            yield next(it)
+                        except StopIteration:
+                            alive = False
+                            break
+                    if alive:
+                        active.append(it)
+                    else:
+                        open_next()
+            return gen()
+
+        return Dataset(make)
+
+    def cache(self) -> "Dataset":
+        """Host-memory cache: materialize on the first full pass, replay
+        thereafter (``tf.data.Dataset.cache()``; the device-side sibling is
+        :meth:`cache_on_device`).  A partial first pass is discarded.
+
+        Both the stored copies and the replayed elements are private: a
+        consumer mutating a yielded array in place (in-place augmentation,
+        ``b += ...``) can never corrupt later epochs — tf.data's
+        fresh-tensor-per-epoch semantics.  ``cache_on_device`` needs no
+        copies because jax arrays are immutable."""
+        src = self._make
+        cached: list = []
+        complete = [False]
+
+        def make():
+            def gen():
+                if complete[0]:
+                    for x in cached:
+                        yield _copy_tree(x)
+                    return
+                attempt: list = []
+                for x in src():
+                    attempt.append(_copy_tree(x))
+                    yield x
+                cached[:] = attempt
+                complete[0] = True
+            return gen()
+
+        return Dataset(make)
+
+    def padded_batch(self, batch_size: int, padding_value=0,
+                     drop_remainder: bool = False) -> "Dataset":
+        """Batch variable-length elements, padding each array dimension to
+        the longest in the batch (``tf.data.Dataset.padded_batch`` with
+        inferred shapes).  Works on arrays, dicts, and tuples — the NLP
+        pattern (ragged token sequences → one rectangular batch) the
+        reference delegates to tf.data.  Mixed dtypes within a batch
+        promote via ``np.result_type`` (never silently truncate)."""
+
+        def pad_leaf(items):
+            arrs = [np.asarray(x) for x in items]
+            rank = arrs[0].ndim
+            if any(a.ndim != rank for a in arrs):
+                raise ValueError("padded_batch: rank mismatch within batch")
+            dtype = np.result_type(*arrs)
+            if rank == 0:
+                return np.stack(arrs).astype(dtype, copy=False)
+            target = tuple(max(a.shape[d] for a in arrs) for d in range(rank))
+            out = np.full((len(arrs),) + target, padding_value, dtype=dtype)
+            for i, a in enumerate(arrs):
+                out[(i,) + tuple(slice(0, s) for s in a.shape)] = a
+            return out
+
+        return self._batched(batch_size, drop_remainder,
+                             lambda items: _stack(items, leaf=pad_leaf))
+
     def batch(self, batch_size: int, drop_remainder: bool = False) -> "Dataset":
         """Stack ``batch_size`` consecutive elements: arrays → a leading
         batch axis; dicts/tuples → per-key/per-position stacking."""
+        return self._batched(batch_size, drop_remainder, _stack)
+
+    def _batched(self, batch_size: int, drop_remainder: bool,
+                 stack_fn: Callable[[list], Any]) -> "Dataset":
         assert batch_size > 0
         src = self._make
 
@@ -249,10 +353,10 @@ class Dataset:
                 for x in src():
                     buf.append(x)
                     if len(buf) == batch_size:
-                        yield _stack(buf)
+                        yield stack_fn(buf)
                         buf = []
                 if buf and not drop_remainder:
-                    yield _stack(buf)
+                    yield stack_fn(buf)
             return gen()
 
         return Dataset(make)
@@ -365,13 +469,34 @@ class Dataset:
         return list(self._make())
 
 
-def _stack(items: list):
+def _default_leaf_stack(items: list):
+    return np.stack([np.asarray(x) for x in items])
+
+
+def _stack(items: list, leaf: Callable[[list], Any] = _default_leaf_stack):
+    """Structure-recursive stacking: dicts per key, tuples per position,
+    ``leaf`` (plain stack or pad-and-stack) at array leaves."""
     first = items[0]
     if isinstance(first, dict):
-        return {k: _stack([it[k] for it in items]) for k in first}
+        return {k: _stack([it[k] for it in items], leaf) for k in first}
     if isinstance(first, (tuple, list)):
-        return tuple(_stack([it[j] for it in items]) for j in range(len(first)))
-    return np.stack([np.asarray(x) for x in items])
+        return tuple(_stack([it[j] for it in items], leaf)
+                     for j in range(len(first)))
+    return leaf(items)
+
+
+def _copy_tree(x):
+    """Private copy of a pipeline element (dict/tuple structure over
+    numpy/scalars) so cached elements can't be mutated by consumers."""
+    if isinstance(x, dict):
+        return {k: _copy_tree(v) for k, v in x.items()}
+    if isinstance(x, tuple):
+        return tuple(_copy_tree(v) for v in x)
+    if isinstance(x, list):
+        return [_copy_tree(v) for v in x]
+    if isinstance(x, np.ndarray):
+        return x.copy()
+    return x
 
 
 def device_prefetch(it: Iterator, depth: int = 2, sharding=None):

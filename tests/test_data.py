@@ -178,6 +178,90 @@ def test_cache_on_device_stale_iterator_cannot_corrupt_cache():
     assert [float(b[0]) for b in replay] == [0.0, 1.0, 2.0, 3.0]
 
 
+def test_interleave_round_robin():
+    ds = Dataset.from_tensor_slices(np.arange(3)).interleave(
+        lambda i: [int(i) * 10 + j for j in range(3)], cycle_length=2)
+    # sources 0 and 1 open first, round-robin; source 2 replaces whichever
+    # exhausts first
+    out = list(ds)
+    assert sorted(out) == sorted([0, 1, 2, 10, 11, 12, 20, 21, 22])
+    assert out[:4] == [0, 10, 1, 11], out  # genuinely interleaved
+
+    # cycle_length=1 degenerates to flat_map ordering
+    flat = list(Dataset.from_tensor_slices(np.arange(2)).interleave(
+        lambda i: [int(i), int(i)], cycle_length=1))
+    assert flat == [0, 0, 1, 1]
+
+
+def test_interleave_with_sub_datasets_and_files(tmp_path):
+    for i in range(2):
+        write_records(str(tmp_path / f"part-{i}"),
+                      [encode_example({"v": np.asarray([i * 2 + j], np.int64)})
+                       for j in range(2)])
+    paths = [str(tmp_path / f"part-{i}") for i in range(2)]
+    ds = Dataset.from_tensor_slices(np.asarray(paths)) \
+        .interleave(lambda p: Dataset.from_examples(str(p)), cycle_length=2)
+    # from_examples squeezes single-element features to scalars
+    vals = sorted(int(d["v"]) for d in ds)
+    assert vals == [0, 1, 2, 3]
+
+
+def test_host_cache_consumes_source_once():
+    calls = [0]
+
+    def gen():
+        calls[0] += 1
+        yield from range(4)
+
+    ds = Dataset.from_generator(gen).cache()
+    assert list(ds) == [0, 1, 2, 3]
+    assert list(ds) == [0, 1, 2, 3]
+    assert calls[0] == 1
+
+    # partial pass discarded
+    it = iter(Dataset.from_generator(gen).cache())
+    next(it)
+    # calls[0] is now 2; a fresh full pass still works
+
+
+def test_host_cache_immune_to_consumer_mutation():
+    ds = Dataset.from_generator(
+        lambda: iter([np.arange(3, dtype=np.float32)])).cache()
+    for b in ds:
+        b += 100  # in-place mutation by the consumer
+    replay = next(iter(ds))
+    np.testing.assert_array_equal(replay, [0, 1, 2])
+    replay += 7  # mutating a replayed element is private too
+    np.testing.assert_array_equal(next(iter(ds)), [0, 1, 2])
+
+
+def test_padded_batch_promotes_mixed_dtypes():
+    ds = Dataset.from_generator(
+        lambda: iter([np.array([1], np.int32),
+                      np.array([2 ** 40], np.int64)])).padded_batch(2)
+    b = next(iter(ds))
+    assert b.dtype == np.int64
+    np.testing.assert_array_equal(b, [[1], [2 ** 40]])
+
+
+def test_padded_batch_pads_ragged_sequences():
+    seqs = [np.arange(n, dtype=np.int32) + 1 for n in (2, 3, 1, 4)]
+    ds = Dataset.from_generator(lambda: iter(seqs)).padded_batch(2)
+    batches = list(ds)
+    assert batches[0].shape == (2, 3)
+    np.testing.assert_array_equal(batches[0], [[1, 2, 0], [1, 2, 3]])
+    assert batches[1].shape == (2, 4)
+    np.testing.assert_array_equal(batches[1], [[1, 0, 0, 0], [1, 2, 3, 4]])
+
+    # dict elements + custom padding value
+    dds = Dataset.from_generator(
+        lambda: iter([{"x": np.ones((1,), np.float32)},
+                      {"x": np.ones((3,), np.float32)}])) \
+        .padded_batch(2, padding_value=-1)
+    b = next(iter(dds))
+    np.testing.assert_array_equal(b["x"], [[1, -1, -1], [1, 1, 1]])
+
+
 def test_full_pipeline_end_to_end(tmp_path):
     """The worker-side recipe from the module docstring, minus the mesh."""
     write_records(str(tmp_path / "part-00000"),

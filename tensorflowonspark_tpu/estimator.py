@@ -266,6 +266,24 @@ class Estimator:
         out["global_step"] = self.global_step
         return out
 
+    def export(self, export_dir: str, serve_fn, example_inputs,
+               is_chief: bool = True, **export_kwargs) -> str | None:
+        """Write a serving export of the trained parameters (the
+        tf.estimator ``export_saved_model`` step; reference:
+        ``compat.py::export_saved_model``, chief-only).
+
+        ``serve_fn(params, *inputs)`` is the inference function —
+        typically ``lambda p, x: model.apply({"params": p}, x)`` — traced
+        and stored as StableHLO via :func:`~.checkpoint.export_model`, so
+        ``TFModel``/``batch_inference`` can serve it with no model code.
+        """
+        from tensorflowonspark_tpu.checkpoint import export_model
+
+        with self._goodput.time("checkpoint"):
+            return export_model(export_dir, serve_fn, self.params,
+                                example_inputs, is_chief=is_chief,
+                                **export_kwargs)
+
     def goodput(self) -> dict:
         """Badput accounting for this estimator's lifetime (SURVEY.md §5's
         ml-goodput-measurement role): wall time split into init/compile,

@@ -85,6 +85,31 @@ def test_resume_at_max_steps_still_runs_final_eval(tmp_path):
         assert "mse" in final
 
 
+def test_export_serves_trained_params(tmp_path):
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.checkpoint import ExportedModel
+
+    x, y = _linreg_problem()
+    with _make_estimator(tmp_path / "m") as est:
+        est.train(_batches(x, y), max_steps=30)
+        w = np.asarray(est.params["w"])
+        out = est.export(str(tmp_path / "export"),
+                         lambda p, x: x @ p["w"],
+                         [jnp.zeros((4, 4))])
+    assert out is not None
+    served = ExportedModel.load(str(tmp_path / "export"))
+    out_vals = served(x[:8])
+    pred = np.asarray(next(iter(out_vals.values()))
+                      if isinstance(out_vals, dict) else out_vals)
+    np.testing.assert_allclose(pred, x[:8] @ w, rtol=1e-5)
+
+    # non-chief writes nothing
+    with _make_estimator(tmp_path / "m") as est2:
+        assert est2.export(str(tmp_path / "e2"), lambda p, x: x @ p["w"],
+                           [np.zeros((4, 4))], is_chief=False) is None
+
+
 def test_goodput_accounting(tmp_path):
     x, y = _linreg_problem()
     with _make_estimator(tmp_path / "m") as est:

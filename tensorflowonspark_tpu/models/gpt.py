@@ -46,6 +46,13 @@ class GPTConfig:
     # update and masked read are one fused program there).
     attention_fn: Callable | None = None
     emb_spec: tuple = ("tp", None)
+    # Stack the decoder blocks with ``nn.scan`` (+ ``nn.remat``): one traced
+    # block instead of ``num_layers`` copies — compile time O(1) in depth,
+    # activations rematerialised per layer on the backward pass.  The XLA
+    # layer-stacking idiom for deep models; params gain a leading ``layers``
+    # axis (``layers/...`` instead of ``layer_{i}/...``).
+    scan_layers: bool = False
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -123,6 +130,14 @@ class DecoderBlock(nn.Module):
         return x + y
 
 
+class _ScanBlock(DecoderBlock):
+    """Scan-body adapter: ``(carry, train) -> (carry, None)``."""
+
+    @nn.compact
+    def __call__(self, x, train):  # noqa: D102 (scan signature)
+        return DecoderBlock.__call__(self, x, train=train), None
+
+
 class GPT(nn.Module):
     """Causal LM: ``input_ids [B, T] -> logits [B, T, V]`` (tied head).
 
@@ -155,8 +170,30 @@ class GPT(nn.Module):
             (cfg.max_position_embeddings, cfg.hidden_size))
         x = tok(input_ids) + pos_emb[positions].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout_rate, deterministic=not train)(x)
-        for i in range(cfg.num_layers):
-            x = DecoderBlock(cfg, self.decode, name=f"layer_{i}")(x, train=train)
+        if cfg.scan_layers:
+            block_cls = _ScanBlock
+            if cfg.remat:
+                block_cls = nn.remat(
+                    _ScanBlock, static_argnums=(2,),
+                    prevent_cse=False)  # scan bodies need no CSE barrier
+            blocks = nn.scan(
+                block_cls,
+                variable_axes={"params": 0, "cache": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=nn.broadcast,  # `train` is config, not scanned data
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: None},
+            )(cfg, self.decode, name="layers")
+            x, _ = blocks(x, train)
+        else:
+            block_cls = DecoderBlock
+            if cfg.remat:
+                # remat is independent of the stacking choice: the loop
+                # branch rematerialises per layer too
+                block_cls = nn.remat(DecoderBlock)
+            for i in range(cfg.num_layers):
+                x = block_cls(cfg, self.decode, name=f"layer_{i}")(
+                    x, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         table = tok.variables["params"]["embedding"]
         table = getattr(table, "value", table)  # unbox partitioned param

@@ -1,25 +1,39 @@
-"""GPT decoder: causality, cached-decode equivalence, compiled generation."""
+"""GPT decoder: causality, cached-decode equivalence, compiled generation.
+
+Parametrized over ``scan_layers`` — the nn.scan(+remat) stacking and the
+plain layer loop must be behaviorally identical (they differ only in the
+parameter tree layout and compile/memory profile).
+"""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tensorflowonspark_tpu.models.gpt import (GPT, GPTConfig, greedy_generate,
                                               init_cache)
 
-CFG = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
-                intermediate_size=64, max_position_embeddings=32,
-                dtype=jnp.float32)
+
+def _cfg(scan_layers=False):
+    return GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                     intermediate_size=64, max_position_embeddings=32,
+                     dtype=jnp.float32, scan_layers=scan_layers,
+                     remat=scan_layers)
 
 
-def _params():
-    model = GPT(CFG)
+CFG = _cfg()
+
+
+def _params(cfg=CFG):
+    model = GPT(cfg)
     ids = jnp.ones((2, 8), jnp.int32)
     return model.init(jax.random.key(0), ids)["params"]
 
 
-def test_forward_shape_and_causality():
-    params = _params()
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_forward_shape_and_causality(scan_layers):
+    CFG = _cfg(scan_layers)
+    params = _params(CFG)
     model = GPT(CFG)
     ids = jax.random.randint(jax.random.key(1), (2, 8), 0, CFG.vocab_size)
     logits = model.apply({"params": params}, ids)
@@ -34,15 +48,17 @@ def test_forward_shape_and_causality():
                            np.asarray(logits2[:, 5:]))
 
 
-def test_cached_decode_matches_full_forward():
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_cached_decode_matches_full_forward(scan_layers):
     """Teacher-forcing equivalence: feeding tokens one at a time through
     the KV cache must reproduce the full-sequence logits."""
-    params = _params()
-    ids = jax.random.randint(jax.random.key(2), (2, 8), 0, CFG.vocab_size)
-    full = GPT(CFG).apply({"params": params}, ids)
+    cfg = _cfg(scan_layers)
+    params = _params(cfg)
+    ids = jax.random.randint(jax.random.key(2), (2, 8), 0, cfg.vocab_size)
+    full = GPT(cfg).apply({"params": params}, ids)
 
-    model = GPT(CFG, decode=True)
-    cache = init_cache(CFG, params, batch=2)
+    model = GPT(cfg, decode=True)
+    cache = init_cache(cfg, params, batch=2)
     outs = []
     for t in range(8):
         logits, vars_ = model.apply({"params": params, "cache": cache},
@@ -52,11 +68,38 @@ def test_cached_decode_matches_full_forward():
     inc = jnp.stack(outs, axis=1)
     np.testing.assert_allclose(np.asarray(inc), np.asarray(full),
                                rtol=2e-4, atol=2e-4)
+    if scan_layers:
+        # params carry ONE stacked block, not per-layer copies
+        assert "layers" in params and "layer_0" not in params
+        assert jax.tree.leaves(params["layers"])[0].shape[0] == cfg.num_layers
 
 
-def test_cached_prefill_matches_full_forward():
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_train_gradients_flow(scan_layers):
+    """value_and_grad through the (possibly remat'd scan) stack: finite
+    loss, nonzero grads for every parameter."""
+    cfg = _cfg(scan_layers)
+    params = _params(cfg)
+    ids = jax.random.randint(jax.random.key(5), (2, 8), 0, cfg.vocab_size)
+
+    def loss_fn(p):
+        import optax
+
+        logits = GPT(cfg).apply({"params": p}, ids)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], ids[:, 1:]).mean()
+
+    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+    assert np.isfinite(float(loss))
+    norms = [float(jnp.abs(g).sum()) for g in jax.tree.leaves(grads)]
+    assert all(n > 0 for n in norms), "dead gradient leaf"
+
+
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_cached_prefill_matches_full_forward(scan_layers):
     """Prefill through the decode path (whole prompt at once) == full."""
-    params = _params()
+    CFG = _cfg(scan_layers)
+    params = _params(CFG)
     ids = jax.random.randint(jax.random.key(3), (2, 6), 0, CFG.vocab_size)
     full = GPT(CFG).apply({"params": params}, ids)
     model = GPT(CFG, decode=True)
@@ -67,16 +110,18 @@ def test_cached_prefill_matches_full_forward():
                                rtol=2e-4, atol=2e-4)
 
 
-def test_greedy_generate_matches_naive_rollout():
-    params = _params()
-    prompt = jax.random.randint(jax.random.key(4), (2, 4), 0, CFG.vocab_size)
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_greedy_generate_matches_naive_rollout(scan_layers):
+    cfg = _cfg(scan_layers)
+    params = _params(cfg)
+    prompt = jax.random.randint(jax.random.key(4), (2, 4), 0, cfg.vocab_size)
     out = jax.jit(greedy_generate, static_argnums=(0, 3))(
-        CFG, params, prompt, 5)
+        cfg, params, prompt, 5)
     assert out.shape == (2, 9)
     np.testing.assert_array_equal(np.asarray(out[:, :4]), np.asarray(prompt))
 
     # naive rollout: recompute the whole sequence each step, take argmax
-    model = GPT(CFG)
+    model = GPT(cfg)
     ids = prompt
     for _ in range(5):
         logits = model.apply({"params": params}, ids)

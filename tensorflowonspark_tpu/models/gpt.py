@@ -210,13 +210,11 @@ def init_cache(cfg: GPTConfig, params, batch: int):
     return jax.tree.map(jnp.zeros_like, vars_["cache"])
 
 
-def greedy_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int):
-    """Greedy decode as ONE compiled program.
-
-    Prefill runs the full-sequence path once; then a ``lax.scan`` rolls
-    single-token decode steps against the KV cache.  Returns
-    ``[B, prompt_len + max_new_tokens]`` token ids.
-    """
+def _generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
+              next_token_fn):
+    """Shared decode loop: prefill once, then ``lax.scan`` single-token
+    steps against the KV cache; ``next_token_fn(logits, step_index) ->
+    [B] tokens`` picks each next token.  ONE compiled program."""
     B, T0 = prompt_ids.shape
     if max_new_tokens <= 0:
         return prompt_ids
@@ -228,21 +226,46 @@ def greedy_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int):
             " the static cache/position table cannot hold the sequence")
     model = GPT(cfg, decode=True)
 
-    def prefill(params, ids, cache):
-        logits, vars_ = model.apply({"params": params, "cache": cache},
-                                    ids, mutable=["cache"])
-        return jnp.argmax(logits[:, -1], axis=-1), vars_["cache"]
-
-    def step(carry, _):
+    def step(carry, i):
         tok, cache = carry
         logits, vars_ = model.apply({"params": params, "cache": cache},
                                     tok[:, None], mutable=["cache"])
-        nxt = jnp.argmax(logits[:, -1], axis=-1)
+        nxt = next_token_fn(logits[:, -1], i)
         return (nxt, vars_["cache"]), nxt
 
     cache = init_cache(cfg, params, B)
-    first, cache = prefill(params, prompt_ids, cache)
-    (_, _), rest = jax.lax.scan(step, (first, cache), None,
-                                length=max_new_tokens - 1)
+    logits, vars_ = model.apply({"params": params, "cache": cache},
+                                prompt_ids, mutable=["cache"])
+    first = next_token_fn(logits[:, -1], jnp.zeros((), jnp.int32))
+    (_, _), rest = jax.lax.scan(step, (first, vars_["cache"]),
+                                jnp.arange(1, max_new_tokens))
     generated = jnp.concatenate([first[:, None], rest.T], axis=1)
     return jnp.concatenate([prompt_ids, generated], axis=1)
+
+
+def greedy_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int):
+    """Greedy decode (argmax each step); see :func:`_generate`.
+    Returns ``[B, prompt_len + max_new_tokens]`` token ids."""
+    return _generate(cfg, params, prompt_ids, max_new_tokens,
+                     lambda logits, i: jnp.argmax(logits, axis=-1))
+
+
+def sample_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
+                    rng, *, temperature: float = 1.0, top_k: int | None = None):
+    """Stochastic decode: temperature-scaled (and optionally top-k
+    truncated) categorical sampling, one compiled program like
+    :func:`greedy_generate`.  ``rng`` is a ``jax.random`` key; each step
+    folds in its index so the whole rollout is reproducible."""
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+
+    def next_token(logits, i):
+        if top_k is not None:
+            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if temperature == 0.0:  # greedy limit
+            return jnp.argmax(logits, axis=-1)
+        return jax.random.categorical(jax.random.fold_in(rng, i),
+                                      logits / temperature, axis=-1)
+
+    return _generate(cfg, params, prompt_ids, max_new_tokens, next_token)

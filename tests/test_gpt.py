@@ -11,7 +11,7 @@ import numpy as np
 import pytest
 
 from tensorflowonspark_tpu.models.gpt import (GPT, GPTConfig, greedy_generate,
-                                              init_cache)
+                                              init_cache, sample_generate)
 
 
 def _cfg(scan_layers=False):
@@ -128,6 +128,34 @@ def test_greedy_generate_matches_naive_rollout(scan_layers):
         ids = jnp.concatenate(
             [ids, jnp.argmax(logits[:, -1:], axis=-1)], axis=1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ids))
+
+
+def test_sample_generate_limits_and_reproducibility():
+    params = _params()
+    prompt = jax.random.randint(jax.random.key(6), (2, 4), 0, CFG.vocab_size)
+    key = jax.random.key(7)
+
+    # temperature -> 0 is exactly greedy
+    greedy = greedy_generate(CFG, params, prompt, 4)
+    cold = sample_generate(CFG, params, prompt, 4, key, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(cold), np.asarray(greedy))
+
+    # top_k=1 is greedy regardless of temperature
+    k1 = sample_generate(CFG, params, prompt, 4, key, temperature=2.0, top_k=1)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+    # same key -> same rollout; different key -> (almost surely) different
+    a = sample_generate(CFG, params, prompt, 8, key, temperature=5.0)
+    b = sample_generate(CFG, params, prompt, 8, key, temperature=5.0)
+    c = sample_generate(CFG, params, prompt, 8, jax.random.key(8),
+                        temperature=5.0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    import pytest
+
+    with pytest.raises(ValueError, match="temperature"):
+        sample_generate(CFG, params, prompt, 4, key, temperature=-1.0)
 
 
 def test_generate_bounds_and_zero_tokens():

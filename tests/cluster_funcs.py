@@ -199,3 +199,61 @@ def fn_train_checkpoint_crash_once(args, ctx):
     if ctx.is_chief:
         ckpt.save(total, {"step": np.asarray(total), "w": w}, force=True)
         ckpt.close()
+
+
+def fn_distributed_pipeline_train(args, ctx):
+    """Cross-process PIPELINE parallelism: a pp=2 mesh spanning two worker
+    processes, so the GPipe schedule's stage-hop ``ppermute`` crosses a
+    real process boundary (gloo) — the multihost path single-process tests
+    can't reach.  Writes ``pipe.<id>`` with the loss trajectory."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    ctx.initialize_distributed()
+
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu.parallel import make_mesh, pipeline_apply
+    from tensorflowonspark_tpu.parallel.mesh import MeshSpec
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    assert len(devs) == 2 and jax.process_count() == 2
+    mesh = make_mesh(MeshSpec(pp=2, dp=1), devices=devs)
+
+    def stage_fn(p, x):
+        return x + jnp.tanh(x @ p["w"])
+
+    hid, num_mb, steps = 8, 2, int(args.get("steps", 2))
+    rng = np.random.default_rng(0)
+    w0 = (rng.standard_normal((2, hid, hid)) * 0.1).astype(np.float32)
+    x_np = rng.standard_normal((4, hid)).astype(np.float32)
+    tx = optax.sgd(0.1)
+
+    stacked_sh = NamedSharding(mesh, P("pp", None, None))
+    stacked = jax.make_array_from_callback(
+        w0.shape, stacked_sh, lambda i: w0[i])
+    params = {"w": stacked}
+    opt_state = jax.jit(tx.init)(params)
+    x = jax.device_put(jnp.asarray(x_np), NamedSharding(mesh, P()))
+
+    @jax.jit
+    def train_step(params, opt_state, x):
+        def loss_fn(p):
+            y = pipeline_apply(mesh, stage_fn, p, x, num_microbatches=num_mb)
+            return jnp.mean(y ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, x)
+        losses.append(float(loss))
+
+    path = os.path.join(ctx.working_dir, f"pipe.{ctx.executor_id}")
+    with open(path, "w") as f:
+        f.write(":".join(f"{v:.8f}" for v in losses))

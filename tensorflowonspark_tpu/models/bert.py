@@ -60,6 +60,11 @@ class BertConfig:
     # shards vocab rows over tp; pass (("ep", "tp"), None) to also spread
     # tables over the embedding-shard axis (the num_ps analogue).
     emb_spec: tuple = ("tp", None)
+    # Stack encoder layers with nn.scan (+ nn.remat): one traced block,
+    # O(1)-in-depth compile time, per-layer rematerialisation — the same
+    # knobs as GPTConfig (params gain a leading ``layers`` axis).
+    scan_layers: bool = False
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -119,6 +124,14 @@ class EncoderLayer(nn.Module):
         return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y).astype(cfg.dtype)
 
 
+class _ScanEncoderLayer(EncoderLayer):
+    """Scan-body adapter: ``(carry, mask, train) -> (carry, None)``."""
+
+    @nn.compact
+    def __call__(self, x, mask, train):  # noqa: D102 (scan signature)
+        return EncoderLayer.__call__(self, x, mask, train=train), None
+
+
 class Bert(nn.Module):
     """Encoder trunk: ``(input_ids, attention_mask, token_type_ids) →
     sequence of hidden states``."""
@@ -143,8 +156,25 @@ class Bert(nn.Module):
                              name="type_emb")(token_type_ids)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x).astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout_rate, deterministic=not train)(x)
-        for i in range(cfg.num_layers):
-            x = EncoderLayer(cfg, name=f"layer_{i}")(x, attention_mask, train=train)
+        if cfg.scan_layers:
+            block_cls = _ScanEncoderLayer
+            if cfg.remat:
+                block_cls = nn.remat(_ScanEncoderLayer, static_argnums=(3,),
+                                     prevent_cse=False)
+            blocks = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=nn.broadcast,  # mask/train are config, not scanned
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: None},
+            )(cfg, name="layers")
+            x, _ = blocks(x, attention_mask, train)
+        else:
+            block_cls = nn.remat(EncoderLayer) if cfg.remat else EncoderLayer
+            for i in range(cfg.num_layers):
+                x = block_cls(cfg, name=f"layer_{i}")(x, attention_mask,
+                                                      train=train)
         return x
 
 

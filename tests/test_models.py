@@ -79,6 +79,30 @@ def test_bert_trunk_and_heads():
     assert cls.apply(cp, ids, mask).shape == (2, 3)
 
 
+def test_bert_scan_layers_stacked_params_and_grads():
+    """scan_layers+remat: one stacked block, masked attention still works,
+    gradients reach every leaf."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY_BERT, scan_layers=True, remat=True)
+    ids = jnp.ones((2, 8), jnp.int32)
+    mask = jnp.array([[1] * 6 + [0] * 2] * 2, bool)
+    trunk = Bert(cfg)
+    params = trunk.init(jax.random.key(0), ids, mask)
+    assert "layers" in params["params"] and "layer_0" not in params["params"]
+    stacked = jax.tree.leaves(params["params"]["layers"])[0]
+    assert stacked.shape[0] == cfg.num_layers
+
+    out = trunk.apply(params, ids, mask)
+    assert out.shape == (2, 8, cfg.hidden_size)
+    g = jax.grad(lambda p: jnp.mean(trunk.apply(p, ids, mask) ** 2))(params)
+    assert all(float(jnp.abs(x).sum()) > 0 for x in jax.tree.leaves(g))
+
+    # mask participates on the scan path too
+    full = trunk.apply(params, ids, jnp.ones((2, 8), bool))
+    assert not np.allclose(np.asarray(full[:, :6]), np.asarray(out[:, :6]))
+
+
 def test_bert_attention_mask_blocks_padding():
     ids = jnp.ones((1, 8), jnp.int32)
     trunk = Bert(TINY_BERT)

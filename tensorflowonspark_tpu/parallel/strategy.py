@@ -55,9 +55,14 @@ jax.tree_util.register_dataclass(
 class MeshStrategy:
     """Base strategy: explicit mesh + optional parameter partition rules."""
 
-    def __init__(self, mesh=None, rules: PartitionRules | None = None, **axis_sizes):
+    def __init__(self, mesh=None, rules: PartitionRules | None = None,
+                 seed: int = 0, **axis_sizes):
         self.mesh = mesh if mesh is not None else make_mesh(**axis_sizes)
         self.rules = rules
+        # base key for per-step rng (dropout etc.): folded with state.step
+        # inside the compiled step, so resume-from-checkpoint reproduces
+        # the exact rng stream
+        self._base_rng = jax.random.key(seed)
 
     # -- state -------------------------------------------------------------
     def init_state(self, init_fn, tx, *init_args) -> TrainState:
@@ -101,11 +106,22 @@ class MeshStrategy:
         state — the ``mutable=["batch_stats"]`` pattern without threading the
         stats through the batch (which would alias donated buffers).
 
+        A ``rng`` keyword parameter in ``loss_fn``'s signature receives a
+        per-step ``jax.random`` key (``fold_in(base, state.step)`` — the
+        dropout plumbing; deterministic given the strategy ``seed``, and
+        resume-safe because it derives from the step counter)::
+
+            def loss_fn(params, batch, rng=None):
+                logits = model.apply({"params": params}, batch["x"],
+                                     train=True, rngs={"dropout": rng})
+
         Gradient averaging across data shards is *not* written here — the
         batch is sharded over dp/fsdp and the loss is a mean over the global
         batch, so XLA inserts the reduce-scatter/all-reduce it needs (the
         NCCL allreduce of ``MultiWorkerMirroredStrategy``, compiled).
         """
+        import inspect
+
         tx = tx or getattr(self, "_tx", None)
         assert tx is not None, "pass tx= or call init_state first"
         has_aux = getattr(loss_fn, "has_aux", False)
@@ -114,8 +130,6 @@ class MeshStrategy:
             # infer only from an explicit third *positional* param named
             # 'extras' — a bare arg-count check would misroute state.extras
             # into **kwargs or a defaulted third arg (e.g. rng=...)
-            import inspect
-
             try:
                 params = list(inspect.signature(loss_fn).parameters.values())
             except (TypeError, ValueError):
@@ -124,15 +138,24 @@ class MeshStrategy:
                 len(params) >= 3 and params[2].name == "extras"
                 and params[2].kind in (inspect.Parameter.POSITIONAL_ONLY,
                                        inspect.Parameter.POSITIONAL_OR_KEYWORD))
+        try:
+            sig_params = inspect.signature(loss_fn).parameters
+        except (TypeError, ValueError):
+            sig_params = {}
+        takes_rng = "rng" in sig_params
+        base_rng = self._base_rng
 
         def step(state: TrainState, batch):
             grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
             args = (state.params, batch, state.extras) if takes_extras \
                 else (state.params, batch)
+            kwargs = {}
+            if takes_rng:
+                kwargs["rng"] = jax.random.fold_in(base_rng, state.step)
             if has_aux:
-                (loss, aux), grads = grad_fn(*args)
+                (loss, aux), grads = grad_fn(*args, **kwargs)
             else:
-                loss, grads = grad_fn(*args)
+                loss, grads = grad_fn(*args, **kwargs)
                 aux = {}
             import optax
 

@@ -39,7 +39,7 @@ def train_fn(args, ctx):
                      num_layers=args.num_layers, num_heads=args.num_heads,
                      intermediate_size=args.hidden_size * 4,
                      max_position_embeddings=args.seq_len,
-                     dropout_rate=0.0,
+                     dropout_rate=args.dropout,
                      dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
                      attention_fn=attention_fn)
     model = BertForQuestionAnswering(cfg)
@@ -50,9 +50,13 @@ def train_fn(args, ctx):
     state = strategy.init_state(
         lambda: model.init(jax.random.key(0), ids0)["params"], tx)
 
-    def loss_fn(params, batch):
+    def loss_fn(params, batch, rng=None):
+        # `rng` is the strategy's per-step key (fold_in(seed, step)):
+        # BERT fine-tuning uses real dropout, resume-reproducibly
         ids, starts, ends, w = batch
-        s_logits, e_logits = model.apply({"params": params}, ids)
+        s_logits, e_logits = model.apply(
+            {"params": params}, ids, train=args.dropout > 0,
+            rngs={"dropout": rng} if args.dropout > 0 else None)
         ce = (optax.softmax_cross_entropy_with_integer_labels(s_logits, starts)
               + optax.softmax_cross_entropy_with_integer_labels(e_logits, ends))
         return (ce * w).sum() / jnp.maximum(w.sum(), 1.0) / 2.0
@@ -110,6 +114,8 @@ if __name__ == "__main__":
     p.add_argument("--hidden_size", type=int, default=64)
     p.add_argument("--num_layers", type=int, default=2)
     p.add_argument("--num_heads", type=int, default=4)
+    p.add_argument("--dropout", type=float, default=0.1,
+                   help="dropout rate; rng threaded per step by the strategy")
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--flash", action="store_true",
                    help="Pallas flash attention (use on TPU)")

@@ -91,6 +91,9 @@ class Estimator:
         ``model_dir/tensorboard`` when ``model_dir`` is set; pass "" to
         disable).  Train metrics land under ``train/`` every
         ``log_every_steps`` steps, eval metrics under ``eval/``.
+      profile_steps: optional ``(start, stop)`` global-step range traced
+        with the jax profiler into ``summary_dir/plugins`` — the xprof
+        trace appears in TensorBoard's Profile tab (chief only).
     """
 
     def __init__(self, init_fn, loss_fn, tx, model_dir: str, *,
@@ -98,7 +101,8 @@ class Estimator:
                  save_every_steps: int = 100, max_to_keep: int = 5,
                  handle_preemption: bool = True,
                  summary_dir: Optional[str] = None,
-                 log_every_steps: int = 10):
+                 log_every_steps: int = 10,
+                 profile_steps: Optional[tuple] = None):
         import os
 
         from tensorflowonspark_tpu.checkpoint import CheckpointManager
@@ -136,6 +140,9 @@ class Estimator:
             summary_dir = os.path.join(model_dir, "tensorboard")
         self._summary = None
         self._pending_log = None  # (metrics, step) written one round late
+        self._summary_dir = summary_dir
+        self._profile_steps = profile_steps
+        self._profiling = False
         if summary_dir:
             import jax
 
@@ -191,6 +198,7 @@ class Estimator:
                     if b is _END or self._host_step >= max_steps or \
                             (guard is not None and guard.preempted):
                         break
+                    self._maybe_profile(start=True)
                     with self._goodput.time("step"):
                         # dispatch step k, then block on step k-1's output:
                         # device time lands in "step" (dispatch alone is
@@ -201,6 +209,7 @@ class Estimator:
                             jax.block_until_ready(prev_metrics)
                         prev_metrics = metrics
                     self._host_step += 1
+                    self._maybe_profile(start=False)
                     made_progress = True
                     if self._host_step % self.save_every_steps == 0:
                         with self._goodput.time("checkpoint"):
@@ -226,6 +235,10 @@ class Estimator:
             jax.block_until_ready(prev_metrics)  # drain the pipeline
             # the drain is the LAST step's device time, not an extra step
             self._goodput.record("step", _time.monotonic() - t0, count=False)
+        if self._profiling:
+            # training ended (or was preempted) inside the profile window
+            jax.profiler.stop_trace()
+            self._profiling = False
         if self._pending_log is not None:
             self._write_scalars("train", *self._pending_log)
             self._pending_log = None
@@ -283,6 +296,25 @@ class Estimator:
             return export_model(export_dir, serve_fn, self.params,
                                 example_inputs, is_chief=is_chief,
                                 **export_kwargs)
+
+    def _maybe_profile(self, start: bool) -> None:
+        """Start/stop the jax profiler at the configured step range."""
+        if self._profile_steps is None or self._summary is None:
+            return
+        import jax
+
+        lo, hi = self._profile_steps
+        if start and not self._profiling and self._host_step == lo:
+            import os
+
+            os.makedirs(self._summary_dir, exist_ok=True)
+            jax.profiler.start_trace(self._summary_dir)
+            self._profiling = True
+            logger.info("estimator: profiling steps %d..%d", lo, hi)
+        elif not start and self._profiling and self._host_step >= hi:
+            jax.block_until_ready(self._state.params)
+            jax.profiler.stop_trace()
+            self._profiling = False
 
     def goodput(self) -> dict:
         """Badput accounting for this estimator's lifetime (SURVEY.md §5's

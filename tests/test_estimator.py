@@ -121,6 +121,31 @@ def test_goodput_accounting(tmp_path):
         assert g["secs"].get(cat, 0) >= 0
 
 
+def test_profile_steps_writes_trace(tmp_path):
+    import glob
+    import os
+
+    import jax.numpy as jnp
+    import optax
+
+    x, y = _linreg_problem()
+
+    def init_fn():
+        return {"w": jnp.zeros((4, 1))}
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+    model_dir = str(tmp_path / "m")
+    with Estimator(init_fn, loss_fn, optax.sgd(0.1), model_dir,
+                   profile_steps=(2, 4)) as est:
+        est.train(_batches(x, y), max_steps=6)
+        assert not est._profiling
+    traces = glob.glob(os.path.join(model_dir, "tensorboard", "plugins",
+                                    "profile", "*"))
+    assert traces, "no xprof trace directory written"
+
+
 def test_throttle_steps_must_be_positive():
     with pytest.raises(ValueError, match="throttle_steps"):
         EvalSpec(input_fn=lambda: iter(()), throttle_steps=0)

@@ -96,7 +96,8 @@ class MeshStrategy:
         return NamedSharding(self.mesh, sh.batch_pspec())
 
     # -- step --------------------------------------------------------------
-    def build_train_step(self, loss_fn, tx=None, donate: bool = True):
+    def build_train_step(self, loss_fn, tx=None, donate: bool = True,
+                         accum_steps: int = 1):
         """Compile ``state, batch -> state, metrics``.
 
         ``loss_fn(params, batch) -> scalar`` or ``(scalar, aux)``.  A
@@ -114,6 +115,14 @@ class MeshStrategy:
             def loss_fn(params, batch, rng=None):
                 logits = model.apply({"params": params}, batch["x"],
                                      train=True, rngs={"dropout": rng})
+
+        ``accum_steps > 1`` enables gradient accumulation: the batch's
+        leading dim splits into that many microbatches, a ``lax.scan``
+        averages their gradients (one set of gradient buffers, activations
+        sized by the microbatch), and ONE optimizer update applies — the
+        standard way to train an effective batch larger than activations
+        allow.  Identical numerics to the single big batch for
+        mean-reduced losses; each microbatch gets its own derived ``rng``.
 
         Gradient averaging across data shards is *not* written here — the
         batch is sharded over dp/fsdp and the loss is a mean over the global
@@ -145,22 +154,73 @@ class MeshStrategy:
         takes_rng = "rng" in sig_params
         base_rng = self._base_rng
 
-        def step(state: TrainState, batch):
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        mesh = self.mesh
+
+        def one_grad(params, extras, batch, rng):
             grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
-            args = (state.params, batch, state.extras) if takes_extras \
-                else (state.params, batch)
-            kwargs = {}
-            if takes_rng:
-                kwargs["rng"] = jax.random.fold_in(base_rng, state.step)
+            args = (params, batch, extras) if takes_extras else (params, batch)
+            kwargs = {"rng": rng} if takes_rng else {}
             if has_aux:
                 (loss, aux), grads = grad_fn(*args, **kwargs)
             else:
                 loss, grads = grad_fn(*args, **kwargs)
                 aux = {}
+            return loss, aux, grads
+
+        def step(state: TrainState, batch):
             import optax
 
-            extras = aux.pop("extras", state.extras) if isinstance(aux, dict) \
-                else state.extras
+            step_rng = jax.random.fold_in(base_rng, state.step) \
+                if takes_rng else None
+            if accum_steps == 1:
+                loss, aux, grads = one_grad(state.params, state.extras,
+                                            batch, step_rng)
+                extras = aux.pop("extras", state.extras) \
+                    if isinstance(aux, dict) else state.extras
+            else:
+                # [B, ...] -> [accum, B/accum, ...]; the microbatch dim
+                # stays sharded over the data axes
+                def split(x):
+                    if x.shape[0] % accum_steps:
+                        raise ValueError(
+                            f"batch size {x.shape[0]} not divisible by "
+                            f"accum_steps={accum_steps}")
+                    y = x.reshape((accum_steps, -1) + x.shape[1:])
+                    return jax.lax.with_sharding_constraint(
+                        y, NamedSharding(mesh, sh.batch_pspec(extra_leading=1)))
+
+                micro = jax.tree.map(split, batch)
+
+                def body(carry, inputs):
+                    extras = carry["extras"]
+                    mb, i = inputs
+                    rng = jax.random.fold_in(step_rng, i) \
+                        if takes_rng else None
+                    loss, aux, grads = one_grad(state.params, extras, mb, rng)
+                    extras = aux.pop("extras", extras) \
+                        if isinstance(aux, dict) else extras
+                    carry = {
+                        "grads": jax.tree.map(jnp.add, carry["grads"], grads),
+                        "loss": carry["loss"] + loss,
+                        "extras": extras,
+                    }
+                    return carry, aux
+
+                zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+                carry0 = {"grads": zero_grads, "loss": jnp.zeros(()),
+                          "extras": state.extras}
+                carry, aux_stack = jax.lax.scan(
+                    body, carry0, (micro, jnp.arange(accum_steps)))
+                grads = jax.tree.map(lambda g: g / accum_steps, carry["grads"])
+                loss = carry["loss"] / accum_steps
+                # extras threaded through the carry; body already stripped
+                # "extras" from the per-microbatch aux, so the stacked aux
+                # is pure metrics — report the last microbatch's
+                extras = carry["extras"]
+                aux = jax.tree.map(lambda a: a[-1], aux_stack)
+
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             new_state = TrainState(params=params, opt_state=opt_state,

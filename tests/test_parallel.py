@@ -258,3 +258,71 @@ def test_build_train_step_rng_plumbing():
     assert float(m0["noise"]) == seen[0]
     state2, m1 = step2(state2, batch)
     assert float(m1["noise"]) == seen[1]
+
+
+def test_gradient_accumulation_matches_big_batch():
+    """accum_steps=4 over a 32-batch == one step on the full 32 batch
+    (mean-reduced loss -> identical SGD update), and extras thread through
+    the microbatch scan."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu.parallel.strategy import DataParallelStrategy
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = rng.normal(size=(32, 1)).astype(np.float32)
+    tx = optax.sgd(0.1)
+
+    def init():
+        return {"w": jnp.zeros((4, 1))}
+
+    def loss_fn(params, batch, extras):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2), \
+            {"extras": {"count": extras["count"] + 1}}
+    loss_fn.has_aux = True
+
+    def run(accum):
+        s = DataParallelStrategy()
+        state = s.init_state(init, tx)
+        state.extras["count"] = jnp.asarray(0)
+        step = s.build_train_step(loss_fn, accum_steps=accum)
+        batch = s.shard_batch({"x": x, "y": y})
+        state, metrics = step(state, batch)
+        return state, metrics
+
+    s1, m1 = run(1)
+    s4, m4 = run(4)
+    np.testing.assert_allclose(float(m1["loss"]), float(m4["loss"]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(s1.params["w"]),
+                               np.asarray(s4.params["w"]), rtol=1e-5, atol=1e-7)
+    assert int(s4.extras["count"]) == 4, "extras must thread per microbatch"
+    assert int(s1.extras["count"]) == 1
+
+    # per-microbatch rng: the i-th microbatch's key must be
+    # fold_in(fold_in(base, step), i) — not the bare step key
+    def loss_rng(params, batch, rng=None):
+        return params["w"].sum() * 0.0 + jnp.mean(batch["x"]) * 0.0 \
+            + jax.random.normal(rng, ()), {"noise": jax.random.normal(rng, ())}
+    loss_rng.has_aux = True
+
+    s = DataParallelStrategy()
+    state = s.init_state(init, tx)
+    step = s.build_train_step(loss_rng, accum_steps=2)
+    # metrics carry the LAST microbatch's aux
+    state, ma = step(state, s.shard_batch({"x": x, "y": y}))
+    step_key = jax.random.fold_in(s._base_rng, 0)
+    want = float(jax.random.normal(jax.random.fold_in(step_key, 1), ()))
+    buggy = float(jax.random.normal(step_key, ()))
+    assert float(ma["noise"]) == want, "microbatch key must fold in its index"
+    assert float(ma["noise"]) != buggy
+
+    with pytest.raises(ValueError, match="accum_steps"):
+        s.build_train_step(loss_rng, accum_steps=0)
+
+    # indivisible batch fails with a CLEAR error at trace time
+    step3 = s.build_train_step(loss_rng, accum_steps=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        step3(s.init_state(init, tx), s.shard_batch({"x": x, "y": y}))

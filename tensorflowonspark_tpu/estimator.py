@@ -322,6 +322,25 @@ class Estimator:
         data waits, productive step time, checkpoint stalls, and idle."""
         return self._goodput.summary()
 
+    def predict(self, input_fn, predict_fn=None):
+        """Yield per-batch predictions (tf.estimator's ``predict``).
+
+        ``predict_fn(params, batch) -> predictions`` is the forward
+        function (default: ``eval_metrics_fn`` would be wrong — metrics
+        aren't predictions — so a missing ``predict_fn`` raises).  Batches
+        stream through the same sharded device path as training; outputs
+        come back as host numpy, one yield per input batch.
+        """
+        import jax
+
+        if predict_fn is None:
+            raise ValueError("predict needs predict_fn(params, batch)")
+        fn = jax.jit(predict_fn)
+        sharding = self.strategy.batch_sharding()
+        for batch in input_fn():
+            out = fn(self._state.params, jax.device_put(batch, sharding))
+            yield jax.device_get(out)
+
     def _write_scalars(self, prefix: str, metrics: dict,
                        step: int | None = None) -> None:
         scalars = {}

@@ -15,6 +15,30 @@ import logging
 logger = logging.getLogger(__name__)
 
 
+def enable_compilation_cache(cache_dir: str | None = None,
+                             min_compile_secs: float = 1.0) -> str:
+    """Turn on XLA's persistent compilation cache.
+
+    First TPU compiles are tens of seconds to minutes; the persistent
+    cache makes every later process (restart, relaunch after preemption,
+    the benchmark's retry attempts) reuse them from disk.  Returns the
+    cache directory.  Safe to call repeatedly; failures (read-only fs,
+    frozen config) are non-fatal by design.
+    """
+    cache_dir = cache_dir or os.environ.get(
+        "TFOS_COMPILATION_CACHE", "/tmp/tfos_jax_cache")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+    except Exception:  # pragma: no cover - cache is an optimisation only
+        logger.warning("compilation cache unavailable", exc_info=True)
+    return cache_dir
+
+
 def apply_jax_platforms_env() -> None:
     """Re-apply ``JAX_PLATFORMS`` when a sitecustomize imported jax at
     interpreter startup (e.g. to register a PJRT plugin), freezing the

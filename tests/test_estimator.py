@@ -1,5 +1,6 @@
 """Estimator surface: train_and_evaluate, max_steps semantics, resume."""
 
+import os
 import numpy as np
 import optax
 import pytest
@@ -121,6 +122,23 @@ def test_goodput_accounting(tmp_path):
         assert g["secs"].get(cat, 0) >= 0
 
 
+def test_predict_streams_batches(tmp_path):
+    x, y = _linreg_problem()
+    with _make_estimator(tmp_path / "m") as est:
+        est.train(_batches(x, y), max_steps=30)
+        w = np.asarray(est.params["w"])
+        preds = list(est.predict(_batches(x, y),
+                                 lambda p, b: b["x"] @ p["w"]))
+    assert len(preds) == 4  # 64 samples / bs 16
+    np.testing.assert_allclose(np.concatenate(preds), x @ w, rtol=1e-5)
+
+    with _make_estimator(tmp_path / "m") as est2:
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="predict_fn"):
+            next(est2.predict(_batches(x, y)))
+
+
 def test_profile_steps_writes_trace(tmp_path):
     import glob
     import os
@@ -157,3 +175,17 @@ def test_empty_input_fn_raises(tmp_path):
             est.train(lambda: iter(()), max_steps=5)
         with pytest.raises(ValueError, match="no batches"):
             est.evaluate(lambda: iter(()), steps=2)
+
+
+def test_enable_compilation_cache(tmp_path):
+    import jax
+
+    from tensorflowonspark_tpu.util import enable_compilation_cache
+
+    old = jax.config.jax_compilation_cache_dir
+    try:
+        d = enable_compilation_cache(str(tmp_path / "cache"))
+        assert os.path.isdir(d)
+        assert jax.config.jax_compilation_cache_dir == d
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)

@@ -99,6 +99,21 @@ def test_mnist_estimator(tmp_path):
     assert "final eval step=8" in out
 
 
+def test_ring_lm_long_context(tmp_path):
+    """Both sequence-parallel constructions; the loss trajectories must
+    agree (ring and ulysses compute the same attention)."""
+    outs = {}
+    for impl in ("ring", "ulysses"):
+        out = _run("long_context/ring_lm.py", "--sp", "2", "--seq_len", "64",
+                   "--max_steps", "10", "--sp_impl", impl,
+                   "--model_dir", str(tmp_path / impl), timeout=600)
+        assert "ring_lm: done" in out
+        import re
+        m = re.search(r"loss (\d+\.\d+) -> (\d+\.\d+)", out)
+        outs[impl] = (float(m.group(1)), float(m.group(2)))
+    assert abs(outs["ring"][1] - outs["ulysses"][1]) < 1e-3, outs
+
+
 def test_gpt_tiny(tmp_path):
     out = _run("gpt/gpt_tiny.py", "--max_steps", "40",
                "--model_dir", str(tmp_path / "gpt"), timeout=600)

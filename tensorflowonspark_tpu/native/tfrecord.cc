@@ -131,3 +131,275 @@ int64_t tfr_next(const uint8_t* buf, size_t buflen, size_t off,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// tf.train.Example decoder (the loadTFRecords/fromTFExample hot path —
+// reference: the tensorflow-hadoop JAR's JVM-side parsing, SURVEY.md §2b).
+// Python's per-varint loop parses ~2.6k records/s; this parser is the
+// native replacement behind example_proto.decode_example.
+//
+// Wire shapes handled (mirrors example_proto.py exactly):
+//   Example{ features=1: Features{ feature=1(map entry){ key=1, value=2:
+//     Feature{ bytes_list=1 | float_list=2 | int64_list=3 } } } }
+//   *List.value = field 1, packed OR unpacked.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// varint; returns new pos or -1 on truncation/overlong
+inline int64_t read_varint(const uint8_t* b, int64_t pos, int64_t end,
+                           uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (pos < end && shift <= 63) {
+    uint8_t byte = b[pos++];
+    v |= (uint64_t)(byte & 0x7F) << shift;
+    if (!(byte & 0x80)) { *out = v; return pos; }
+    shift += 7;
+  }
+  return -1;
+}
+
+inline int64_t skip_field(const uint8_t* b, int64_t pos, int64_t end,
+                          uint32_t wire) {
+  uint64_t tmp;
+  switch (wire) {
+    case 0: return read_varint(b, pos, end, &tmp);
+    case 1: return pos + 8 <= end ? pos + 8 : -1;
+    case 2: {
+      int64_t p = read_varint(b, pos, end, &tmp);
+      if (p < 0 || tmp > (uint64_t)(end - p)) return -1;
+      return p + (int64_t)tmp;
+    }
+    case 5: return pos + 4 <= end ? pos + 4 : -1;
+    default: return -1;
+  }
+}
+
+// count elements in a *List message body [pos, end): field 1 packed/unpacked
+inline int64_t count_list(const uint8_t* b, int64_t pos, int64_t end,
+                          int kind /*0 bytes,1 float,2 int64*/) {
+  int64_t count = 0;
+  uint64_t tmp;
+  while (pos < end) {
+    uint64_t tag;
+    pos = read_varint(b, pos, end, &tag);
+    if (pos < 0) return -1;
+    uint32_t field = tag >> 3, wire = tag & 7;
+    if (field == 1 && wire == 2) {           // length-delimited
+      uint64_t n;
+      pos = read_varint(b, pos, end, &n);
+      if (pos < 0 || n > (uint64_t)(end - pos)) return -1;
+      if (kind == 0) {
+        count += 1;                          // one bytes value
+      } else if (kind == 1) {
+        count += (int64_t)(n / 4);           // packed floats
+      } else {                               // packed varints
+        int64_t p = pos, pend = pos + (int64_t)n;
+        while (p < pend) {
+          p = read_varint(b, p, pend, &tmp);
+          if (p < 0) return -1;
+          ++count;
+        }
+      }
+      pos += (int64_t)n;
+    } else if (field == 1 && wire == 5 && kind == 1) {
+      count += 1; pos += 4;                  // unpacked float
+      if (pos > end) return -1;
+    } else if (field == 1 && wire == 0 && kind == 2) {
+      pos = read_varint(b, pos, end, &tmp);  // unpacked int64
+      if (pos < 0) return -1;
+      ++count;
+    } else {
+      pos = skip_field(b, pos, end, wire);
+      if (pos < 0) return -1;
+    }
+  }
+  return count;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scan an Example. meta rows of 6 int64s per feature:
+//   {name_off, name_len, kind(0/1/2), count, payload_off, payload_len}
+// offsets into buf; payload is the *List message body.  Returns the number
+// of features (even if > max_feats — caller re-calls with a bigger meta),
+// or -1 on malformed input.
+int64_t exp_scan(const uint8_t* buf, size_t buflen, int64_t* meta,
+                 int64_t max_feats) {
+  int64_t n_feats = 0;
+  int64_t pos = 0, end = (int64_t)buflen;
+  while (pos < end) {
+    uint64_t tag;
+    pos = read_varint(buf, pos, end, &tag);
+    if (pos < 0) return -1;
+    if ((tag >> 3) == 1 && (tag & 7) == 2) {          // Example.features
+      uint64_t flen;
+      pos = read_varint(buf, pos, end, &flen);
+      if (pos < 0 || flen > (uint64_t)(end - pos)) return -1;
+      int64_t fpos = pos, fend = pos + (int64_t)flen;
+      pos = fend;
+      while (fpos < fend) {
+        uint64_t ftag;
+        fpos = read_varint(buf, fpos, fend, &ftag);
+        if (fpos < 0) return -1;
+        if ((ftag >> 3) != 1 || (ftag & 7) != 2) {
+          fpos = skip_field(buf, fpos, fend, ftag & 7);
+          if (fpos < 0) return -1;
+          continue;
+        }
+        uint64_t elen;                                 // map entry
+        fpos = read_varint(buf, fpos, fend, &elen);
+        if (fpos < 0 || elen > (uint64_t)(fend - fpos)) return -1;
+        int64_t epos = fpos, eend = fpos + (int64_t)elen;
+        fpos = eend;
+        int64_t name_off = -1, name_len = 0;
+        int64_t kind = 0, count = 0, pay_off = 0, pay_len = 0;
+        while (epos < eend) {
+          uint64_t etag;
+          epos = read_varint(buf, epos, eend, &etag);
+          if (epos < 0) return -1;
+          uint32_t efield = etag >> 3, ewire = etag & 7;
+          if (ewire != 2) {
+            epos = skip_field(buf, epos, eend, ewire);
+            if (epos < 0) return -1;
+            continue;
+          }
+          uint64_t vlen;
+          epos = read_varint(buf, epos, eend, &vlen);
+          if (epos < 0 || vlen > (uint64_t)(eend - epos)) return -1;
+          if (efield == 1) {                           // key
+            name_off = epos; name_len = (int64_t)vlen;
+          } else if (efield == 2) {    // Feature (proto: LAST value wins,
+                                       // matching the Python oracle)
+            int64_t vpos = epos, vend = epos + (int64_t)vlen;
+            while (vpos < vend) {
+              uint64_t vtag;
+              vpos = read_varint(buf, vpos, vend, &vtag);
+              if (vpos < 0) return -1;
+              uint32_t vfield = vtag >> 3, vwire = vtag & 7;
+              if (vwire != 2 || vfield < 1 || vfield > 3) {
+                vpos = skip_field(buf, vpos, vend, vwire);
+                if (vpos < 0) return -1;
+                continue;
+              }
+              uint64_t llen;                           // the *List message
+              vpos = read_varint(buf, vpos, vend, &llen);
+              if (vpos < 0 || llen > (uint64_t)(vend - vpos)) return -1;
+              kind = (int64_t)vfield - 1;              // 0/1/2
+              pay_off = vpos; pay_len = (int64_t)llen;
+              count = count_list(buf, vpos, vpos + (int64_t)llen, (int)kind);
+              if (count < 0) return -1;
+              break;               // first list within THIS Feature wins
+            }
+          }
+          epos += (int64_t)vlen;
+        }
+        if (name_off >= 0) {
+          if (n_feats < max_feats) {
+            int64_t* row = meta + n_feats * 6;
+            row[0] = name_off; row[1] = name_len; row[2] = kind;
+            row[3] = count; row[4] = pay_off; row[5] = pay_len;
+          }
+          ++n_feats;
+        }
+      }
+    } else {
+      pos = skip_field(buf, pos, end, tag & 7);
+      if (pos < 0) return -1;
+    }
+  }
+  return n_feats;
+}
+
+// Decode an int64 *List body into out[count].  Returns elements written.
+int64_t exp_read_int64(const uint8_t* b, size_t len, int64_t* out,
+                       int64_t count) {
+  int64_t pos = 0, end = (int64_t)len, w = 0;
+  uint64_t tmp;
+  while (pos < end && w < count) {
+    uint64_t tag;
+    pos = read_varint(b, pos, end, &tag);
+    if (pos < 0) return -1;
+    uint32_t field = tag >> 3, wire = tag & 7;
+    if (field == 1 && wire == 2) {
+      uint64_t n;
+      pos = read_varint(b, pos, end, &n);
+      if (pos < 0 || n > (uint64_t)(end - pos)) return -1;
+      int64_t p = pos, pend = pos + (int64_t)n;
+      while (p < pend && w < count) {
+        p = read_varint(b, p, pend, &tmp);
+        if (p < 0) return -1;
+        out[w++] = (int64_t)tmp;
+      }
+      pos += (int64_t)n;
+    } else if (field == 1 && wire == 0) {
+      pos = read_varint(b, pos, end, &tmp);
+      if (pos < 0) return -1;
+      out[w++] = (int64_t)tmp;
+    } else {
+      pos = skip_field(b, pos, end, wire);
+      if (pos < 0) return -1;
+    }
+  }
+  return w;
+}
+
+// Decode a float *List body into out[count].
+int64_t exp_read_float(const uint8_t* b, size_t len, float* out,
+                       int64_t count) {
+  int64_t pos = 0, end = (int64_t)len, w = 0;
+  while (pos < end && w < count) {
+    uint64_t tag;
+    pos = read_varint(b, pos, end, &tag);
+    if (pos < 0) return -1;
+    uint32_t field = tag >> 3, wire = tag & 7;
+    if (field == 1 && wire == 2) {
+      uint64_t n;
+      pos = read_varint(b, pos, end, &n);
+      if (pos < 0 || n > (uint64_t)(end - pos)) return -1;
+      int64_t m = (int64_t)(n / 4);
+      if (m > count - w) m = count - w;
+      std::memcpy(out + w, b + pos, (size_t)m * 4);
+      w += m;
+      pos += (int64_t)n;
+    } else if (field == 1 && wire == 5) {
+      if (pos + 4 > end) return -1;
+      std::memcpy(out + w, b + pos, 4);
+      ++w; pos += 4;
+    } else {
+      pos = skip_field(b, pos, end, wire);
+      if (pos < 0) return -1;
+    }
+  }
+  return w;
+}
+
+// Offsets of bytes values within a bytes *List body: offs[i*2]={off,len}
+// relative to the payload pointer.  Returns values written.
+int64_t exp_read_bytes(const uint8_t* b, size_t len, int64_t* offs,
+                       int64_t count) {
+  int64_t pos = 0, end = (int64_t)len, w = 0;
+  while (pos < end && w < count) {
+    uint64_t tag;
+    pos = read_varint(b, pos, end, &tag);
+    if (pos < 0) return -1;
+    uint32_t field = tag >> 3, wire = tag & 7;
+    if (field == 1 && wire == 2) {
+      uint64_t n;
+      pos = read_varint(b, pos, end, &n);
+      if (pos < 0 || n > (uint64_t)(end - pos)) return -1;
+      offs[w * 2] = pos; offs[w * 2 + 1] = (int64_t)n;
+      ++w;
+      pos += (int64_t)n;
+    } else {
+      pos = skip_field(b, pos, end, wire);
+      if (pos < 0) return -1;
+    }
+  }
+  return w;
+}
+
+}  // extern "C"

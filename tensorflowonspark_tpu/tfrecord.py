@@ -138,6 +138,20 @@ def _native():
         lib.tfr_next.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
                                  ctypes.POINTER(ctypes.c_size_t),
                                  ctypes.POINTER(ctypes.c_size_t), ctypes.c_int]
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.exp_scan.restype = ctypes.c_int64
+        lib.exp_scan.argtypes = [ctypes.c_char_p, ctypes.c_size_t, i64p,
+                                 ctypes.c_int64]
+        lib.exp_read_int64.restype = ctypes.c_int64
+        lib.exp_read_int64.argtypes = [ctypes.c_char_p, ctypes.c_size_t, i64p,
+                                       ctypes.c_int64]
+        lib.exp_read_float.restype = ctypes.c_int64
+        lib.exp_read_float.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                       ctypes.POINTER(ctypes.c_float),
+                                       ctypes.c_int64]
+        lib.exp_read_bytes.restype = ctypes.c_int64
+        lib.exp_read_bytes.argtypes = [ctypes.c_char_p, ctypes.c_size_t, i64p,
+                                       ctypes.c_int64]
     except (OSError, AttributeError) as e:  # stale/corrupt/wrong-arch cache
         logger.warning("native TFRecord codec failed to load (%s); "
                        "using pure-Python CRC32C", e)

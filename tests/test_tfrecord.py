@@ -328,3 +328,96 @@ def test_filesystem_join_and_scheme_detection():
     assert fsutil.join("gs://b/dir", "part-0") == "gs://b/dir/part-0"
     assert fsutil.join("gs://b/dir/", "sub", "f") == "gs://b/dir/sub/f"
     assert fsutil.join("/local/dir", "f").endswith("/local/dir/f")
+
+
+def test_native_example_decoder_matches_python_oracle():
+    """decode_example (native path when built) must be byte-identical to
+    decode_example_py across feature shapes, including packed/unpacked
+    lists, negatives, empties, and unicode names."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.example_proto import (decode_example,
+                                                     decode_example_py,
+                                                     encode_example)
+
+    rng = np.random.default_rng(0)
+    for trial in range(20):
+        feats = {}
+        for j in range(rng.integers(0, 6)):
+            kind = rng.integers(0, 3)
+            name = f"f{trial}_{j}_é"
+            if kind == 0:
+                feats[name] = [bytes(rng.integers(0, 255, rng.integers(0, 9),
+                                                  ).astype(np.uint8))
+                               for _ in range(rng.integers(0, 4))]
+            elif kind == 1:
+                feats[name] = rng.normal(size=rng.integers(0, 50)) \
+                    .astype(np.float32)
+            else:
+                feats[name] = (rng.integers(-2**40, 2**40,
+                                            rng.integers(0, 50))
+                               .astype(np.int64))
+        ex = encode_example(feats)
+        assert decode_example(ex) == decode_example_py(ex)
+
+    # malformed input raises on both paths
+    import pytest
+
+    with pytest.raises(ValueError):
+        decode_example_py(b"\x0a\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff")
+    with pytest.raises(ValueError):
+        decode_example(b"\x0a\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff")
+
+
+def test_native_decoder_hostile_inputs_never_crash():
+    """Adversarial wire bytes: huge length varints (would wrap a signed
+    bound check into out-of-bounds reads), truncation, junk — every case
+    must raise or return, never segfault, and agree with the oracle."""
+    from tensorflowonspark_tpu.example_proto import (decode_example,
+                                                     decode_example_py)
+
+    hostile = [
+        b"\x0a" + b"\x80" * 9 + b"\x01",          # flen = 2^63 (INT64_MIN)
+        b"\x0a" + b"\xff" * 9 + b"\x01",          # flen near UINT64_MAX
+        b"\x0a\x05\x0a\xff\xff\xff\x7f",          # inner len >> remaining
+        b"\x0a\x03\x0a\x01",                      # truncated entry
+        bytes(range(256)) * 3,                    # junk
+        b"",
+    ]
+    for buf in hostile:
+        try:
+            a = decode_example(buf)
+            ok_native = True
+        except ValueError:
+            ok_native = False
+        try:
+            b = decode_example_py(buf)
+            ok_py = True
+        except ValueError:
+            ok_py = False
+        if ok_native and ok_py:
+            assert a == b, buf
+
+
+def test_native_decoder_accepts_bytearray_and_last_value_wins():
+    from tensorflowonspark_tpu.example_proto import (_write_len_field,
+                                                     decode_example,
+                                                     decode_example_py,
+                                                     encode_example,
+                                                     encode_float_list,
+                                                     encode_int64_list)
+
+    ba = bytearray(encode_example({"a": [1, 2]}))
+    assert decode_example(ba) == decode_example_py(bytes(ba))
+
+    # two Feature values in one map entry: proto says LAST wins
+    entry = bytearray()
+    _write_len_field(entry, 1, b"k")
+    _write_len_field(entry, 2, encode_int64_list([1]))
+    _write_len_field(entry, 2, encode_float_list([2.0]))
+    fmap = bytearray()
+    _write_len_field(fmap, 1, bytes(entry))
+    ex = bytearray()
+    _write_len_field(ex, 1, bytes(fmap))
+    assert decode_example(bytes(ex)) == decode_example_py(bytes(ex)) \
+        == {"k": ("float", [2.0])}

@@ -1,0 +1,124 @@
+"""Data-plane A/B: reference-style per-sample manager queue vs this
+framework's chunked socket queue.
+
+SURVEY.md §3.2 identifies the reference's InputMode.SPARK hot path — every
+sample pickled through a ``multiprocessing.managers.BaseManager`` proxy —
+as its documented bottleneck, and the rebuild's chunk-granularity socket
+protocol as the deliberate divergence.  This benchmark measures both on
+identical data so the divergence is a number, not a claim.
+
+Run:  python scripts/bench_dataplane.py [--samples 20000]
+Prints one JSON line per transport.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+
+def bench_reference_style(samples, sample):
+    """Per-sample puts through a BaseManager queue proxy (the reference's
+    TFManager pattern: TFManager.py::start + queue proxies)."""
+    from multiprocessing.managers import BaseManager
+    from queue import Queue
+
+    q = Queue(maxsize=1024)
+
+    class Mgr(BaseManager):
+        pass
+
+    Mgr.register("get_queue", callable=lambda: q)
+    mgr = Mgr(address=("127.0.0.1", 0), authkey=b"bench")
+    mgr.start()
+    try:
+        cli = Mgr(address=mgr.address, authkey=b"bench")
+        cli.connect()
+        proxy_in = cli.get_queue()
+        cli2 = Mgr(address=mgr.address, authkey=b"bench")
+        cli2.connect()
+        proxy_out = cli2.get_queue()
+
+        got = [0]
+
+        def consumer():
+            while got[0] < samples:
+                proxy_out.get()
+                got[0] += 1
+
+        t = threading.Thread(target=consumer)
+        t0 = time.perf_counter()
+        t.start()
+        for _ in range(samples):
+            proxy_in.put(sample)          # one pickled proxy call PER SAMPLE
+        t.join()
+        dt = time.perf_counter() - t0
+    finally:
+        mgr.shutdown()
+    return dt
+
+
+def bench_chunked(samples, sample, chunk_size=256):
+    """Chunked puts through the framework's socket queue (queues.py)."""
+    from tensorflowonspark_tpu.queues import QueueClient, QueueServer
+
+    srv = QueueServer(authkey=b"k" * 16, qnames=("input",), mode="local")
+    addr = srv.start()
+    try:
+        put_cli = QueueClient(addr, authkey=b"k" * 16)
+        get_cli = QueueClient(addr, authkey=b"k" * 16)
+        n_chunks = samples // chunk_size
+        # DISTINCT arrays per slot: pickle memoizes repeated identical
+        # objects, which would flatter the chunked number dishonestly
+        chunk = [sample + np.float32(i) for i in range(chunk_size)]
+        got = [0]
+
+        def consumer():
+            while got[0] < n_chunks:
+                get_cli.get("input", timeout=60)
+                got[0] += 1
+
+        t = threading.Thread(target=consumer)
+        t0 = time.perf_counter()
+        t.start()
+        for _ in range(n_chunks):
+            put_cli.put("input", chunk, timeout=60)
+        t.join()
+        dt = time.perf_counter() - t0
+    finally:
+        srv.stop()
+    return dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--samples", type=int, default=20000)
+    p.add_argument("--sample_bytes", type=int, default=3136,
+                   help="per-sample payload (default: one 28x28 float32)")
+    args = p.parse_args()
+
+    sample = np.random.rand(args.sample_bytes // 4).astype(np.float32)
+    mb = args.samples * sample.nbytes / 1e6
+
+    dt_ref = bench_reference_style(args.samples, sample)
+    print(json.dumps({
+        "transport": "per-sample BaseManager proxy (reference pattern)",
+        "samples_per_sec": round(args.samples / dt_ref, 1),
+        "MB_per_sec": round(mb / dt_ref, 1)}))
+
+    dt_chunk = bench_chunked(args.samples, sample)
+    print(json.dumps({
+        "transport": "chunked socket queue (this framework)",
+        "samples_per_sec": round(args.samples / dt_chunk, 1),
+        "MB_per_sec": round(mb / dt_chunk, 1),
+        "speedup_vs_reference_pattern": round(dt_ref / dt_chunk, 1)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,149 @@
+"""ImageNet Inception-v3 training — the original TensorFlowOnSpark demo job.
+
+Reference: ``examples/imagenet/inception`` (SURVEY.md §2d "1.x-era" row) —
+Inception trained under the gRPC parameter-server strategy with
+``replica_device_setter`` variable placement.  Here the PS machinery is gone
+(SURVEY §2c: PS is an anti-pattern on TPU): the same job is sync
+data-parallel over the mesh via :class:`MultiWorkerMirroredStrategy`, with
+the reference's training recipe kept — auxiliary classifier head at loss
+weight 0.3, RMSProp, exponential LR decay.
+
+Run (CI smoke uses --image_size 75 so the synthetic pass stays cheap):
+
+    python examples/imagenet/inception_imagenet.py --cpu --cluster_size 1 \
+        --steps 4 --batch_size 4 --image_size 75 --model_dir /tmp/incep
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def _shard(args, ctx):
+    """Synthetic ImageNet-shaped shard; swap for TFRecords via --data_dir."""
+    import numpy as np
+
+    s = args.image_size
+    if args.data_dir:
+        from tensorflowonspark_tpu.data import Dataset
+
+        ds = Dataset.from_examples(args.data_dir).shard(
+            ctx.num_workers, ctx.executor_id)
+        rows = ds.as_numpy()
+        x = np.stack([np.asarray(r["image"], np.float32).reshape(s, s, 3)
+                      for r in rows])
+        y = np.asarray([int(r["label"]) for r in rows])
+        return x, y
+    rng = np.random.default_rng(7 + ctx.executor_id)
+    n = args.num_samples // ctx.num_workers
+    return (rng.random((n, s, s, 3), np.float32),
+            rng.integers(0, args.num_classes, size=n))
+
+
+def main_fun(args, ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.checkpoint import CheckpointManager
+    from tensorflowonspark_tpu.models import InceptionV3
+    from tensorflowonspark_tpu.parallel import sharding as _sh
+    from tensorflowonspark_tpu.parallel.strategy import (
+        MultiWorkerMirroredStrategy)
+
+    if jax.default_backend() == "tpu" and ctx.num_workers > 1:
+        ctx.initialize_distributed()
+
+    images, labels = _shard(args, ctx)
+    # aux head needs a 17x17 grid; tiny CI images (<128px) train without it
+    use_aux = args.image_size >= 128
+    model = InceptionV3(num_classes=args.num_classes, aux_logits=use_aux,
+                        dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
+                        else jnp.float32)
+    # reference recipe: RMSProp, exponential decay
+    sched = optax.exponential_decay(args.lr, max(args.steps, 1), 0.94)
+    tx = optax.rmsprop(sched, decay=0.9, eps=1.0, momentum=0.9)
+    strategy = MultiWorkerMirroredStrategy()
+
+    sample = jnp.zeros((args.batch_size, args.image_size, args.image_size, 3),
+                       jnp.float32)
+    variables = model.init({"params": jax.random.key(0),
+                            "dropout": jax.random.key(1)}, sample, train=True)
+
+    state = strategy.init_state(lambda: variables["params"], tx)
+    state.extras["batch_stats"] = jax.device_put(
+        variables["batch_stats"], _sh.replicated(strategy.mesh))
+
+    def loss_fn(params, batch, extras, rng=None):
+        x, y = batch
+        out, updates = model.apply(
+            {"params": params, "batch_stats": extras["batch_stats"]}, x,
+            train=True, mutable=["batch_stats"], rngs={"dropout": rng})
+        if use_aux:
+            logits, aux = out
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            loss += 0.3 * optax.softmax_cross_entropy_with_integer_labels(
+                aux, y).mean()
+        else:
+            logits = out
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+        return loss, {"extras": {"batch_stats": updates["batch_stats"]},
+                      "acc": (logits.argmax(-1) == y).mean()}
+    loss_fn.has_aux = True
+
+    step = strategy.build_train_step(loss_fn)
+
+    # restore on EVERY worker (divergent-replica hazard otherwise); save
+    # stays chief-gated
+    ckpt = CheckpointManager(args.model_dir) if args.model_dir else None
+    start_step = 0
+    if ckpt is not None and ckpt.latest_step() is not None:
+        state = ckpt.restore(target=jax.eval_shape(lambda: state))
+        start_step = int(np.asarray(state.step))
+        print(f"node {ctx.executor_id}: resumed from step {start_step}",
+              flush=True)
+
+    rng = np.random.default_rng(ctx.executor_id)
+    for s in range(start_step, args.steps):
+        idx = rng.integers(0, len(images), size=args.batch_size)
+        state, metrics = step(state, strategy.shard_batch(
+            (images[idx], labels[idx])))
+        if (s + 1) % 10 == 0 or s + 1 == args.steps:
+            print(f"node {ctx.executor_id}: step {s + 1} "
+                  f"loss {float(metrics['loss']):.4f} "
+                  f"acc {float(metrics['acc']):.3f}", flush=True)
+
+    if ckpt is not None:
+        if ctx.is_chief and ckpt.latest_step() != args.steps:
+            ckpt.save(args.steps, state, force=True)
+        ckpt.close()
+
+
+if __name__ == "__main__":
+    from tensorflowonspark_tpu import InputMode, TPUCluster
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=1)
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--lr", type=float, default=0.045)
+    p.add_argument("--image_size", type=int, default=299)
+    p.add_argument("--num_classes", type=int, default=1000)
+    p.add_argument("--num_samples", type=int, default=256)
+    p.add_argument("--data_dir", default="")
+    p.add_argument("--model_dir", default="")
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    worker_env = {"JAX_PLATFORMS": "cpu"} if args.cpu else None
+    cluster = TPUCluster.run(main_fun, args, args.cluster_size,
+                             input_mode=InputMode.TENSORFLOW,
+                             worker_env=worker_env, reservation_timeout=60)
+    cluster.shutdown(timeout=1800)
+    print("inception_imagenet: done")

@@ -5,6 +5,7 @@
 | ``examples/mnist/keras/mnist_*.py``     | :class:`MNISTNet`         |
 | ``examples/resnet`` (CIFAR-10 ResNet)   | :func:`ResNet` variants   |
 | ``examples/imagenet`` / ResNet-50       | :func:`ResNet50`          |
+| ``examples/imagenet/inception`` (1.x)   | :class:`InceptionV3`      |
 | ``examples/segmentation`` (U-Net)       | :class:`UNet`             |
 | BERT-SQuAD pipeline (BASELINE configs)  | :class:`Bert`, heads      |
 | ``examples/wide_deep`` (Criteo)         | :class:`WideDeep`         |
@@ -22,6 +23,7 @@ from tensorflowonspark_tpu.models.unet import UNet  # noqa: F401
 from tensorflowonspark_tpu.models.bert import (Bert, BertConfig,
                                                BertForQuestionAnswering,
                                                BertForSequenceClassification)  # noqa: F401
+from tensorflowonspark_tpu.models.inception import InceptionV3  # noqa: F401
 from tensorflowonspark_tpu.models.wide_deep import WideDeep  # noqa: F401
 from tensorflowonspark_tpu.models.gpt import (GPT, GPTConfig,  # noqa: F401
                                               greedy_generate, init_cache)

@@ -1,0 +1,193 @@
+"""Inception-v3, bf16/MXU-friendly.
+
+Reference workload: the historical ``examples/imagenet/inception`` job
+(SURVEY.md §2d "1.x-era" row) — ImageNet Inception training under the
+parameter-server strategy, the original TensorFlowOnSpark launch demo.
+
+TPU-first choices: NHWC layout, bf16 conv compute with fp32 BatchNorm
+statistics and fp32 logits (same recipe as :mod:`.resnet`), all branch
+concatenations on the trailing (lane) axis so XLA keeps them in-register,
+and the factorized 1×7/7×1 and 1×3/3×1 convolutions expressed directly —
+they lower onto the MXU as narrow matmuls without any im2col blowup.
+
+The auxiliary classifier head (reference trains with it at weight 0.3) is
+behind ``aux_logits=True`` and only materialises in ``train=True`` calls;
+inference graphs never pay for it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    """Conv → BatchNorm → ReLU, the unit every Inception branch is made of."""
+
+    filters: int
+    kernel: Sequence[int] = (3, 3)
+    strides: Sequence[int] = (1, 1)
+    padding: str | Sequence = "SAME"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = nn.Conv(self.filters, tuple(self.kernel), strides=tuple(self.strides),
+                    padding=self.padding, use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    """35×35 mixed block: 1×1 / 5×5 / double-3×3 / pool-proj branches."""
+
+    pool_filters: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(64, (1, 1))(x, train=train)
+        b5 = cbn(48, (1, 1))(x, train=train)
+        b5 = cbn(64, (5, 5))(b5, train=train)
+        b3 = cbn(64, (1, 1))(x, train=train)
+        b3 = cbn(96, (3, 3))(b3, train=train)
+        b3 = cbn(96, (3, 3))(b3, train=train)
+        bp = cbn(self.pool_filters, (1, 1))(_avg_pool_same(x), train=train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class ReductionA(nn.Module):
+    """35×35 → 17×17 grid reduction."""
+
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b3 = cbn(384, (3, 3), strides=(2, 2), padding="VALID")(x, train=train)
+        bd = cbn(64, (1, 1))(x, train=train)
+        bd = cbn(96, (3, 3))(bd, train=train)
+        bd = cbn(96, (3, 3), strides=(2, 2), padding="VALID")(bd, train=train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp.astype(b3.dtype)], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """17×17 mixed block with factorized 1×7 / 7×1 convolutions."""
+
+    c7: int  # bottleneck width of the factorized branches (128/160/192)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        c7 = self.c7
+        b1 = cbn(192, (1, 1))(x, train=train)
+        b7 = cbn(c7, (1, 1))(x, train=train)
+        b7 = cbn(c7, (1, 7))(b7, train=train)
+        b7 = cbn(192, (7, 1))(b7, train=train)
+        bd = cbn(c7, (1, 1))(x, train=train)
+        bd = cbn(c7, (7, 1))(bd, train=train)
+        bd = cbn(c7, (1, 7))(bd, train=train)
+        bd = cbn(c7, (7, 1))(bd, train=train)
+        bd = cbn(192, (1, 7))(bd, train=train)
+        bp = cbn(192, (1, 1))(_avg_pool_same(x), train=train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class ReductionB(nn.Module):
+    """17×17 → 8×8 grid reduction."""
+
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b3 = cbn(192, (1, 1))(x, train=train)
+        b3 = cbn(320, (3, 3), strides=(2, 2), padding="VALID")(b3, train=train)
+        b7 = cbn(192, (1, 1))(x, train=train)
+        b7 = cbn(192, (1, 7))(b7, train=train)
+        b7 = cbn(192, (7, 1))(b7, train=train)
+        b7 = cbn(192, (3, 3), strides=(2, 2), padding="VALID")(b7, train=train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp.astype(b3.dtype)], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """8×8 mixed block with split 1×3 / 3×1 output branches."""
+
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(320, (1, 1))(x, train=train)
+        b3 = cbn(384, (1, 1))(x, train=train)
+        b3 = jnp.concatenate([cbn(384, (1, 3))(b3, train=train),
+                              cbn(384, (3, 1))(b3, train=train)], axis=-1)
+        bd = cbn(448, (1, 1))(x, train=train)
+        bd = cbn(384, (3, 3))(bd, train=train)
+        bd = jnp.concatenate([cbn(384, (1, 3))(bd, train=train),
+                              cbn(384, (3, 1))(bd, train=train)], axis=-1)
+        bp = cbn(192, (1, 1))(_avg_pool_same(x), train=train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Inception-v3 (299×299 canonical; any H,W ≥ 75 works).
+
+    Returns logits, or ``(logits, aux_logits)`` when ``aux_logits=True`` and
+    ``train=True`` (the reference's PS-mode job adds the aux loss at 0.3).
+    """
+
+    num_classes: int = 1000
+    aux_logits: bool = False
+    dropout_rate: float = 0.2
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # stem: 299 → 35×35×192
+        x = cbn(32, (3, 3), strides=(2, 2), padding="VALID")(x, train=train)
+        x = cbn(32, (3, 3), padding="VALID")(x, train=train)
+        x = cbn(64, (3, 3))(x, train=train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = cbn(80, (1, 1), padding="VALID")(x, train=train)
+        x = cbn(192, (3, 3), padding="VALID")(x, train=train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # 3× InceptionA (35×35), pool-proj 32/64/64
+        for pf in (32, 64, 64):
+            x = InceptionA(pool_filters=pf, dtype=self.dtype)(x, train=train)
+        x = ReductionA(dtype=self.dtype)(x, train=train)
+        # 4× InceptionB (17×17), widths 128/160/160/192
+        for c7 in (128, 160, 160, 192):
+            x = InceptionB(c7=c7, dtype=self.dtype)(x, train=train)
+        aux = None
+        if self.aux_logits and train:
+            a = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+            a = cbn(128, (1, 1))(a, train=train)
+            a = cbn(768, tuple(a.shape[1:3]), padding="VALID")(a, train=train)
+            a = jnp.mean(a, axis=(1, 2))
+            aux = nn.Dense(self.num_classes, dtype=jnp.float32,
+                           name="aux_head")(a.astype(jnp.float32))
+        x = ReductionB(dtype=self.dtype)(x, train=train)
+        for _ in range(2):
+            x = InceptionC(dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32))
+        if self.aux_logits and train:
+            return logits, aux
+        return logits

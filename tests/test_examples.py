@@ -137,3 +137,11 @@ def test_bert_squad(tmp_path):
                "--num_heads", "2", "--vocab_size", "128",
                "--export_dir", str(tmp_path / "bert_export"), timeout=600)
     assert "bert_squad: done" in out
+
+
+def test_inception_imagenet(tmp_path):
+    out = _run("imagenet/inception_imagenet.py", "--cluster_size", "1",
+               "--batch_size", "4", "--steps", "3", "--image_size", "75",
+               "--num_classes", "12", "--num_samples", "16",
+               "--model_dir", str(tmp_path / "incep"), timeout=600)
+    assert "inception_imagenet: done" in out

@@ -179,3 +179,43 @@ def test_wide_deep_forward_and_grad():
     g = jax.grad(loss_fn)(params)
     leaves = jax.tree.leaves(g)
     assert leaves and all(jnp.isfinite(l).all() for l in leaves)
+
+
+def test_inception_v3_forward_shape():
+    from tensorflowonspark_tpu.models import InceptionV3
+
+    model = InceptionV3(num_classes=11, dtype=jnp.float32)
+    x = jnp.zeros((1, 75, 75, 3))  # smallest supported spatial extent
+    variables = model.init({"params": jax.random.key(0),
+                            "dropout": jax.random.key(1)}, x, train=True)
+    assert "batch_stats" in variables
+    logits, updates = model.apply(variables, x, train=True,
+                                  mutable=["batch_stats"],
+                                  rngs={"dropout": jax.random.key(1)})
+    assert logits.shape == (1, 11)
+    assert "batch_stats" in updates
+    # inference path: no dropout rng needed
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (1, 11)
+
+
+def test_inception_v3_aux_head_canonical_size():
+    from tensorflowonspark_tpu.models import InceptionV3
+
+    model = InceptionV3(num_classes=7, aux_logits=True, dtype=jnp.float32)
+    x = jax.ShapeDtypeStruct((2, 299, 299, 3), jnp.float32)
+
+    def init(x):
+        return model.init({"params": jax.random.key(0),
+                           "dropout": jax.random.key(1)}, x, train=True)
+
+    variables = jax.eval_shape(init, x)
+
+    def fwd(v, x):
+        return model.apply(v, x, train=True, mutable=["batch_stats"],
+                           rngs={"dropout": jax.random.key(1)})
+
+    (out, _updates) = jax.eval_shape(fwd, variables, x)
+    logits, aux = out
+    assert logits.shape == (2, 7)
+    assert aux.shape == (2, 7)

@@ -306,7 +306,9 @@ def bench_gpt_decode() -> dict | None:
     """Autoregressive decode throughput (tokens/sec) for the GPT family.
 
     The compiled KV-cache scan (``models.gpt.greedy_generate``) is the
-    inference-side headline; written to ``bench_artifacts/gpt_decode.json``.
+    inference-side headline, measured bf16 and int8-weight-only
+    (``ops.quant`` — decode is HBM-bound, so int8 weights should approach
+    2x); written to ``bench_artifacts/gpt_decode.json``.
     """
     import jax
     import jax.numpy as jnp
@@ -314,6 +316,7 @@ def bench_gpt_decode() -> dict | None:
     if jax.devices()[0].platform != "tpu":
         return None
     from tensorflowonspark_tpu.models import GPTConfig, GPT, greedy_generate
+    from tensorflowonspark_tpu.ops import quantize_params
 
     cfg = GPTConfig(vocab_size=32000, hidden_size=768, num_layers=12,
                     num_heads=12, intermediate_size=3072,
@@ -324,14 +327,17 @@ def bench_gpt_decode() -> dict | None:
     prompt = jax.random.randint(jax.random.key(1), (B, T0), 0, cfg.vocab_size)
 
     gen = jax.jit(greedy_generate, static_argnums=(0, 3))
-    out = gen(cfg, params, prompt, NEW)
-    out.block_until_ready()  # compile + warmup
-    t0 = time.perf_counter()
-    iters = 3
-    for _ in range(iters):
-        out = gen(cfg, params, prompt, NEW)
-    out.block_until_ready()
-    dt = (time.perf_counter() - t0) / iters
+
+    def timed(p, iters=3):
+        out = gen(cfg, p, prompt, NEW)
+        out.block_until_ready()  # compile + warmup
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = gen(cfg, p, prompt, NEW)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / iters
+
+    dt = timed(params)
     tps = B * NEW / dt
     result = {"batch": B, "prompt": T0, "new_tokens": NEW,
               "tokens_per_sec": round(tps, 1),
@@ -339,6 +345,14 @@ def bench_gpt_decode() -> dict | None:
               "model": "gpt-124M-ish bf16",
               "device": jax.devices()[0].device_kind}
     log(f"bench: gpt decode {tps:.0f} tok/s (batch {B})")
+    try:
+        dt_q = timed(jax.device_put(quantize_params(params)))
+        result["int8_tokens_per_sec"] = round(B * NEW / dt_q, 1)
+        result["int8_vs_bf16"] = round(dt / dt_q, 3)
+        log(f"bench: gpt int8 decode {B * NEW / dt_q:.0f} tok/s "
+            f"({dt / dt_q:.2f}x bf16)")
+    except Exception as e:
+        log(f"bench: int8 decode failed ({e!r})")
     os.makedirs(os.path.join(REPO, "bench_artifacts"), exist_ok=True)
     with open(os.path.join(REPO, "bench_artifacts", "gpt_decode.json"), "w") as f:
         json.dump(result, f, indent=2)

@@ -143,10 +143,12 @@ class InceptionC(nn.Module):
 
 
 class InceptionV3(nn.Module):
-    """Inception-v3 (299×299 canonical; any H,W ≥ 75 works).
+    """Inception-v3 (299×299 canonical; any H,W ≥ 75 works aux-free).
 
     Returns logits, or ``(logits, aux_logits)`` when ``aux_logits=True`` and
     ``train=True`` (the reference's PS-mode job adds the aux loss at 0.3).
+    The aux head's 5×5/3 VALID pool needs a ≥5-wide 17×17-stage grid, i.e.
+    inputs ≥ ~139px; smaller inputs with ``aux_logits=True`` raise.
     """
 
     num_classes: int = 1000
@@ -175,6 +177,11 @@ class InceptionV3(nn.Module):
             x = InceptionB(c7=c7, dtype=self.dtype)(x, train=train)
         aux = None
         if self.aux_logits and train:
+            if min(x.shape[1:3]) < 5:
+                raise ValueError(
+                    f"aux_logits=True needs a >=5-wide 17x17-stage grid, got "
+                    f"{x.shape[1]}x{x.shape[2]} (input too small; use inputs "
+                    ">= ~139px or aux_logits=False)")
             a = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
             a = cbn(128, (1, 1))(a, train=train)
             a = cbn(768, tuple(a.shape[1:3]), padding="VALID")(a, train=train)

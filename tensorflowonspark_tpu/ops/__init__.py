@@ -7,5 +7,8 @@ where fusion beyond XLA's pays: attention (the O(T²) memory hog) first.
 """
 
 from tensorflowonspark_tpu.ops.flash_attention import flash_attention
+from tensorflowonspark_tpu.ops.quant import (Int8Array, quantize_int8,
+                                             quantize_params, tree_nbytes)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "Int8Array", "quantize_int8",
+           "quantize_params", "tree_nbytes"]

@@ -52,8 +52,9 @@ def _causal_mask(s, q_block, block_k, qi, j):
 
 # ---------------------------------------------------------------- forward
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
-                scale, causal, block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
+                has_bias):
+    bias_ref, o_ref, lse_ref = rest if has_bias else (None, *rest)
     bq = q_ref.shape[2]
     T = k_ref.shape[2]
     q = q_ref[0, 0]                                       # (bq, D)
@@ -68,7 +69,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
         v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
         s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        s = s + bias_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
+        if bias_ref is not None:  # key-padding mask: one VPU pass over s
+            s = s + bias_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
         if causal:
             s = _causal_mask(s, bq, block_k, qi, j)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
@@ -102,16 +104,20 @@ def _fwd_impl(q, k, v, bias, causal, scale, block_q, block_k, interpret):
     Tk = k.shape[2]
     grid = (B, H, Tq // block_q)
     blk = lambda bs, im: pl.BlockSpec(bs, im)  # noqa: E731
+    in_specs = [
+        blk((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
+        blk((1, 1, Tk, D), lambda b, h, qi: (b, h, 0, 0)),
+        blk((1, 1, Tk, D), lambda b, h, qi: (b, h, 0, 0)),
+    ]
+    args = (q, k, v)
+    if bias is not None:
+        in_specs.append(blk((1, 1, Tk), lambda b, h, qi: (b, 0, 0)))
+        args += (bias,)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_k=block_k),
+                          block_k=block_k, has_bias=bias is not None),
         grid=grid,
-        in_specs=[
-            blk((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
-            blk((1, 1, Tk, D), lambda b, h, qi: (b, h, 0, 0)),
-            blk((1, 1, Tk, D), lambda b, h, qi: (b, h, 0, 0)),
-            blk((1, 1, Tk), lambda b, h, qi: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             blk((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
             blk((1, 1, block_q, 1), lambda b, h, qi: (b, h, qi, 0)),
@@ -124,14 +130,16 @@ def _fwd_impl(q, k, v, bias, causal, scale, block_q, block_k, interpret):
             flops=4 * B * H * Tq * Tk * D, transcendentals=B * H * Tq * Tk,
             bytes_accessed=q.dtype.itemsize * B * H * (Tq + Tk) * D * 2),
         interpret=interpret,
-    )(q, k, v, bias)
+    )(*args)
     return out, lse
 
 
 # --------------------------------------------------------------- backward
 
-def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, *, scale, causal, block_k):
+def _dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
+               has_bias):
+    (bias_ref, do_ref, lse_ref, delta_ref, dq_ref) = \
+        rest if has_bias else (None, *rest)
     bq = q_ref.shape[2]
     T = k_ref.shape[2]
     q = q_ref[0, 0]
@@ -148,7 +156,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
         v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
         s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        s = s + bias_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
         if causal:
             s = _causal_mask(s, bq, block_k, qi, j)
         p = jnp.exp(s - lse)                               # (bq, bk)
@@ -165,14 +174,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
     dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(k_ref, v_ref, q_ref, bias_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, block_q):
+def _dkv_kernel(k_ref, v_ref, q_ref, *rest, scale, causal, block_q,
+                has_bias):
+    (bias_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref) = \
+        rest if has_bias else (None, *rest)
     bk = k_ref.shape[2]
     T = q_ref.shape[2]
     k_blk = k_ref[0, 0]
     v_blk = v_ref[0, 0].astype(jnp.float32)
     ki = pl.program_id(2)
-    bias = bias_ref[0, 0, pl.ds(ki * bk, bk)][None, :]     # (1, bk)
+    bias = None if bias_ref is None \
+        else bias_ref[0, 0, pl.ds(ki * bk, bk)][None, :]   # (1, bk)
     nq = T // block_q
     start = (ki * bk) // block_q if causal else 0
 
@@ -184,7 +196,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, bias_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), :]
         s = lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        s = s + bias
+        if bias is not None:
+            s = s + bias
         if causal:
             s = _causal_mask(s, block_q, bk, i, ki)
         p = jnp.exp(s - lse)                               # (bq, bk)
@@ -213,37 +226,49 @@ def _bwd_impl(q, k, v, bias, out, lse, g, causal, scale, block_q, block_k,
                     axis=-1, keepdims=True)
     blk = lambda bs, im: pl.BlockSpec(bs, im)  # noqa: E731
 
+    dq_specs = [
+        blk((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
+        blk((1, 1, Tk, D), lambda b, h, qi: (b, h, 0, 0)),
+        blk((1, 1, Tk, D), lambda b, h, qi: (b, h, 0, 0)),
+    ]
+    dq_args = (q, k, v)
+    if bias is not None:
+        dq_specs.append(blk((1, 1, Tk), lambda b, h, qi: (b, 0, 0)))
+        dq_args += (bias,)
+    dq_specs += [
+        blk((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
+        blk((1, 1, block_q, 1), lambda b, h, qi: (b, h, qi, 0)),
+        blk((1, 1, block_q, 1), lambda b, h, qi: (b, h, qi, 0)),
+    ]
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k),
+                          block_k=block_k, has_bias=bias is not None),
         grid=(B, H, Tq // block_q),
-        in_specs=[
-            blk((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
-            blk((1, 1, Tk, D), lambda b, h, qi: (b, h, 0, 0)),
-            blk((1, 1, Tk, D), lambda b, h, qi: (b, h, 0, 0)),
-            blk((1, 1, Tk), lambda b, h, qi: (b, 0, 0)),
-            blk((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
-            blk((1, 1, block_q, 1), lambda b, h, qi: (b, h, qi, 0)),
-            blk((1, 1, block_q, 1), lambda b, h, qi: (b, h, qi, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=blk((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
         interpret=interpret,
-    )(q, k, v, bias, g, lse, delta)
+    )(*dq_args, g, lse, delta)
 
+    dkv_specs = [
+        blk((1, 1, block_k, D), lambda b, h, ki: (b, h, ki, 0)),
+        blk((1, 1, block_k, D), lambda b, h, ki: (b, h, ki, 0)),
+        blk((1, 1, Tq, D), lambda b, h, ki: (b, h, 0, 0)),
+    ]
+    dkv_args = (k, v, q)
+    if bias is not None:
+        dkv_specs.append(blk((1, 1, Tk), lambda b, h, ki: (b, 0, 0)))
+        dkv_args += (bias,)
+    dkv_specs += [
+        blk((1, 1, Tq, D), lambda b, h, ki: (b, h, 0, 0)),
+        blk((1, 1, Tq, 1), lambda b, h, ki: (b, h, 0, 0)),
+        blk((1, 1, Tq, 1), lambda b, h, ki: (b, h, 0, 0)),
+    ]
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q),
+                          block_q=block_q, has_bias=bias is not None),
         grid=(B, H, Tk // block_k),
-        in_specs=[
-            blk((1, 1, block_k, D), lambda b, h, ki: (b, h, ki, 0)),
-            blk((1, 1, block_k, D), lambda b, h, ki: (b, h, ki, 0)),
-            blk((1, 1, Tq, D), lambda b, h, ki: (b, h, 0, 0)),
-            blk((1, 1, Tk), lambda b, h, ki: (b, 0, 0)),
-            blk((1, 1, Tq, D), lambda b, h, ki: (b, h, 0, 0)),
-            blk((1, 1, Tq, 1), lambda b, h, ki: (b, h, 0, 0)),
-            blk((1, 1, Tq, 1), lambda b, h, ki: (b, h, 0, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             blk((1, 1, block_k, D), lambda b, h, ki: (b, h, ki, 0)),
             blk((1, 1, block_k, D), lambda b, h, ki: (b, h, ki, 0)),
@@ -253,7 +278,7 @@ def _bwd_impl(q, k, v, bias, out, lse, g, causal, scale, block_q, block_k,
             jax.ShapeDtypeStruct((B, H, Tk, D), v.dtype),
         ],
         interpret=interpret,
-    )(k, v, q, bias, g, lse, delta)
+    )(*dkv_args, g, lse, delta)
     return dq, dk, dv
 
 
@@ -276,7 +301,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     q, k, v, bias, out, lse = res
     dq, dk, dv = _bwd_impl(q, k, v, bias, out, lse, g, causal, scale,
                            block_q, block_k, interpret)
-    return dq, dk, dv, jnp.zeros_like(bias)
+    return dq, dk, dv, None if bias is None else jnp.zeros_like(bias)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -324,15 +349,18 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
         vt = jnp.pad(vt, ((0, 0), (0, 0), (0, Tk_p - Tk), (0, 0)))
 
     # Key-padding mask → additive f32 bias row (padded keys masked out).
+    # No mask and no K padding → bias=None: the kernels skip the bias DMA
+    # and the per-block VPU pass over the score matrix entirely.
     if mask is not None:
         bias = jnp.where(mask, 0.0, NEG_INF).astype(jnp.float32)
         bias = jnp.pad(bias, ((0, 0), (0, Tk_p - Tk)),
                        constant_values=NEG_INF)
+    elif Tk_p != Tk:
+        bias = jnp.zeros((B, Tk_p), jnp.float32).at[:, Tk:].set(NEG_INF)
     else:
-        bias = jnp.zeros((B, Tk_p), jnp.float32)
-        if Tk_p != Tk:
-            bias = bias.at[:, Tk:].set(NEG_INF)
-    bias = bias[:, None, :]                                # (B, 1, Tk)
+        bias = None
+    if bias is not None:
+        bias = bias[:, None, :]                            # (B, 1, Tk)
 
     out = _flash(qt, kt, vt, bias, causal, scale, block_q, block_k,
                  interpret)

@@ -55,6 +55,17 @@ def main(args):
 
     def loss_fn(params, batch):
         ids = batch["ids"]
+        if args.chunked_xent:
+            # memory-efficient LM head: never materialises [B, T, V]
+            # logits (ops.tied_softmax_xent chunks the vocab axis)
+            from tensorflowonspark_tpu.ops import tied_softmax_xent
+
+            h = model.apply({"params": params}, ids, method="hidden")
+            table = params["tok_emb"]["embedding"]
+            table = getattr(table, "value", table)
+            return tied_softmax_xent(
+                h[:, :-1], table, ids[:, 1:],
+                chunk_size=max(1, args.vocab // 2)).mean()
         logits = model.apply({"params": params}, ids)
         return optax.softmax_cross_entropy_with_integer_labels(
             logits[:, :-1], ids[:, 1:]).mean()
@@ -87,6 +98,8 @@ if __name__ == "__main__":
     p.add_argument("--seq_len", type=int, default=16)
     p.add_argument("--batch_size", type=int, default=16)
     p.add_argument("--max_steps", type=int, default=60)
+    p.add_argument("--chunked_xent", action="store_true",
+                   help="train with ops.tied_softmax_xent (no [B,T,V] logits)")
     p.add_argument("--model_dir", default="/tmp/gpt_tiny")
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args()

@@ -150,7 +150,10 @@ class GPT(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, input_ids, *, train: bool = False):
+    def hidden(self, input_ids, *, train: bool = False):
+        """Trunk only: ``[B, T] -> [B, T, H]`` final hidden states (post
+        ``ln_f``, fp32).  Pair with ``ops.tied_softmax_xent(h, table,
+        labels)`` to train without materialising ``[B, T, V]`` logits."""
         cfg = self.cfg
         B, T = input_ids.shape
         tok = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="tok_emb",
@@ -194,8 +197,11 @@ class GPT(nn.Module):
             for i in range(cfg.num_layers):
                 x = block_cls(cfg, self.decode, name=f"layer_{i}")(
                     x, train=train)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
-        table = tok.variables["params"]["embedding"]
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+
+    def __call__(self, input_ids, *, train: bool = False):
+        x = self.hidden(input_ids, train=train)
+        table = self.get_variable("params", "tok_emb")["embedding"]
         table = getattr(table, "value", table)  # unbox partitioned param
         return jnp.einsum("bth,vh->btv", x.astype(jnp.float32),
                           table.astype(jnp.float32))

@@ -1,0 +1,136 @@
+"""Memory-efficient LM-head cross-entropy (chunked over the vocabulary).
+
+The reference never trains language models (SURVEY.md §2d) so it has no
+analogue; for this framework's decoder family the LM head is the memory
+hog: materialising ``[B, T, V]`` fp32 logits for a 32k–256k vocab dwarfs
+every activation in the network (B8·T1024·V50k fp32 = 1.6 GB — per layer
+of nothing).  :func:`tied_softmax_xent` computes
+
+    loss[b, t] = logsumexp_v(h[b,t] @ W[v]) - h[b,t] @ W[label[b,t]]
+
+without ever materialising the full logits tensor: a ``lax.scan`` over
+vocabulary chunks keeps a running online logsumexp (the flash-attention
+trick applied to the vocab axis) and picks out the label logit on the
+fly.  The custom VJP recomputes each chunk's probabilities from the saved
+logsumexp on the backward pass — activation memory is ``O(B·T·chunk)``
+instead of ``O(B·T·V)``, compute unchanged (two extra passes of the same
+matmuls, exactly like flash attention's backward).
+
+All matmuls are MXU-shaped (``[B·T, H] @ [H, chunk]``), the scan carry is
+static-shape, and XLA pipelines chunk k+1's weight fetch under chunk k's
+compute — HBM-friendly by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _col_mask(c, chunk, V):
+    """Valid-column test for the (zero-padded) last chunk; ``None`` when the
+    table wasn't padded so the masking pass is statically skipped."""
+    if V % chunk == 0:
+        return None
+    return c * chunk + jnp.arange(chunk) < V
+
+
+def _lse_and_label_logit(h, table, labels, chunk, V):
+    """Online pass: returns (lse [N], label_logit [N]) for flat ``h [N,H]``."""
+    N = h.shape[0]
+    n = table.shape[0] // chunk
+
+    def body(carry, c):
+        m, l, ll = carry
+        w = lax.dynamic_slice_in_dim(table, c * chunk, chunk, 0)  # [chunk, H]
+        s = (h @ w.astype(h.dtype).T).astype(jnp.float32)         # [N, chunk]
+        valid = _col_mask(c, chunk, V)
+        if valid is not None:  # ragged tail: padded cols can't win
+            s = jnp.where(valid[None, :], s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.exp(s - m_new[:, None]).sum(-1)
+        # label logit if this chunk holds it (one-hot dot, no gather scatter)
+        idx = labels - c * chunk
+        in_chunk = (idx >= 0) & (idx < chunk)
+        picked = jnp.take_along_axis(
+            s, jnp.clip(idx, 0, chunk - 1)[:, None], axis=-1)[:, 0]
+        ll = jnp.where(in_chunk, picked, ll)
+        return (m_new, l, ll), None
+
+    m0 = jnp.full((N,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((N,), jnp.float32)
+    ll0 = jnp.zeros((N,), jnp.float32)
+    (m, l, ll), _ = lax.scan(body, (m0, l0, ll0), jnp.arange(n))
+    return m + jnp.log(l), ll
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _xent_flat(h, table, labels, chunk, V):
+    lse, ll = _lse_and_label_logit(h, table, labels, chunk, V)
+    return lse - ll
+
+
+def _xent_flat_fwd(h, table, labels, chunk, V):
+    lse, ll = _lse_and_label_logit(h, table, labels, chunk, V)
+    return lse - ll, (h, table, labels, lse)
+
+
+def _xent_flat_bwd(chunk, V, res, g):
+    h, table, labels, lse = res
+    n = table.shape[0] // chunk
+    gf = g.astype(jnp.float32)
+
+    def body(dh, c):
+        w = lax.dynamic_slice_in_dim(table, c * chunk, chunk, 0)
+        s = (h @ w.astype(h.dtype).T).astype(jnp.float32)
+        valid = _col_mask(c, chunk, V)
+        if valid is not None:
+            s = jnp.where(valid[None, :], s, -jnp.inf)
+        p = jnp.exp(s - lse[:, None])                      # softmax chunk
+        idx = labels - c * chunk
+        in_chunk = (idx >= 0) & (idx < chunk)
+        onehot = (jnp.clip(idx, 0, chunk - 1)[:, None]
+                  == jnp.arange(chunk)[None, :]) & in_chunk[:, None]
+        d = (p - onehot) * gf[:, None]                     # dlogits chunk
+        d = d.astype(h.dtype)
+        dh = dh + d @ w.astype(h.dtype)
+        dw = d.T @ h                                       # [chunk, H]
+        return dh, dw
+
+    dh0 = jnp.zeros_like(h)
+    dh, dws = lax.scan(body, dh0, jnp.arange(n))
+    dtable = dws.reshape(table.shape).astype(table.dtype)
+    return dh, dtable, None
+
+
+_xent_flat.defvjp(_xent_flat_fwd, _xent_flat_bwd)
+
+
+def tied_softmax_xent(hidden, table, labels, *, chunk_size: int = 4096):
+    """Per-token cross-entropy of a (tied) LM head, chunked over vocab.
+
+    Args:
+      hidden: ``[..., H]`` final hidden states (any leading shape).
+      table: ``[V, H]`` projection/embedding table (tied head layout —
+        ``models.GPT``/``models.Bert`` store ``tok_emb`` exactly so).
+      labels: ``[...]`` int targets, same leading shape as ``hidden``.
+      chunk_size: vocab slab per scan step (clamped to V).  Any V works:
+        a ragged final chunk is zero-padded internally and its columns
+        masked out of both passes.
+
+    Returns per-token losses ``[...]`` in fp32; ``mean()`` it for the
+    usual scalar.  Gradients flow to ``hidden`` and ``table``.
+    """
+    if chunk_size <= 0:
+        raise ValueError(f"chunk_size must be positive, got {chunk_size}")
+    V = table.shape[0]
+    chunk = min(chunk_size, V)
+    pad = (-V) % chunk
+    table_p = jnp.pad(table, ((0, pad), (0, 0))) if pad else table
+    lead = hidden.shape[:-1]
+    h = hidden.reshape(-1, hidden.shape[-1])
+    out = _xent_flat(h, table_p, labels.reshape(-1), chunk, V)
+    return out.reshape(lead)

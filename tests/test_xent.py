@@ -1,0 +1,136 @@
+"""Chunked LM-head cross-entropy vs the dense oracle (values + grads)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensorflowonspark_tpu.ops import tied_softmax_xent
+
+
+def _dense_ref(hidden, table, labels):
+    logits = jnp.einsum("...h,vh->...v", hidden.astype(jnp.float32),
+                        table.astype(jnp.float32))
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+@pytest.mark.parametrize("chunk", [7, 32, 224])
+def test_matches_dense_forward(chunk):
+    V, H = 224, 16
+    h = jax.random.normal(jax.random.key(0), (3, 5, H))
+    t = jax.random.normal(jax.random.key(1), (V, H))
+    y = jax.random.randint(jax.random.key(2), (3, 5), 0, V)
+    got = tied_softmax_xent(h, t, y, chunk_size=chunk)
+    np.testing.assert_allclose(got, _dense_ref(h, t, y), rtol=2e-5, atol=2e-5)
+
+
+def test_matches_dense_gradients():
+    V, H = 96, 8
+    h = jax.random.normal(jax.random.key(0), (4, 3, H))
+    t = jax.random.normal(jax.random.key(1), (V, H))
+    y = jax.random.randint(jax.random.key(2), (4, 3), 0, V)
+
+    def loss_chunked(h, t):
+        return tied_softmax_xent(h, t, y, chunk_size=24).mean()
+
+    def loss_dense(h, t):
+        return _dense_ref(h, t, y).mean()
+
+    (gh, gt) = jax.grad(loss_chunked, argnums=(0, 1))(h, t)
+    (gh_r, gt_r) = jax.grad(loss_dense, argnums=(0, 1))(h, t)
+    np.testing.assert_allclose(gh, gh_r, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(gt, gt_r, rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_hidden_fp32_loss():
+    V, H = 64, 8
+    h = jax.random.normal(jax.random.key(0), (2, 4, H)).astype(jnp.bfloat16)
+    t = jax.random.normal(jax.random.key(1), (V, H)).astype(jnp.bfloat16)
+    y = jax.random.randint(jax.random.key(2), (2, 4), 0, V)
+    out = tied_softmax_xent(h, t, y, chunk_size=16)
+    assert out.dtype == jnp.float32
+    ref = _dense_ref(h, t, y)
+    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+
+def test_ragged_vocab_matches_dense():
+    # V=50 with chunk 16 -> 4 chunks, last one 14 columns of zero padding
+    V, H = 50, 8
+    h = jax.random.normal(jax.random.key(0), (3, 4, H))
+    t = jax.random.normal(jax.random.key(1), (V, H))
+    y = jax.random.randint(jax.random.key(2), (3, 4), 0, V)
+    got = tied_softmax_xent(h, t, y, chunk_size=16)
+    np.testing.assert_allclose(got, _dense_ref(h, t, y), rtol=2e-5, atol=2e-5)
+    # gradients too: padded columns must contribute nothing
+    gh, gt = jax.grad(lambda h, t: tied_softmax_xent(
+        h, t, y, chunk_size=16).mean(), argnums=(0, 1))(h, t)
+    gh_r, gt_r = jax.grad(lambda h, t: _dense_ref(h, t, y).mean(),
+                          argnums=(0, 1))(h, t)
+    np.testing.assert_allclose(gh, gh_r, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(gt, gt_r, rtol=2e-5, atol=2e-5)
+
+
+def test_gpt_default_vocab_traces():
+    # the GPT family's default vocab (50257, prime) with the default chunk
+    h = jax.ShapeDtypeStruct((4, 16), jnp.float32)
+    t = jax.ShapeDtypeStruct((50257, 16), jnp.float32)
+    y = jax.ShapeDtypeStruct((4,), jnp.int32)
+    out = jax.eval_shape(lambda h, t, y: tied_softmax_xent(h, t, y), h, t, y)
+    assert out.shape == (4,)
+
+
+def test_nonpositive_chunk_raises():
+    h = jnp.zeros((2, 8))
+    t = jnp.zeros((30, 8))
+    y = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(ValueError, match="positive"):
+        tied_softmax_xent(h, t, y, chunk_size=0)
+
+
+def test_under_jit_and_sharded_batch(jax_cpu_mesh_devices):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    V, H = 128, 16
+    mesh = Mesh(np.array(jax_cpu_mesh_devices).reshape(8), ("dp",))
+    h = jax.random.normal(jax.random.key(0), (16, 4, H))
+    t = jax.random.normal(jax.random.key(1), (V, H))
+    y = jax.random.randint(jax.random.key(2), (16, 4), 0, V)
+    hs = jax.device_put(h, NamedSharding(mesh, P("dp")))
+
+    @jax.jit
+    def f(h, t):
+        return tied_softmax_xent(h, t, y, chunk_size=32).mean()
+
+    np.testing.assert_allclose(float(f(hs, t)),
+                               float(_dense_ref(h, t, y).mean()), rtol=1e-5)
+
+
+def test_gpt_hidden_plus_chunked_xent_matches_logits_loss():
+    from tensorflowonspark_tpu.models import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+                    intermediate_size=64, max_position_embeddings=32,
+                    dtype=jnp.float32)
+    model = GPT(cfg)
+    ids = jax.random.randint(jax.random.key(0), (2, 16), 0, cfg.vocab_size)
+    params = model.init(jax.random.key(1), ids)["params"]
+
+    def loss_dense(params):
+        logits = model.apply({"params": params}, ids)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], ids[:, 1:]).mean()
+
+    def loss_chunked(params):
+        h = model.apply({"params": params}, ids, method="hidden")
+        table = params["tok_emb"]["embedding"]
+        table = getattr(table, "value", table)
+        return tied_softmax_xent(h[:, :-1], table, ids[:, 1:],
+                                 chunk_size=32).mean()
+
+    np.testing.assert_allclose(float(loss_chunked(params)),
+                               float(loss_dense(params)), rtol=1e-5)
+    gd = jax.grad(loss_dense)(params)
+    gc = jax.grad(loss_chunked)(params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        a, b, rtol=5e-4, atol=1e-5), gd, gc)

@@ -468,6 +468,46 @@ class Dataset:
     def as_numpy(self) -> list:
         return list(self._make())
 
+    def checkpointable(self, state: dict | None = None) -> "CheckpointableIterator":
+        """Iterator whose position can be saved with a checkpoint and
+        restored after a restart — the ``tf.data`` iterator-checkpointing
+        analogue the reference leans on via ``BackupAndRestore`` (SURVEY.md
+        §5 checkpoint/resume).
+
+        ``state`` is the dict a previous iterator's :meth:`~
+        CheckpointableIterator.state` returned (store it next to the model
+        checkpoint, e.g. in ``TrainState.extras`` or a sidecar JSON).
+        Restore replays the pipeline and skips the consumed prefix, so it
+        is exact for *deterministic* pipelines (fixed ``shuffle`` seed,
+        pure ``map`` fns) and costs one pass over the skipped elements.
+        Call it on the **outermost** dataset (post-``batch``) so the state
+        counts batches, not samples.
+        """
+        return CheckpointableIterator(self, state)
+
+
+class CheckpointableIterator:
+    """See :meth:`Dataset.checkpointable`."""
+
+    def __init__(self, ds: "Dataset", state: dict | None = None):
+        consumed = int(state.get("elements_consumed", 0)) if state else 0
+        self._it = iter(ds)
+        for _ in range(consumed):  # deterministic replay of the prefix
+            next(self._it)
+        self._count = consumed
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = next(self._it)
+        self._count += 1
+        return item
+
+    def state(self) -> dict:
+        """Savable position: pickle/JSON-safe, stable across restarts."""
+        return {"elements_consumed": self._count}
+
 
 def _default_leaf_stack(items: list):
     return np.stack([np.asarray(x) for x in items])

@@ -94,6 +94,11 @@ class Estimator:
       profile_steps: optional ``(start, stop)`` global-step range traced
         with the jax profiler into ``summary_dir/plugins`` — the xprof
         trace appears in TensorBoard's Profile tab (chief only).
+      checkpoint_input_state: save the input pipeline's position — (epoch,
+        batches consumed) — in a JSON sidecar beside each checkpoint and,
+        on resume, skip the already-trained prefix of ``input_fn``'s first
+        epoch instead of re-training it (the tf.data iterator-checkpoint
+        analogue; exact for deterministic pipelines).  Default True.
     """
 
     def __init__(self, init_fn, loss_fn, tx, model_dir: str, *,
@@ -102,7 +107,8 @@ class Estimator:
                  handle_preemption: bool = True,
                  summary_dir: Optional[str] = None,
                  log_every_steps: int = 10,
-                 profile_steps: Optional[tuple] = None):
+                 profile_steps: Optional[tuple] = None,
+                 checkpoint_input_state: bool = True):
         import os
 
         from tensorflowonspark_tpu.checkpoint import CheckpointManager
@@ -120,10 +126,19 @@ class Estimator:
             self._ckpt = CheckpointManager(model_dir, max_to_keep=max_to_keep)
             self._state = self.strategy.init_state(init_fn, tx)
             latest = self._ckpt.latest_step()
+            # Pending restart-resume position {"epoch": int, "batches": int}:
+            # consumed by the FIRST epoch of the next train() call.  Only a
+            # process restart sets it — in-process train() calls keep the
+            # fresh-input_fn-per-call contract (replaying an ever-growing
+            # prefix at every eval round would go quadratic).
+            self._pending_input_resume = None
+            self._ckpt_input_state = checkpoint_input_state
             if latest is not None:
                 self._state = self._ckpt.restore(latest, target=self._state)
                 logger.info("estimator: resumed from %s step %d",
                             model_dir, latest)
+                if checkpoint_input_state:
+                    self._pending_input_resume = self._load_input_state(latest)
         # Host-side mirror of state.step: reading the device scalar every
         # loop iteration would block on the in-flight step and kill JAX's
         # async dispatch; the mirror advances with each dispatched step.
@@ -152,6 +167,54 @@ class Estimator:
                 self._summary = SummaryWriter(summary_dir)
 
     # ------------------------------------------------------------------
+    def _input_state_path(self, step: int) -> str:
+        from tensorflowonspark_tpu import filesystem as fsutil
+
+        return fsutil.join(self.model_dir, "input_state", f"{step}.json")
+
+    def _save_input_state(self, step: int, epoch: int, batches: int) -> None:
+        """JSON sidecar beside the checkpoint (own subdir so orbax's step
+        scan never sees foreign files; works on gs:// via filesystem)."""
+        import json
+
+        from tensorflowonspark_tpu import filesystem as fsutil
+
+        if not self._ckpt_input_state or not self.model_dir:
+            return
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        path = self._input_state_path(step)
+        side_dir = fsutil.join(self.model_dir, "input_state")
+        fsutil.makedirs(side_dir)
+        with fsutil.open_output(path, "wb") as f:
+            f.write(json.dumps({"epoch": epoch, "batches": batches}).encode())
+        # prune sidecars whose checkpoints CheckpointManager already dropped
+        try:
+            keep = set(self._ckpt.all_steps()) | {step}
+            for name in fsutil.listdir(side_dir):
+                base = name.rsplit("/", 1)[-1]
+                if base.endswith(".json") and base[:-5].isdigit() \
+                        and int(base[:-5]) not in keep:
+                    fsutil.remove(fsutil.join(side_dir, base))
+        except OSError:
+            pass
+
+    def _load_input_state(self, step: int):
+        import json
+
+        from tensorflowonspark_tpu import filesystem as fsutil
+
+        try:
+            with fsutil.open_file(self._input_state_path(step), "rb") as f:
+                state = json.loads(f.read().decode())
+            logger.info("estimator: input pipeline resumes at epoch %d, "
+                        "batch %d", state["epoch"], state["batches"])
+            return state
+        except (OSError, ValueError, KeyError):
+            return None
+
     @property
     def global_step(self) -> int:
         return self._host_step
@@ -182,16 +245,33 @@ class Estimator:
 
         _END = object()
         prev_metrics = None  # blocked on one step late: see "step" timing
+        epoch, batches = 0, 0  # input position within THIS train call
+        resumed_skip = False  # this epoch began with a restart-resume skip
+        entered = False  # loop ran at all (else the sidecar must survive)
         with guard if guard is not None else contextlib.nullcontext():
             while self._host_step < max_steps:
+                entered = True
                 made_progress = False
                 # device_prefetch keeps transfers ahead of compute — the
                 # same host/device overlap the data plane provides
                 # everywhere else.  Epoch setup (input_fn itself) is data
                 # badput too.
                 with self._goodput.time("data"):
-                    it = device_prefetch(iter(input_fn()), depth=2,
-                                         sharding=sharding)
+                    base = iter(input_fn())
+                    if self._pending_input_resume is not None:
+                        # restart resume: skip this epoch's already-trained
+                        # prefix (deterministic replay; counted in "data")
+                        resume = self._pending_input_resume
+                        self._pending_input_resume = None  # first epoch only
+                        epoch = int(resume.get("epoch", 0))
+                        skip = int(resume.get("batches", 0))
+                        batches = 0
+                        resumed_skip = skip > 0
+                        for _ in range(skip):
+                            if next(base, _END) is _END:
+                                break
+                            batches += 1
+                    it = device_prefetch(base, depth=2, sharding=sharding)
                 while True:
                     with self._goodput.time("data"):
                         b = next(it, _END)
@@ -209,11 +289,14 @@ class Estimator:
                             jax.block_until_ready(prev_metrics)
                         prev_metrics = metrics
                     self._host_step += 1
+                    batches += 1  # executed batches, not prefetched pulls
                     self._maybe_profile(start=False)
                     made_progress = True
                     if self._host_step % self.save_every_steps == 0:
                         with self._goodput.time("checkpoint"):
                             self._ckpt.save(self._host_step, self._state)
+                            self._save_input_state(self._host_step,
+                                                   epoch, batches)
                     if self._summary is not None and \
                             self._host_step % self.log_every_steps == 0:
                         # write the PREVIOUS boundary's metrics (long since
@@ -226,8 +309,13 @@ class Estimator:
                     logger.warning("estimator: preempted at step %d; saving "
                                    "and stopping", self._host_step)
                     break
-                if not made_progress:
+                if not made_progress and not resumed_skip:
                     raise ValueError("input_fn yielded no batches")
+                # a resume skip that consumed the whole epoch (checkpoint
+                # fell on an epoch boundary) rolls to the next epoch
+                resumed_skip = False
+                if self._host_step < max_steps:  # epoch exhausted: next one
+                    epoch, batches = epoch + 1, 0
         if prev_metrics is not None:
             import time as _time
 
@@ -244,6 +332,10 @@ class Estimator:
             self._pending_log = None
         with self._goodput.time("checkpoint"):
             self._ckpt.save(self._host_step, self._state)
+            if entered:
+                # zero-step calls (target already reached) must not clobber
+                # the saved position with this call's unused local zeros
+                self._save_input_state(self._host_step, epoch, batches)
             self._ckpt.wait()
         return self._host_step
 

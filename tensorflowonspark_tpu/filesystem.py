@@ -105,6 +105,21 @@ def listdir(path: str) -> list[str]:
             for entry in fs.ls(p, detail=False)]
 
 
+def remove(path: str) -> None:
+    """Delete one file/object (missing paths raise ``OSError`` like
+    ``os.remove``)."""
+    if not has_scheme(path):
+        os.remove(path)
+        return
+    fs, p = _fs(path)
+    try:
+        fs.rm_file(p)
+    except FileNotFoundError:
+        raise
+    except Exception as e:  # fsspec backends vary in error types
+        raise OSError(f"remove({path}) failed: {e}") from e
+
+
 def makedirs(path: str) -> None:
     if not has_scheme(path):
         os.makedirs(path, exist_ok=True)

@@ -96,14 +96,16 @@ def _xent_flat_bwd(chunk, V, res, g):
                   == jnp.arange(chunk)[None, :]) & in_chunk[:, None]
         d = (p - onehot) * gf[:, None]                     # dlogits chunk
         d = d.astype(h.dtype)
-        dh = dh + d @ w.astype(h.dtype)
+        # fp32 carry: a bf16 running sum re-rounds after every chunk and
+        # drifts from the dense backward's single fp32-accumulated matmul
+        dh = dh + (d @ w.astype(h.dtype)).astype(jnp.float32)
         dw = d.T @ h                                       # [chunk, H]
         return dh, dw
 
-    dh0 = jnp.zeros_like(h)
+    dh0 = jnp.zeros(h.shape, jnp.float32)
     dh, dws = lax.scan(body, dh0, jnp.arange(n))
     dtable = dws.reshape(table.shape).astype(table.dtype)
-    return dh, dtable, None
+    return dh.astype(h.dtype), dtable, None
 
 
 _xent_flat.defvjp(_xent_flat_fwd, _xent_flat_bwd)

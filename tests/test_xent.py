@@ -134,3 +134,19 @@ def test_gpt_hidden_plus_chunked_xent_matches_logits_loss():
     gc = jax.grad(loss_chunked)(params)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         a, b, rtol=5e-4, atol=1e-5), gd, gc)
+
+
+def test_bf16_gradients_track_fp32_reference():
+    # many chunks: the fp32 dh carry must keep bf16 grads near the fp32 ones
+    V, H = 256, 16
+    h32 = jax.random.normal(jax.random.key(0), (4, 8, H))
+    t32 = jax.random.normal(jax.random.key(1), (V, H))
+    y = jax.random.randint(jax.random.key(2), (4, 8), 0, V)
+    gh32 = jax.grad(lambda h: tied_softmax_xent(
+        h, t32, y, chunk_size=16).mean())(h32)
+    gh16 = jax.grad(lambda h: tied_softmax_xent(
+        h, t32.astype(jnp.bfloat16), y, chunk_size=16).mean())(
+            h32.astype(jnp.bfloat16))
+    # bf16 inputs cost ~1e-2 relative noise; chunk-count must not amplify it
+    np.testing.assert_allclose(np.asarray(gh16, np.float32), gh32,
+                               rtol=0.1, atol=0.02)

@@ -487,13 +487,21 @@ class Dataset:
 
 
 class CheckpointableIterator:
-    """See :meth:`Dataset.checkpointable`."""
+    """See :meth:`Dataset.checkpointable` (accepts any iterable source)."""
 
-    def __init__(self, ds: "Dataset", state: dict | None = None):
-        consumed = int(state.get("elements_consumed", 0)) if state else 0
-        self._it = iter(ds)
-        for _ in range(consumed):  # deterministic replay of the prefix
-            next(self._it)
+    _DONE = object()
+
+    def __init__(self, source, state: dict | None = None):
+        target = int(state.get("elements_consumed", 0)) if state else 0
+        self._it = iter(source)
+        # deterministic replay of the prefix; a source that shrank since
+        # the state was saved stops early (position = what was skippable)
+        # rather than raising StopIteration out of a constructor
+        consumed = 0
+        for _ in range(target):
+            if next(self._it, self._DONE) is self._DONE:
+                break
+            consumed += 1
         self._count = consumed
 
     def __iter__(self):
@@ -503,6 +511,11 @@ class CheckpointableIterator:
         item = next(self._it)
         self._count += 1
         return item
+
+    @property
+    def position(self) -> int:
+        """Elements consumed so far (including a restored prefix)."""
+        return self._count
 
     def state(self) -> dict:
         """Savable position: pickle/JSON-safe, stable across restarts."""

@@ -198,7 +198,7 @@ class Estimator:
                 if base.endswith(".json") and base[:-5].isdigit() \
                         and int(base[:-5]) not in keep:
                     fsutil.remove(fsutil.join(side_dir, base))
-        except OSError:
+        except Exception:  # best-effort: fsspec backends raise non-OSErrors
             pass
 
     def _load_input_state(self, step: int):
@@ -260,17 +260,19 @@ class Estimator:
                     base = iter(input_fn())
                     if self._pending_input_resume is not None:
                         # restart resume: skip this epoch's already-trained
-                        # prefix (deterministic replay; counted in "data")
+                        # prefix (deterministic replay via the data layer's
+                        # CheckpointableIterator; counted in "data")
+                        from tensorflowonspark_tpu.data import (
+                            CheckpointableIterator)
+
                         resume = self._pending_input_resume
                         self._pending_input_resume = None  # first epoch only
                         epoch = int(resume.get("epoch", 0))
                         skip = int(resume.get("batches", 0))
-                        batches = 0
+                        base = CheckpointableIterator(
+                            base, {"elements_consumed": skip})
+                        batches = base.position  # < skip if source shrank
                         resumed_skip = skip > 0
-                        for _ in range(skip):
-                            if next(base, _END) is _END:
-                                break
-                            batches += 1
                     it = device_prefetch(base, depth=2, sharding=sharding)
                 while True:
                     with self._goodput.time("data"):

@@ -28,7 +28,7 @@ import re
 from typing import IO
 
 __all__ = ["has_scheme", "open_file", "open_output", "expand_glob",
-           "exists", "isfile", "listdir", "makedirs", "join"]
+           "exists", "isfile", "listdir", "makedirs", "remove", "join"]
 
 _SCHEME_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9+.\-]*://")
 

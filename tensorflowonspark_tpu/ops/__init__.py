@@ -8,8 +8,10 @@ where fusion beyond XLA's pays: attention (the O(T²) memory hog) first.
 
 from tensorflowonspark_tpu.ops.flash_attention import flash_attention
 from tensorflowonspark_tpu.ops.quant import (Int8Array, quantize_int8,
-                                             quantize_params, tree_nbytes)
+                                             quantize_params,
+                                             shard_quantized, tree_nbytes)
 from tensorflowonspark_tpu.ops.xent import tied_softmax_xent
 
 __all__ = ["flash_attention", "Int8Array", "quantize_int8",
-           "quantize_params", "tree_nbytes", "tied_softmax_xent"]
+           "quantize_params", "shard_quantized", "tree_nbytes",
+           "tied_softmax_xent"]

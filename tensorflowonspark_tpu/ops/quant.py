@@ -128,6 +128,34 @@ def quantize_params(params, predicate: Callable | None = None):
     return jax.tree_util.tree_map_with_path(visit, params)
 
 
+def shard_quantized(params, shardings):
+    """Place a quantized pytree on a mesh (tensor-parallel int8 decode).
+
+    ``shardings`` is the tree ``parallel.sharding.flax_shardings`` builds
+    for the *unquantized* params (``NamedSharding`` leaves).  ``q`` takes
+    its kernel's sharding verbatim; ``scale`` takes the same spec with the
+    contraction axis (−2, size 1 after quantization) dropped to ``None``.
+    Plain leaves are ``device_put`` with their sharding unchanged.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def place(leaf, sh):
+        if sh is None:
+            return leaf
+        if not isinstance(leaf, Int8Array):
+            return jax.device_put(leaf, sh)
+        spec = tuple(sh.spec) + (None,) * (leaf.ndim - len(tuple(sh.spec)))
+        scale_spec = spec[:-2] + (None,) + spec[-1:]
+        return Int8Array(
+            jax.device_put(leaf.q, NamedSharding(sh.mesh, PartitionSpec(*spec))),
+            jax.device_put(leaf.scale,
+                           NamedSharding(sh.mesh, PartitionSpec(*scale_spec))))
+
+    return jax.tree.map(place, params, shardings,
+                        is_leaf=lambda x: isinstance(x, Int8Array))
+
+
 def tree_nbytes(params) -> int:
     """Total parameter bytes (Int8Array-aware) — for compression reports."""
     leaves = jax.tree.leaves(

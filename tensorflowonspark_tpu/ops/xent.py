@@ -46,7 +46,8 @@ def _lse_and_label_logit(h, table, labels, chunk, V):
     def body(carry, c):
         m, l, ll = carry
         w = lax.dynamic_slice_in_dim(table, c * chunk, chunk, 0)  # [chunk, H]
-        s = (h @ w.astype(h.dtype).T).astype(jnp.float32)         # [N, chunk]
+        s = jnp.matmul(h, w.astype(h.dtype).T,
+                       preferred_element_type=jnp.float32)        # [N, chunk]
         valid = _col_mask(c, chunk, V)
         if valid is not None:  # ragged tail: padded cols can't win
             s = jnp.where(valid[None, :], s, -jnp.inf)
@@ -85,7 +86,8 @@ def _xent_flat_bwd(chunk, V, res, g):
 
     def body(dh, c):
         w = lax.dynamic_slice_in_dim(table, c * chunk, chunk, 0)
-        s = (h @ w.astype(h.dtype).T).astype(jnp.float32)
+        s = jnp.matmul(h, w.astype(h.dtype).T,
+                       preferred_element_type=jnp.float32)
         valid = _col_mask(c, chunk, V)
         if valid is not None:
             s = jnp.where(valid[None, :], s, -jnp.inf)
@@ -96,10 +98,12 @@ def _xent_flat_bwd(chunk, V, res, g):
                   == jnp.arange(chunk)[None, :]) & in_chunk[:, None]
         d = (p - onehot) * gf[:, None]                     # dlogits chunk
         d = d.astype(h.dtype)
-        # fp32 carry: a bf16 running sum re-rounds after every chunk and
-        # drifts from the dense backward's single fp32-accumulated matmul
-        dh = dh + (d @ w.astype(h.dtype)).astype(jnp.float32)
-        dw = d.T @ h                                       # [chunk, H]
+        # fp32 carry + fp32 MXU accumulation: a bf16 running sum (or a
+        # bf16-rounded per-chunk product) drifts from the dense backward's
+        # single fp32-accumulated matmul as the chunk count grows
+        dh = dh + jnp.matmul(d, w.astype(h.dtype),
+                             preferred_element_type=jnp.float32)
+        dw = jnp.matmul(d.T, h, preferred_element_type=jnp.float32)
         return dh, dw
 
     dh0 = jnp.zeros(h.shape, jnp.float32)

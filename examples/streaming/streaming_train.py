@@ -1,0 +1,117 @@
+"""Online training on an unbounded stream, stopped from the driver.
+
+Reference: the Spark-Streaming mode of ``TFCluster.py`` — ``train(rdd,
+num_epochs=0)`` feeds forever (each micro-batch a new "RDD") and
+``shutdown``'s streaming path stops the feed from the driver when the
+StreamingContext ends.  Here the same contract: a background feeder thread
+streams synthetic (x, y) chunks with ``num_epochs=0``, workers run an
+online SGD loop until ``DataFeed.should_stop()``, and the driver calls
+``cluster.stop_feed()`` after a deadline — no worker-side ``terminate()``
+involved.
+
+Run:
+
+    python examples/streaming/streaming_train.py --cpu --cluster_size 2 \
+        --stream_seconds 3
+"""
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def main_fun(args, ctx):
+    """Online linear regression on whatever the stream delivers."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    tx = optax.sgd(0.05)
+    params = {"w": jnp.zeros((4,)), "b": jnp.zeros(())}
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            pred = x @ p["w"] + p["b"]
+            return jnp.mean((pred - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    feed = ctx.get_data_feed(train_mode=True)
+    batches, loss = 0, float("nan")
+    while not feed.should_stop():
+        try:
+            # short timeout keeps the poll responsive; a quiet stretch on a
+            # live stream (micro-batch gap, stop racing shutdown) re-polls
+            batch = feed.next_batch(args.batch_size, timeout=10)
+        except TimeoutError:
+            continue
+        if not batch:
+            continue
+        x = np.stack([b[0] for b in batch]).astype(np.float32)
+        y = np.asarray([b[1] for b in batch], np.float32)
+        params, opt_state, loss = step(params, opt_state, x, y)
+        batches += 1
+    print(f"node {ctx.executor_id}: stream ended after {batches} batches, "
+          f"final loss {float(loss):.4f}", flush=True)
+    assert batches > 0, "stream delivered no data before stop"
+
+
+def stream(args):
+    """Unbounded micro-batch source (the StreamingContext stand-in)."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=4).astype(np.float32)
+    while True:  # one micro-batch per call; train(num_epochs=0) repeats us
+        x = rng.normal(size=(args.batch_size, 4)).astype(np.float32)
+        yield from ((xi, float(xi @ w_true)) for xi in x)
+
+
+if __name__ == "__main__":
+    from tensorflowonspark_tpu import InputMode, TPUCluster
+    from tensorflowonspark_tpu.cluster import Partitioned
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=2)
+    p.add_argument("--batch_size", type=int, default=16)
+    p.add_argument("--stream_seconds", type=float, default=3.0)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    worker_env = {"JAX_PLATFORMS": "cpu"} if args.cpu else None
+    cluster = TPUCluster.run(main_fun, args, args.cluster_size,
+                             input_mode=InputMode.SPARK,
+                             worker_env=worker_env, reservation_timeout=60)
+
+    # Spark-Streaming analogue: every foreachRDD tick slices a FRESH
+    # micro-batch off the source and feeds it as one train() round; the
+    # loop runs on a background thread until the driver stops the stream.
+    src = stream(args)
+    stopping = threading.Event()
+
+    def feed_stream():
+        while not stopping.is_set():
+            micro = Partitioned(
+                [[next(src) for _ in range(args.batch_size)]
+                 for _ in range(args.cluster_size)])
+            cluster.train(micro, num_epochs=1)
+
+    feeder = threading.Thread(target=feed_stream, daemon=True)
+    feeder.start()
+
+    time.sleep(args.stream_seconds)  # ... the stream runs ...
+    stopping.set()
+    cluster.stop_feed()              # driver-side stop, no worker terminate
+    feeder.join(timeout=30)
+    cluster.shutdown(timeout=120)
+    print("streaming_train: done")

@@ -111,10 +111,10 @@ def _default_predicate(path: tuple, leaf) -> bool:
 def quantize_params(params, predicate: Callable | None = None):
     """Quantize matching leaves of a params pytree to :class:`Int8Array`.
 
-    Flax ``Partitioned`` metadata boxes are unboxed first (generation /
-    inference doesn't need them; pass unquantized params where GSPMD
-    sharding of the quantized tree matters and shard ``q``/``scale``
-    explicitly).  ``predicate(path, leaf) -> bool`` overrides the default
+    Flax ``Partitioned`` metadata boxes are unboxed first; to place the
+    quantized tree on a mesh (tensor-parallel int8 decode), pass the
+    result through :func:`shard_quantized` with the unquantized tree's
+    shardings.  ``predicate(path, leaf) -> bool`` overrides the default
     "2D+ leaves named 'kernel'" rule.
     """
     if _nn_meta is not None:
@@ -137,7 +137,6 @@ def shard_quantized(params, shardings):
     contraction axis (−2, size 1 after quantization) dropped to ``None``.
     Plain leaves are ``device_put`` with their sharding unchanged.
     """
-    import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
     def place(leaf, sh):

@@ -145,3 +145,10 @@ def test_inception_imagenet(tmp_path):
                "--num_classes", "12", "--num_samples", "16",
                "--model_dir", str(tmp_path / "incep"), timeout=600)
     assert "inception_imagenet: done" in out
+
+
+def test_streaming_train_driver_side_stop():
+    out = _run("streaming/streaming_train.py", "--cluster_size", "2",
+               "--stream_seconds", "2", "--batch_size", "8", timeout=300)
+    assert "streaming_train: done" in out
+    assert "stream ended after" in out

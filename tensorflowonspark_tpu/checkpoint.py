@@ -216,6 +216,56 @@ def _abstract(tree):
     return jax.tree.map(_leaf, tree)
 
 
+_INT8_KEYS = frozenset({"q", "scale"})
+
+
+def _plainify_int8(params):
+    """Replace ``ops.quant.Int8Array`` leaves with ``{"q", "scale"}`` dicts
+    (serializable by jax.export and orbax alike).  Returns
+    ``(tree, had_any)``."""
+    import jax
+
+    try:
+        from tensorflowonspark_tpu.ops.quant import Int8Array
+    except ImportError:  # pragma: no cover
+        return params, False
+    found = []
+
+    def plain(leaf):
+        if isinstance(leaf, Int8Array):
+            found.append(True)
+            return {"q": leaf.q, "scale": leaf.scale}
+        return leaf
+
+    out = jax.tree.map(plain, params,
+                       is_leaf=lambda x: isinstance(x, Int8Array))
+    return out, bool(found)
+
+
+def _requant_int8(params):
+    """Inverse of :func:`_plainify_int8`: rebuild lazy-dequant wrappers so
+    unmodified model code consumes the int8 weights."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.ops.quant import Int8Array
+
+    def is_q(node):
+        return (isinstance(node, dict) and set(node) == _INT8_KEYS
+                and getattr(node["q"], "dtype", None) == jnp.int8)
+
+    def walk(node):  # exact inverse of _plainify_int8 over any containers
+        if is_q(node):
+            return Int8Array(node["q"], node["scale"])
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        return node
+
+    return walk(params)
+
+
 def export_model(export_dir: str,
                  fn: Callable,
                  params,
@@ -259,6 +309,13 @@ def export_model(export_dir: str,
     except ImportError:
         pass
 
+    # int8-quantized exports: jax.export can't serialize the Int8Array
+    # pytreedef (custom node) and orbax round-trips it as a plain dict
+    # anyway, so store {"q", "scale"} dicts and rebuild the lazy-dequant
+    # wrapper inside each traced signature — the serving artifact stays
+    # self-contained and the weights stay int8 on disk and in HBM.
+    params, had_quant = _plainify_int8(params)
+
     # parameters (orbax pytree) — loadable standalone
     import orbax.checkpoint as ocp
 
@@ -268,6 +325,11 @@ def export_model(export_dir: str,
 
     signatures = {signature_name: (fn, example_inputs)}
     signatures.update(extra_signatures or {})
+    if had_quant:
+        signatures = {
+            name: ((lambda f: lambda p, *a: f(_requant_int8(p), *a))(sig_fn),
+                   sig_inputs)
+            for name, (sig_fn, sig_inputs) in signatures.items()}
 
     meta: dict[str, Any] = {"format_version": _FORMAT_VERSION,
                             "tags": sorted(tags), "signatures": {}}

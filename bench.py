@@ -328,12 +328,12 @@ def bench_gpt_decode() -> dict | None:
 
     gen = jax.jit(greedy_generate, static_argnums=(0, 3))
 
-    def timed(p, iters=3):
-        out = gen(cfg, p, prompt, NEW)
+    def timed(p, c=cfg, iters=3):
+        out = gen(c, p, prompt, NEW)
         out.block_until_ready()  # compile + warmup
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = gen(cfg, p, prompt, NEW)
+            out = gen(c, p, prompt, NEW)
         out.block_until_ready()
         return (time.perf_counter() - t0) / iters
 
@@ -346,13 +346,26 @@ def bench_gpt_decode() -> dict | None:
               "device": jax.devices()[0].device_kind}
     log(f"bench: gpt decode {tps:.0f} tok/s (batch {B})")
     try:
-        dt_q = timed(jax.device_put(quantize_params(params)))
+        qp = jax.device_put(quantize_params(params))
+        dt_q = timed(qp)
         result["int8_tokens_per_sec"] = round(B * NEW / dt_q, 1)
         result["int8_vs_bf16"] = round(dt / dt_q, 3)
         log(f"bench: gpt int8 decode {B * NEW / dt_q:.0f} tok/s "
             f"({dt / dt_q:.2f}x bf16)")
     except Exception as e:
-        log(f"bench: int8 decode failed ({e!r})")
+        log(f"bench: int8 weight-only decode failed ({e!r})")
+        qp = None
+    if qp is not None:
+        try:
+            # int8 weights AND int8 KV cache (long-context decode regime)
+            import dataclasses
+
+            dt_kv = timed(qp, dataclasses.replace(cfg, kv_cache_int8=True))
+            result["int8_kv_tokens_per_sec"] = round(B * NEW / dt_kv, 1)
+            result["int8_kv_vs_bf16"] = round(dt / dt_kv, 3)
+            log(f"bench: gpt int8+int8kv decode {B * NEW / dt_kv:.0f} tok/s")
+        except Exception as e:
+            log(f"bench: int8 KV-cache decode failed ({e!r})")
     os.makedirs(os.path.join(REPO, "bench_artifacts"), exist_ok=True)
     with open(os.path.join(REPO, "bench_artifacts", "gpt_decode.json"), "w") as f:
         json.dump(result, f, indent=2)

@@ -245,20 +245,25 @@ def _plainify_int8(params):
 def _requant_int8(params):
     """Inverse of :func:`_plainify_int8`: rebuild lazy-dequant wrappers so
     unmodified model code consumes the int8 weights."""
-    import jax
     import jax.numpy as jnp
+    from collections.abc import Mapping
 
     from tensorflowonspark_tpu.ops.quant import Int8Array
 
     def is_q(node):
-        return (isinstance(node, dict) and set(node) == _INT8_KEYS
+        return (isinstance(node, Mapping) and set(node.keys()) == _INT8_KEYS
                 and getattr(node["q"], "dtype", None) == jnp.int8)
 
-    def walk(node):  # exact inverse of _plainify_int8 over any containers
+    def walk(node):
+        # inverse of _plainify_int8 over the containers a params tree can
+        # hold: any Mapping (dict/FrozenDict/OrderedDict — rebuilt via the
+        # same type), namedtuples, lists/tuples
         if is_q(node):
             return Int8Array(node["q"], node["scale"])
-        if isinstance(node, dict):
-            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, Mapping):
+            return type(node)({k: walk(v) for k, v in node.items()})
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            return type(node)(*(walk(v) for v in node))
         if isinstance(node, (list, tuple)):
             return type(node)(walk(v) for v in node)
         return node

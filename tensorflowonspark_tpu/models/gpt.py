@@ -53,6 +53,11 @@ class GPTConfig:
     # axis (``layers/...`` instead of ``layer_{i}/...``).
     scan_layers: bool = False
     remat: bool = False
+    # Store the decode KV cache as int8 with per-(position, head) scales:
+    # at long context the cache — 2·L·B·T·H·D·2 bytes read per token —
+    # outweighs the weights in HBM traffic, and decode is HBM-bound;
+    # int8 halves it.  XLA fuses the dequantize into the attention reads.
+    kv_cache_int8: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -75,19 +80,45 @@ class CausalSelfAttention(nn.Module):
         if self.decode:
             # Static-shape KV cache: [B, max_len, H, D] per layer; `index`
             # is the write position.  T==1 per decode step.
-            ck = self.variable("cache", "k", jnp.zeros,
-                               (B, cfg.max_position_embeddings, H, D), cfg.dtype)
-            cv = self.variable("cache", "v", jnp.zeros,
-                               (B, cfg.max_position_embeddings, H, D), cfg.dtype)
+            L = cfg.max_position_embeddings
             ci = self.variable("cache", "index",
                                lambda: jnp.zeros((), jnp.int32))
             idx = ci.value
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            if cfg.kv_cache_int8:
+                # int8 values + fp32 scale per (batch, position, head);
+                # symmetric over D.  Dequant happens inside the attention
+                # einsum reads, so HBM sees int8 only.
+                def write(vq_ref, vs_ref, x):
+                    s = jnp.max(jnp.abs(x), axis=-1, keepdims=True) \
+                        .astype(jnp.float32) / 127.0 + 1e-12
+                    q8 = jnp.round(x.astype(jnp.float32) / s).astype(jnp.int8)
+                    vq_ref.value = jax.lax.dynamic_update_slice(
+                        vq_ref.value, q8, (0, idx, 0, 0))
+                    vs_ref.value = jax.lax.dynamic_update_slice(
+                        vs_ref.value, s, (0, idx, 0, 0))
+                    return vq_ref.value.astype(jnp.float32) * vs_ref.value
+
+                ckq = self.variable("cache", "k_q", jnp.zeros,
+                                    (B, L, H, D), jnp.int8)
+                cks = self.variable("cache", "k_s", jnp.zeros,
+                                    (B, L, H, 1), jnp.float32)
+                cvq = self.variable("cache", "v_q", jnp.zeros,
+                                    (B, L, H, D), jnp.int8)
+                cvs = self.variable("cache", "v_s", jnp.zeros,
+                                    (B, L, H, 1), jnp.float32)
+                k_all = write(ckq, cks, k)
+                v_all = write(cvq, cvs, v)
+            else:
+                ck = self.variable("cache", "k", jnp.zeros,
+                                   (B, L, H, D), cfg.dtype)
+                cv = self.variable("cache", "v", jnp.zeros,
+                                   (B, L, H, D), cfg.dtype)
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+                k_all, v_all = ck.value, cv.value
             ci.value = idx + T
-            k_all, v_all = ck.value, cv.value
             # attend only to written positions (<= current index)
             k_pos = jnp.arange(cfg.max_position_embeddings)
             visible = k_pos[None, :] <= (idx + jnp.arange(T))[:, None]  # [T, L]

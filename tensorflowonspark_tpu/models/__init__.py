@@ -26,4 +26,5 @@ from tensorflowonspark_tpu.models.bert import (Bert, BertConfig,
 from tensorflowonspark_tpu.models.inception import InceptionV3  # noqa: F401
 from tensorflowonspark_tpu.models.wide_deep import WideDeep  # noqa: F401
 from tensorflowonspark_tpu.models.gpt import (GPT, GPTConfig,  # noqa: F401
-                                              greedy_generate, init_cache)
+                                              beam_generate, greedy_generate,
+                                              init_cache, sample_generate)

@@ -287,6 +287,103 @@ def greedy_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int):
                      lambda logits, i: jnp.argmax(logits, axis=-1))
 
 
+def beam_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
+                  *, num_beams: int = 4, eos_id: int | None = None,
+                  return_scores: bool = False):
+    """Beam-search decode: ONE compiled program, like the other decoders.
+
+    Beams ride the batch axis (``B·K`` rows) so every step is the same
+    static-shape cached forward the greedy path uses; the per-step beam
+    reorder is a gather over the cache's leading axis.  The prompt is
+    prefilled ONCE at batch ``B`` and the cache tiled to ``B·K`` — no
+    K-fold prefill cost.  With ``eos_id`` a finished beam is frozen (only
+    its EOS continuation survives, score unchanged).  Returns the best
+    beam ``[B, T0 + max_new_tokens]`` (and per-sequence log-prob scores
+    ``[B]`` when ``return_scores``).
+    """
+    B, T0 = prompt_ids.shape
+    K = int(num_beams)
+    if K < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if max_new_tokens <= 0:
+        return (prompt_ids, jnp.zeros((B,))) if return_scores else prompt_ids
+    total = T0 + max_new_tokens
+    if total > cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) = {total} "
+            f"exceeds max_position_embeddings ({cfg.max_position_embeddings})")
+    model = GPT(cfg, decode=True)
+    V = cfg.vocab_size
+    N = max_new_tokens
+    NEG = jnp.float32(-1e30)
+
+    def map_cache_batch(cache, batch, fn):
+        """Apply ``fn(x, axis)`` to every batch-carrying cache leaf.  Under
+        ``scan_layers`` the stacked per-layer leaves (under "layers") carry
+        batch on axis 1 behind the layer axis; path-based detection, not
+        shape-matching, so num_layers == batch coincidences can't misfire.
+        Stacked scalars (per-layer ``index``, shape [layers]) fall through
+        the ndim check."""
+        def visit(path, x):
+            top = getattr(path[0], "key", None) if path else None
+            axis = 1 if (cfg.scan_layers and top == "layers") else 0
+            if x.ndim > axis and x.shape[axis] == batch:
+                return fn(x, axis)
+            return x
+        return jax.tree_util.tree_map_with_path(visit, cache)
+
+    # prefill at batch B, then tile every batch axis of the cache to B*K
+    cache = init_cache(cfg, params, B)
+    logits, vars_ = model.apply({"params": params, "cache": cache},
+                                prompt_ids, mutable=["cache"])
+    logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [B, V]
+    cache = map_cache_batch(vars_["cache"], B,
+                            lambda x, ax: jnp.repeat(x, K, axis=ax))
+    frozen = jnp.full((V,), NEG).at[eos_id].set(0.0) \
+        if eos_id is not None else None
+
+    # beam 0 holds the top-1, beams 1.. the runners-up; all live
+    scores, tok = jax.lax.top_k(logp0, K)                  # [B, K] each
+    seqs = jnp.zeros((B, K, N), jnp.int32)
+    seqs = seqs.at[:, :, 0].set(tok)
+    finished = (tok == eos_id) if eos_id is not None \
+        else jnp.zeros((B, K), bool)
+
+    def step(carry, i):
+        seqs, scores, tok, finished, cache = carry
+        logits, vars_ = model.apply(
+            {"params": params, "cache": cache},
+            tok.reshape(B * K)[:, None], mutable=["cache"])
+        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32)) \
+            .reshape(B, K, V)
+        if eos_id is not None:
+            # frozen beams: only the EOS continuation survives, at cost 0
+            logp = jnp.where(finished[:, :, None], frozen[None, None], logp)
+        cand = scores[:, :, None] + logp                    # [B, K, V]
+        scores, idx = jax.lax.top_k(cand.reshape(B, K * V), K)
+        parent, tok = idx // V, idx % V                     # [B, K] each
+        # reorder beam state (and the cache rows) by parent
+        take = lambda a: jnp.take_along_axis(a, parent, axis=1)  # noqa: E731
+        seqs = jnp.take_along_axis(
+            seqs, parent[:, :, None], axis=1).at[:, :, i].set(tok)
+        finished = take(finished) | ((tok == eos_id) if eos_id is not None
+                                     else False)
+        flat_parent = (jnp.arange(B)[:, None] * K + parent).reshape(B * K)
+        cache = map_cache_batch(
+            vars_["cache"], B * K,
+            lambda x, ax: jnp.take(x, flat_parent, axis=ax))
+        return (seqs, scores, tok, finished, cache), None
+
+    (seqs, scores, _, _, _), _ = jax.lax.scan(
+        step, (seqs, scores, tok, finished, cache), jnp.arange(1, N))
+    best = jnp.argmax(scores, axis=-1)                      # [B]
+    out = jnp.take_along_axis(seqs, best[:, None, None], axis=1)[:, 0]
+    out = jnp.concatenate([prompt_ids, out], axis=1)
+    if return_scores:
+        return out, jnp.take_along_axis(scores, best[:, None], axis=1)[:, 0]
+    return out
+
+
 def sample_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
                     rng, *, temperature: float = 1.0, top_k: int | None = None):
     """Stochastic decode: temperature-scaled (and optionally top-k

@@ -35,6 +35,13 @@ class GPTConfig:
     hidden_size: int = 768
     num_layers: int = 12
     num_heads: int = 12
+    # Grouped-query attention: number of K/V heads (None = num_heads, i.e.
+    # plain MHA; 1 = MQA).  Shrinks the decode KV cache — and its HBM
+    # traffic, the decode bound — by num_heads/num_kv_heads; composes with
+    # ``kv_cache_int8``.  On the decode and dense paths query heads attend
+    # in groups via a grouped einsum (repeated K/V never materialise); a
+    # custom ``attention_fn`` gets K/V broadcast to num_heads once.
+    num_kv_heads: int | None = None
     intermediate_size: int = 3072
     max_position_embeddings: int = 1024
     dropout_rate: float = 0.0
@@ -73,13 +80,35 @@ class CausalSelfAttention(nn.Module):
         cfg = self.cfg
         B, T, _ = x.shape
         H, D = cfg.num_heads, cfg.head_dim
+        Hkv = cfg.num_kv_heads or H
+        if H % Hkv:
+            raise ValueError(
+                f"num_heads ({H}) must be divisible by num_kv_heads ({Hkv})")
+        G = H // Hkv  # query heads per K/V head (1 = MHA, H = MQA)
         q = _dense(H * D, (None, "tp"), cfg.dtype, "query")(x).reshape(B, T, H, D)
-        k = _dense(H * D, (None, "tp"), cfg.dtype, "key")(x).reshape(B, T, H, D)
-        v = _dense(H * D, (None, "tp"), cfg.dtype, "value")(x).reshape(B, T, H, D)
+        k = _dense(Hkv * D, (None, "tp"), cfg.dtype, "key")(x) \
+            .reshape(B, T, Hkv, D)
+        v = _dense(Hkv * D, (None, "tp"), cfg.dtype, "value")(x) \
+            .reshape(B, T, Hkv, D)
+
+        def grouped_attention(q, k_all, v_all, mask):
+            """``q [B,T,H,D]`` vs ``k/v [B,S,Hkv,D]``: query heads attend
+            in groups of G per K/V head — the broadcast happens inside the
+            einsum, so the repeated K/V never materialise."""
+            qg = q.reshape(B, T, Hkv, G, D).astype(jnp.float32)
+            s = jnp.einsum("btkgd,bskd->bkgts", qg,
+                           k_all.astype(jnp.float32)) * (D ** -0.5)
+            s = jnp.where(mask[None, None, None], s, -1e30)
+            p = nn.softmax(s, axis=-1)
+            if not self.decode:
+                p = nn.Dropout(cfg.dropout_rate, deterministic=not train)(p)
+            ctx = jnp.einsum("bkgts,bskd->btkgd", p,
+                             v_all.astype(jnp.float32))
+            return ctx.reshape(B, T, H, D)
 
         if self.decode:
-            # Static-shape KV cache: [B, max_len, H, D] per layer; `index`
-            # is the write position.  T==1 per decode step.
+            # Static-shape KV cache: [B, max_len, Hkv, D] per layer;
+            # `index` is the write position.  T==1 per decode step.
             L = cfg.max_position_embeddings
             ci = self.variable("cache", "index",
                                lambda: jnp.zeros((), jnp.int32))
@@ -99,20 +128,20 @@ class CausalSelfAttention(nn.Module):
                     return vq_ref.value.astype(jnp.float32) * vs_ref.value
 
                 ckq = self.variable("cache", "k_q", jnp.zeros,
-                                    (B, L, H, D), jnp.int8)
+                                    (B, L, Hkv, D), jnp.int8)
                 cks = self.variable("cache", "k_s", jnp.zeros,
-                                    (B, L, H, 1), jnp.float32)
+                                    (B, L, Hkv, 1), jnp.float32)
                 cvq = self.variable("cache", "v_q", jnp.zeros,
-                                    (B, L, H, D), jnp.int8)
+                                    (B, L, Hkv, D), jnp.int8)
                 cvs = self.variable("cache", "v_s", jnp.zeros,
-                                    (B, L, H, 1), jnp.float32)
+                                    (B, L, Hkv, 1), jnp.float32)
                 k_all = write(ckq, cks, k)
                 v_all = write(cvq, cvs, v)
             else:
                 ck = self.variable("cache", "k", jnp.zeros,
-                                   (B, L, H, D), cfg.dtype)
+                                   (B, L, Hkv, D), cfg.dtype)
                 cv = self.variable("cache", "v", jnp.zeros,
-                                   (B, L, H, D), cfg.dtype)
+                                   (B, L, Hkv, D), cfg.dtype)
                 ck.value = jax.lax.dynamic_update_slice(
                     ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
                 cv.value = jax.lax.dynamic_update_slice(
@@ -122,22 +151,16 @@ class CausalSelfAttention(nn.Module):
             # attend only to written positions (<= current index)
             k_pos = jnp.arange(cfg.max_position_embeddings)
             visible = k_pos[None, :] <= (idx + jnp.arange(T))[:, None]  # [T, L]
-            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                           k_all.astype(jnp.float32)) * (D ** -0.5)
-            s = jnp.where(visible[None, None], s, -1e30)
-            p = nn.softmax(s, axis=-1)
-            ctx = jnp.einsum("bhqk,bkhd->bqhd", p, v_all.astype(jnp.float32))
+            ctx = grouped_attention(q, k_all, v_all, visible)
         elif cfg.attention_fn is not None:
+            if G > 1:  # kernels take equal head counts; broadcast K/V once
+                k = jnp.repeat(k, G, axis=2)
+                v = jnp.repeat(v, G, axis=2)
             ctx = cfg.attention_fn(q, k, v, causal=True)
         else:
-            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                           k.astype(jnp.float32)) * (D ** -0.5)
             pos = jnp.arange(T)
             causal = pos[:, None] >= pos[None, :]
-            s = jnp.where(causal[None, None], s, -1e30)
-            p = nn.softmax(s, axis=-1)
-            p = nn.Dropout(cfg.dropout_rate, deterministic=not train)(p)
-            ctx = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+            ctx = grouped_attention(q, k, v, causal)
         ctx = ctx.astype(cfg.dtype).reshape(B, T, H * D)
         return _dense(cfg.hidden_size, ("tp", None), cfg.dtype, "out")(ctx)
 

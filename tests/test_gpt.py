@@ -302,3 +302,93 @@ class TestBeamSearch:
             hits = np.where(row == 0)[0]
             if len(hits):  # after the first EOS, only EOS (frozen beam)
                 assert np.all(row[hits[0]:] == 0), row
+
+
+class TestGroupedQueryAttention:
+    @pytest.mark.parametrize("kv_heads", [1, 2])
+    def test_gqa_cached_decode_matches_full_forward(self, kv_heads):
+        import dataclasses
+
+        cfg = dataclasses.replace(_cfg(), num_kv_heads=kv_heads)
+        model = GPT(cfg)
+        ids = jax.random.randint(jax.random.key(0), (2, 10), 0,
+                                 cfg.vocab_size)
+        params = model.init(jax.random.key(1), ids)["params"]
+        full = model.apply({"params": params}, ids)
+
+        dm = GPT(cfg, decode=True)
+        cache = init_cache(cfg, params, batch=2)
+        outs = []
+        for t in range(ids.shape[1]):
+            logits, vars_ = dm.apply({"params": params, "cache": cache},
+                                     ids[:, t:t + 1], mutable=["cache"])
+            cache = vars_["cache"]
+            outs.append(logits)
+        step_logits = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(step_logits),
+                                   np.asarray(full), rtol=2e-4, atol=2e-4)
+
+    def test_gqa_shrinks_cache_and_generates(self):
+        import dataclasses
+
+        base = _cfg()
+        gqa = dataclasses.replace(base, num_kv_heads=1)  # MQA: 4x smaller
+        p_gqa = GPT(gqa).init(jax.random.key(0),
+                              jnp.ones((1, 8), jnp.int32))["params"]
+
+        def nbytes(tree):
+            return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
+        c_base = init_cache(base, _params(base), batch=2)
+        c_gqa = init_cache(gqa, p_gqa, batch=2)
+        assert nbytes(c_gqa) < 0.3 * nbytes(c_base)
+
+        out = greedy_generate(gqa, p_gqa, jnp.ones((2, 4), jnp.int32), 6)
+        assert out.shape == (2, 10)
+
+    def test_gqa_with_int8_kv_and_beam(self):
+        import dataclasses
+
+        from tensorflowonspark_tpu.models.gpt import beam_generate
+
+        cfg = dataclasses.replace(_cfg(), num_kv_heads=2, kv_cache_int8=True)
+        params = GPT(cfg).init(jax.random.key(0),
+                               jnp.ones((1, 8), jnp.int32))["params"]
+        prompt = jax.random.randint(jax.random.key(2), (2, 4), 0,
+                                    cfg.vocab_size)
+        want = greedy_generate(cfg, params, prompt, 6)
+        got = beam_generate(cfg, params, prompt, 6, num_beams=1)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_bad_kv_heads_raises(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(_cfg(), num_kv_heads=3)  # 4 % 3 != 0
+        with pytest.raises(ValueError, match="divisible"):
+            GPT(cfg).init(jax.random.key(0), jnp.ones((1, 4), jnp.int32))
+
+
+    def test_gqa_dense_matches_custom_attention_fn(self):
+        """The attention_fn broadcast path (jnp.repeat of K/V) must agree
+        with the grouped-einsum dense path — head-order parity."""
+        import dataclasses
+
+        def dense_attn(q, k, v, mask=None, causal=False):
+            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                           k.astype(jnp.float32)) / (q.shape[-1] ** 0.5)
+            if causal:
+                pos = jnp.arange(q.shape[1])
+                s = jnp.where((pos[:, None] >= pos[None, :])[None, None],
+                              s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+        base = dataclasses.replace(_cfg(), num_kv_heads=2)
+        withfn = dataclasses.replace(base, attention_fn=dense_attn)
+        ids = jax.random.randint(jax.random.key(0), (2, 8), 0,
+                                 base.vocab_size)
+        params = GPT(base).init(jax.random.key(1), ids)["params"]
+        np.testing.assert_allclose(
+            np.asarray(GPT(withfn).apply({"params": params}, ids)),
+            np.asarray(GPT(base).apply({"params": params}, ids)),
+            rtol=2e-4, atol=2e-4)

@@ -46,6 +46,13 @@ class GPTConfig:
     max_position_embeddings: int = 1024
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
+    # "learned" = GPT-2-style absolute position table; "rope" = rotary
+    # embeddings applied to q/k (no position table at all) — relative
+    # positions by construction, the long-context-friendly default of
+    # modern decoders.  K is cached post-rotation, so decode matches the
+    # full forward exactly.
+    pos_encoding: str = "learned"
+    rope_base: float = 10000.0
     # Optional attention override for the full-sequence TRAINING path
     # (``decode=False``), signature ``(q, k, v, mask=None, causal=...) ->
     # out``.  The decode path — including prefill through ``decode=True``
@@ -66,9 +73,34 @@ class GPTConfig:
     # int8 halves it.  XLA fuses the dequantize into the attention reads.
     kv_cache_int8: bool = False
 
+    def __post_init__(self):
+        if self.pos_encoding not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_encoding must be 'learned' or 'rope', "
+                f"got {self.pos_encoding!r}")
+        if self.pos_encoding == "rope" and self.head_dim % 2:
+            raise ValueError(
+                f"rope needs an even head_dim, got {self.head_dim} "
+                f"(hidden_size {self.hidden_size} / num_heads {self.num_heads})")
+
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+
+def _rope(x, positions, base: float):
+    """Rotary embedding: rotate feature pairs of ``x [B, T, H, D]`` by
+    position-dependent angles (``positions [T]``).  fp32 trig, result in
+    ``x.dtype``."""
+    D = x.shape[-1]
+    half = D // 2
+    freq = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freq[None, :]  # [T, half]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
 
 
 class CausalSelfAttention(nn.Module):
@@ -91,6 +123,16 @@ class CausalSelfAttention(nn.Module):
         v = _dense(Hkv * D, (None, "tp"), cfg.dtype, "value")(x) \
             .reshape(B, T, Hkv, D)
 
+        ci = self.variable("cache", "index",
+                           lambda: jnp.zeros((), jnp.int32)) \
+            if self.decode else None
+        if cfg.pos_encoding == "rope":
+            # rotate q/k by absolute position; K is cached POST-rotation,
+            # so incremental decode sees identical keys to the full forward
+            positions = (ci.value if ci is not None else 0) + jnp.arange(T)
+            q = _rope(q, positions, cfg.rope_base)
+            k = _rope(k, positions, cfg.rope_base)
+
         def grouped_attention(q, k_all, v_all, mask):
             """``q [B,T,H,D]`` vs ``k/v [B,S,Hkv,D]``: query heads attend
             in groups of G per K/V head — the broadcast happens inside the
@@ -110,8 +152,6 @@ class CausalSelfAttention(nn.Module):
             # Static-shape KV cache: [B, max_len, Hkv, D] per layer;
             # `index` is the write position.  T==1 per decode step.
             L = cfg.max_position_embeddings
-            ci = self.variable("cache", "index",
-                               lambda: jnp.zeros((), jnp.int32))
             idx = ci.value
             if cfg.kv_cache_int8:
                 # int8 values + fp32 scale per (batch, position, head);
@@ -214,18 +254,23 @@ class GPT(nn.Module):
                        dtype=cfg.dtype,
                        embedding_init=nn.with_partitioning(
                            nn.initializers.normal(0.02), cfg.emb_spec))
-        if self.decode:
-            start = self.variable("cache", "pos",
-                                  lambda: jnp.zeros((), jnp.int32))
-            positions = start.value + jnp.arange(T)
-            start.value = start.value + T
+        if cfg.pos_encoding == "rope":
+            # positions live in the attention rotations; no table at all
+            x = tok(input_ids)
         else:
-            positions = jnp.arange(T)
-        pos_emb = self.param(
-            "pos_emb",
-            nn.with_partitioning(nn.initializers.normal(0.02), (None, None)),
-            (cfg.max_position_embeddings, cfg.hidden_size))
-        x = tok(input_ids) + pos_emb[positions].astype(cfg.dtype)
+            if self.decode:
+                start = self.variable("cache", "pos",
+                                      lambda: jnp.zeros((), jnp.int32))
+                positions = start.value + jnp.arange(T)
+                start.value = start.value + T
+            else:
+                positions = jnp.arange(T)
+            pos_emb = self.param(
+                "pos_emb",
+                nn.with_partitioning(nn.initializers.normal(0.02),
+                                     (None, None)),
+                (cfg.max_position_embeddings, cfg.hidden_size))
+            x = tok(input_ids) + pos_emb[positions].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout_rate, deterministic=not train)(x)
         if cfg.scan_layers:
             block_cls = _ScanBlock

@@ -392,3 +392,70 @@ class TestGroupedQueryAttention:
             np.asarray(GPT(withfn).apply({"params": params}, ids)),
             np.asarray(GPT(base).apply({"params": params}, ids)),
             rtol=2e-4, atol=2e-4)
+
+
+class TestRoPE:
+    @pytest.mark.parametrize("scan_layers", [False, True])
+    def test_rope_cached_decode_matches_full_forward(self, scan_layers):
+        import dataclasses
+
+        cfg = dataclasses.replace(_cfg(scan_layers), pos_encoding="rope")
+        model = GPT(cfg)
+        ids = jax.random.randint(jax.random.key(0), (2, 9), 0,
+                                 cfg.vocab_size)
+        params = model.init(jax.random.key(1), ids)["params"]
+        assert "pos_emb" not in params  # no position table under rope
+        full = model.apply({"params": params}, ids)
+
+        dm = GPT(cfg, decode=True)
+        cache = init_cache(cfg, params, batch=2)
+        outs = []
+        for t in range(ids.shape[1]):
+            logits, vars_ = dm.apply({"params": params, "cache": cache},
+                                     ids[:, t:t + 1], mutable=["cache"])
+            cache = vars_["cache"]
+            outs.append(logits)
+        np.testing.assert_allclose(np.asarray(jnp.concatenate(outs, 1)),
+                                   np.asarray(full), rtol=2e-4, atol=2e-4)
+
+    def test_rope_relative_shift_invariance(self):
+        """RoPE scores depend on relative distance only: rotating q/k at
+        positions p and p+s must give identical q·k for any shift s."""
+        from tensorflowonspark_tpu.models.gpt import _rope
+
+        q = jax.random.normal(jax.random.key(0), (1, 6, 2, 16))
+        k = jax.random.normal(jax.random.key(1), (1, 6, 2, 16))
+
+        def scores(shift):
+            pos = jnp.arange(6) + shift
+            qr = _rope(q, pos, 10000.0)
+            kr = _rope(k, pos, 10000.0)
+            return jnp.einsum("bqhd,bkhd->bhqk", qr, kr)
+
+        np.testing.assert_allclose(np.asarray(scores(0)),
+                                   np.asarray(scores(37)), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_rope_generation_and_beam(self):
+        import dataclasses
+
+        from tensorflowonspark_tpu.models.gpt import beam_generate
+
+        cfg = dataclasses.replace(_cfg(), pos_encoding="rope",
+                                  num_kv_heads=2, kv_cache_int8=True)
+        params = GPT(cfg).init(jax.random.key(0),
+                               jnp.ones((1, 8), jnp.int32))["params"]
+        prompt = jax.random.randint(jax.random.key(2), (2, 4), 0,
+                                    cfg.vocab_size)
+        want = greedy_generate(cfg, params, prompt, 6)
+        got = beam_generate(cfg, params, prompt, 6, num_beams=1)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+    def test_bad_pos_encoding_and_odd_head_dim_raise(self):
+        import dataclasses
+
+        with pytest.raises(ValueError, match="pos_encoding"):
+            dataclasses.replace(_cfg(), pos_encoding="rotary")
+        with pytest.raises(ValueError, match="even head_dim"):
+            GPTConfig(hidden_size=40, num_heads=8, pos_encoding="rope")

@@ -53,6 +53,16 @@ class GPTConfig:
     # full forward exactly.
     pos_encoding: str = "learned"
     rope_base: float = 10000.0
+    # "layernorm" (GPT-2) or "rmsnorm" (Llama-class: no mean-centering, no
+    # bias — one fewer reduction on the VPU per sublayer).
+    norm: str = "layernorm"
+    norm_eps: float = 1e-5
+    # "gelu" (GPT-2 2-matmul MLP) or "swiglu" (Llama-class gated MLP:
+    # gate/up/down, silu(gate)*up).  rope+rmsnorm+swiglu+num_kv_heads
+    # covers Llama-class architectures (rotate-half RoPE pairing, the
+    # GPT-NeoX/HF convention; interleaved-pairing checkpoints need their
+    # usual weight permutation at conversion).
+    mlp: str = "gelu"
     # Optional attention override for the full-sequence TRAINING path
     # (``decode=False``), signature ``(q, k, v, mask=None, causal=...) ->
     # out``.  The decode path — including prefill through ``decode=True``
@@ -78,6 +88,12 @@ class GPTConfig:
             raise ValueError(
                 f"pos_encoding must be 'learned' or 'rope', "
                 f"got {self.pos_encoding!r}")
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(
+                f"norm must be 'layernorm' or 'rmsnorm', got {self.norm!r}")
+        if self.mlp not in ("gelu", "swiglu"):
+            raise ValueError(
+                f"mlp must be 'gelu' or 'swiglu', got {self.mlp!r}")
         if self.pos_encoding == "rope" and self.head_dim % 2:
             raise ValueError(
                 f"rope needs an even head_dim, got {self.head_dim} "
@@ -205,6 +221,12 @@ class CausalSelfAttention(nn.Module):
         return _dense(cfg.hidden_size, ("tp", None), cfg.dtype, "out")(ctx)
 
 
+def _norm(cfg: GPTConfig, name: str):
+    if cfg.norm == "rmsnorm":
+        return nn.RMSNorm(epsilon=cfg.norm_eps, dtype=jnp.float32, name=name)
+    return nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32, name=name)
+
+
 class DecoderBlock(nn.Module):
     cfg: GPTConfig
     decode: bool = False
@@ -212,13 +234,21 @@ class DecoderBlock(nn.Module):
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         cfg = self.cfg
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(cfg.dtype)
+        y = _norm(cfg, "ln1")(x).astype(cfg.dtype)
         y = CausalSelfAttention(cfg, self.decode, name="attn")(y, train=train)
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
         x = x + y
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
-        y = _dense(cfg.intermediate_size, (None, "tp"), cfg.dtype, "mlp_up")(y)
-        y = nn.gelu(y)
+        y = _norm(cfg, "ln2")(x).astype(cfg.dtype)
+        if cfg.mlp == "swiglu":
+            gate = _dense(cfg.intermediate_size, (None, "tp"), cfg.dtype,
+                          "mlp_gate")(y)
+            up = _dense(cfg.intermediate_size, (None, "tp"), cfg.dtype,
+                        "mlp_up")(y)
+            y = nn.silu(gate) * up
+        else:
+            y = _dense(cfg.intermediate_size, (None, "tp"), cfg.dtype,
+                       "mlp_up")(y)
+            y = nn.gelu(y)
         y = _dense(cfg.hidden_size, ("tp", None), cfg.dtype, "mlp_down")(y)
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
         return x + y
@@ -296,7 +326,7 @@ class GPT(nn.Module):
             for i in range(cfg.num_layers):
                 x = block_cls(cfg, self.decode, name=f"layer_{i}")(
                     x, train=train)
-        return nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return _norm(cfg, "ln_f")(x)
 
     def __call__(self, input_ids, *, train: bool = False):
         x = self.hidden(input_ids, train=train)

@@ -48,26 +48,30 @@ def test_forward_shape_and_causality(scan_layers):
                            np.asarray(logits2[:, 5:]))
 
 
-@pytest.mark.parametrize("scan_layers", [False, True])
-def test_cached_decode_matches_full_forward(scan_layers):
-    """Teacher-forcing equivalence: feeding tokens one at a time through
-    the KV cache must reproduce the full-sequence logits."""
-    cfg = _cfg(scan_layers)
-    params = _params(cfg)
-    ids = jax.random.randint(jax.random.key(2), (2, 8), 0, cfg.vocab_size)
+def _assert_cached_decode_matches(cfg, params=None, seq_len=8, seed=2):
+    """Shared oracle: token-by-token decode through the KV cache must
+    reproduce the full-sequence logits for any config."""
+    params = _params(cfg) if params is None else params
+    ids = jax.random.randint(jax.random.key(seed), (2, seq_len), 0,
+                             cfg.vocab_size)
     full = GPT(cfg).apply({"params": params}, ids)
-
     model = GPT(cfg, decode=True)
     cache = init_cache(cfg, params, batch=2)
     outs = []
-    for t in range(8):
+    for t in range(seq_len):
         logits, vars_ = model.apply({"params": params, "cache": cache},
                                     ids[:, t:t + 1], mutable=["cache"])
         cache = vars_["cache"]
         outs.append(logits[:, 0])
-    inc = jnp.stack(outs, axis=1)
-    np.testing.assert_allclose(np.asarray(inc), np.asarray(full),
-                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(jnp.stack(outs, axis=1)),
+                               np.asarray(full), rtol=2e-4, atol=2e-4)
+    return params
+
+
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_cached_decode_matches_full_forward(scan_layers):
+    cfg = _cfg(scan_layers)
+    params = _assert_cached_decode_matches(cfg)
     if scan_layers:
         # params carry ONE stacked block, not per-layer copies
         assert "layers" in params and "layer_0" not in params
@@ -309,24 +313,8 @@ class TestGroupedQueryAttention:
     def test_gqa_cached_decode_matches_full_forward(self, kv_heads):
         import dataclasses
 
-        cfg = dataclasses.replace(_cfg(), num_kv_heads=kv_heads)
-        model = GPT(cfg)
-        ids = jax.random.randint(jax.random.key(0), (2, 10), 0,
-                                 cfg.vocab_size)
-        params = model.init(jax.random.key(1), ids)["params"]
-        full = model.apply({"params": params}, ids)
-
-        dm = GPT(cfg, decode=True)
-        cache = init_cache(cfg, params, batch=2)
-        outs = []
-        for t in range(ids.shape[1]):
-            logits, vars_ = dm.apply({"params": params, "cache": cache},
-                                     ids[:, t:t + 1], mutable=["cache"])
-            cache = vars_["cache"]
-            outs.append(logits)
-        step_logits = jnp.concatenate(outs, axis=1)
-        np.testing.assert_allclose(np.asarray(step_logits),
-                                   np.asarray(full), rtol=2e-4, atol=2e-4)
+        _assert_cached_decode_matches(
+            dataclasses.replace(_cfg(), num_kv_heads=kv_heads), seq_len=10)
 
     def test_gqa_shrinks_cache_and_generates(self):
         import dataclasses
@@ -400,23 +388,8 @@ class TestRoPE:
         import dataclasses
 
         cfg = dataclasses.replace(_cfg(scan_layers), pos_encoding="rope")
-        model = GPT(cfg)
-        ids = jax.random.randint(jax.random.key(0), (2, 9), 0,
-                                 cfg.vocab_size)
-        params = model.init(jax.random.key(1), ids)["params"]
+        params = _assert_cached_decode_matches(cfg, seq_len=9)
         assert "pos_emb" not in params  # no position table under rope
-        full = model.apply({"params": params}, ids)
-
-        dm = GPT(cfg, decode=True)
-        cache = init_cache(cfg, params, batch=2)
-        outs = []
-        for t in range(ids.shape[1]):
-            logits, vars_ = dm.apply({"params": params, "cache": cache},
-                                     ids[:, t:t + 1], mutable=["cache"])
-            cache = vars_["cache"]
-            outs.append(logits)
-        np.testing.assert_allclose(np.asarray(jnp.concatenate(outs, 1)),
-                                   np.asarray(full), rtol=2e-4, atol=2e-4)
 
     def test_rope_relative_shift_invariance(self):
         """RoPE scores depend on relative distance only: rotating q/k at
@@ -459,3 +432,44 @@ class TestRoPE:
             dataclasses.replace(_cfg(), pos_encoding="rotary")
         with pytest.raises(ValueError, match="even head_dim"):
             GPTConfig(hidden_size=40, num_heads=8, pos_encoding="rope")
+
+
+class TestLlamaStyleConfig:
+    def _llama_cfg(self, scan_layers=False):
+        return GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                         num_heads=4, num_kv_heads=2, intermediate_size=48,
+                         max_position_embeddings=32, dtype=jnp.float32,
+                         pos_encoding="rope", norm="rmsnorm", mlp="swiglu",
+                         scan_layers=scan_layers, remat=scan_layers)
+
+    @pytest.mark.parametrize("scan_layers", [False, True])
+    def test_cached_decode_matches_full_forward(self, scan_layers):
+        _assert_cached_decode_matches(self._llama_cfg(scan_layers))
+
+    def test_param_structure_and_grads(self):
+        import optax
+
+        cfg = self._llama_cfg()
+        model = GPT(cfg)
+        ids = jax.random.randint(jax.random.key(0), (2, 8), 0,
+                                 cfg.vocab_size)
+        params = model.init(jax.random.key(1), ids)["params"]
+        block = params["layer_0"]
+        assert "mlp_gate" in block and "mlp_up" in block
+        assert "scale" in block["ln1"] and "bias" not in block["ln1"]  # RMS
+
+        def loss(p):
+            logits = model.apply({"params": p}, ids)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], ids[:, 1:]).mean()
+
+        g = jax.grad(loss)(params)
+        flat = jax.tree.leaves(g)
+        assert all(bool(jnp.all(jnp.isfinite(x))) for x in flat)
+        assert any(float(jnp.abs(x).sum()) > 0 for x in flat)
+
+    def test_bad_norm_or_mlp_raises(self):
+        with pytest.raises(ValueError, match="norm"):
+            GPTConfig(norm="batchnorm")
+        with pytest.raises(ValueError, match="mlp"):
+            GPTConfig(mlp="relu")

@@ -29,8 +29,14 @@ def main(args):
                                                  TrainSpec, train_and_evaluate)
     from tensorflowonspark_tpu.models import GPT, GPTConfig, greedy_generate
 
+    modern = args.arch == "llama"
     cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
                     num_layers=2, num_heads=4,
+                    # llama-class: rope + rmsnorm + swiglu + GQA
+                    num_kv_heads=2 if modern else None,
+                    pos_encoding="rope" if modern else "learned",
+                    norm="rmsnorm" if modern else "layernorm",
+                    mlp="swiglu" if modern else "gelu",
                     intermediate_size=args.hidden * 4,
                     max_position_embeddings=args.seq_len * 2,
                     dtype=jnp.float32)
@@ -98,6 +104,9 @@ if __name__ == "__main__":
     p.add_argument("--seq_len", type=int, default=16)
     p.add_argument("--batch_size", type=int, default=16)
     p.add_argument("--max_steps", type=int, default=60)
+    p.add_argument("--arch", choices=["gpt2", "llama"], default="gpt2",
+                   help="gpt2 = learned pos + layernorm + gelu; llama = "
+                        "rope + rmsnorm + swiglu + grouped-query attention")
     p.add_argument("--chunked_xent", action="store_true",
                    help="train with ops.tied_softmax_xent (no [B,T,V] logits)")
     p.add_argument("--model_dir", default="/tmp/gpt_tiny")

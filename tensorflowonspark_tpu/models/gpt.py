@@ -56,7 +56,9 @@ class GPTConfig:
     # "layernorm" (GPT-2) or "rmsnorm" (Llama-class: no mean-centering, no
     # bias — one fewer reduction on the VPU per sublayer).
     norm: str = "layernorm"
-    norm_eps: float = 1e-5
+    # flax's LayerNorm default, so pre-existing layernorm configs keep
+    # bit-identical numerics; Llama-class recipes typically pass 1e-5.
+    norm_eps: float = 1e-6
     # "gelu" (GPT-2 2-matmul MLP) or "swiglu" (Llama-class gated MLP:
     # gate/up/down, silu(gate)*up).  rope+rmsnorm+swiglu+num_kv_heads
     # covers Llama-class architectures (rotate-half RoPE pairing, the

@@ -114,13 +114,24 @@ def test_ring_lm_long_context(tmp_path):
     assert abs(outs["ring"][1] - outs["ulysses"][1]) < 1e-3, outs
 
 
-def test_gpt_tiny(tmp_path):
-    out = _run("gpt/gpt_tiny.py", "--max_steps", "40",
-               "--model_dir", str(tmp_path / "gpt"), timeout=600)
-    assert "gpt_tiny: done" in out
+def _check_gpt_tiny(out):
     import re
+
+    assert "gpt_tiny: done" in out
     m = re.search(r"continuation accuracy (\d\.\d+)", out)
     assert m and float(m.group(1)) >= 0.5, out
+
+
+def test_gpt_tiny(tmp_path):
+    _check_gpt_tiny(_run("gpt/gpt_tiny.py", "--max_steps", "40",
+                         "--model_dir", str(tmp_path / "gpt"), timeout=600))
+
+
+def test_gpt_tiny_llama_arch(tmp_path):
+    _check_gpt_tiny(_run("gpt/gpt_tiny.py", "--max_steps", "40",
+                         "--arch", "llama", "--chunked_xent",
+                         "--model_dir", str(tmp_path / "gpt_l"),
+                         timeout=600))
 
 
 def test_switch_lm_moe(tmp_path):

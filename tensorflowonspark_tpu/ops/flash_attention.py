@@ -43,25 +43,44 @@ def _on_tpu() -> bool:
     return jax.devices()[0].platform == "tpu"
 
 
-def _causal_mask(s, q_block, block_k, qi, j):
+def _causal_mask(s, q_block, block_k, qi, j, window=None):
     bq, bk = s.shape
     q_pos = qi * q_block + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+    keep = q_pos >= k_pos
+    if window is not None:  # sliding window: only the last `window` keys
+        keep &= k_pos > q_pos - window
+    return jnp.where(keep, s, NEG_INF)
+
+
+def _k_span(Tk, causal, window, block_k):
+    """Average keys actually visited per query (for cost estimates)."""
+    if window is not None:
+        return min(Tk, window + block_k)
+    return max(block_k, Tk // 2) if causal else Tk
+
+
+def _k_lo(qi, bq, block_k, window):
+    """First K block a query block can see under a sliding window."""
+    if window is None:
+        return 0
+    return jnp.maximum(0, (qi * bq - (window - 1)) // block_k)
 
 
 # ---------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
-                has_bias):
+                has_bias, window):
     bias_ref, o_ref, lse_ref = rest if has_bias else (None, *rest)
     bq = q_ref.shape[2]
     T = k_ref.shape[2]
     q = q_ref[0, 0]                                       # (bq, D)
     qi = pl.program_id(2)
     nk = T // block_k
+    j0 = 0
     if causal:  # only K blocks at or below this Q block's diagonal
         nk = jnp.minimum(nk, (qi * bq + bq - 1) // block_k + 1)
+        j0 = _k_lo(qi, bq, block_k, window)  # window trims from below
 
     def body(j, carry):
         o, m, l = carry
@@ -72,7 +91,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
         if bias_ref is not None:  # key-padding mask: one VPU pass over s
             s = s + bias_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
         if causal:
-            s = _causal_mask(s, bq, block_k, qi, j)
+            s = _causal_mask(s, bq, block_k, qi, j, window)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -86,7 +105,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
     o0 = jnp.zeros((bq, D), jnp.float32)
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
-    o, m, l = lax.fori_loop(0, nk, body, (o0, m0, l0))
+    o, m, l = lax.fori_loop(j0, nk, body, (o0, m0, l0))
     # A row whose keys are ALL masked keeps m pinned at NEG_INF (any real
     # score sits far above NEG_INF/2): without this check the online softmax
     # degenerates to p=exp(0)=1 on the masked scores and the row silently
@@ -99,7 +118,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
     lse_ref[0, 0] = jnp.where(valid, m + jnp.log(l), -NEG_INF)
 
 
-def _fwd_impl(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+def _fwd_impl(q, k, v, bias, causal, scale, block_q, block_k, interpret,
+              window=None):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     grid = (B, H, Tq // block_q)
@@ -115,7 +135,8 @@ def _fwd_impl(q, k, v, bias, causal, scale, block_q, block_k, interpret):
         args += (bias,)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_k=block_k, has_bias=bias is not None),
+                          block_k=block_k, has_bias=bias is not None,
+                          window=window),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
@@ -127,7 +148,10 @@ def _fwd_impl(q, k, v, bias, causal, scale, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((B, H, Tq, 1), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
-            flops=4 * B * H * Tq * Tk * D, transcendentals=B * H * Tq * Tk,
+            # banded paths do O(Tq·(window+block)) work, not O(Tq·Tk);
+            # causal halves it — keep the scheduler's intensity model honest
+            flops=4 * B * H * Tq * _k_span(Tk, causal, window, block_k) * D,
+            transcendentals=B * H * Tq * _k_span(Tk, causal, window, block_k),
             bytes_accessed=q.dtype.itemsize * B * H * (Tq + Tk) * D * 2),
         interpret=interpret,
     )(*args)
@@ -137,7 +161,7 @@ def _fwd_impl(q, k, v, bias, causal, scale, block_q, block_k, interpret):
 # --------------------------------------------------------------- backward
 
 def _dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
-               has_bias):
+               has_bias, window):
     (bias_ref, do_ref, lse_ref, delta_ref, dq_ref) = \
         rest if has_bias else (None, *rest)
     bq = q_ref.shape[2]
@@ -148,8 +172,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
     delta = delta_ref[0, 0]
     qi = pl.program_id(2)
     nk = T // block_k
+    j0 = 0
     if causal:
         nk = jnp.minimum(nk, (qi * bq + bq - 1) // block_k + 1)
+        j0 = _k_lo(qi, bq, block_k, window)
 
     def body(j, dq):
         k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
@@ -159,7 +185,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
         if bias_ref is not None:
             s = s + bias_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
         if causal:
-            s = _causal_mask(s, bq, block_k, qi, j)
+            s = _causal_mask(s, bq, block_k, qi, j, window)
         p = jnp.exp(s - lse)                               # (bq, bk)
         dp = lax.dot_general(do, v_blk.astype(jnp.float32),
                              (((1,), (1,)), ((), ())),
@@ -170,12 +196,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
                                     preferred_element_type=jnp.float32)
 
     D = q_ref.shape[3]
-    dq = lax.fori_loop(0, nk, body, jnp.zeros((bq, D), jnp.float32))
+    dq = lax.fori_loop(j0, nk, body, jnp.zeros((bq, D), jnp.float32))
     dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(k_ref, v_ref, q_ref, *rest, scale, causal, block_q,
-                has_bias):
+                has_bias, window):
     (bias_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref) = \
         rest if has_bias else (None, *rest)
     bk = k_ref.shape[2]
@@ -187,6 +213,9 @@ def _dkv_kernel(k_ref, v_ref, q_ref, *rest, scale, causal, block_q,
         else bias_ref[0, 0, pl.ds(ki * bk, bk)][None, :]   # (1, bk)
     nq = T // block_q
     start = (ki * bk) // block_q if causal else 0
+    if causal and window is not None:
+        # queries beyond k_pos + window - 1 can't see this key block
+        nq = jnp.minimum(nq, (ki * bk + bk - 1 + window - 1) // block_q + 1)
 
     def body(i, carry):
         dk, dv = carry
@@ -199,7 +228,7 @@ def _dkv_kernel(k_ref, v_ref, q_ref, *rest, scale, causal, block_q,
         if bias is not None:
             s = s + bias
         if causal:
-            s = _causal_mask(s, block_q, bk, i, ki)
+            s = _causal_mask(s, block_q, bk, i, ki, window)
         p = jnp.exp(s - lse)                               # (bq, bk)
         dv = dv + lax.dot_general(p, do_blk, (((0,), (0,)), ((), ())),
                                   preferred_element_type=jnp.float32)
@@ -219,7 +248,7 @@ def _dkv_kernel(k_ref, v_ref, q_ref, *rest, scale, causal, block_q,
 
 
 def _bwd_impl(q, k, v, bias, out, lse, g, causal, scale, block_q, block_k,
-              interpret):
+              interpret, window=None):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32),
@@ -242,7 +271,8 @@ def _bwd_impl(q, k, v, bias, out, lse, g, causal, scale, block_q, block_k,
     ]
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k, has_bias=bias is not None),
+                          block_k=block_k, has_bias=bias is not None,
+                          window=window),
         grid=(B, H, Tq // block_q),
         in_specs=dq_specs,
         out_specs=blk((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
@@ -266,7 +296,8 @@ def _bwd_impl(q, k, v, bias, out, lse, g, causal, scale, block_q, block_k,
     ]
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, has_bias=bias is not None),
+                          block_q=block_q, has_bias=bias is not None,
+                          window=window),
         grid=(B, H, Tk // block_k),
         in_specs=dkv_specs,
         out_specs=[
@@ -284,23 +315,25 @@ def _bwd_impl(q, k, v, bias, out, lse, g, causal, scale, block_q, block_k,
 
 # ----------------------------------------------------- custom-VJP plumbing
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, bias, causal, scale, block_q, block_k, interpret,
+           window):
     out, _ = _fwd_impl(q, k, v, bias, causal, scale, block_q, block_k,
-                       interpret)
+                       interpret, window)
     return out
 
 
-def _flash_fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret,
+               window):
     out, lse = _fwd_impl(q, k, v, bias, causal, scale, block_q, block_k,
-                         interpret)
+                         interpret, window)
     return out, (q, k, v, bias, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res, g):
     q, k, v, bias, out, lse = res
     dq, dk, dv = _bwd_impl(q, k, v, bias, out, lse, g, causal, scale,
-                           block_q, block_k, interpret)
+                           block_q, block_k, interpret, window)
     return dq, dk, dv, None if bias is None else jnp.zeros_like(bias)
 
 
@@ -311,7 +344,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, mask=None, causal: bool = False,
                     scale: float | None = None, block_q: int = 512,
-                    block_k: int = 512, interpret: bool | None = None):
+                    block_k: int = 512, interpret: bool | None = None,
+                    window: int | None = None):
     """Fused attention over ``[batch, seq, heads, head_dim]`` arrays.
 
     Drop-in for the dense path of ``models.bert.SelfAttention`` (pass it as
@@ -324,6 +358,10 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
         row with *no* True keys yields zeros (and zero gradients), matching
         the "fully padded row" convention.
       causal: causal masking by absolute position.
+      window: sliding-window (local) attention — each query attends to
+        its last ``window`` keys only (itself included); requires
+        ``causal=True``.  K blocks wholly outside the band are skipped,
+        so compute is O(T·window) instead of O(T²/2).
       scale: score scale, default ``1/sqrt(D)``.
       block_q, block_k: kernel tile sizes (clamped to the padded seq len).
         Measured speedups vs XLA dense attention live in
@@ -333,6 +371,13 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
     """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
+    if window is not None:
+        if not causal:
+            raise ValueError("window (sliding-window attention) requires "
+                             "causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        window = int(window)
     scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
     interpret = (not _on_tpu()) if interpret is None else interpret
 
@@ -363,7 +408,7 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
         bias = bias[:, None, :]                            # (B, 1, Tk)
 
     out = _flash(qt, kt, vt, bias, causal, scale, block_q, block_k,
-                 interpret)
+                 interpret, window)
     return jnp.transpose(out[:, :, :Tq], (0, 2, 1, 3))
 
 

@@ -182,3 +182,59 @@ def test_fully_masked_rows_yield_zeros():
         g = np.asarray(g)
         assert np.all(np.isfinite(g))
         assert np.all(g[1] == 0.0), "masked batch row must get zero grads"
+
+
+class TestSlidingWindow:
+    def _dense_windowed(self, q, k, v, window):
+        T = q.shape[1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (q.shape[-1] ** 0.5)
+        pos = jnp.arange(T)
+        keep = (pos[:, None] >= pos[None, :]) & \
+               (pos[None, :] > pos[:, None] - window)
+        s = jnp.where(keep[None, None], s.astype(jnp.float32), -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+    @pytest.mark.parametrize("window", [1, 5, 48, 200])
+    def test_matches_dense_band_oracle(self, window):
+        from tensorflowonspark_tpu.ops import flash_attention
+
+        B, T, H, D = 2, 128, 2, 16
+        q = jax.random.normal(jax.random.key(0), (B, T, H, D))
+        k = jax.random.normal(jax.random.key(1), (B, T, H, D))
+        v = jax.random.normal(jax.random.key(2), (B, T, H, D))
+        got = flash_attention(q, k, v, causal=True, window=window,
+                              block_q=32, block_k=32)
+        want = self._dense_windowed(q, k, v, window)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gradients_match_band_oracle(self):
+        from tensorflowonspark_tpu.ops import flash_attention
+
+        B, T, H, D, W = 1, 64, 2, 8, 13
+        q = jax.random.normal(jax.random.key(3), (B, T, H, D))
+        k = jax.random.normal(jax.random.key(4), (B, T, H, D))
+        v = jax.random.normal(jax.random.key(5), (B, T, H, D))
+
+        def f_flash(q, k, v):
+            return flash_attention(q, k, v, causal=True, window=W,
+                                   block_q=16, block_k=16).sum()
+
+        def f_dense(q, k, v):
+            return self._dense_windowed(q, k, v, W).sum()
+
+        gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_window_requires_causal_and_positive(self):
+        from tensorflowonspark_tpu.ops import flash_attention
+
+        x = jnp.zeros((1, 16, 1, 8))
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(x, x, x, window=4)
+        with pytest.raises(ValueError, match=">= 1"):
+            flash_attention(x, x, x, causal=True, window=0)

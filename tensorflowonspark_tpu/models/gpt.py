@@ -79,6 +79,12 @@ class GPTConfig:
     # axis (``layers/...`` instead of ``layer_{i}/...``).
     scan_layers: bool = False
     remat: bool = False
+    # Sliding-window (local) attention: each token attends to its last
+    # ``sliding_window`` positions only (Mistral-style).  Applied on the
+    # dense/decode paths via the band mask and passed to a custom
+    # ``attention_fn`` as ``window=`` (ops.flash_attention skips
+    # out-of-band blocks entirely).  None = full causal attention.
+    sliding_window: int | None = None
     # Store the decode KV cache as int8 with per-(position, head) scales:
     # at long context the cache — 2·L·B·T·H·D·2 bytes read per token —
     # outweighs the weights in HBM traffic, and decode is HBM-bound;
@@ -96,6 +102,9 @@ class GPTConfig:
         if self.mlp not in ("gelu", "swiglu"):
             raise ValueError(
                 f"mlp must be 'gelu' or 'swiglu', got {self.mlp!r}")
+        if self.sliding_window is not None and self.sliding_window < 1:
+            raise ValueError(
+                f"sliding_window must be >= 1, got {self.sliding_window}")
         if self.pos_encoding == "rope" and self.head_dim % 2:
             raise ValueError(
                 f"rope needs an even head_dim, got {self.head_dim} "
@@ -206,18 +215,39 @@ class CausalSelfAttention(nn.Module):
                     cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
                 k_all, v_all = ck.value, cv.value
             ci.value = idx + T
-            # attend only to written positions (<= current index)
+            # attend only to written positions (<= current index), and
+            # within the sliding window when configured
             k_pos = jnp.arange(cfg.max_position_embeddings)
-            visible = k_pos[None, :] <= (idx + jnp.arange(T))[:, None]  # [T, L]
+            q_pos = (idx + jnp.arange(T))[:, None]
+            visible = k_pos[None, :] <= q_pos                        # [T, L]
+            if cfg.sliding_window is not None:
+                visible &= k_pos[None, :] > q_pos - cfg.sliding_window
             ctx = grouped_attention(q, k_all, v_all, visible)
         elif cfg.attention_fn is not None:
             if G > 1:  # kernels take equal head counts; broadcast K/V once
                 k = jnp.repeat(k, G, axis=2)
                 v = jnp.repeat(v, G, axis=2)
-            ctx = cfg.attention_fn(q, k, v, causal=True)
+            if cfg.sliding_window is None:
+                ctx = cfg.attention_fn(q, k, v, causal=True)
+            else:
+                import inspect
+
+                sig = inspect.signature(cfg.attention_fn).parameters
+                if "window" not in sig and not any(
+                        p.kind == p.VAR_KEYWORD for p in sig.values()):
+                    raise ValueError(
+                        "sliding_window is set but attention_fn does not "
+                        "accept a window= kwarg (the ring/ulysses wrappers "
+                        "don't take one — for ulysses, pass "
+                        "attn_fn=partial(flash_attention, window=W) to the "
+                        "wrapper instead, or drop sliding_window)")
+                ctx = cfg.attention_fn(q, k, v, causal=True,
+                                       window=cfg.sliding_window)
         else:
             pos = jnp.arange(T)
             causal = pos[:, None] >= pos[None, :]
+            if cfg.sliding_window is not None:
+                causal &= pos[None, :] > pos[:, None] - cfg.sliding_window
             ctx = grouped_attention(q, k, v, causal)
         ctx = ctx.astype(cfg.dtype).reshape(B, T, H * D)
         return _dense(cfg.hidden_size, ("tp", None), cfg.dtype, "out")(ctx)

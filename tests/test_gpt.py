@@ -473,3 +473,63 @@ class TestLlamaStyleConfig:
             GPTConfig(norm="batchnorm")
         with pytest.raises(ValueError, match="mlp"):
             GPTConfig(mlp="relu")
+
+
+class TestSlidingWindow:
+    def test_windowed_decode_matches_full_forward(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(_cfg(), sliding_window=5,
+                                  pos_encoding="rope", num_kv_heads=2)
+        _assert_cached_decode_matches(cfg, seq_len=12)
+
+    def test_window_changes_long_range_attention(self):
+        import dataclasses
+
+        cfg = _cfg()
+        wcfg = dataclasses.replace(cfg, sliding_window=2)
+        params = _params(cfg)
+        ids = jax.random.randint(jax.random.key(0), (1, 12), 0,
+                                 cfg.vocab_size)
+        full = GPT(cfg).apply({"params": params}, ids)
+        local = GPT(wcfg).apply({"params": params}, ids)
+        # early positions (inside any window) agree; late ones differ
+        np.testing.assert_allclose(np.asarray(full[:, :2]),
+                                   np.asarray(local[:, :2]), rtol=1e-5)
+        assert not np.allclose(np.asarray(full[:, 6:]),
+                               np.asarray(local[:, 6:]))
+
+    def test_windowed_dense_matches_flash_kernel(self):
+        import dataclasses
+
+        from tensorflowonspark_tpu.ops import flash_attention
+
+        cfg = dataclasses.replace(_cfg(), sliding_window=6)
+        withfn = dataclasses.replace(
+            cfg, attention_fn=lambda q, k, v, mask=None, causal=False,
+            window=None: flash_attention(q, k, v, causal=causal,
+                                         window=window, block_q=16,
+                                         block_k=16))
+        ids = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                                 cfg.vocab_size)
+        params = _params(cfg)
+        np.testing.assert_allclose(
+            np.asarray(GPT(withfn).apply({"params": params}, ids)),
+            np.asarray(GPT(cfg).apply({"params": params}, ids)),
+            rtol=2e-4, atol=2e-4)
+
+    def test_bad_window_raises(self):
+        with pytest.raises(ValueError, match="sliding_window"):
+            GPTConfig(sliding_window=0)
+
+
+    def test_window_with_incompatible_attention_fn_raises(self):
+        import dataclasses
+
+        def no_window_attn(q, k, v, mask=None, causal=False):
+            raise AssertionError("should not be called")
+
+        cfg = dataclasses.replace(_cfg(), sliding_window=4,
+                                  attention_fn=no_window_attn)
+        with pytest.raises(ValueError, match="window= kwarg"):
+            GPT(cfg).init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))

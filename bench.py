@@ -285,13 +285,19 @@ def bench_flash_attention() -> dict | None:
 
         t_dense = time_fn(dense)
         t_flash = time_fn(lambda q, k, v: flash_attention(q, k, v))
+        # causal + sliding window: the O(T·W) banded path (W = T/8)
+        t_win = time_fn(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=T // 8))
         results[f"T{T}"] = {
             "dense_ms": round(t_dense * 1e3, 3),
             "flash_ms": round(t_flash * 1e3, 3),
             "speedup": round(t_dense / t_flash, 3),
+            "windowed_ms": round(t_win * 1e3, 3),
+            "window": T // 8,
         }
         log(f"bench: flash-attn T={T}: dense {t_dense*1e3:.2f}ms "
-            f"flash {t_flash*1e3:.2f}ms ({t_dense/t_flash:.2f}x)")
+            f"flash {t_flash*1e3:.2f}ms ({t_dense/t_flash:.2f}x) "
+            f"window{T//8} {t_win*1e3:.2f}ms")
 
     os.makedirs(os.path.join(REPO, "bench_artifacts"), exist_ok=True)
     with open(os.path.join(REPO, "bench_artifacts",

@@ -1,0 +1,161 @@
+"""Hugging Face checkpoint conversion for the GPT family.
+
+The reference has no model-interchange story (its SavedModels are its own);
+this gives the decoder family a weights on-ramp: map a ``transformers``
+GPT-2 or Llama-class state dict onto :class:`~.gpt.GPT`'s parameter tree.
+The mapping is **verified at the logit level** in ``tests/test_convert.py``
+— a randomly initialised HF model and the converted JAX model produce the
+same outputs — which also pins down that ``GPTConfig`` reproduces those
+architectures operation-for-operation (rotate-half RoPE, RMSNorm eps,
+SwiGLU, GQA, gelu-tanh, tied head).
+
+Only numpy is required here: pass ``model.state_dict()`` (torch tensors
+are converted via ``.numpy()``) or any mapping of arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from tensorflowonspark_tpu.models.gpt import GPTConfig
+
+
+def _np(x) -> np.ndarray:
+    if hasattr(x, "detach"):  # torch tensor
+        x = x.detach().cpu().float().numpy()
+    return np.asarray(x)
+
+
+def gpt2_config_from_hf(hf_cfg) -> GPTConfig:
+    """``transformers.GPT2Config`` → :class:`GPTConfig` (GPT-2 recipe:
+    learned positions, pre-LN layernorm at the HF epsilon, gelu-tanh)."""
+    return GPTConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.n_embd,
+        num_layers=hf_cfg.n_layer,
+        num_heads=hf_cfg.n_head,
+        intermediate_size=hf_cfg.n_inner or 4 * hf_cfg.n_embd,
+        max_position_embeddings=hf_cfg.n_positions,
+        dtype=np.float32,
+        pos_encoding="learned",
+        norm="layernorm",
+        norm_eps=hf_cfg.layer_norm_epsilon,
+        mlp="gelu",
+    )
+
+
+def gpt2_params_from_hf(state_dict: Mapping[str, Any], cfg: GPTConfig):
+    """HF GPT-2 ``state_dict`` → params for ``GPT(cfg)``.
+
+    HF's Conv1D stores weights ``[in, out]`` — flax Dense kernel layout —
+    so no transposes; ``c_attn`` is split into query/key/value thirds.
+    """
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    E = cfg.hidden_size
+
+    def dense(w, b):
+        return {"kernel": _np(w), "bias": _np(b)}
+
+    def norm(prefix):
+        return {"scale": _np(sd[f"{prefix}.weight"]),
+                "bias": _np(sd[f"{prefix}.bias"])}
+
+    params = {
+        "tok_emb": {"embedding": _np(sd["wte.weight"])},
+        "pos_emb": _np(sd["wpe.weight"]),
+        "ln_f": norm("ln_f"),
+    }
+    for i in range(cfg.num_layers):
+        p = f"h.{i}"
+        ca_w, ca_b = _np(sd[f"{p}.attn.c_attn.weight"]), \
+            _np(sd[f"{p}.attn.c_attn.bias"])
+        params[f"layer_{i}"] = {
+            "ln1": norm(f"{p}.ln_1"),
+            "ln2": norm(f"{p}.ln_2"),
+            "attn": {
+                "query": dense(ca_w[:, :E], ca_b[:E]),
+                "key": dense(ca_w[:, E:2 * E], ca_b[E:2 * E]),
+                "value": dense(ca_w[:, 2 * E:], ca_b[2 * E:]),
+                "out": dense(sd[f"{p}.attn.c_proj.weight"],
+                             sd[f"{p}.attn.c_proj.bias"]),
+            },
+            "mlp_up": dense(sd[f"{p}.mlp.c_fc.weight"],
+                            sd[f"{p}.mlp.c_fc.bias"]),
+            "mlp_down": dense(sd[f"{p}.mlp.c_proj.weight"],
+                              sd[f"{p}.mlp.c_proj.bias"]),
+        }
+    return params
+
+
+def llama_config_from_hf(hf_cfg) -> GPTConfig:
+    """``transformers.LlamaConfig``-class → :class:`GPTConfig` (rope +
+    rmsnorm + swiglu + GQA).  The LM head must be tied
+    (``tie_word_embeddings=True``) — :class:`GPT` always ties."""
+    if not getattr(hf_cfg, "tie_word_embeddings", False):
+        raise ValueError(
+            "GPT ties the LM head to the token embedding; convert only "
+            "checkpoints with tie_word_embeddings=True")
+    return GPTConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        num_kv_heads=getattr(hf_cfg, "num_key_value_heads", None),
+        intermediate_size=hf_cfg.intermediate_size,
+        max_position_embeddings=hf_cfg.max_position_embeddings,
+        dtype=np.float32,
+        pos_encoding="rope",
+        rope_base=getattr(hf_cfg, "rope_theta", 10000.0),
+        norm="rmsnorm",
+        norm_eps=hf_cfg.rms_norm_eps,
+        mlp="swiglu",
+        # Mistral/Qwen2-class sliding windows carry over (only when the
+        # checkpoint actually uses them)
+        sliding_window=(getattr(hf_cfg, "sliding_window", None)
+                        if getattr(hf_cfg, "use_sliding_window", True)
+                        else None),
+    )
+
+
+def llama_params_from_hf(state_dict: Mapping[str, Any], cfg: GPTConfig):
+    """HF Llama-class ``state_dict`` → params for ``GPT(cfg)``.
+
+    torch ``nn.Linear`` stores ``[out, in]`` → transposed to flax's
+    ``[in, out]``.  Llama layers are bias-free; our Dense layers carry
+    bias params, set to zeros (numerically identical).
+    """
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def linear(key):
+        w = _np(sd[key]).T
+        # bias-free in Llama; Qwen2-class attention biases carry over
+        bias_key = key.removesuffix(".weight") + ".bias"
+        b = _np(sd[bias_key]) if bias_key in sd \
+            else np.zeros(w.shape[1], w.dtype)
+        return {"kernel": w, "bias": b}
+
+    def rms(key):
+        return {"scale": _np(sd[key])}
+
+    params = {
+        "tok_emb": {"embedding": _np(sd["embed_tokens.weight"])},
+        "ln_f": rms("norm.weight"),
+    }
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        params[f"layer_{i}"] = {
+            "ln1": rms(f"{p}.input_layernorm.weight"),
+            "ln2": rms(f"{p}.post_attention_layernorm.weight"),
+            "attn": {
+                "query": linear(f"{p}.self_attn.q_proj.weight"),
+                "key": linear(f"{p}.self_attn.k_proj.weight"),
+                "value": linear(f"{p}.self_attn.v_proj.weight"),
+                "out": linear(f"{p}.self_attn.o_proj.weight"),
+            },
+            "mlp_gate": linear(f"{p}.mlp.gate_proj.weight"),
+            "mlp_up": linear(f"{p}.mlp.up_proj.weight"),
+            "mlp_down": linear(f"{p}.mlp.down_proj.weight"),
+        }
+    return params

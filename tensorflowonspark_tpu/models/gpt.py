@@ -85,6 +85,15 @@ class GPTConfig:
     # ``attention_fn`` as ``window=`` (ops.flash_attention skips
     # out-of-band blocks entirely).  None = full causal attention.
     sliding_window: int | None = None
+    # With ``sliding_window``, keep only the window in the decode cache
+    # (Mistral-style rolling buffer, slots indexed position mod W): cache
+    # size and per-token HBM traffic drop from max_position_embeddings to
+    # W.  Generation is exact (each step's window is fully present);
+    # intermediate PREFILL logits for positions other than the last are
+    # not — prompt positions older than the final window are gone by the
+    # time the block is scored.  greedy/beam/sample only consume the last
+    # position's logits, so decoding is unaffected.
+    rolling_kv_cache: bool = False
     # Store the decode KV cache as int8 with per-(position, head) scales:
     # at long context the cache — 2·L·B·T·H·D·2 bytes read per token —
     # outweighs the weights in HBM traffic, and decode is HBM-bound;
@@ -105,6 +114,9 @@ class GPTConfig:
         if self.sliding_window is not None and self.sliding_window < 1:
             raise ValueError(
                 f"sliding_window must be >= 1, got {self.sliding_window}")
+        if self.rolling_kv_cache and self.sliding_window is None:
+            raise ValueError(
+                "rolling_kv_cache requires sliding_window to be set")
         if self.pos_encoding == "rope" and self.head_dim % 2:
             raise ValueError(
                 f"rope needs an even head_dim, got {self.head_dim} "
@@ -176,10 +188,31 @@ class CausalSelfAttention(nn.Module):
             return ctx.reshape(B, T, H, D)
 
         if self.decode:
-            # Static-shape KV cache: [B, max_len, Hkv, D] per layer;
-            # `index` is the write position.  T==1 per decode step.
+            # Static-shape KV cache: [B, C, Hkv, D] per layer; `index` is
+            # the absolute write position.  C = max_position_embeddings,
+            # or just the window with rolling_kv_cache (slot = pos mod C).
             L = cfg.max_position_embeddings
+            rolling = cfg.rolling_kv_cache
+            C = min(L, cfg.sliding_window) if rolling else L
             idx = ci.value
+
+            def store(ref, x):
+                """Write positions idx..idx+T-1 (keeping only the last C
+                under rolling; slot indices stay unique so the scatter is
+                well-defined)."""
+                Tw = x.shape[1]
+                if not rolling:
+                    ref.value = jax.lax.dynamic_update_slice(
+                        ref.value, x, (0, idx, 0, 0))
+                    return ref.value
+                if Tw > C:
+                    x = x[:, Tw - C:]
+                    slots = (idx + Tw - C + jnp.arange(C)) % C
+                else:
+                    slots = (idx + jnp.arange(Tw)) % C
+                ref.value = ref.value.at[:, slots].set(x)
+                return ref.value
+
             if cfg.kv_cache_int8:
                 # int8 values + fp32 scale per (batch, position, head);
                 # symmetric over D.  Dequant happens inside the attention
@@ -188,40 +221,40 @@ class CausalSelfAttention(nn.Module):
                     s = jnp.max(jnp.abs(x), axis=-1, keepdims=True) \
                         .astype(jnp.float32) / 127.0 + 1e-12
                     q8 = jnp.round(x.astype(jnp.float32) / s).astype(jnp.int8)
-                    vq_ref.value = jax.lax.dynamic_update_slice(
-                        vq_ref.value, q8, (0, idx, 0, 0))
-                    vs_ref.value = jax.lax.dynamic_update_slice(
-                        vs_ref.value, s, (0, idx, 0, 0))
-                    return vq_ref.value.astype(jnp.float32) * vs_ref.value
+                    return store(vq_ref, q8).astype(jnp.float32) \
+                        * store(vs_ref, s)
 
                 ckq = self.variable("cache", "k_q", jnp.zeros,
-                                    (B, L, Hkv, D), jnp.int8)
+                                    (B, C, Hkv, D), jnp.int8)
                 cks = self.variable("cache", "k_s", jnp.zeros,
-                                    (B, L, Hkv, 1), jnp.float32)
+                                    (B, C, Hkv, 1), jnp.float32)
                 cvq = self.variable("cache", "v_q", jnp.zeros,
-                                    (B, L, Hkv, D), jnp.int8)
+                                    (B, C, Hkv, D), jnp.int8)
                 cvs = self.variable("cache", "v_s", jnp.zeros,
-                                    (B, L, Hkv, 1), jnp.float32)
+                                    (B, C, Hkv, 1), jnp.float32)
                 k_all = write(ckq, cks, k)
                 v_all = write(cvq, cvs, v)
             else:
                 ck = self.variable("cache", "k", jnp.zeros,
-                                   (B, L, Hkv, D), cfg.dtype)
+                                   (B, C, Hkv, D), cfg.dtype)
                 cv = self.variable("cache", "v", jnp.zeros,
-                                   (B, L, Hkv, D), cfg.dtype)
-                ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-                cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
-                k_all, v_all = ck.value, cv.value
+                                   (B, C, Hkv, D), cfg.dtype)
+                k_all = store(ck, k.astype(cfg.dtype))
+                v_all = store(cv, v.astype(cfg.dtype))
             ci.value = idx + T
-            # attend only to written positions (<= current index), and
-            # within the sliding window when configured
-            k_pos = jnp.arange(cfg.max_position_embeddings)
-            q_pos = (idx + jnp.arange(T))[:, None]
-            visible = k_pos[None, :] <= q_pos                        # [T, L]
-            if cfg.sliding_window is not None:
-                visible &= k_pos[None, :] > q_pos - cfg.sliding_window
+            q_pos = (idx + jnp.arange(T))[:, None]                   # [T, 1]
+            if rolling:
+                # slot s holds position p(s) = the latest pos == s (mod C);
+                # visible iff written, causal, and inside the window
+                p_end = idx + T - 1
+                p_slot = p_end - ((p_end - jnp.arange(C)[None, :]) % C)
+                visible = (p_slot >= 0) & (p_slot <= q_pos) \
+                    & (p_slot > q_pos - cfg.sliding_window)
+            else:
+                k_pos = jnp.arange(L)
+                visible = k_pos[None, :] <= q_pos                    # [T, L]
+                if cfg.sliding_window is not None:
+                    visible &= k_pos[None, :] > q_pos - cfg.sliding_window
             ctx = grouped_attention(q, k_all, v_all, visible)
         elif cfg.attention_fn is not None:
             if G > 1:  # kernels take equal head counts; broadcast K/V once

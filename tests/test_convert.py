@@ -1,0 +1,71 @@
+"""HF → GPT conversion verified at the logit level.
+
+Randomly initialised ``transformers`` models (no network needed) and the
+converted JAX model must produce the same logits — this pins the GPT
+config down to operation-for-operation agreement with the GPT-2 and
+Llama-class architectures.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from tensorflowonspark_tpu.models import GPT  # noqa: E402
+from tensorflowonspark_tpu.models.convert import (  # noqa: E402
+    gpt2_config_from_hf, gpt2_params_from_hf, llama_config_from_hf,
+    llama_params_from_hf)
+
+
+def test_gpt2_conversion_matches_hf_logits():
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    hf_cfg = GPT2Config(vocab_size=97, n_positions=32, n_embd=32,
+                        n_layer=2, n_head=4,
+                        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = GPT2LMHeadModel(hf_cfg).eval()
+
+    cfg = gpt2_config_from_hf(hf_cfg)
+    params = gpt2_params_from_hf(hf.state_dict(), cfg)
+
+    ids = np.random.default_rng(0).integers(0, 97, (2, 12))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got = GPT(cfg).apply({"params": params}, jnp.asarray(ids, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_conversion_matches_hf_logits():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(vocab_size=101, hidden_size=32,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, intermediate_size=48,
+                         max_position_embeddings=32, rms_norm_eps=1e-5,
+                         tie_word_embeddings=True,
+                         attention_dropout=0.0)
+    torch.manual_seed(1)
+    hf = LlamaForCausalLM(hf_cfg).eval()
+
+    cfg = llama_config_from_hf(hf_cfg)
+    assert cfg.pos_encoding == "rope" and cfg.norm == "rmsnorm" \
+        and cfg.mlp == "swiglu" and cfg.num_kv_heads == 2
+    params = llama_params_from_hf(hf.state_dict(), cfg)
+
+    ids = np.random.default_rng(1).integers(0, 101, (2, 10))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got = GPT(cfg).apply({"params": params}, jnp.asarray(ids, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=3e-4, atol=3e-4)
+
+
+def test_llama_untied_head_rejected():
+    from transformers import LlamaConfig
+
+    hf_cfg = LlamaConfig(tie_word_embeddings=False)
+    with pytest.raises(ValueError, match="tie"):
+        llama_config_from_hf(hf_cfg)

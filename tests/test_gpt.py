@@ -533,3 +533,34 @@ class TestSlidingWindow:
                                   attention_fn=no_window_attn)
         with pytest.raises(ValueError, match="window= kwarg"):
             GPT(cfg).init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))
+
+
+    @pytest.mark.parametrize("int8", [False, True])
+    def test_rolling_cache_generation_matches_full_cache(self, int8):
+        """Rolling buffer (cache size = window) must generate the exact
+        same tokens as the full-length cache under the same window."""
+        import dataclasses
+
+        base = dataclasses.replace(_cfg(), sliding_window=5,
+                                   pos_encoding="rope", kv_cache_int8=int8)
+        rolled = dataclasses.replace(base, rolling_kv_cache=True)
+        params = _params(base)
+        prompt = jax.random.randint(jax.random.key(4), (2, 9), 0,
+                                    base.vocab_size)
+        want = greedy_generate(base, params, prompt, 10)
+        got = greedy_generate(rolled, params, prompt, 10)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_rolling_cache_is_window_sized(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(_cfg(), sliding_window=4,
+                                  rolling_kv_cache=True)
+        params = _params(cfg)
+        cache = init_cache(cfg, params, batch=2)
+        k = cache["layer_0"]["attn"]["k"]
+        assert k.shape[1] == 4  # window slots, not max_position_embeddings
+
+    def test_rolling_requires_window(self):
+        with pytest.raises(ValueError, match="rolling_kv_cache"):
+            GPTConfig(rolling_kv_cache=True)

@@ -31,6 +31,12 @@ def _np(x) -> np.ndarray:
 def gpt2_config_from_hf(hf_cfg) -> GPTConfig:
     """``transformers.GPT2Config`` → :class:`GPTConfig` (GPT-2 recipe:
     learned positions, pre-LN layernorm at the HF epsilon, gelu-tanh)."""
+    act = getattr(hf_cfg, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(
+            f"GPT's gelu path is the tanh approximation (gelu_new); "
+            f"checkpoint uses activation_function={act!r} — converting "
+            "would silently change the numerics")
     return GPTConfig(
         vocab_size=hf_cfg.vocab_size,
         hidden_size=hf_cfg.n_embd,
@@ -97,6 +103,19 @@ def llama_config_from_hf(hf_cfg) -> GPTConfig:
         raise ValueError(
             "GPT ties the LM head to the token embedding; convert only "
             "checkpoints with tie_word_embeddings=True")
+    scaling = getattr(hf_cfg, "rope_scaling", None)
+    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not supported (plain RoPE only); "
+            "converting would silently change the frequencies")
+    use_sw = getattr(hf_cfg, "use_sliding_window", True)
+    mwl = getattr(hf_cfg, "max_window_layers", None)
+    if use_sw and getattr(hf_cfg, "sliding_window", None) is not None \
+            and mwl is not None and mwl < hf_cfg.num_hidden_layers:
+        raise ValueError(
+            f"max_window_layers={mwl} < num_hidden_layers="
+            f"{hf_cfg.num_hidden_layers}: per-layer window mixes are not "
+            "supported (GPTConfig.sliding_window is global)")
     return GPTConfig(
         vocab_size=hf_cfg.vocab_size,
         hidden_size=hf_cfg.hidden_size,

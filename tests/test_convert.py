@@ -69,3 +69,39 @@ def test_llama_untied_head_rejected():
     hf_cfg = LlamaConfig(tie_word_embeddings=False)
     with pytest.raises(ValueError, match="tie"):
         llama_config_from_hf(hf_cfg)
+
+
+def test_mistral_conversion_with_active_sliding_window():
+    """Mistral-class: GQA + rope + rmsnorm + swiglu + sliding window.
+    Sequence longer than the window, so the band actually engages."""
+    from transformers import MistralConfig, MistralForCausalLM
+
+    hf_cfg = MistralConfig(vocab_size=89, hidden_size=32,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           num_key_value_heads=2, intermediate_size=48,
+                           max_position_embeddings=64, rms_norm_eps=1e-5,
+                           sliding_window=6, tie_word_embeddings=True,
+                           attention_dropout=0.0)
+    torch.manual_seed(2)
+    hf = MistralForCausalLM(hf_cfg).eval()
+
+    cfg = llama_config_from_hf(hf_cfg)
+    assert cfg.sliding_window == 6
+    params = llama_params_from_hf(hf.state_dict(), cfg)
+
+    ids = np.random.default_rng(2).integers(0, 89, (2, 16))  # 16 > window
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got = GPT(cfg).apply({"params": params}, jnp.asarray(ids, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=3e-4, atol=3e-4)
+
+
+def test_unsupported_variants_rejected():
+    from transformers import GPT2Config, LlamaConfig
+
+    with pytest.raises(ValueError, match="activation_function"):
+        gpt2_config_from_hf(GPT2Config(activation_function="gelu"))
+    with pytest.raises(ValueError, match="rope_scaling"):
+        llama_config_from_hf(LlamaConfig(
+            tie_word_embeddings=True,
+            rope_scaling={"rope_type": "linear", "factor": 2.0}))

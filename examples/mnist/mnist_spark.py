@@ -84,11 +84,19 @@ def main_fun(args, ctx):
         def serve(params, x):
             return jax.nn.softmax(model.apply({"params": params}, x), axis=-1)
 
-        export_model(args.export_dir, serve, state.params,
+        params = state.params
+        if args.int8_export:
+            # int8 weight-only serving: the export stores int8 kernels and
+            # dequantizes lazily inside the traced signature
+            from tensorflowonspark_tpu.ops import quantize_params
+
+            params = quantize_params(params)
+        export_model(args.export_dir, serve, params,
                      [np.zeros((1, 28, 28, 1), np.float32)],
                      input_names=["image"], output_names=["prob"],
                      is_chief=True)
-        print(f"chief: exported to {args.export_dir}", flush=True)
+        kind = "int8" if args.int8_export else "fp"
+        print(f"chief: exported ({kind}) to {args.export_dir}", flush=True)
 
 
 def synthetic_mnist(n: int, seed: int = 0):
@@ -110,6 +118,8 @@ if __name__ == "__main__":
     p.add_argument("--steps", type=int, default=0, help="0 = until feed ends")
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--num_samples", type=int, default=2000)
+    p.add_argument("--int8_export", action="store_true",
+                   help="quantize kernels to int8 before the serving export")
     p.add_argument("--images", help="npy file of [N,28,28] images")
     p.add_argument("--labels", help="npy file of [N] labels")
     p.add_argument("--model_dir", default="")

@@ -108,14 +108,23 @@ def llama_config_from_hf(hf_cfg) -> GPTConfig:
         raise ValueError(
             f"rope_scaling={scaling!r} is not supported (plain RoPE only); "
             "converting would silently change the frequencies")
+    # Sliding-window semantics (Qwen2-class): layers BELOW
+    # max_window_layers use full attention, the rest the window.  Only
+    # uniform configurations convert: all layers windowed (mwl in
+    # {None, 0}) keeps the window; none windowed (mwl >= num_layers)
+    # drops it; a mix has no global-GPTConfig equivalent and raises.
     use_sw = getattr(hf_cfg, "use_sliding_window", True)
+    sw = getattr(hf_cfg, "sliding_window", None)
     mwl = getattr(hf_cfg, "max_window_layers", None)
-    if use_sw and getattr(hf_cfg, "sliding_window", None) is not None \
-            and mwl is not None and mwl < hf_cfg.num_hidden_layers:
-        raise ValueError(
-            f"max_window_layers={mwl} < num_hidden_layers="
-            f"{hf_cfg.num_hidden_layers}: per-layer window mixes are not "
-            "supported (GPTConfig.sliding_window is global)")
+    if use_sw and sw is not None and mwl is not None:
+        if mwl >= hf_cfg.num_hidden_layers:
+            use_sw = False  # HF applies the window to no layer at all
+        elif mwl > 0:
+            raise ValueError(
+                f"max_window_layers={mwl} of "
+                f"{hf_cfg.num_hidden_layers} layers: per-layer window "
+                "mixes are not supported (GPTConfig.sliding_window is "
+                "global)")
     return GPTConfig(
         vocab_size=hf_cfg.vocab_size,
         hidden_size=hf_cfg.hidden_size,
@@ -132,9 +141,7 @@ def llama_config_from_hf(hf_cfg) -> GPTConfig:
         mlp="swiglu",
         # Mistral/Qwen2-class sliding windows carry over (only when the
         # checkpoint actually uses them)
-        sliding_window=(getattr(hf_cfg, "sliding_window", None)
-                        if getattr(hf_cfg, "use_sliding_window", True)
-                        else None),
+        sliding_window=sw if use_sw else None,
     )
 
 

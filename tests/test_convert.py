@@ -105,3 +105,21 @@ def test_unsupported_variants_rejected():
         llama_config_from_hf(LlamaConfig(
             tie_word_embeddings=True,
             rope_scaling={"rope_type": "linear", "factor": 2.0}))
+
+
+def test_qwen2_window_layer_semantics():
+    from transformers import Qwen2Config
+
+    base = dict(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                num_attention_heads=4, num_key_value_heads=2,
+                intermediate_size=48, tie_word_embeddings=True,
+                use_sliding_window=True, sliding_window=6)
+    # mwl >= num_layers: HF windows NO layer -> converted window dropped
+    cfg = llama_config_from_hf(Qwen2Config(max_window_layers=2, **base))
+    assert cfg.sliding_window is None
+    # mwl == 0: every layer windowed -> global window carries over
+    cfg = llama_config_from_hf(Qwen2Config(max_window_layers=0, **base))
+    assert cfg.sliding_window == 6
+    # mixed: no global equivalent
+    with pytest.raises(ValueError, match="max_window_layers"):
+        llama_config_from_hf(Qwen2Config(max_window_layers=1, **base))

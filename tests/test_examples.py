@@ -32,14 +32,17 @@ def _run(script, *argv, timeout=300, cpu_flag=True):
     return proc.stdout
 
 
-def test_mnist_spark_and_batch_inference(tmp_path):
+@pytest.mark.parametrize("int8", [False, True])
+def test_mnist_spark_and_batch_inference(tmp_path, int8):
     export = str(tmp_path / "export")
+    extra = ["--int8_export"] if int8 else []
     out = _run("mnist/mnist_spark.py", "--cluster_size", "2", "--steps", "6",
                "--batch_size", "16", "--num_samples", "128",
-               "--export_dir", export)
+               "--export_dir", export, *extra)
     assert "mnist_spark: done" in out
     assert os.path.exists(os.path.join(export, "export_meta.json"))
 
+    # the unchanged server consumes fp and int8 exports alike
     out = _run("utils/batch_inference.py", "--export_dir", export,
                "--num_samples", "32", "--batch_size", "16", cpu_flag=False)
     assert "ran 32 samples" in out

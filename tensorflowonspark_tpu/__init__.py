@@ -44,7 +44,8 @@ from tensorflowonspark_tpu.estimator import (Estimator, EvalSpec,  # noqa: F401
 from tensorflowonspark_tpu.preemption import PreemptionGuard  # noqa: F401
 from tensorflowonspark_tpu.pipeline import (Namespace, Pipeline,  # noqa: F401
                                             ParamGridBuilder, TFEstimator,
-                                            TFModel, TrainValidationSplit)
+                                            TFModel, TrainValidationSplit,
+                                            CrossValidator)
 
 # Reference-named façade modules: a reference user's
 # ``from tensorflowonspark import TFCluster, TFNode`` maps 1:1 onto

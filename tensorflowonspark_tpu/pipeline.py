@@ -365,6 +365,72 @@ class TrainValidationSplitModel(Transformer):
         return self.bestModel.transform(df)
 
 
+class CrossValidator(Estimator):
+    """K-fold grid search (pyspark ``CrossValidator`` analogue).
+
+    Each grid point is scored as the mean of ``numFolds`` held-out-fold
+    metrics (seeded shuffle → contiguous fold slices, pyspark's scheme);
+    the winner is refit on the FULL DataFrame — the pyspark contract.
+    """
+
+    def __init__(self, estimator: Estimator,
+                 evaluator: Callable[[DataFrame], float],
+                 estimatorParamMaps: Sequence[dict], numFolds: int = 3,
+                 seed: int = 0):
+        super().__init__()
+        if numFolds < 2:
+            raise ValueError(f"numFolds must be >= 2, got {numFolds}")
+        self.estimator = estimator
+        self.evaluator = evaluator  # transformed df -> metric (higher better)
+        self.estimatorParamMaps = list(estimatorParamMaps)
+        self.numFolds = numFolds
+        self.seed = seed
+
+    def _fit(self, df: DataFrame) -> "CrossValidatorModel":
+        if not self.estimatorParamMaps:
+            raise ValueError("estimatorParamMaps is empty — nothing to search")
+        rows = df.collect()
+        if len(rows) < self.numFolds:
+            raise ValueError(
+                f"{len(rows)} rows cannot form {self.numFolds} folds")
+        order = np.random.default_rng(self.seed).permutation(len(rows))
+        bounds = np.linspace(0, len(rows), self.numFolds + 1).astype(int)
+
+        def fold(i):
+            val_idx = order[bounds[i]:bounds[i + 1]]
+            train_idx = np.concatenate([order[:bounds[i]],
+                                        order[bounds[i + 1]:]])
+            mk = lambda idx: DataFrame(  # noqa: E731
+                [rows[j] for j in idx], columns=df.columns,
+                num_partitions=df.num_partitions)
+            return mk(train_idx), mk(val_idx)
+
+        avg_metrics = []
+        for params in self.estimatorParamMaps:
+            scores = []
+            for i in range(self.numFolds):
+                train, val = fold(i)
+                model = self.estimator.fit(train, params)
+                scores.append(self.evaluator(model.transform(val)))
+            avg_metrics.append(float(np.mean(scores)))
+            logger.info("cv grid point %s -> %.6f",
+                        {p.name: v for p, v in params.items()},
+                        avg_metrics[-1])
+        best = int(np.argmax(avg_metrics))
+        best_model = self.estimator.fit(df, self.estimatorParamMaps[best])
+        return CrossValidatorModel(best_model, avg_metrics)
+
+
+class CrossValidatorModel(Transformer):
+    def __init__(self, bestModel: Transformer, avgMetrics: list[float]):
+        super().__init__()
+        self.bestModel = bestModel
+        self.avgMetrics = avgMetrics
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        return self.bestModel.transform(df)
+
+
 # --------------------------------------------------------------------------
 # TFEstimator / TFModel
 # --------------------------------------------------------------------------

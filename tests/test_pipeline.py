@@ -270,3 +270,34 @@ def test_driver_ps_nodes_rejected():
     with pytest.raises(ValueError, match="driver_ps_nodes"):
         TPUCluster.run(funcs.fn_noop, {}, num_workers=2, num_ps=1,
                        driver_ps_nodes=True)
+
+
+def test_cross_validator_kfold_picks_best_and_refits_on_full_data():
+    df = DataFrame([Row(y=1.0) for _ in range(21)])
+    est = _MeanEstimator()
+    grid = pl.ParamGridBuilder().addGrid(
+        est.getParam("shift"), [-1.0, 0.0, 2.0]).build()
+
+    def evaluator(out):  # higher is better
+        return -float(np.mean([(r.pred - r.y) ** 2 for r in out.collect()]))
+
+    cv = pl.CrossValidator(est, evaluator, grid, numFolds=3)
+    best = cv.fit(df)
+    assert len(best.avgMetrics) == 3
+    assert int(np.argmax(best.avgMetrics)) == 1       # shift=0 wins
+    # winner refit on the FULL frame (pyspark contract)
+    assert best.transform(df).collect()[0].pred == pytest.approx(1.0)
+
+
+def test_cross_validator_validates_inputs():
+    est = _MeanEstimator()
+    with pytest.raises(ValueError, match="numFolds"):
+        pl.CrossValidator(est, lambda d: 0.0, [{}], numFolds=1)
+    cv = pl.CrossValidator(est, lambda d: 0.0, [], numFolds=2)
+    with pytest.raises(ValueError, match="empty"):
+        cv.fit(DataFrame([Row(y=1.0) for _ in range(4)]))
+    grid = pl.ParamGridBuilder().addGrid(
+        est.getParam("shift"), [0.0]).build()
+    cv = pl.CrossValidator(est, lambda d: 0.0, grid, numFolds=4)
+    with pytest.raises(ValueError, match="folds"):
+        cv.fit(DataFrame([Row(y=1.0) for _ in range(3)]))

@@ -348,7 +348,8 @@ class TrainValidationSplit(Estimator):
             model = self.estimator.fit(train, params)
             metric = self.evaluator(model.transform(val))
             metrics.append(metric)
-            logger.info("grid point %s -> %.6f", {p.name: v for p, v in params.items()},
+            logger.info("grid point %s -> %.6f",
+                        {getattr(p, "name", p): v for p, v in params.items()},
                         metric)
             if best_model is None or metric > best_metric:
                 best_model, best_metric = model, metric
@@ -405,16 +406,16 @@ class CrossValidator(Estimator):
                 num_partitions=df.num_partitions)
             return mk(train_idx), mk(val_idx)
 
+        folds = [fold(i) for i in range(self.numFolds)]  # seed-fixed; share
         avg_metrics = []
         for params in self.estimatorParamMaps:
             scores = []
-            for i in range(self.numFolds):
-                train, val = fold(i)
+            for train, val in folds:
                 model = self.estimator.fit(train, params)
                 scores.append(self.evaluator(model.transform(val)))
             avg_metrics.append(float(np.mean(scores)))
             logger.info("cv grid point %s -> %.6f",
-                        {p.name: v for p, v in params.items()},
+                        {getattr(p, "name", p): v for p, v in params.items()},
                         avg_metrics[-1])
         best = int(np.argmax(avg_metrics))
         best_model = self.estimator.fit(df, self.estimatorParamMaps[best])

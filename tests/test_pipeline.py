@@ -301,3 +301,19 @@ def test_cross_validator_validates_inputs():
     cv = pl.CrossValidator(est, lambda d: 0.0, grid, numFolds=4)
     with pytest.raises(ValueError, match="folds"):
         cv.fit(DataFrame([Row(y=1.0) for _ in range(3)]))
+
+
+def test_cross_validator_accepts_string_keyed_param_maps(caplog):
+    import logging
+
+    df = DataFrame([Row(y=1.0) for _ in range(9)])
+    est = _MeanEstimator()
+
+    def evaluator(out):
+        return -float(np.mean([(r.pred - r.y) ** 2 for r in out.collect()]))
+
+    with caplog.at_level(logging.INFO):
+        best = pl.CrossValidator(est, evaluator,
+                                 [{"shift": 0.0}, {"shift": 2.0}],
+                                 numFolds=3).fit(df)
+    assert int(np.argmax(best.avgMetrics)) == 0

@@ -53,17 +53,32 @@ class TrainSpec:
 class EvalSpec:
     """How to evaluate.  ``steps`` batches from ``input_fn`` per round;
     a round runs every ``throttle_steps`` train steps (and once at the
-    end)."""
+    end).
+
+    Early stopping (the ``tf.estimator.experimental.stop_if_no_decrease_
+    hook`` analogue): with ``early_stopping_patience=N``, training stops
+    after N consecutive eval rounds without ``metric`` improving
+    (decreasing when ``higher_is_better=False``, the loss default).
+    """
 
     input_fn: Callable[[], object]
     steps: int = 10
     throttle_steps: int = 100
+    early_stopping_patience: int | None = None
+    metric: str = "loss"
+    higher_is_better: bool = False
+    min_delta: float = 0.0
 
     def __post_init__(self):
         if self.throttle_steps < 1:
             raise ValueError(
                 f"throttle_steps must be >= 1, got {self.throttle_steps} "
                 "(0 would make train_and_evaluate spin forever)")
+        if self.early_stopping_patience is not None \
+                and self.early_stopping_patience < 1:
+            raise ValueError(
+                f"early_stopping_patience must be >= 1, got "
+                f"{self.early_stopping_patience}")
 
 
 class Estimator:
@@ -475,6 +490,8 @@ def train_and_evaluate(estimator: Estimator, train_spec: TrainSpec,
     # eval round must latch too, not hit the default handler and kill us.
     guard = PreemptionGuard() if estimator._handle_preemption else None
     metrics: dict = {}
+    best, stale = None, 0
+    sign = 1.0 if eval_spec.higher_is_better else -1.0
     with guard if guard is not None else contextlib.nullcontext():
         while estimator.global_step < train_spec.max_steps:
             target = min(estimator.global_step + eval_spec.throttle_steps,
@@ -489,6 +506,18 @@ def train_and_evaluate(estimator: Estimator, train_spec: TrainSpec,
             metrics = estimator.evaluate(eval_spec.input_fn, eval_spec.steps)
             logger.info("estimator: step %d eval %s", estimator.global_step,
                         {k: round(v, 4) for k, v in metrics.items()})
+            if eval_spec.early_stopping_patience is not None:
+                score = sign * float(metrics[eval_spec.metric])
+                if best is None or score > best + eval_spec.min_delta:
+                    best, stale = score, 0
+                else:
+                    stale += 1
+                    if stale >= eval_spec.early_stopping_patience:
+                        logger.info(
+                            "estimator: early stop at step %d — %r did not "
+                            "improve for %d eval rounds",
+                            estimator.global_step, eval_spec.metric, stale)
+                        return metrics
         if not metrics:
             # resumed already at (or past) max_steps: the promised final
             # eval still happens

@@ -255,3 +255,34 @@ def test_input_state_disabled_restarts_epoch(tmp_path):
                    **kw) as est:
         assert est._pending_input_resume is None
         est.train(input_fn, max_steps=8)
+
+
+def test_early_stopping_halts_on_plateau(tmp_path):
+    import jax.numpy as jnp
+
+    def init_fn():
+        return {"w": jnp.zeros(())}
+
+    def loss_fn(params, batch):
+        # loss is constant in w: every eval round plateaus immediately
+        return 1.0 + 0.0 * params["w"] + 0.0 * batch["i"].sum()
+
+    def input_fn():
+        for i in range(16):
+            yield {"i": np.full((8,), i, np.float32)}
+
+    with Estimator(init_fn, loss_fn, optax.sgd(0.1), str(tmp_path / "m"),
+                   summary_dir="") as est:
+        final = train_and_evaluate(
+            est,
+            TrainSpec(input_fn=input_fn, max_steps=1000),
+            EvalSpec(input_fn=input_fn, steps=2, throttle_steps=4,
+                     early_stopping_patience=2))
+        # 1 improving round (first) + 2 stale rounds = stop at step 12
+        assert est.global_step == 12, est.global_step
+        assert final["loss"] == pytest.approx(1.0)
+
+
+def test_early_stopping_patience_validation():
+    with pytest.raises(ValueError, match="early_stopping_patience"):
+        EvalSpec(input_fn=lambda: [], early_stopping_patience=0)

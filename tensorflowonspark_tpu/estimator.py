@@ -79,6 +79,10 @@ class EvalSpec:
             raise ValueError(
                 f"early_stopping_patience must be >= 1, got "
                 f"{self.early_stopping_patience}")
+        if self.min_delta < 0:
+            raise ValueError(
+                f"min_delta must be >= 0, got {self.min_delta} (a negative "
+                "delta would count degradations as improvements)")
 
 
 class Estimator:
@@ -488,10 +492,46 @@ def train_and_evaluate(estimator: Estimator, train_spec: TrainSpec,
 
     # Guard the WHOLE loop, not just train(): a SIGTERM landing during an
     # eval round must latch too, not hit the default handler and kill us.
+    import json
+
+    from tensorflowonspark_tpu import filesystem as fsutil
+
     guard = PreemptionGuard() if estimator._handle_preemption else None
     metrics: dict = {}
     best, stale = None, 0
     sign = 1.0 if eval_spec.higher_is_better else -1.0
+    # Early-stop state survives restarts (tf.estimator's hook reads eval
+    # event files; here a JSON sidecar in model_dir): patience does not
+    # reset on relaunch, and a run that already stopped stays stopped.
+    es_path = fsutil.join(estimator.model_dir, "early_stop.json") \
+        if eval_spec.early_stopping_patience is not None \
+        and estimator.model_dir else None
+    if es_path and estimator.global_step > 0:
+        try:
+            with fsutil.open_file(es_path, "rb") as f:
+                saved = json.loads(f.read().decode())
+            best, stale = saved.get("best"), int(saved.get("stale", 0))
+            if saved.get("stopped"):
+                logger.info("estimator: early stop already latched at step "
+                            "%d; skipping training", saved.get("step"))
+                return estimator.evaluate(eval_spec.input_fn, eval_spec.steps)
+        except (OSError, ValueError):
+            pass
+
+    def save_es(stopped: bool) -> None:
+        if es_path is None:
+            return
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        try:
+            with fsutil.open_output(es_path, "wb") as f:
+                f.write(json.dumps(
+                    {"best": best, "stale": stale, "stopped": stopped,
+                     "step": estimator.global_step}).encode())
+        except OSError:
+            pass
     with guard if guard is not None else contextlib.nullcontext():
         while estimator.global_step < train_spec.max_steps:
             target = min(estimator.global_step + eval_spec.throttle_steps,
@@ -507,17 +547,24 @@ def train_and_evaluate(estimator: Estimator, train_spec: TrainSpec,
             logger.info("estimator: step %d eval %s", estimator.global_step,
                         {k: round(v, 4) for k, v in metrics.items()})
             if eval_spec.early_stopping_patience is not None:
+                if eval_spec.metric not in metrics:
+                    raise ValueError(
+                        f"EvalSpec.metric {eval_spec.metric!r} not in eval "
+                        f"metrics {sorted(metrics)} — set eval_metrics_fn "
+                        "or pick one of these keys")
                 score = sign * float(metrics[eval_spec.metric])
                 if best is None or score > best + eval_spec.min_delta:
                     best, stale = score, 0
                 else:
                     stale += 1
-                    if stale >= eval_spec.early_stopping_patience:
-                        logger.info(
-                            "estimator: early stop at step %d — %r did not "
-                            "improve for %d eval rounds",
-                            estimator.global_step, eval_spec.metric, stale)
-                        return metrics
+                if stale >= eval_spec.early_stopping_patience:
+                    logger.info(
+                        "estimator: early stop at step %d — %r did not "
+                        "improve for %d eval rounds",
+                        estimator.global_step, eval_spec.metric, stale)
+                    save_es(stopped=True)
+                    return metrics
+                save_es(stopped=False)
         if not metrics:
             # resumed already at (or past) max_steps: the promised final
             # eval still happens

@@ -286,3 +286,74 @@ def test_early_stopping_halts_on_plateau(tmp_path):
 def test_early_stopping_patience_validation():
     with pytest.raises(ValueError, match="early_stopping_patience"):
         EvalSpec(input_fn=lambda: [], early_stopping_patience=0)
+
+
+def test_early_stopping_state_survives_restart(tmp_path):
+    import jax.numpy as jnp
+
+    def make():
+        def init_fn():
+            return {"w": jnp.zeros(())}
+
+        def loss_fn(params, batch):
+            return 1.0 + 0.0 * params["w"] + 0.0 * batch["i"].sum()
+
+        def input_fn():
+            for i in range(16):
+                yield {"i": np.full((8,), i, np.float32)}
+
+        return init_fn, loss_fn, input_fn
+
+    init_fn, loss_fn, input_fn = make()
+    spec = dict(steps=2, throttle_steps=4, early_stopping_patience=3)
+    with Estimator(init_fn, loss_fn, optax.sgd(0.1), str(tmp_path / "m"),
+                   summary_dir="") as est:
+        # run exactly 2 eval rounds (1 improving + 1 stale), then "crash"
+        train_and_evaluate(est, TrainSpec(input_fn=input_fn, max_steps=8),
+                           EvalSpec(input_fn=input_fn, **spec))
+        assert est.global_step == 8
+
+    init_fn, loss_fn, input_fn = make()
+    with Estimator(init_fn, loss_fn, optax.sgd(0.1), str(tmp_path / "m"),
+                   summary_dir="") as est:
+        # resumed run: stale=1 carried over, so only 2 more stale rounds
+        # (not 3) before the stop — step 16, not 20
+        train_and_evaluate(est, TrainSpec(input_fn=input_fn, max_steps=1000),
+                           EvalSpec(input_fn=input_fn, **spec))
+        assert est.global_step == 16, est.global_step
+
+    # a third launch of an already-stopped run must not train at all
+    init_fn, loss_fn, input_fn = make()
+    with Estimator(init_fn, loss_fn, optax.sgd(0.1), str(tmp_path / "m"),
+                   summary_dir="") as est:
+        train_and_evaluate(est, TrainSpec(input_fn=input_fn, max_steps=1000),
+                           EvalSpec(input_fn=input_fn, **spec))
+        assert est.global_step == 16, est.global_step
+
+
+def test_early_stopping_unknown_metric_raises(tmp_path):
+    import jax.numpy as jnp
+
+    def init_fn():
+        return {"w": jnp.zeros(())}
+
+    def loss_fn(params, batch):
+        return params["w"] ** 2 + 0.0 * batch["i"].sum()
+
+    def input_fn():
+        for i in range(8):
+            yield {"i": np.full((8,), i, np.float32)}
+
+    with Estimator(init_fn, loss_fn, optax.sgd(0.1), str(tmp_path / "m"),
+                   summary_dir="") as est:
+        with pytest.raises(ValueError, match="accuracy"):
+            train_and_evaluate(
+                est, TrainSpec(input_fn=input_fn, max_steps=8),
+                EvalSpec(input_fn=input_fn, steps=2, throttle_steps=4,
+                         early_stopping_patience=1, metric="accuracy"))
+
+
+def test_negative_min_delta_rejected():
+    with pytest.raises(ValueError, match="min_delta"):
+        EvalSpec(input_fn=lambda: [], early_stopping_patience=1,
+                 min_delta=-0.1)

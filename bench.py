@@ -454,10 +454,35 @@ def _run_with_watchdog() -> int:
         attempts.append(("cleared", cleared))
     cpu_env = dict(os.environ)
     cpu_env["JAX_PLATFORMS"] = "cpu"
+    # A wedged accelerator tunnel can hang backend init even under
+    # JAX_PLATFORMS=cpu (the sitecustomize registers the accelerator PJRT
+    # plugin in every process, gated on this env var) — drop it so the CPU
+    # fallback is immune to the tunnel's state.
+    cpu_env.pop("PALLAS_AXON_POOL_IPS", None)
     attempts.append(("cpu", cpu_env))
 
     for name, env in attempts:
         env = {**env, _CHILD_ENV: "1"}
+        # Cheap preflight: a wedged accelerator tunnel hangs backend init
+        # in C (uninterruptible in-process).  Probing client init alone —
+        # no compile, so no cold-compile false negatives — in a 240s
+        # subprocess saves the 900s timeout per dead attempt, the
+        # difference between a recorded CPU fallback and none.
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                timeout=240, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL)
+            if probe.returncode != 0:
+                log(f"bench: [{name}] preflight failed "
+                    f"(rc={probe.returncode}); skipping")
+                continue
+            log(f"bench: [{name}] preflight ok "
+                f"({probe.stdout.decode().strip()})")
+        except subprocess.TimeoutExpired:
+            log(f"bench: [{name}] preflight hung (>240s); skipping")
+            continue
         log(f"bench: attempt [{name}]")
         try:
             r = subprocess.run([sys.executable, os.path.abspath(__file__)],

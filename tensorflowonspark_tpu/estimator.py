@@ -503,34 +503,49 @@ def train_and_evaluate(estimator: Estimator, train_spec: TrainSpec,
     # Early-stop state survives restarts (tf.estimator's hook reads eval
     # event files; here a JSON sidecar in model_dir): patience does not
     # reset on relaunch, and a run that already stopped stays stopped.
-    es_path = fsutil.join(estimator.model_dir, "early_stop.json") \
-        if eval_spec.early_stopping_patience is not None \
-        and estimator.model_dir else None
+    import jax
+
+    # Multi-process runs skip the sidecar: a per-host file read that can
+    # fail on one host but not another would diverge SPMD control flow
+    # (mismatched collectives -> hang).  In-memory patience still works;
+    # only restart persistence is single-process.
+    es_path = None
+    if eval_spec.early_stopping_patience is not None and estimator.model_dir:
+        if jax.process_count() == 1:
+            es_path = fsutil.join(estimator.model_dir, "early_stop",
+                                  "state.json")  # own subdir: orbax's step
+            # scan must never see foreign files in model_dir itself
+        else:
+            logger.info("estimator: early-stop state not persisted in "
+                        "multi-process runs (restart resets patience)")
+    es_cfg = [eval_spec.metric, eval_spec.higher_is_better,
+              eval_spec.min_delta]
     if es_path and estimator.global_step > 0:
         try:
             with fsutil.open_file(es_path, "rb") as f:
                 saved = json.loads(f.read().decode())
+            if not isinstance(saved, dict) or saved.get("config") != es_cfg:
+                saved = None  # different metric/direction: start fresh
+        except Exception:  # best-effort: fsspec raises non-OSErrors too
+            saved = None
+        if saved:
             best, stale = saved.get("best"), int(saved.get("stale", 0))
             if saved.get("stopped"):
                 logger.info("estimator: early stop already latched at step "
                             "%d; skipping training", saved.get("step"))
                 return estimator.evaluate(eval_spec.input_fn, eval_spec.steps)
-        except (OSError, ValueError):
-            pass
 
     def save_es(stopped: bool) -> None:
         if es_path is None:
             return
-        import jax
-
-        if jax.process_index() != 0:
-            return
         try:
+            fsutil.makedirs(fsutil.join(estimator.model_dir, "early_stop"))
             with fsutil.open_output(es_path, "wb") as f:
                 f.write(json.dumps(
                     {"best": best, "stale": stale, "stopped": stopped,
-                     "step": estimator.global_step}).encode())
-        except OSError:
+                     "step": estimator.global_step,
+                     "config": es_cfg}).encode())
+        except Exception:  # best-effort, never kills a training run
             pass
     with guard if guard is not None else contextlib.nullcontext():
         while estimator.global_step < train_spec.max_steps:

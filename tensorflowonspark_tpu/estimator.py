@@ -118,6 +118,11 @@ class Estimator:
         on resume, skip the already-trained prefix of ``input_fn``'s first
         epoch instead of re-training it (the tf.data iterator-checkpoint
         analogue; exact for deterministic pipelines).  Default True.
+      warm_start_from: another model_dir to initialise PARAMS from (the
+        ``tf.estimator.WarmStartSettings`` analogue) when ``model_dir``
+        itself holds no checkpoint yet: the donor's latest params are
+        loaded, optimizer state and global step start fresh.  A resumed
+        job (checkpoint present) ignores it.
     """
 
     def __init__(self, init_fn, loss_fn, tx, model_dir: str, *,
@@ -127,7 +132,8 @@ class Estimator:
                  summary_dir: Optional[str] = None,
                  log_every_steps: int = 10,
                  profile_steps: Optional[tuple] = None,
-                 checkpoint_input_state: bool = True):
+                 checkpoint_input_state: bool = True,
+                 warm_start_from: Optional[str] = None):
         import os
 
         from tensorflowonspark_tpu.checkpoint import CheckpointManager
@@ -158,6 +164,29 @@ class Estimator:
                             model_dir, latest)
                 if checkpoint_input_state:
                     self._pending_input_resume = self._load_input_state(latest)
+            elif warm_start_from:
+                import dataclasses as _dc
+
+                import jax
+                import jax.numpy as jnp
+
+                with CheckpointManager(warm_start_from) as donor:
+                    if donor.latest_step() is None:
+                        raise ValueError(
+                            f"warm_start_from={warm_start_from!r} holds no "
+                            "checkpoint")
+                    # no target: host-numpy tree, so the donor's OPTIMIZER
+                    # shape never has to match this estimator's (params are
+                    # all we take — fresh opt state and step 0, the
+                    # tf.estimator warm-start contract)
+                    donated = donor.restore()
+                donated_params = donated["params"] if isinstance(donated, dict) \
+                    else donated.params
+                self._state = _dc.replace(self._state, params=jax.tree.map(
+                    lambda t, s: jnp.asarray(s, t.dtype),
+                    self._state.params, donated_params))
+                logger.info("estimator: warm-started params from %s step %d",
+                            warm_start_from, donor.latest_step())
         # Host-side mirror of state.step: reading the device scalar every
         # loop iteration would block on the in-flight step and kill JAX's
         # async dispatch; the mirror advances with each dispatched step.

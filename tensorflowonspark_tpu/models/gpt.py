@@ -548,21 +548,39 @@ def beam_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
 
 
 def sample_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
-                    rng, *, temperature: float = 1.0, top_k: int | None = None):
-    """Stochastic decode: temperature-scaled (and optionally top-k
-    truncated) categorical sampling, one compiled program like
-    :func:`greedy_generate`.  ``rng`` is a ``jax.random`` key; each step
-    folds in its index so the whole rollout is reproducible."""
+                    rng, *, temperature: float = 1.0,
+                    top_k: int | None = None, top_p: float | None = None):
+    """Stochastic decode: temperature-scaled categorical sampling with
+    optional top-k and/or top-p (nucleus) truncation, one compiled program
+    like :func:`greedy_generate`.  ``rng`` is a ``jax.random`` key; each
+    step folds in its index so the whole rollout is reproducible.  With
+    both filters set, top-k applies first (HF convention)."""
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
     def next_token(logits, i):
-        if top_k is not None:
+        if top_k is not None:  # rank-invariant: pre- or post-temperature
             kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
         if temperature == 0.0:  # greedy limit
             return jnp.argmax(logits, axis=-1)
+        logits = logits / temperature
+        if top_p is not None and top_p < 1.0:
+            # nucleus on the TEMPERATURE-SCALED distribution (HF order):
+            # keep the smallest sorted prefix whose mass reaches top_p
+            # (the top token always survives)
+            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = cum - probs < top_p  # mass BEFORE this token
+            # threshold = smallest kept logit, mapped back per row
+            kept_min = jnp.min(
+                jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+                keepdims=True)
+            logits = jnp.where(logits < kept_min, -jnp.inf, logits)
         return jax.random.categorical(jax.random.fold_in(rng, i),
-                                      logits / temperature, axis=-1)
+                                      logits, axis=-1)
 
     return _generate(cfg, params, prompt_ids, max_new_tokens, next_token)

@@ -17,7 +17,7 @@ def _linreg_problem(seed=0, n=64, d=4):
     return x, y
 
 
-def _make_estimator(model_dir, save_every=10):
+def _make_estimator(model_dir, save_every=10, tx=None, **kwargs):
     import jax.numpy as jnp
 
     def init_fn():
@@ -32,8 +32,9 @@ def _make_estimator(model_dir, save_every=10):
         return {"mse": jnp.mean((pred - batch["y"]) ** 2),
                 "mae": jnp.mean(jnp.abs(pred - batch["y"]))}
 
-    return Estimator(init_fn, loss_fn, optax.sgd(0.1), str(model_dir),
-                     eval_metrics_fn=metrics_fn, save_every_steps=save_every)
+    return Estimator(init_fn, loss_fn, tx or optax.sgd(0.1), str(model_dir),
+                     eval_metrics_fn=metrics_fn, save_every_steps=save_every,
+                     **kwargs)
 
 
 def _batches(x, y, bs=16):
@@ -357,3 +358,37 @@ def test_negative_min_delta_rejected():
     with pytest.raises(ValueError, match="min_delta"):
         EvalSpec(input_fn=lambda: [], early_stopping_patience=1,
                  min_delta=-0.1)
+
+
+def test_warm_start_loads_params_but_not_step(tmp_path):
+    x, y = _linreg_problem()
+    with _make_estimator(tmp_path / "donor") as est:
+        est.train(_batches(x, y), max_steps=20)
+        trained_w = np.asarray(est.params["w"])
+    assert not np.allclose(trained_w, 0.0)
+
+    with Estimator(*_triple(), str(tmp_path / "fresh"), summary_dir="",
+                   warm_start_from=str(tmp_path / "donor")) as est:
+        assert est.global_step == 0  # step starts fresh...
+        np.testing.assert_allclose(np.asarray(est.params["w"]), trained_w)
+
+    # a dir with a checkpoint ignores warm_start_from
+    with Estimator(*_triple(), str(tmp_path / "donor"), summary_dir="",
+                   warm_start_from=str(tmp_path / "fresh")) as est:
+        assert est.global_step == 20
+
+    with pytest.raises(ValueError, match="no\\s+checkpoint"):
+        Estimator(*_triple(), str(tmp_path / "x"), summary_dir="",
+                  warm_start_from=str(tmp_path / "empty"))
+
+
+def _triple():
+    import jax.numpy as jnp
+
+    def init_fn():
+        return {"w": jnp.zeros((4, 1))}
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+    return init_fn, loss_fn, optax.sgd(0.1)

@@ -564,3 +564,51 @@ class TestSlidingWindow:
     def test_rolling_requires_window(self):
         with pytest.raises(ValueError, match="rolling_kv_cache"):
             GPTConfig(rolling_kv_cache=True)
+
+
+class TestTopP:
+    def test_top_p_one_equals_plain_sampling(self):
+        params = _params()
+        prompt = jnp.ones((2, 4), jnp.int32)
+        a = sample_generate(CFG, params, prompt, 6, jax.random.key(0),
+                            temperature=0.9)
+        b = sample_generate(CFG, params, prompt, 6, jax.random.key(0),
+                            temperature=0.9, top_p=1.0)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_tiny_top_p_is_greedy(self):
+        # a nucleus so small only the argmax survives -> greedy rollout
+        params = _params()
+        prompt = jnp.ones((2, 4), jnp.int32)
+        want = greedy_generate(CFG, params, prompt, 6)
+        got = sample_generate(CFG, params, prompt, 6, jax.random.key(3),
+                              temperature=1.0, top_p=1e-6)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_top_p_restricts_support(self):
+        # sampled tokens must come from each row's exact nucleus (the
+        # smallest sorted prefix whose mass reaches top_p)
+        params = _params()
+        prompt = jnp.ones((3, 4), jnp.int32)
+        logits = np.asarray(GPT(CFG).apply({"params": params}, prompt)[:, -1])
+
+        def nucleus(row, p):
+            order = np.argsort(row)[::-1]
+            probs = np.exp(row - row.max())
+            probs /= probs.sum()
+            cum = np.cumsum(probs[order])
+            keep = (cum - probs[order]) < p  # mass before the token
+            return set(order[keep].tolist())
+
+        nuclei = [nucleus(row, 0.1) for row in logits]
+        for seed in range(8):
+            out = sample_generate(CFG, params, prompt, 1,
+                                  jax.random.key(seed), top_p=0.1)
+            first = np.asarray(out)[:, -1]
+            for b, t in enumerate(first):
+                assert int(t) in nuclei[b], (b, int(t), nuclei[b])
+
+    def test_top_p_validation(self):
+        with pytest.raises(ValueError, match="top_p"):
+            sample_generate(CFG, _params(), jnp.ones((1, 2), jnp.int32), 2,
+                            jax.random.key(0), top_p=0.0)

@@ -83,3 +83,30 @@ def test_ulysses_typoed_axis_fails_loudly_inside_shard_map():
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     with pytest.raises((NameError, Exception), match="sq_typo|unbound"):
         jax.block_until_ready(fn(q, k, v))
+
+
+def test_ulysses_with_windowed_flash_inner_kernel():
+    """The documented sliding-window + SP recipe: ulysses re-shards heads,
+    the inner kernel is flash attention with window=W; must match the
+    dense band oracle."""
+    import functools
+
+    from tensorflowonspark_tpu.ops import flash_attention
+
+    W = 5
+    mesh = make_mesh(MeshSpec(sp=2, dp=1), devices=jax.devices()[:2])
+    q, k, v = _qkv(jax.random.key(7))
+    out = ulysses_self_attention(
+        mesh, q, k, v, causal=True,
+        attn_fn=functools.partial(flash_attention, window=W,
+                                  block_q=8, block_k=8))
+
+    # dense band oracle
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (D ** 0.5)
+    pos = jnp.arange(T)
+    keep = (pos[:, None] >= pos[None, :]) & (pos[None, :] > pos[:, None] - W)
+    s = jnp.where(keep[None, None], s.astype(jnp.float32), -jnp.inf)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1),
+                     v.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

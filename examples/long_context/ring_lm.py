@@ -45,6 +45,17 @@ def main(args):
     sp_fn = {"ring": ring_self_attention,
              "ulysses": ulysses_self_attention}[args.sp_impl]
     attention_fn = functools.partial(sp_fn, mesh, causal=True)
+    if args.window:
+        # sliding-window + SP: each head shard runs the banded flash
+        # kernel over its full-sequence view (ulysses only — the ring
+        # streams K/V blocks and has no pluggable inner kernel)
+        if args.sp_impl != "ulysses":
+            raise SystemExit("--window requires --sp_impl ulysses")
+        from tensorflowonspark_tpu.ops import flash_attention
+
+        attention_fn = functools.partial(
+            sp_fn, mesh, causal=True,
+            attn_fn=functools.partial(flash_attention, window=args.window))
 
     cfg = BertConfig(vocab_size=args.vocab, hidden_size=args.hidden,
                      num_layers=2, num_heads=4,
@@ -99,6 +110,9 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--sp", type=int, default=4)
     p.add_argument("--sp_impl", choices=("ring", "ulysses"), default="ring")
+    p.add_argument("--window", type=int, default=0,
+                   help="sliding-window attention width (ulysses only; "
+                        "0 = full causal)")
     p.add_argument("--vocab", type=int, default=32)
     p.add_argument("--hidden", type=int, default=32)
     p.add_argument("--seq_len", type=int, default=256)

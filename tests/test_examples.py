@@ -102,6 +102,14 @@ def test_mnist_estimator(tmp_path):
     assert "final eval step=8" in out
 
 
+def test_ring_lm_windowed_ulysses(tmp_path):
+    out = _run("long_context/ring_lm.py", "--sp", "2", "--sp_impl", "ulysses",
+               "--window", "32", "--seq_len", "64", "--batch_size", "4",
+               "--max_steps", "6", "--model_dir", str(tmp_path / "w"),
+               timeout=600)
+    assert "ring_lm: done" in out
+
+
 def test_ring_lm_long_context(tmp_path):
     """Both sequence-parallel constructions; the loss trajectories must
     agree (ring and ulysses compute the same attention)."""

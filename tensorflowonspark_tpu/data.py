@@ -180,6 +180,11 @@ class Dataset:
 
         return Dataset(make)
 
+    def flat_map(self, fn: Callable[[Any], "Dataset | Iterable"]) -> "Dataset":
+        """Map each element to a sub-dataset and concatenate them in order
+        (``tf.data.Dataset.flat_map`` = sequential ``interleave``)."""
+        return self.interleave(fn, cycle_length=1, block_length=1)
+
     def filter(self, pred: Callable[[Any], bool]) -> "Dataset":
         src = self._make
         return Dataset(lambda: (x for x in src() if pred(x)))

@@ -307,3 +307,11 @@ class TestCheckpointableIterator:
         it = Dataset.from_tensor_slices(np.arange(6)).checkpointable()
         next(it)
         assert json.loads(json.dumps(it.state())) == it.state()
+
+
+def test_flat_map_concatenates_in_order():
+    from tensorflowonspark_tpu.data import Dataset
+
+    ds = Dataset.from_tensor_slices(np.arange(3)).flat_map(
+        lambda x: [int(x) * 10 + i for i in range(2)])
+    assert ds.as_numpy() == [0, 1, 10, 11, 20, 21]

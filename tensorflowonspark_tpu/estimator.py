@@ -118,6 +118,16 @@ class Estimator:
         on resume, skip the already-trained prefix of ``input_fn``'s first
         epoch instead of re-training it (the tf.data iterator-checkpoint
         analogue; exact for deterministic pipelines).  Default True.
+        Caveat: the sidecar is also written when a ``train()`` call ends
+        normally (the preemption path needs it), but in-process
+        continuation (e.g. ``train_and_evaluate``'s next throttle
+        segment) intentionally starts ``input_fn`` fresh at batch 0 —
+        so a segment that runs after a process restart skips the
+        recorded prefix while the same segment in an uninterrupted run
+        does not.  Restarted and uninterrupted runs therefore see the
+        same steps but a (benignly) different data schedule; pipelines
+        that must be restart-invariant should key shuffling on the
+        global step rather than the within-epoch position.
       warm_start_from: another model_dir to initialise PARAMS from (the
         ``tf.estimator.WarmStartSettings`` analogue) when ``model_dir``
         itself holds no checkpoint yet: the donor's latest params are

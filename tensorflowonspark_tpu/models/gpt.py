@@ -450,9 +450,18 @@ def greedy_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int):
                      lambda logits, i: jnp.argmax(logits, axis=-1))
 
 
+def _select_beam(scores, lengths, T0: int, length_penalty: float):
+    """argmax over beams of ``score / (T0 + length)**length_penalty``
+    (HF ``BeamHypotheses`` normalization: full sequence length, prompt
+    included); raw-score argmax when the penalty is 0."""
+    sel = scores if length_penalty == 0.0 else \
+        scores / (T0 + lengths).astype(jnp.float32) ** length_penalty
+    return jnp.argmax(sel, axis=-1)
+
+
 def beam_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
                   *, num_beams: int = 4, eos_id: int | None = None,
-                  return_scores: bool = False):
+                  length_penalty: float = 0.0, return_scores: bool = False):
     """Beam-search decode: ONE compiled program, like the other decoders.
 
     Beams ride the batch axis (``B·K`` rows) so every step is the same
@@ -461,13 +470,24 @@ def beam_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
     prefilled ONCE at batch ``B`` and the cache tiled to ``B·K`` — no
     K-fold prefill cost.  With ``eos_id`` a finished beam is frozen (only
     its EOS continuation survives, score unchanged).  Returns the best
-    beam ``[B, T0 + max_new_tokens]`` (and per-sequence log-prob scores
-    ``[B]`` when ``return_scores``).
+    beam ``[B, T0 + max_new_tokens]`` (and its raw log-prob sum ``[B]``
+    when ``return_scores``).
+
+    ``length_penalty`` selects the best beam by
+    ``score / seq_len**length_penalty`` where ``seq_len`` is the FULL
+    sequence length — prompt plus generated tokens up to and including
+    EOS — matching HF's ``BeamHypotheses`` normalization.  The default
+    0.0 compares raw log-prob sums, which — with finished beams frozen
+    at constant score — biases toward shorter sequences relative to
+    HF's default of 1.0; pass 1.0 for HF-equivalent selection.
     """
     B, T0 = prompt_ids.shape
     K = int(num_beams)
     if K < 1:
         raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if eos_id is not None and not 0 <= eos_id < cfg.vocab_size:
+        raise ValueError(
+            f"eos_id {eos_id} out of range for vocab_size {cfg.vocab_size}")
     if max_new_tokens <= 0:
         return (prompt_ids, jnp.zeros((B,))) if return_scores else prompt_ids
     total = T0 + max_new_tokens
@@ -511,9 +531,10 @@ def beam_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
     seqs = seqs.at[:, :, 0].set(tok)
     finished = (tok == eos_id) if eos_id is not None \
         else jnp.zeros((B, K), bool)
+    lengths = jnp.ones((B, K), jnp.int32)  # generated tokens incl. EOS
 
     def step(carry, i):
-        seqs, scores, tok, finished, cache = carry
+        seqs, scores, tok, finished, lengths, cache = carry
         logits, vars_ = model.apply(
             {"params": params, "cache": cache},
             tok.reshape(B * K)[:, None], mutable=["cache"])
@@ -529,17 +550,21 @@ def beam_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
         take = lambda a: jnp.take_along_axis(a, parent, axis=1)  # noqa: E731
         seqs = jnp.take_along_axis(
             seqs, parent[:, :, None], axis=1).at[:, :, i].set(tok)
-        finished = take(finished) | ((tok == eos_id) if eos_id is not None
-                                     else False)
+        was_finished = take(finished)
+        finished = was_finished | ((tok == eos_id) if eos_id is not None
+                                   else False)
+        # a beam not finished BEFORE this token grew to i+1 tokens
+        lengths = jnp.where(was_finished, take(lengths), i + 1)
         flat_parent = (jnp.arange(B)[:, None] * K + parent).reshape(B * K)
         cache = map_cache_batch(
             vars_["cache"], B * K,
             lambda x, ax: jnp.take(x, flat_parent, axis=ax))
-        return (seqs, scores, tok, finished, cache), None
+        return (seqs, scores, tok, finished, lengths, cache), None
 
-    (seqs, scores, _, _, _), _ = jax.lax.scan(
-        step, (seqs, scores, tok, finished, cache), jnp.arange(1, N))
-    best = jnp.argmax(scores, axis=-1)                      # [B]
+    (seqs, scores, _, _, lengths, _), _ = jax.lax.scan(
+        step, (seqs, scores, tok, finished, lengths, cache),
+        jnp.arange(1, N))
+    best = _select_beam(scores, lengths, T0, length_penalty)  # [B]
     out = jnp.take_along_axis(seqs, best[:, None, None], axis=1)[:, 0]
     out = jnp.concatenate([prompt_ids, out], axis=1)
     if return_scores:

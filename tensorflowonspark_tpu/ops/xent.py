@@ -115,7 +115,8 @@ def _xent_flat_bwd(chunk, V, res, g):
 _xent_flat.defvjp(_xent_flat_fwd, _xent_flat_bwd)
 
 
-def tied_softmax_xent(hidden, table, labels, *, chunk_size: int = 4096):
+def tied_softmax_xent(hidden, table, labels, *, chunk_size: int = 4096,
+                      ignore_index: int | None = None):
     """Per-token cross-entropy of a (tied) LM head, chunked over vocab.
 
     Args:
@@ -123,9 +124,19 @@ def tied_softmax_xent(hidden, table, labels, *, chunk_size: int = 4096):
       table: ``[V, H]`` projection/embedding table (tied head layout —
         ``models.GPT``/``models.Bert`` store ``tok_emb`` exactly so).
       labels: ``[...]`` int targets, same leading shape as ``hidden``.
+        Labels MUST lie in ``[0, V)``.  An out-of-range label is NOT an
+        error: one landing in the zero-padded tail chunk (``V <= label <
+        padded_V`` when ``V % chunk_size != 0``) reads a masked column
+        and yields ``+inf`` loss; any other stray value (negative, or
+        ``>= padded_V``) silently yields ``loss == lse``.  Use
+        ``ignore_index`` for intentional padding labels.
       chunk_size: vocab slab per scan step (clamped to V).  Any V works:
         a ragged final chunk is zero-padded internally and its columns
         masked out of both passes.
+      ignore_index: if set (e.g. the HF ``-100`` convention), tokens whose
+        label equals it get loss 0 and contribute no gradient.  ``mean()``
+        over the result divides by ALL tokens; for the usual masked mean
+        divide ``sum()`` by ``(labels != ignore_index).sum()``.
 
     Returns per-token losses ``[...]`` in fp32; ``mean()`` it for the
     usual scalar.  Gradients flow to ``hidden`` and ``table``.
@@ -138,5 +149,13 @@ def tied_softmax_xent(hidden, table, labels, *, chunk_size: int = 4096):
     table_p = jnp.pad(table, ((0, pad), (0, 0))) if pad else table
     lead = hidden.shape[:-1]
     h = hidden.reshape(-1, hidden.shape[-1])
-    out = _xent_flat(h, table_p, labels.reshape(-1), chunk, V)
-    return out.reshape(lead)
+    flat_labels = labels.reshape(-1)
+    if ignore_index is None:
+        out = _xent_flat(h, table_p, flat_labels, chunk, V)
+        return out.reshape(lead)
+    keep = flat_labels != ignore_index
+    safe = jnp.where(keep, flat_labels, 0)
+    out = _xent_flat(h, table_p, safe, chunk, V)
+    # the multiply (not a where on out) zeroes the cotangent into _xent_flat
+    # for ignored tokens, so neither hidden nor table receives gradient.
+    return (out * keep.astype(out.dtype)).reshape(lead)

@@ -101,14 +101,17 @@ def ulysses_self_attention(mesh, q, k, v, mask=None, causal: bool = False,
     spec = P(batch_axes, sp_axis, None, None)
     kernel = functools.partial(ulysses_attention, axis_name=sp_axis,
                                causal=causal, attn_fn=attn_fn)
-    # check_vma=False: custom attn_fns (the documented flash-attention
-    # drop-in) contain pallas_calls whose out_shapes carry no varying-mesh
-    # annotation; jax's default vma check rejects them inside shard_map.
+    # check_vma=False only for custom attn_fns (the documented
+    # flash-attention drop-in): their pallas_calls carry no varying-mesh
+    # annotation on out_shapes, which jax's default vma check rejects
+    # inside shard_map.  The default reference-attention path keeps the
+    # check on so future sharding bugs fail loudly.
+    check_vma = attn_fn is None
     if mask is None:
-        fn = jax.shard_map(kernel, mesh=mesh, check_vma=False,
+        fn = jax.shard_map(kernel, mesh=mesh, check_vma=check_vma,
                            in_specs=(spec, spec, spec), out_specs=spec)
         return fn(q, k, v)
     mask_spec = P(batch_axes, sp_axis)
-    fn = jax.shard_map(kernel, mesh=mesh, check_vma=False,
+    fn = jax.shard_map(kernel, mesh=mesh, check_vma=check_vma,
                        in_specs=(spec, spec, spec, mask_spec), out_specs=spec)
     return fn(q, k, v, mask)

@@ -307,6 +307,56 @@ class TestBeamSearch:
             if len(hits):  # after the first EOS, only EOS (frozen beam)
                 assert np.all(row[hits[0]:] == 0), row
 
+    def test_out_of_range_eos_raises(self):
+        from tensorflowonspark_tpu.models.gpt import beam_generate
+
+        params = _params()
+        prompt = jnp.zeros((1, 3), jnp.int32)
+        with pytest.raises(ValueError, match="eos_id"):
+            beam_generate(CFG, params, prompt, 4, eos_id=CFG.vocab_size)
+        with pytest.raises(ValueError, match="eos_id"):
+            beam_generate(CFG, params, prompt, 4, eos_id=-1)
+
+    def test_length_penalty_selection(self):
+        # length_penalty=1.0 selects by mean logprob; with no EOS all
+        # lengths equal so selection must match the default raw-sum pick
+        from tensorflowonspark_tpu.models.gpt import beam_generate
+
+        params = _params()
+        prompt = jax.random.randint(jax.random.key(11), (2, 4), 0,
+                                    CFG.vocab_size)
+        raw, s_raw = beam_generate(CFG, params, prompt, 6, num_beams=3,
+                                   return_scores=True)
+        lp, s_lp = beam_generate(CFG, params, prompt, 6, num_beams=3,
+                                 length_penalty=1.0, return_scores=True)
+        np.testing.assert_array_equal(np.asarray(raw), np.asarray(lp))
+        np.testing.assert_allclose(np.asarray(s_raw), np.asarray(s_lp),
+                                   rtol=1e-5)
+        # and with an EOS the penalized run still returns a valid beam
+        out = beam_generate(CFG, params, prompt, 8, num_beams=3, eos_id=0,
+                            length_penalty=1.0)
+        assert out.shape == (2, 4 + 8)
+
+    def test_length_penalty_flips_selection(self):
+        # deterministic case where per-length normalization reverses the
+        # raw-sum pick: beam 0 has the better sum but a much shorter
+        # sequence's mean beats it after dividing by T0+length
+        from tensorflowonspark_tpu.models.gpt import _select_beam
+
+        scores = jnp.array([[-4.0, -4.5]])
+        lengths = jnp.array([[2, 8]])
+        T0 = 2
+        assert int(_select_beam(scores, lengths, T0, 0.0)[0]) == 0
+        # -4/(2+2)=-1.0 vs -4.5/(2+8)=-0.45 -> penalized picks beam 1
+        assert int(_select_beam(scores, lengths, T0, 1.0)[0]) == 1
+        # HF full-length convention: a generated-only normalization
+        # (lengths without T0) would pick differently here
+        scores2 = jnp.array([[-5.0, -9.0]])
+        lengths2 = jnp.array([[5, 10]])
+        # full length: -5/15=-0.333 vs -9/20=-0.45 -> beam 0
+        assert int(_select_beam(scores2, lengths2, 10, 1.0)[0]) == 0
+        # generated-only would give -5/5=-1.0 vs -9/10=-0.9 -> beam 1
+
 
 class TestGroupedQueryAttention:
     @pytest.mark.parametrize("kv_heads", [1, 2])

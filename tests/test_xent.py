@@ -80,6 +80,35 @@ def test_gpt_default_vocab_traces():
     assert out.shape == (4,)
 
 
+def test_ignore_index_zero_loss_and_grad():
+    # HF -100 convention: ignored tokens get loss 0 and NO gradient
+    V, H = 64, 8
+    h = jax.random.normal(jax.random.key(0), (3, 4, H))
+    t = jax.random.normal(jax.random.key(1), (V, H))
+    y = jax.random.randint(jax.random.key(2), (3, 4), 0, V)
+    y = y.at[0, 1].set(-100).at[2, 3].set(-100)
+    got = tied_softmax_xent(h, t, y, chunk_size=16, ignore_index=-100)
+    keep = y != -100
+    ref = jnp.where(keep, _dense_ref(h, t, jnp.where(keep, y, 0)), 0.0)
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+    assert got[0, 1] == 0.0 and got[2, 3] == 0.0
+
+    def masked_mean(h, t):
+        per = tied_softmax_xent(h, t, y, chunk_size=16, ignore_index=-100)
+        return per.sum() / keep.sum()
+
+    def dense_masked_mean(h, t):
+        per = jnp.where(keep, _dense_ref(h, t, jnp.where(keep, y, 0)), 0.0)
+        return per.sum() / keep.sum()
+
+    gh, gt = jax.grad(masked_mean, argnums=(0, 1))(h, t)
+    gh_r, gt_r = jax.grad(dense_masked_mean, argnums=(0, 1))(h, t)
+    np.testing.assert_allclose(gh, gh_r, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(gt, gt_r, rtol=2e-5, atol=2e-5)
+    # ignored tokens' hidden rows get exactly zero gradient
+    np.testing.assert_array_equal(gh[0, 1], np.zeros(H))
+
+
 def test_nonpositive_chunk_raises():
     h = jnp.zeros((2, 8))
     t = jnp.zeros((30, 8))

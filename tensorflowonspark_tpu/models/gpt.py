@@ -450,12 +450,13 @@ def greedy_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int):
                      lambda logits, i: jnp.argmax(logits, axis=-1))
 
 
-def _select_beam(scores, lengths, T0: int, length_penalty: float):
-    """argmax over beams of ``score / (T0 + length)**length_penalty``
-    (HF ``BeamHypotheses`` normalization: full sequence length, prompt
-    included); raw-score argmax when the penalty is 0."""
+def _select_beam(scores, lengths, length_penalty: float):
+    """argmax over beams of ``score / generated_len**length_penalty`` —
+    modern HF's ``BeamHypotheses`` normalization (transformers >= 4.38
+    passes ``generated_len = cur_len - decoder_prompt_len``: prompt
+    excluded, EOS included); raw-score argmax when the penalty is 0."""
     sel = scores if length_penalty == 0.0 else \
-        scores / (T0 + lengths).astype(jnp.float32) ** length_penalty
+        scores / lengths.astype(jnp.float32) ** length_penalty
     return jnp.argmax(sel, axis=-1)
 
 
@@ -474,12 +475,13 @@ def beam_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
     when ``return_scores``).
 
     ``length_penalty`` selects the best beam by
-    ``score / seq_len**length_penalty`` where ``seq_len`` is the FULL
-    sequence length — prompt plus generated tokens up to and including
-    EOS — matching HF's ``BeamHypotheses`` normalization.  The default
-    0.0 compares raw log-prob sums, which — with finished beams frozen
-    at constant score — biases toward shorter sequences relative to
-    HF's default of 1.0; pass 1.0 for HF-equivalent selection.
+    ``score / generated_len**length_penalty`` where ``generated_len``
+    counts generated tokens up to and including EOS (prompt excluded) —
+    modern HF's ``BeamHypotheses`` normalization (transformers >= 4.38;
+    older releases divided by the full prompt-inclusive length).  The
+    default 0.0 compares raw log-prob sums, which — with finished beams
+    frozen at constant score — biases toward shorter sequences relative
+    to HF's default of 1.0; pass 1.0 for HF-equivalent selection.
     """
     B, T0 = prompt_ids.shape
     K = int(num_beams)
@@ -564,7 +566,7 @@ def beam_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
     (seqs, scores, _, _, lengths, _), _ = jax.lax.scan(
         step, (seqs, scores, tok, finished, lengths, cache),
         jnp.arange(1, N))
-    best = _select_beam(scores, lengths, T0, length_penalty)  # [B]
+    best = _select_beam(scores, lengths, length_penalty)    # [B]
     out = jnp.take_along_axis(seqs, best[:, None, None], axis=1)[:, 0]
     out = jnp.concatenate([prompt_ids, out], axis=1)
     if return_scores:

@@ -164,6 +164,179 @@ def fn_distributed_pjit_train(args, ctx):
                 + ",".join(f"{v:.8f}" for v in w_host))
 
 
+def fn_distributed_multidev_train(args, ctx):
+    """Multi-process × MULTI-DEVICE GSPMD: 2 processes × 4 CPU devices each
+    → one 8-device global mesh — the actual TPU-pod regime (SURVEY.md §7
+    hard part 1) that neither the 2×1-device tests nor the single-process
+    8-device dryrun reach.
+
+    Two mesh layouts, switched by ``args["span_process_boundary"]``:
+      False — dp2 ACROSS the processes, fsdp2·tp2 INSIDE each (the layout
+        a pod would use: high-traffic axes on-host);
+      True — device order transposed so every tp PAIR spans the process
+        boundary (tp collectives ride the inter-process link) — the
+        composition no single-process test can exercise.
+
+    Trains a tanh MLP and writes loss trajectory + a replicated parameter
+    fingerprint; the driver compares both against a numpy oracle.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    ctx.initialize_distributed()
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel import make_mesh
+    from tensorflowonspark_tpu.parallel.mesh import MeshSpec
+
+    assert jax.process_count() == 2, jax.process_count()
+    devs = jax.devices()
+    assert len(devs) == 8, f"need 2 procs x 4 devices, got {len(devs)}"
+    span = bool(args.get("span_process_boundary"))
+    if span:
+        # transpose the device grid: tp pairs become (proc0_dev, proc1_dev)
+        grid = np.array(devs).reshape(2, 4).T.reshape(-1)
+        mesh = make_mesh(MeshSpec(dp=4, fsdp=1, tp=2), devices=grid)
+        pairs = mesh.devices.reshape(4, 2)
+        for pair in pairs:
+            procs = {d.process_index for d in pair}
+            assert procs == {0, 1}, f"tp pair does not span processes: {procs}"
+        w1_spec, data_spec = P(None, "tp"), P("dp")
+    else:
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2), devices=devs)
+        outer = mesh.devices.reshape(2, -1)
+        assert {d.process_index for d in outer[0]} == {0}
+        assert {d.process_index for d in outer[1]} == {1}
+        w1_spec, data_spec = P("fsdp", "tp"), P(("dp", "fsdp"))
+
+    rng = np.random.default_rng(0)
+    X_np = rng.standard_normal((8, 4)).astype(np.float32)
+    y_np = rng.standard_normal((8,)).astype(np.float32)
+    W1_np = (rng.standard_normal((4, 8)) * 0.5).astype(np.float32)
+    W2_np = (rng.standard_normal((8,)) * 0.5).astype(np.float32)
+
+    def put(a, spec):
+        sh = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(a.shape, sh, lambda i: a[i])
+
+    X = put(X_np, data_spec)
+    y = put(y_np, P(data_spec[0]) if data_spec else P())
+    W1 = put(W1_np, w1_spec)
+    W2 = put(W2_np, P("tp"))
+
+    lr = 0.1
+
+    @jax.jit
+    def train_step(W1, W2, X, y):
+        def loss_fn(W1, W2):
+            h = jnp.tanh(X @ W1)
+            return jnp.mean((h @ W2 - y) ** 2)
+
+        loss, (g1, g2) = jax.value_and_grad(loss_fn, argnums=(0, 1))(W1, W2)
+        return W1 - lr * g1, W2 - lr * g2, loss
+
+    losses = []
+    for _ in range(int(args.get("steps", 3))):
+        W1, W2, loss = train_step(W1, W2, X, y)
+        losses.append(float(loss))
+    # replicated scalar fingerprint (the sharded weights themselves are not
+    # addressable from any single process)
+    fp = float(jax.jit(lambda a, b: jnp.sum(a ** 2) + jnp.sum(b ** 2))(W1, W2))
+
+    path = os.path.join(ctx.working_dir, f"mdev.{ctx.executor_id}")
+    with open(path, "w") as f:
+        f.write(f"{jax.process_count()}:{len(devs)}:"
+                + ",".join(f"{v:.8f}" for v in losses) + f":{fp:.8f}")
+
+
+def fn_distributed_pipeline_multidev(args, ctx):
+    """GPipe across processes WITH multi-device stages: mesh pp2·dp2·tp2
+    over 2 processes × 4 devices — each pipeline stage lives on one
+    process and is itself Megatron-tp·dp-sharded
+    (``make_transformer_stage``), so the stage-hop ppermute crosses the
+    process boundary while tp psums stay inside each stage."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    ctx.initialize_distributed()
+
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel import (make_mesh, pipeline_apply,
+                                                make_transformer_stage,
+                                                stack_stage_params)
+    from tensorflowonspark_tpu.parallel.mesh import MeshSpec
+
+    devs = jax.devices()
+    assert len(devs) == 8 and jax.process_count() == 2
+    mesh = make_mesh(MeshSpec(pp=2, dp=2, tp=2), devices=devs)
+    stages = mesh.devices.reshape(2, -1)  # pp outermost -> one per process
+    assert {d.process_index for d in stages[0]} == {0}
+    assert {d.process_index for d in stages[1]} == {1}
+
+    hid, heads, ffn, seq, vocab = 32, 4, 64, 8, 64
+    num_mb, steps = 2, int(args.get("steps", 2))
+    stage_fn, init_fn, param_specs = make_transformer_stage(
+        hid, heads, ffn, tp=2, causal=True)
+    tx = optax.adamw(1e-3)
+    batch = 2 * num_mb * 2  # 2 rows per microbatch per dp shard
+    data_spec = P(("dp", "fsdp"), "sp", None)
+    rng = np.random.default_rng(0)
+    ids_np = rng.integers(0, vocab, (batch, seq)).astype(np.int32)
+
+    def init_params():
+        keys = jax.random.split(jax.random.key(0), 2)
+        return {
+            "emb": jax.random.normal(jax.random.key(1), (vocab, hid)) * 0.02,
+            "stages": stack_stage_params([init_fn(k) for k in keys]),
+        }
+
+    p_sh = {
+        "emb": NamedSharding(mesh, P()),
+        "stages": jax.tree.map(
+            lambda s: NamedSharding(mesh, P("pp", *s)), param_specs,
+            is_leaf=lambda s: isinstance(s, P)),
+    }
+
+    with mesh:
+        params = jax.jit(init_params, out_shardings=p_sh)()
+        opt_state = jax.jit(tx.init)(params)
+        ids = jax.make_array_from_callback(
+            ids_np.shape, NamedSharding(mesh, P(("dp", "fsdp"), None)),
+            lambda i: ids_np[i])
+
+        def loss_fn(p):
+            x = p["emb"][ids]
+            y = pipeline_apply(mesh, stage_fn, p["stages"], x,
+                               num_microbatches=num_mb,
+                               param_specs=param_specs, data_spec=data_spec)
+            logits = jnp.einsum("bsh,vh->bsv", y, p["emb"])
+            labels = jnp.roll(ids, -1, axis=1)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+
+        @jax.jit
+        def train_step(p, o):
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            updates, o = tx.update(grads, o, p)
+            return optax.apply_updates(p, updates), o, loss
+
+        losses = []
+        for _ in range(steps):
+            params, opt_state, loss = train_step(params, opt_state)
+            losses.append(float(loss))
+
+    path = os.path.join(ctx.working_dir, f"mpipe.{ctx.executor_id}")
+    with open(path, "w") as f:
+        f.write(":".join(f"{v:.8f}" for v in losses))
+
+
 def fn_train_checkpoint_crash_once(args, ctx):
     """Deterministic 'training' with orbax checkpoints; injects ONE chief
     crash mid-run on the first attempt (sentinel file) so

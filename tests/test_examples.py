@@ -13,6 +13,11 @@ import sys
 
 import pytest
 
+# The slow tail of the suite (each test spawns a fresh interpreter that
+# re-imports jax).  Core development loop: ``pytest -m "not example"``;
+# CI / driver rounds run the full suite.
+pytestmark = pytest.mark.example
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EX = os.path.join(ROOT, "examples")
 

@@ -339,23 +339,21 @@ class TestBeamSearch:
 
     def test_length_penalty_flips_selection(self):
         # deterministic case where per-length normalization reverses the
-        # raw-sum pick: beam 0 has the better sum but a much shorter
-        # sequence's mean beats it after dividing by T0+length
+        # raw-sum pick: beam 0 has the better sum but a much longer
+        # sequence's mean beats it after dividing by generated length
         from tensorflowonspark_tpu.models.gpt import _select_beam
 
         scores = jnp.array([[-4.0, -4.5]])
         lengths = jnp.array([[2, 8]])
-        T0 = 2
-        assert int(_select_beam(scores, lengths, T0, 0.0)[0]) == 0
-        # -4/(2+2)=-1.0 vs -4.5/(2+8)=-0.45 -> penalized picks beam 1
-        assert int(_select_beam(scores, lengths, T0, 1.0)[0]) == 1
-        # HF full-length convention: a generated-only normalization
-        # (lengths without T0) would pick differently here
+        assert int(_select_beam(scores, lengths, 0.0)[0]) == 0
+        # -4/2=-2.0 vs -4.5/8=-0.5625 -> penalized picks beam 1
+        assert int(_select_beam(scores, lengths, 1.0)[0]) == 1
+        # modern-HF generated-only normalization (prompt EXCLUDED): the
+        # review's canonical example — old full-length (T0=10) HF picked
+        # beam 0 (-5/15 vs -9/20); transformers >= 4.38 picks beam 1
         scores2 = jnp.array([[-5.0, -9.0]])
         lengths2 = jnp.array([[5, 10]])
-        # full length: -5/15=-0.333 vs -9/20=-0.45 -> beam 0
-        assert int(_select_beam(scores2, lengths2, 10, 1.0)[0]) == 0
-        # generated-only would give -5/5=-1.0 vs -9/10=-0.9 -> beam 1
+        assert int(_select_beam(scores2, lengths2, 1.0)[0]) == 1
 
 
 class TestGroupedQueryAttention:

@@ -19,8 +19,8 @@ Artifact: ``bench_artifacts/embedding_<platform>.json``.  CPU numbers prove
 memory behavior + give a floor; the same script reruns on real chips when
 the tunnel allows (ep collectives then ride ICI).
 
-Usage: ``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
-python scripts/bench_embedding.py``
+Usage: ``python scripts/bench_embedding.py`` (self-provisions the 8-device
+CPU mesh; ``--platform native`` to run on the ambient real backend).
 """
 
 from __future__ import annotations
@@ -43,7 +43,26 @@ def main() -> None:
     p.add_argument("--batch", type=int, default=8192)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--ep", type=int, default=8)
+    p.add_argument("--platform", choices=("sim", "native"), default="sim",
+                   help="sim (default): self-provision an ep-device CPU "
+                        "mesh; native: use the ambient backend (real chips)")
     args = p.parse_args()
+
+    # Default: self-exec into the simulated ep-device CPU mesh BEFORE any
+    # jax import.  A bare `python scripts/bench_embedding.py` on a
+    # 1-device box would otherwise clamp ep to 1 and overwrite the 8-way
+    # evidence artifact with a degenerate non-sharded run (and this box's
+    # ambient JAX_PLATFORMS=axon hangs at backend init when the tunnel is
+    # down).  ``--platform native`` opts into the ambient backend.
+    flag = f"--xla_force_host_platform_device_count={args.ep}"
+    if args.platform == "sim" and (os.environ.get("JAX_PLATFORMS") != "cpu"
+                                   or flag not in
+                                   os.environ.get("XLA_FLAGS", "")):
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flag).strip()
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
     from tensorflowonspark_tpu.util import apply_jax_platforms_env
 
@@ -54,6 +73,12 @@ def main() -> None:
     import numpy as np
     import optax
 
+    if len(jax.devices()) < args.ep and args.ep > 1:
+        raise SystemExit(
+            f"need {args.ep} devices for the sharding evidence, have "
+            f"{len(jax.devices())}; pass --ep 1 explicitly for a "
+            f"single-device throughput run")
+
     from tensorflowonspark_tpu.parallel import make_mesh
     from tensorflowonspark_tpu.parallel.embedding import (ShardedEmbedding,
                                                           apply_sharded_lookup)
@@ -61,7 +86,7 @@ def main() -> None:
     from tensorflowonspark_tpu.parallel.sharding import flax_shardings
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    ep = min(args.ep, len(jax.devices()))
+    ep = args.ep
     mesh = make_mesh(MeshSpec(ep=ep, dp=1), devices=jax.devices()[:ep])
     V, F = args.vocab, args.features
     V -= V % ep  # exact shards keep the accounting assertions simple
@@ -136,7 +161,7 @@ def main() -> None:
         "vocab": V, "features": F, "ep": ep, "batch": args.batch,
         "table_MB": total_bytes / 1e6,
         "per_device_MB": shard_bytes[0] / 1e6,
-        "sharded_not_replicated": True,
+        "sharded_not_replicated": ep > 1,  # ep=1 is a throughput-only run
         "init_s": t_init,
         "train_step_ms": dt * 1e3,
         "train_lookups_per_sec": train_lookups_per_sec,

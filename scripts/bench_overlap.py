@@ -102,12 +102,16 @@ def main() -> None:
     t_cached = (time.perf_counter() - t0) / steps
 
     # ---- naive: synchronous put-then-step ----
+    # block on BOTH the copy and the step output each iteration: on async
+    # backends jax's dispatch would otherwise overlap step k's compute
+    # with step k+1's device_put, silently pipelining the "unpipelined"
+    # baseline and collapsing the overlap denominator
     t0 = time.perf_counter()
     for x in producer():
         d = jax.device_put(x)
-        jax.block_until_ready(d)          # the unpipelined pattern
+        jax.block_until_ready(d)
         out = step(d, W)
-    out.block_until_ready()
+        out.block_until_ready()
     t_naive = (time.perf_counter() - t0) / steps
 
     # ---- prefetch: the framework streaming path ----

@@ -110,7 +110,7 @@ _DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
 
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 _OP_RE = re.compile(
-    r"=\s*(\([^)]*\)|\S+)\s+"
+    r"=\s*(\((?:[^()]|\([^()]*\))*\)|\S+)\s+"  # type: tuple (1 nesting) or scalar
     r"(all-reduce-start|all-reduce|all-gather-start|all-gather|"
     r"reduce-scatter-start|reduce-scatter|"
     r"collective-permute-start|collective-permute|"
@@ -133,8 +133,43 @@ def _shape_bytes(type_str: str) -> float:
     return total
 
 
-def _first_group(line: str):
-    """First replica group's device ids, handling explicit and iota forms."""
+def _tuple_elements(type_str: str) -> list[str]:
+    """Split a tuple type ``(f32[8,2]{1,0}, (f32[4]), u32[])`` at its TOP
+    level — commas inside ``[]``/``{}``/nested ``()`` don't split."""
+    s = type_str.strip()
+    if not (s.startswith("(") and s.endswith(")")):
+        return [s]
+    s = s[1:-1]
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(s):
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(s[start:i])
+            start = i + 1
+    parts.append(s[start:])
+    return [p for p in (p.strip() for p in parts) if p]
+
+
+def _payload_bytes(type_str: str, is_async_start: bool) -> float:
+    """Collective payload from the HLO result type.  Sync forms: the whole
+    (possibly variadic-tuple) result IS the payload.  Async ``-start``
+    forms return ``(operand, result[, context scalars...])`` — counting
+    the whole tuple would double the payload, so take the result element."""
+    if not is_async_start:
+        return _shape_bytes(type_str)
+    elems = _tuple_elements(type_str)
+    if len(elems) >= 2:
+        return _shape_bytes(elems[1])
+    return _shape_bytes(elems[0])
+
+
+def _first_group(line: str, n_devices: int):
+    """First replica group's device ids, handling explicit, iota, and
+    empty (= all devices) forms.  Raises on anything else — a silently
+    unpriced collective would inflate the predicted efficiency."""
     m = _GROUPS_RE.search(line)
     if m:
         return [int(v) for v in m.group(1).split(",")]
@@ -148,6 +183,8 @@ def _first_group(line: str):
         if m.group(4):
             ids = ids.transpose([int(v) for v in m.group(4).split(",")])
         return list(ids.reshape(n_groups, group_size)[0])
+    if "replica_groups={}" in line:  # empty form: one group of everyone
+        return list(range(n_devices))
     return None
 
 
@@ -163,18 +200,19 @@ def extract_collectives(hlo: str, axis_sizes: dict) -> list[dict]:
         m = _OP_RE.search(line)
         if not m:
             continue
-        type_str, op = m.group(1), m.group(2).removesuffix("-start")
-        bytes_ = _shape_bytes(type_str)
-        if op == "all-gather":
-            # payload counted at the gathered (output) size already, since
-            # the result type is the full gather
-            pass
-        group = _first_group(line)
+        raw_op = m.group(2)
+        type_str, op = m.group(1), raw_op.removesuffix("-start")
+        bytes_ = _payload_bytes(type_str, raw_op.endswith("-start"))
+        # (all-gather payload is counted at the gathered size: the result
+        # type is the full gather)
+        total = math.prod(sizes)
+        group = _first_group(line, total)
         if group is None and op == "collective-permute":
             pm = _PERMUTE_RE.search(line)
-            group = [int(pm.group(1)), int(pm.group(2))] if pm else [0]
+            group = [int(pm.group(1)), int(pm.group(2))] if pm else None
         if not group:
-            group = [0]
+            raise ValueError(
+                f"unparseable replica_groups in collective line: {line!r}")
         coords = np.array(np.unravel_index(np.array(group), sizes)).T
         axes = [names[i] for i in range(len(names))
                 if len(set(coords[:, i])) > 1]
@@ -240,19 +278,17 @@ def _build_resnet_dp(n: int):
 
 
 def _build_bert_gspmd(n: int):
-    """Flagship workload: the dryrun's GSPMD BERT at base dims — tp2·sp2
-    inside a host, dp = n/4 across; ring attention over sp, chunked tied
-    xent, adamw."""
+    """Flagship workload: THE dryrun train step (``__graft_entry__.
+    build_bert_train_step`` — same loss, same shardings, same donation)
+    at BERT-base dims: tp2·sp2 inside a host, dp = n/4 across, ring
+    attention over sp, chunked tied xent, adamw."""
     import jax
     import jax.numpy as jnp
-    import optax
     from functools import partial
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from tensorflowonspark_tpu.models import Bert, BertConfig
-    from tensorflowonspark_tpu.ops import tied_softmax_xent
+    from __graft_entry__ import build_bert_train_step
+    from tensorflowonspark_tpu.models import BertConfig
     from tensorflowonspark_tpu.parallel import make_mesh, ring_self_attention
-    from tensorflowonspark_tpu.parallel.sharding import flax_shardings
     from tensorflowonspark_tpu.parallel.mesh import MeshSpec
 
     mesh = make_mesh(MeshSpec(dp=n // 4, sp=2, tp=2),
@@ -262,40 +298,14 @@ def _build_bert_gspmd(n: int):
                      dtype=jnp.bfloat16, dropout_rate=0.0,
                      attention_fn=partial(ring_self_attention, mesh),
                      emb_spec=(("ep", "tp"), None))
-    model = Bert(cfg)
-    tx = optax.adamw(1e-4)
     per_chip_batch = 8           # per-dp-group batch; global = 8 * dp
-    batch = per_chip_batch * mesh.shape["dp"]
-    seq = 512
+    built = build_bert_train_step(
+        mesh, cfg, chunk_size=4096,
+        batch=per_chip_batch * mesh.shape["dp"], seq=512)
+    batch, seq = built["batch"], built["seq"]
     ids = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
     labels = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
-
-    def init_fn():
-        params = model.init(jax.random.key(0),
-                            jnp.ones((batch, seq), jnp.int32))
-        return params, tx.init(params["params"])
-
-    abstract = jax.eval_shape(init_fn)
-    shardings = flax_shardings(mesh, abstract)
-    data_sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
-
-    def loss_fn(p, ids, labels):
-        hidden = model.apply(p, ids)
-        table = p["params"]["tok_emb"]["embedding"]
-        table = getattr(table, "value", table)
-        return tied_softmax_xent(hidden, table, labels,
-                                 chunk_size=4096).mean()
-
-    def train_step(params, opt_state, ids, labels):
-        loss, grads = jax.value_and_grad(
-            lambda pp: loss_fn({"params": pp}, ids, labels))(params["params"])
-        updates, opt_state = tx.update(grads, opt_state, params["params"])
-        new_params = optax.apply_updates(params["params"], updates)
-        return {"params": new_params}, opt_state, loss
-
-    jitted = jax.jit(train_step, donate_argnums=(0, 1),
-                     in_shardings=(*shardings, data_sh, data_sh))
-    return mesh, jitted, (*abstract, ids, labels)
+    return mesh, built["step"], (*built["abstract"], ids, labels)
 
 
 WORKLOADS = {"resnet50_dp": _build_resnet_dp, "bert_tp_sp_dp": _build_bert_gspmd}
@@ -398,16 +408,17 @@ def main() -> None:
         rows = [r for r in results if r["workload"] == workload]
         if not rows:  # every compile for this workload failed
             continue
-        base = next((r for r in rows if r["n"] == min(r2["n"] for r2 in rows)),
-                    None)
+        base = min(rows, key=lambda r: r["n"])
         for r in rows:
             for key in ("efficiency_no_overlap", "efficiency_full_overlap"):
-                r["scaling_" + key] = r[key] / base[key] if base and base[key] \
-                    else None
+                r["scaling_" + key] = r[key] / base[key] if base[key] else None
 
     out = {"assumptions": MODEL_ASSUMPTIONS, "results": results}
     os.makedirs(os.path.join(REPO, "bench_artifacts"), exist_ok=True)
-    path = os.path.join(REPO, "bench_artifacts", "scaling_model.json")
+    # partial sweeps (smoke / debugging) must not clobber the full artifact
+    name = "scaling_model.json" if sizes == MESH_SIZES \
+        else "scaling_model_partial.json"
+    path = os.path.join(REPO, "bench_artifacts", name)
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(f"wrote {path}")

@@ -11,6 +11,12 @@ Run (2 "ps" shards simulated on an 8-device CPU mesh):
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python examples/wide_deep/wide_deep_criteo.py --cpu --num_ps 2 --steps 20
+
+This example runs a toy vocab; the Criteo-scale evidence (1M×64 table over
+ep=8: exact 1/8-per-device memory incl. optimizer state, lookup+update
+throughput) is ``scripts/bench_embedding.py`` →
+``bench_artifacts/embedding_cpu.json`` (ledger row in
+``docs/performance.md`` "Scale evidence").
 """
 
 import argparse

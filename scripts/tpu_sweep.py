@@ -67,7 +67,8 @@ def _device():
 # ---------------------------------------------------------------------------
 # Stage: resnet batch sweep
 # ---------------------------------------------------------------------------
-def stage_resnet(batch: int, remat: bool = False) -> dict:
+def stage_resnet(batch: int, remat: bool = False,
+                 stem: str = "conv7") -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -79,7 +80,7 @@ def stage_resnet(batch: int, remat: bool = False) -> dict:
     image, steps, warmup = (64, 2, 1) if SMOKE else (224, 20, 3)
     if SMOKE:
         batch = min(batch, 8)
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem)
     tx = optax.sgd(0.1, momentum=0.9)
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal(
@@ -125,7 +126,7 @@ def stage_resnet(batch: int, remat: bool = False) -> dict:
     dt = (time.perf_counter() - t0) / steps
     peak = 197e12 if "v5 lite" in dev.device_kind.lower() else None
     row = {
-        "batch": batch, "remat": remat,
+        "batch": batch, "remat": remat, "stem": stem,
         "images_per_sec": round(batch / dt, 1),
         "step_ms": round(dt * 1e3, 2),
         "flops_per_step": flops,
@@ -139,9 +140,9 @@ def stage_resnet(batch: int, remat: bool = False) -> dict:
     if os.path.exists(path):
         with open(path) as f:
             data = json.load(f)
-    data["rows"] = [r for r in data["rows"]
-                    if (r["batch"], r["remat"]) != (batch, remat)] + [row]
-    data["rows"].sort(key=lambda r: (r["batch"], r["remat"]))
+    key = lambda r: (r["batch"], r["remat"], r.get("stem", "conv7"))  # noqa: E731
+    data["rows"] = [r for r in data["rows"] if key(r) != key(row)] + [row]
+    data["rows"].sort(key=key)
     _write("resnet_sweep.json", data)
     return row
 
@@ -309,10 +310,11 @@ def main() -> None:
                    help="run one stage in-process (internal)")
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--stem", default="conv7", choices=("conv7", "s2d"))
     args = p.parse_args()
 
     if args.stage == "resnet":
-        stage_resnet(args.batch, args.remat)
+        stage_resnet(args.batch, args.remat, args.stem)
         return
     if args.stage == "flash":
         stage_flash()
@@ -338,6 +340,8 @@ def main() -> None:
                           "--batch", "1024"], 900),
         ("resnet_b128", [sys.executable, me, "--stage", "resnet",
                          "--batch", "128"], 900),
+        ("resnet_b256_s2d", [sys.executable, me, "--stage", "resnet",
+                             "--batch", "256", "--stem", "s2d"], 900),
         ("flash_sweep", [sys.executable, me, "--stage", "flash"], 1200),
         ("decode_matrix", [sys.executable, me, "--stage", "decode"], 1800),
         ("overlap_tpu", [sys.executable,

@@ -20,6 +20,37 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
+def space_to_depth(x, block: int = 2):
+    """NHWC space-to-depth: ``[B, H, W, C] -> [B, H/b, W/b, b*b*C]`` with
+    channel order ``(dy, dx, c)``.  The MXU-feeding transform for the
+    ImageNet stem: a 224×224×3 image becomes 112×112×12, so the stem
+    conv's contraction dim grows 4× toward the MXU's 128 lanes."""
+    B, H, W, C = x.shape
+    if H % block or W % block:
+        raise ValueError(f"space_to_depth needs H and W divisible by "
+                         f"{block}, got {H}x{W} (pad or crop the input)")
+    x = x.reshape(B, H // block, block, W // block, block, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, H // block, W // block, block * block * C)
+
+
+def conv7_stem_to_s2d_kernel(k7):
+    """EXACT weight transform from the standard 7×7/s2 ImageNet stem to
+    the space-to-depth stem's 4×4/s1 kernel.
+
+    A 7×7 stride-2 pad-3 conv equals an 8×8 stride-2 conv whose kernel is
+    zero-padded one row/col at the top/left (padding (4,3)); on the
+    2×2-space-to-depth image that is exactly a 4×4 stride-1 conv with
+    padding (2,1) over 4C channels ordered ``(dy, dx, c)`` — the MLPerf
+    ResNet trick.  ``k7`` is HWIO ``[7, 7, C, O]``; returns
+    ``[4, 4, 4C, O]``.  ``tests/test_models.py`` locks bit-level parity.
+    """
+    C, O = k7.shape[2], k7.shape[3]
+    k8 = jnp.pad(k7, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    k4 = k8.reshape(4, 2, 4, 2, C, O).transpose(0, 2, 1, 3, 4, 5)
+    return k4.reshape(4, 4, 4 * C, O)
+
+
 class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
@@ -80,13 +111,28 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     cifar_stem: bool = False  # 3x3/1 stem, no maxpool (CIFAR-10 inputs)
+    # "s2d": MLPerf-style space-to-depth stem — 2×2 s2d then a 4×4/s1 conv
+    # over 4C channels, mathematically EXACT vs the 7×7/s2 stem under the
+    # conv7_stem_to_s2d_kernel weight transform.  The 7×7 stem contracts
+    # only 3 input channels (the MXU's 128 contraction lanes mostly idle);
+    # s2d contracts 12 over a 4× smaller spatial extent.  Ignored when
+    # ``cifar_stem`` is set.
+    stem: str = "conv7"
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
+        if self.stem not in ("conv7", "s2d"):
+            raise ValueError(f"unknown stem {self.stem!r} "
+                             "(expected 'conv7' or 's2d')")
         x = x.astype(self.dtype)
         if self.cifar_stem:
             x = nn.Conv(self.num_filters, (3, 3), use_bias=False, dtype=self.dtype)(x)
+        elif self.stem == "s2d":
+            x = space_to_depth(x, 2)
+            x = nn.Conv(self.num_filters, (4, 4), strides=(1, 1),
+                        padding=[(2, 1), (2, 1)], use_bias=False,
+                        dtype=self.dtype)(x)
         else:
             x = nn.Conv(self.num_filters, (7, 7), strides=(2, 2),
                         padding=[(3, 3), (3, 3)], use_bias=False, dtype=self.dtype)(x)

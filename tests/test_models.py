@@ -53,6 +53,42 @@ def test_resnet50_forward_shape():
     assert logits.shape == (1, 1000)
 
 
+def test_s2d_stem_exactly_matches_conv7_stem():
+    """The space-to-depth stem is the 7×7/s2 stem under an exact weight
+    transform (MLPerf ResNet trick) — same params everywhere else, full
+    forward outputs must agree to float32 tolerance."""
+    from tensorflowonspark_tpu.models.resnet import (ResNet, BasicBlock,
+                                                     conv7_stem_to_s2d_kernel)
+
+    k = dict(stage_sizes=(1, 1), block=BasicBlock, num_classes=7,
+             dtype=jnp.float32)
+    m7 = ResNet(**k)
+    ms = ResNet(**k, stem="s2d")
+    x = jax.random.normal(jax.random.key(0), (2, 64, 64, 3), jnp.float32)
+    v7 = m7.init(jax.random.key(1), x)
+    k7 = v7["params"]["Conv_0"]["kernel"]
+    assert k7.shape == (7, 7, 3, 64)
+    vs = {**v7, "params": {**v7["params"],
+                           "Conv_0": {"kernel": conv7_stem_to_s2d_kernel(k7)}}}
+    out7 = m7.apply(v7, x)
+    outs = ms.apply(vs, x)
+    np.testing.assert_allclose(np.asarray(outs), np.asarray(out7),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_s2d_stem_trains_from_scratch():
+    from tensorflowonspark_tpu.models.resnet import ResNet, BasicBlock
+
+    model = ResNet(stage_sizes=(1, 1), block=BasicBlock, num_classes=5,
+                   stem="s2d", dtype=jnp.float32)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x, train=True)
+    assert variables["params"]["Conv_0"]["kernel"].shape == (4, 4, 12, 64)
+    logits, _ = model.apply(variables, x, train=True,
+                            mutable=["batch_stats"])
+    assert logits.shape == (2, 5)
+
+
 def test_unet_preserves_spatial_dims():
     model = UNet(num_classes=3, features=(8, 16, 32), dtype=jnp.float32)
     x = jnp.zeros((2, 32, 32, 1))

@@ -28,6 +28,24 @@ def _local_shard(args, ctx):
 
     from tensorflowonspark_tpu.data import Dataset
 
+    if getattr(args, "grain", False):
+        # grain-backed per-host loader (SURVEY §7's named InputMode.
+        # TENSORFLOW equivalent): a grain MapDataset over the sample
+        # index, globally shuffled with a host-consistent seed, sliced to
+        # this worker via Dataset.from_grain_sharded.
+        import grain.python as grain_py
+
+        rng = np.random.default_rng(1234)  # same seed on EVERY worker:
+        all_images = rng.random((args.num_samples, 28, 28), np.float32)
+        all_labels = rng.integers(0, 10, size=args.num_samples)
+        md = grain_py.MapDataset.source(np.arange(args.num_samples))
+        ds = Dataset.from_grain_sharded(
+            md, ctx.num_workers, ctx.executor_id, shuffle=True,
+            seed=42).map(lambda i: (all_images[i], all_labels[i]))
+        pairs = ds.as_numpy()
+        return (np.stack([p[0] for p in pairs]),
+                np.asarray([p[1] for p in pairs]))
+
     if args.data_dir:
         ds = (Dataset.from_examples(os.path.join(args.data_dir, "part-*"),
                                     shard=(ctx.num_workers, ctx.executor_id))
@@ -100,9 +118,15 @@ if __name__ == "__main__":
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--num_samples", type=int, default=2000)
     p.add_argument("--data_dir", default="", help="TFRecord dir (image,label)")
+    p.add_argument("--grain", action="store_true",
+                   help="build the per-worker shard with a grain loader "
+                        "(Dataset.from_grain_sharded; synthetic data)")
     p.add_argument("--model_dir", default="")
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args()
+    if args.grain and args.data_dir:
+        p.error("--grain demonstrates the grain loader on synthetic data; "
+                "it does not read --data_dir — pass one or the other")
 
     worker_env = {"JAX_PLATFORMS": "cpu"} if args.cpu else None
     cluster = TPUCluster.run(main_fun, args, args.cluster_size,

@@ -46,11 +46,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ART = os.path.join(REPO, "bench_artifacts")
 
 
+def _path(name: str) -> str:
+    """Artifact path; smoke runs get a ``smoke_`` prefix so they can never
+    clobber real-chip artifacts."""
+    return os.path.join(ART, ("smoke_" if SMOKE else "") + name)
+
+
 def _write(name: str, payload: dict) -> None:
     os.makedirs(ART, exist_ok=True)
-    with open(os.path.join(ART, name), "w") as f:
+    with open(_path(name), "w") as f:
         json.dump(payload, f, indent=2)
-    print(f"sweep: wrote bench_artifacts/{name}", flush=True)
+    print(f"sweep: wrote {os.path.relpath(_path(name), REPO)}", flush=True)
 
 
 SMOKE = bool(os.environ.get("SWEEP_SMOKE"))  # tiny-shape CPU validation mode
@@ -135,7 +141,7 @@ def stage_resnet(batch: int, remat: bool = False,
     }
     print("sweep resnet:", json.dumps(row), flush=True)
     # merge into the sweep artifact
-    path = os.path.join(ART, "resnet_sweep.json")
+    path = _path("resnet_sweep.json")
     data = {"rows": []}
     if os.path.exists(path):
         with open(path) as f:
@@ -292,8 +298,10 @@ def stage_decode() -> dict:
 # Orchestrator
 # ---------------------------------------------------------------------------
 def probe(timeout_s: int = 120) -> bool:
+    platform_check = "" if SMOKE else \
+        "assert jax.devices()[0].platform == 'tpu'; "
     code = ("import jax, jax.numpy as jnp; "
-            "assert jax.devices()[0].platform == 'tpu'; "
+            + platform_check +
             "x = jnp.ones((256, 256), jnp.bfloat16); "
             "(x @ x).block_until_ready(); print('probe ok')")
     try:
@@ -331,7 +339,12 @@ def main() -> None:
 
     me = os.path.abspath(__file__)
     stages: list[tuple[str, list[str], int]] = [
-        ("bench_py", [sys.executable, os.path.join(REPO, "bench.py")], 1800),
+        # bench.py writes real artifact names (gpt_decode.json,
+        # flash_attention.json, bench_baseline.json) with no smoke
+        # awareness — skipped in smoke, like bench_overlap below
+        *([] if SMOKE else [
+            ("bench_py", [sys.executable,
+                          os.path.join(REPO, "bench.py")], 1800)]),
         ("resnet_b256", [sys.executable, me, "--stage", "resnet",
                          "--batch", "256"], 900),
         ("resnet_b512", [sys.executable, me, "--stage", "resnet",
@@ -344,9 +357,13 @@ def main() -> None:
                              "--batch", "256", "--stem", "s2d"], 900),
         ("flash_sweep", [sys.executable, me, "--stage", "flash"], 1200),
         ("decode_matrix", [sys.executable, me, "--stage", "decode"], 1800),
-        ("overlap_tpu", [sys.executable,
-                         os.path.join(REPO, "scripts", "bench_overlap.py"),
-                         "--batch-mb", "64"], 900),
+        # bench_overlap writes its own overlap_<platform>.json; skipped in
+        # smoke so a CPU smoke run can't clobber the committed CPU artifact
+        *([] if SMOKE else [
+            ("overlap_tpu", [sys.executable,
+                             os.path.join(REPO, "scripts",
+                                          "bench_overlap.py"),
+                             "--batch-mb", "64"], 900)]),
         ("resnet_b1024_remat", [sys.executable, me, "--stage", "resnet",
                                 "--batch", "1024", "--remat"], 900),
     ]

@@ -148,6 +148,40 @@ class Dataset:
         """A re-invocable generator factory (called once per iteration)."""
         return Dataset(lambda: iter(fn()))
 
+    @staticmethod
+    def from_grain(source) -> "Dataset":
+        """Wrap a grain object — ``DataLoader``, ``IterDataset``, or
+        ``MapDataset`` — as a framework Dataset.
+
+        Grain is the TPU-idiomatic host input library (SURVEY.md §7 names
+        it as the InputMode.TENSORFLOW equivalent: per-host sharded
+        loaders where the reference ran tf.data on each executor).  All
+        three grain types re-iterate from the start on each ``iter()``,
+        matching this class's re-invocable contract, so the wrapped
+        dataset composes with every transform here (``.batch``,
+        ``.prefetch``, ``cache_on_device`` …).
+        """
+        return Dataset(lambda: iter(source))
+
+    @staticmethod
+    def from_grain_sharded(map_dataset, num_shards: int, index: int, *,
+                           shuffle: bool = False,
+                           seed: int | None = None) -> "Dataset":
+        """Per-host shard of a grain ``MapDataset`` — the
+        InputMode.TENSORFLOW pattern (each worker reads its own slice;
+        reference: ``tf.data.Dataset.shard(num_workers, worker_num)`` on
+        executors) built from grain's native ops: optional global
+        ``shuffle(seed)`` BEFORE the ``[index::num_shards]`` slice (so
+        every epoch's permutation is consistent across hosts), then an
+        ``IterDataset``.  Inside ``map_fun``, pass
+        ``ctx.num_workers``/``ctx.task_index``.
+        """
+        assert 0 <= index < num_shards, f"bad shard ({num_shards}, {index})"
+        ds = map_dataset
+        if shuffle:
+            ds = ds.shuffle(seed=0 if seed is None else seed)
+        return Dataset.from_grain(ds[index::num_shards].to_iter_dataset())
+
     # ------------------------------------------------------------- transforms
     def shard(self, num_shards: int, index: int) -> "Dataset":
         """Element-stride partition ``index`` of ``num_shards`` (exact and

@@ -25,6 +25,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache: the suite is compile-bound on this class of
+# box (~16 min cold for the core loop, mostly >1s jit compiles); cached
+# re-runs skip straight to execution.  Keyed by HLO hash, so code changes
+# invalidate exactly the programs they touch.
+from tensorflowonspark_tpu.util import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache(os.environ.get("TFOS_TEST_CACHE",
+                                        "/tmp/tfos_test_jax_cache"))
+
 
 @pytest.fixture(scope="session")
 def jax_cpu_mesh_devices():

@@ -59,6 +59,12 @@ def test_mnist_tf_mode():
     assert "mnist_tf: done" in out
 
 
+def test_mnist_tf_mode_grain_loader():
+    out = _run("mnist/mnist_tf.py", "--cluster_size", "2", "--steps", "8",
+               "--batch_size", "16", "--num_samples", "128", "--grain")
+    assert "mnist_tf: done" in out
+
+
 def test_mnist_pipeline(tmp_path):
     out = _run("mnist/mnist_pipeline.py", "--cluster_size", "1",
                "--num_samples", "64", "--batch_size", "16",

@@ -1,6 +1,7 @@
 """Model zoo tests: forward shapes + one optimization step each, at toy sizes."""
 
 import dataclasses
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -18,12 +19,18 @@ TINY_BERT = BertConfig(vocab_size=128, hidden_size=32, num_layers=2,
                        num_heads=4, intermediate_size=64,
                        max_position_embeddings=64, dtype=jnp.float32)
 
+# The big-model smoke tests jit init/apply instead of running eagerly:
+# eager dispatch of a deep conv net is the slow path on a 1-core box
+# (inception eager ≈ 50 s), and only jitted programs land in the
+# persistent compile cache conftest enables — cached re-runs of these
+# tests are seconds, not minutes.
+
 
 def test_mnist_forward_and_step():
     model = MNISTNet()
     x = jnp.zeros((4, 28, 28, 1))
-    params = model.init(jax.random.key(0), x)
-    logits = model.apply(params, x)
+    params = jax.jit(model.init)(jax.random.key(0), x)
+    logits = jax.jit(model.apply)(params, x)
     assert logits.shape == (4, 10)
 
     def loss_fn(p):
@@ -31,16 +38,19 @@ def test_mnist_forward_and_step():
         return optax.softmax_cross_entropy_with_integer_labels(
             out, jnp.zeros(4, jnp.int32)).mean()
 
-    g = jax.grad(loss_fn)(params)
+    g = jax.jit(jax.grad(loss_fn))(params)
     assert jnp.isfinite(jax.tree.reduce(lambda a, b: a + b.sum(), g, 0.0))
 
 
 def test_cifar_resnet_forward_train_mode():
     model = CifarResNet(dtype=jnp.float32)
     x = jnp.zeros((2, 32, 32, 3))
-    variables = model.init(jax.random.key(0), x, train=True)
+    variables = jax.jit(partial(model.init, train=True))(
+        jax.random.key(0), x)
     assert "batch_stats" in variables
-    logits, updates = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    logits, updates = jax.jit(
+        partial(model.apply, train=True, mutable=["batch_stats"]))(
+            variables, x)
     assert logits.shape == (2, 10)
     assert "batch_stats" in updates
 
@@ -48,8 +58,8 @@ def test_cifar_resnet_forward_train_mode():
 def test_resnet50_forward_shape():
     model = ResNet50(num_classes=1000, dtype=jnp.float32)
     x = jnp.zeros((1, 64, 64, 3))  # small spatial for test speed
-    variables = model.init(jax.random.key(0), x)
-    logits = model.apply(variables, x)
+    variables = jax.jit(model.init)(jax.random.key(0), x)
+    logits = jax.jit(model.apply)(variables, x)
     assert logits.shape == (1, 1000)
 
 
@@ -92,8 +102,8 @@ def test_s2d_stem_trains_from_scratch():
 def test_unet_preserves_spatial_dims():
     model = UNet(num_classes=3, features=(8, 16, 32), dtype=jnp.float32)
     x = jnp.zeros((2, 32, 32, 1))
-    variables = model.init(jax.random.key(0), x)
-    out = model.apply(variables, x)
+    variables = jax.jit(model.init)(jax.random.key(0), x)
+    out = jax.jit(model.apply)(variables, x)
     assert out.shape == (2, 32, 32, 3)
 
 
@@ -222,12 +232,13 @@ def test_inception_v3_forward_shape():
 
     model = InceptionV3(num_classes=11, dtype=jnp.float32)
     x = jnp.zeros((1, 75, 75, 3))  # smallest supported spatial extent
-    variables = model.init({"params": jax.random.key(0),
-                            "dropout": jax.random.key(1)}, x, train=True)
+    variables = jax.jit(lambda x: model.init(
+        {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+        x, train=True))(x)
     assert "batch_stats" in variables
-    logits, updates = model.apply(variables, x, train=True,
-                                  mutable=["batch_stats"],
-                                  rngs={"dropout": jax.random.key(1)})
+    logits, updates = jax.jit(lambda v, x: model.apply(
+        v, x, train=True, mutable=["batch_stats"],
+        rngs={"dropout": jax.random.key(1)}))(variables, x)
     assert logits.shape == (1, 11)
     assert "batch_stats" in updates
     # inference path: no dropout rng needed

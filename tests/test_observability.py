@@ -78,7 +78,10 @@ def test_start_tensorboard_real_module(tmp_path):
     assert port > 0
     try:
         status = None
-        for _ in range(30):
+        # 90s budget: TB's bootstrap on a saturated 1-core box can exceed
+        # 30s (observed flake when the suite shares the core with other
+        # jobs); serving normally starts within ~5s
+        for _ in range(90):
             try:
                 status = urllib.request.urlopen(
                     f"http://127.0.0.1:{port}", timeout=3).status

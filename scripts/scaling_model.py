@@ -35,6 +35,7 @@ compile per (workload, n)); ``--child`` is internal.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import math
 import os
@@ -63,7 +64,19 @@ MODEL_ASSUMPTIONS = {
                                    # resnet50_tpu_2026-07-29.json) b256 bf16
         "bert_tp_sp_dp": 0.24,     # assumed = measured ResNet MFU until a
                                    # BERT step is measured on-chip
+        "bert_fsdp8_dp": 0.24,     # same assumption
+        "ring_longctx_sp": 0.24,   # same assumption
+        "ring_longctx_sp_t8k": 0.24,
     },
+    "loop_collectives": "a collective inside a while-loop body appears "
+                        "once in HLO but runs trip-count times; each "
+                        "loop's trip is read from the constant bound in "
+                        "its condition computation (lax.scan/fori emit "
+                        "counted loops; ring K/V rotation = sp trips, "
+                        "chunked-xent scan = ceil(V/chunk)), nested "
+                        "loops multiply, and a loop with no parseable "
+                        "bound and no declared fallback is an error — "
+                        "never a silent undercount",
     "collective_models": {
         "all-reduce": "2*bytes*(k-1)/k / BW   (bidirectional ring, "
                       "reduce-scatter + all-gather phases)",
@@ -188,36 +201,116 @@ def _first_group(line: str, n_devices: int):
     return None
 
 
-def extract_collectives(hlo: str, axis_sizes: dict) -> list[dict]:
+# a computation definition line: `%name (args...) -> type {` — args/types
+# nest parens freely, so anchor on the NAME-then-( prefix and the `{` tail
+_COMPUTATION_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*->.*\{\s*$")
+_WHILE_RE = re.compile(r"while\([^)]*\),\s*condition=%?([\w.\-]+),\s*"
+                       r"body=%?([\w.\-]+)")
+_CONST_RE = re.compile(r"constant\((\d+)\)")
+
+
+def _split_computations(hlo: str) -> dict[str, list[str]]:
+    comps: dict[str, list[str]] = {}
+    cur = None
+    for line in hlo.splitlines():
+        m = _COMPUTATION_RE.match(line.strip())
+        if m:
+            cur = m.group(1)
+            comps[cur] = []
+        elif cur is not None:
+            comps[cur].append(line)
+    return comps
+
+
+def _loop_multipliers(comps: dict[str, list[str]],
+                      fallback_trip: int | None) -> dict[str, int]:
+    """Execution-count multiplier per computation.
+
+    A collective in a ``while`` body runs trip-count times but appears
+    once in HLO.  XLA emits counted loops (``lax.scan`` / ``fori_loop``,
+    and its own pipelined 'wide' transforms of them) with the bound as a
+    constant in the CONDITION computation — read it there; nested whiles
+    multiply.  A body whose condition has no usable constant falls back
+    to ``fallback_trip``; ``None`` fallback raises at lookup so traffic
+    is never silently underpriced.
+    """
+    # (parent computation, cond, body) for every while instruction
+    whiles = []
+    for parent, lines in comps.items():
+        for line in lines:
+            for cond, body in _WHILE_RE.findall(line):
+                whiles.append((parent, cond, body))
+
+    def trip_of(cond: str) -> int | None:
+        consts = [int(v) for v in _CONST_RE.findall(
+            "\n".join(comps.get(cond, [])))]
+        best = max(consts, default=0)
+        return best if best > 0 else fallback_trip
+
+    mult: dict[str, int] = {}
+
+    def resolve(comp: str, seen=()) -> int:
+        if comp in mult:
+            return mult[comp]
+        if comp in seen:  # cycle guard (should not happen in HLO)
+            return 1
+        m = 1
+        for parent, cond, body in whiles:
+            if body == comp:
+                trip = trip_of(cond)
+                if trip is None:
+                    raise ValueError(
+                        f"while body {comp!r}: no trip-count constant in "
+                        f"condition {cond!r} and no fallback declared — "
+                        f"in-loop collectives would be underpriced")
+                m = trip * resolve(parent, (*seen, comp))
+                break
+        mult[comp] = m
+        return m
+
+    for comp in comps:
+        resolve(comp)
+    return mult
+
+
+def extract_collectives(hlo: str, axis_sizes: dict,
+                        loop_trip: int | None = None) -> list[dict]:
     """One record per collective op in the partitioned module: payload
-    bytes, group size, and which mesh axes the group spans."""
+    bytes (already multiplied by the enclosing loops' trip counts — see
+    :func:`_loop_multipliers`), group size, and which mesh axes the
+    group spans."""
     import numpy as np
 
     sizes = tuple(axis_sizes.values())
     names = list(axis_sizes.keys())
+    comps = _split_computations(hlo)
+    mult = _loop_multipliers(comps, loop_trip)
     out = []
-    for line in hlo.splitlines():
-        m = _OP_RE.search(line)
-        if not m:
-            continue
-        raw_op = m.group(2)
-        type_str, op = m.group(1), raw_op.removesuffix("-start")
-        bytes_ = _payload_bytes(type_str, raw_op.endswith("-start"))
-        # (all-gather payload is counted at the gathered size: the result
-        # type is the full gather)
-        total = math.prod(sizes)
-        group = _first_group(line, total)
-        if group is None and op == "collective-permute":
-            pm = _PERMUTE_RE.search(line)
-            group = [int(pm.group(1)), int(pm.group(2))] if pm else None
-        if not group:
-            raise ValueError(
-                f"unparseable replica_groups in collective line: {line!r}")
-        coords = np.array(np.unravel_index(np.array(group), sizes)).T
-        axes = [names[i] for i in range(len(names))
-                if len(set(coords[:, i])) > 1]
-        out.append({"op": op, "bytes": bytes_, "group_size": len(group),
-                    "axes": axes})
+    for comp, lines in comps.items():
+        for line in lines:
+            m = _OP_RE.search(line)
+            if not m:
+                continue
+            raw_op = m.group(2)
+            type_str, op = m.group(1), raw_op.removesuffix("-start")
+            bytes_ = _payload_bytes(type_str, raw_op.endswith("-start"))
+            # (all-gather payload is counted at the gathered size: the
+            # result type is the full gather)
+            bytes_ *= mult[comp]
+            total = math.prod(sizes)
+            group = _first_group(line, total)
+            if group is None and op == "collective-permute":
+                pm = _PERMUTE_RE.search(line)
+                group = [int(pm.group(1)), int(pm.group(2))] if pm else None
+            if not group:
+                raise ValueError(
+                    f"unparseable replica_groups in collective: {line!r}")
+            coords = np.array(np.unravel_index(np.array(group), sizes)).T
+            axes = [names[i] for i in range(len(names))
+                    if len(set(coords[:, i])) > 1]
+            out.append({"op": op, "bytes": bytes_,
+                        "group_size": len(group), "axes": axes,
+                        "loop_multiplier": mult[comp]})
     return out
 
 
@@ -274,7 +367,7 @@ def _build_resnet_dp(n: int):
     jitted = jax.jit(
         train_step, donate_argnums=(0, 1),
         in_shardings=(var_sh, opt_sh, data_sh, data_sh))
-    return mesh, jitted, (variables, abstract_opt, x, y)
+    return mesh, jitted, (variables, abstract_opt, x, y), 1
 
 
 def _build_bert_gspmd(n: int):
@@ -305,10 +398,75 @@ def _build_bert_gspmd(n: int):
     batch, seq = built["batch"], built["seq"]
     ids = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
     labels = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
-    return mesh, built["step"], (*built["abstract"], ids, labels)
+    # ring attention's K/V rotation is a fori_loop over the sp axis
+    return mesh, built["step"], (*built["abstract"], ids, labels), \
+        mesh.shape["sp"]
 
 
-WORKLOADS = {"resnet50_dp": _build_resnet_dp, "bert_tp_sp_dp": _build_bert_gspmd}
+def _build_bert_fsdp(n: int):
+    """ZeRO-3 regime: BERT-base with weights auto-sharded over fsdp=8
+    inside a host (the dryrun phase-4 overlay), dp = n/8 across — the
+    traffic is per-layer weight all-gathers + grad reduce-scatters, the
+    scaling question FSDP users actually have."""
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import auto_fsdp_overlay, build_bert_train_step
+    from tensorflowonspark_tpu.models import BertConfig
+    from tensorflowonspark_tpu.parallel import make_mesh
+    from tensorflowonspark_tpu.parallel.mesh import MeshSpec
+
+    mesh = make_mesh(MeshSpec(dp=n // 8, fsdp=8),
+                     devices=jax.devices()[:n])
+    cfg = BertConfig(num_layers=12, hidden_size=768, num_heads=12,
+                     intermediate_size=3072, max_position_embeddings=512,
+                     dtype=jnp.bfloat16, dropout_rate=0.0)
+    built = build_bert_train_step(
+        mesh, cfg, chunk_size=4096, batch=8 * n, seq=512,
+        shard_overlay=auto_fsdp_overlay(mesh))
+    batch, seq = built["batch"], built["seq"]
+    ids = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    labels = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    return mesh, built["step"], (*built["abstract"], ids, labels), 1
+
+
+def _build_ring_longctx(n: int, per_device_seq: int = 2048):
+    """Long-context regime: sequence sharded over sp = ALL n devices with
+    ring attention, ``per_device_seq`` tokens per device (T grows with
+    the mesh — 524k tokens at n=256·2048), batch 1.  Prices the brief's
+    long-context-first-class claim: K/V blocks rotate (sp hops per layer,
+    again on the backward).  The per-device shard size is THE efficiency
+    knob: ring comm per device is O(T_total) while attention compute per
+    device is O(T_local·T_total), so efficiency scales with T_local."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from __graft_entry__ import build_bert_train_step
+    from tensorflowonspark_tpu.models import BertConfig
+    from tensorflowonspark_tpu.parallel import make_mesh, ring_self_attention
+    from tensorflowonspark_tpu.parallel.mesh import MeshSpec
+
+    mesh = make_mesh(MeshSpec(sp=n, dp=1), devices=jax.devices()[:n])
+    seq = per_device_seq * n
+    cfg = BertConfig(num_layers=12, hidden_size=768, num_heads=12,
+                     intermediate_size=3072, max_position_embeddings=seq,
+                     dtype=jnp.bfloat16, dropout_rate=0.0,
+                     attention_fn=partial(ring_self_attention, mesh))
+    built = build_bert_train_step(mesh, cfg, chunk_size=4096, batch=1,
+                                  seq=seq)
+    ids = jax.ShapeDtypeStruct((1, seq), jnp.int32)
+    labels = jax.ShapeDtypeStruct((1, seq), jnp.int32)
+    return mesh, built["step"], (*built["abstract"], ids, labels), \
+        mesh.shape["sp"]
+
+
+WORKLOADS = {"resnet50_dp": _build_resnet_dp,
+             "bert_tp_sp_dp": _build_bert_gspmd,
+             "bert_fsdp8_dp": _build_bert_fsdp,
+             "ring_longctx_sp": _build_ring_longctx,
+             "ring_longctx_sp_t8k": functools.partial(_build_ring_longctx,
+                                                      per_device_seq=8192)}
 
 
 def child(workload: str, n: int) -> None:
@@ -318,17 +476,17 @@ def child(workload: str, n: int) -> None:
     import jax
 
     assert len(jax.devices()) >= n, (len(jax.devices()), n)
-    mesh, jitted, abstract_args = WORKLOADS[workload](n)
+    mesh, jitted, abstract_args, loop_trip = WORKLOADS[workload](n)
     compiled = jitted.lower(*abstract_args).compile()
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0]
     flops_per_device = float(cost.get("flops", 0.0))
     hlo = compiled.as_text()
-    colls = extract_collectives(hlo, dict(mesh.shape))
+    colls = extract_collectives(hlo, dict(mesh.shape), loop_trip=loop_trip)
     print(json.dumps({
         "workload": workload, "n": n, "mesh": dict(mesh.shape),
-        "flops_per_device": flops_per_device,
+        "flops_per_device": flops_per_device, "loop_trip": loop_trip,
         "collectives": colls,
     }))
 

@@ -125,6 +125,23 @@ def test_fsdp_train_step_matches_single_device():
                                rtol=1e-5)
 
 
+def test_anchor_activations_batch_sharding():
+    """anchor_activations pins (pytrees of) activations to the data axes
+    — the FSDP propagation anchor (scaling_model measured 47 GB -> 1.1 GB
+    per step on BERT-base fsdp=8 from one anchor at the loss head)."""
+    strat = FSDPStrategy(min_shard_size=1)
+    x = jnp.ones((8, 4, 6))
+    out = strat.anchor_activations({"h": x, "pooled": jnp.ones((8, 6)),
+                                    "loss": jnp.float32(0.5)})
+    assert out["h"].sharding.spec == P(("dp", "fsdp"), None, None)
+    assert out["pooled"].sharding.spec == P(("dp", "fsdp"), None)
+    assert float(out["loss"]) == 0.5  # scalars pass through untouched
+    # numerics untouched, and usable under jit (the real usage site)
+    np.testing.assert_array_equal(np.asarray(out["h"]), np.asarray(x))
+    y = jax.jit(lambda a: strat.anchor_activations(a) * 2)(x)
+    np.testing.assert_array_equal(np.asarray(y), 2 * np.asarray(x))
+
+
 # -- sharded embedding (num_ps replacement) --------------------------------
 
 def test_sharded_embedding_module_matches_dense():

@@ -67,6 +67,8 @@ MODEL_ASSUMPTIONS = {
         "bert_fsdp8_dp": 0.24,     # same assumption
         "ring_longctx_sp": 0.24,   # same assumption
         "ring_longctx_sp_t8k": 0.24,
+        "ring16_sp_t8k": 0.24,
+        "ulysses16_sp_t8k": 0.24,
     },
     "loop_collectives": "a collective inside a while-loop body appears "
                         "once in HLO but runs trip-count times; each "
@@ -77,6 +79,11 @@ MODEL_ASSUMPTIONS = {
                         "loops multiply, and a loop with no parseable "
                         "bound and no declared fallback is an error — "
                         "never a silent undercount",
+    "loop_flops": "cost_analysis also counts while-body FLOPs once; "
+                  "body DOT flops (2*out_elems*contracted_extent) are "
+                  "re-added x(trip-1) from the HLO — elementwise body "
+                  "flops remain counted once (negligible next to the "
+                  "dots in these workloads)",
     "collective_models": {
         "all-reduce": "2*bytes*(k-1)/k / BW   (bidirectional ring, "
                       "reduce-scatter + all-gather phases)",
@@ -222,24 +229,41 @@ def _split_computations(hlo: str) -> dict[str, list[str]]:
     return comps
 
 
+_CALLEE_RE = re.compile(
+    r"(?:calls=|to_apply=|true_computation=|false_computation=)"
+    r"%?([\w.\-]+)")
+_BRANCHES_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+
+
 def _loop_multipliers(comps: dict[str, list[str]],
                       fallback_trip: int | None) -> dict[str, int]:
     """Execution-count multiplier per computation.
 
-    A collective in a ``while`` body runs trip-count times but appears
-    once in HLO.  XLA emits counted loops (``lax.scan`` / ``fori_loop``,
-    and its own pipelined 'wide' transforms of them) with the bound as a
-    constant in the CONDITION computation — read it there; nested whiles
-    multiply.  A body whose condition has no usable constant falls back
-    to ``fallback_trip``; ``None`` fallback raises at lookup so traffic
-    is never silently underpriced.
+    A collective (or dot) in a ``while`` body runs trip-count times but
+    appears once in HLO.  XLA emits counted loops (``lax.scan`` /
+    ``fori_loop``, and its own pipelined 'wide' transforms of them) with
+    the bound as a constant in the CONDITION computation — read it there
+    (largest constant = the ascending bound); nested whiles multiply.
+    Multipliers ALSO flow through plain call edges (fusions' ``calls=``,
+    ``to_apply=`` reducers, conditional branches) so an op the compiler
+    moved into a sub-computation of a loop body is still scaled; a
+    computation reachable from several callers takes the MAX multiplier
+    (conservative over-count, never an undercount).  A while body whose
+    condition has no usable constant falls back to ``fallback_trip``;
+    ``None`` fallback raises so traffic is never silently underpriced.
     """
-    # (parent computation, cond, body) for every while instruction
-    whiles = []
+    # edges: callee -> list of (caller, factor)
+    edges: dict[str, list[tuple[str, str | None]]] = {}
     for parent, lines in comps.items():
         for line in lines:
             for cond, body in _WHILE_RE.findall(line):
-                whiles.append((parent, cond, body))
+                edges.setdefault(body, []).append((parent, cond))
+                edges.setdefault(cond, []).append((parent, None))
+            for callee in _CALLEE_RE.findall(line):
+                edges.setdefault(callee, []).append((parent, None))
+            for m in _BRANCHES_RE.finditer(line):
+                for callee in re.findall(r"%?([\w.\-]+)", m.group(1)):
+                    edges.setdefault(callee, []).append((parent, None))
 
     def trip_of(cond: str) -> int | None:
         consts = [int(v) for v in _CONST_RE.findall(
@@ -255,16 +279,17 @@ def _loop_multipliers(comps: dict[str, list[str]],
         if comp in seen:  # cycle guard (should not happen in HLO)
             return 1
         m = 1
-        for parent, cond, body in whiles:
-            if body == comp:
+        for parent, cond in edges.get(comp, ()):
+            factor = 1
+            if cond is not None:  # comp is this while's BODY
                 trip = trip_of(cond)
                 if trip is None:
                     raise ValueError(
                         f"while body {comp!r}: no trip-count constant in "
                         f"condition {cond!r} and no fallback declared — "
                         f"in-loop collectives would be underpriced")
-                m = trip * resolve(parent, (*seen, comp))
-                break
+                factor = trip
+            m = max(m, factor * resolve(parent, (*seen, comp)))
         mult[comp] = m
         return m
 
@@ -273,18 +298,77 @@ def _loop_multipliers(comps: dict[str, list[str]],
     return mult
 
 
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(\w+\[[\d,]*\])")
+_DOT_LINE_RE = re.compile(
+    r"=\s*(\w+\[[\d,]*\])\S*\s+dot\(\s*%?([\w.\-]+)\s*,\s*%?([\w.\-]+)")
+_CONTRACT_RE = re.compile(r"rhs_contracting_dims=\{([\d,]*)\}")
+
+
+def _dims(type_str: str) -> list[int]:
+    m = _SHAPE_RE.search(type_str)
+    if not m or not m.group(2):
+        return []
+    return [int(v) for v in m.group(2).split(",")]
+
+
+def _loop_dot_flops(comps: dict[str, list[str]],
+                    mult: dict[str, int]) -> float:
+    """Extra matmul FLOPs hidden by loops: XLA's ``cost_analysis`` counts
+    a while body's FLOPs once, but the body runs trip-count times — the
+    same undercount the collective extractor corrects for bytes.  Dots
+    dominate (ring attention blocks, xent chunk matmuls); elementwise
+    body FLOPs stay undercounted and are noted in the assumptions.
+
+    dot FLOPs = 2 × result_elements × contracted_extent.  Operand types
+    are not printed inline, so each computation's instruction definitions
+    (``%name = type ...``) form a local symbol table the rhs shape is
+    resolved from.  Returns Σ body-dot FLOPs × (multiplier − 1), to be
+    added to ``cost_analysis``'s total (which priced each body once).
+    """
+    extra = 0.0
+    for comp, m in mult.items():
+        if m <= 1:
+            continue
+        table = {}
+        for line in comps.get(comp, []):
+            im = _INSTR_RE.match(line)
+            if im:
+                table[im.group(1)] = im.group(2)
+        for line in comps.get(comp, []):
+            dm = _DOT_LINE_RE.search(line)
+            if not dm:
+                continue
+            out_elems = math.prod(_dims(dm.group(1))) or 1
+            cm = _CONTRACT_RE.search(line)
+            rhs_type = table.get(dm.group(3))
+            if not cm or rhs_type is None:
+                continue  # conservative: skip rather than guess
+            rhs_dims = _dims(rhs_type)
+            contract = 1
+            for idx in (int(v) for v in cm.group(1).split(",") if v):
+                if idx < len(rhs_dims):
+                    contract *= rhs_dims[idx]
+            extra += 2.0 * out_elems * contract * (m - 1)
+    return extra
+
+
 def extract_collectives(hlo: str, axis_sizes: dict,
-                        loop_trip: int | None = None) -> list[dict]:
+                        loop_trip: int | None = None,
+                        comps: dict | None = None,
+                        mult: dict | None = None) -> list[dict]:
     """One record per collective op in the partitioned module: payload
     bytes (already multiplied by the enclosing loops' trip counts — see
     :func:`_loop_multipliers`), group size, and which mesh axes the
-    group spans."""
+    group spans.  Pass precomputed ``comps``/``mult`` to avoid re-parsing
+    a large HLO text (the 2M-token ring modules run to hundreds of MB)."""
     import numpy as np
 
     sizes = tuple(axis_sizes.values())
     names = list(axis_sizes.keys())
-    comps = _split_computations(hlo)
-    mult = _loop_multipliers(comps, loop_trip)
+    if comps is None:
+        comps = _split_computations(hlo)
+    if mult is None:
+        mult = _loop_multipliers(comps, loop_trip)
     out = []
     for comp, lines in comps.items():
         for line in lines:
@@ -461,12 +545,57 @@ def _build_ring_longctx(n: int, per_device_seq: int = 2048):
         mesh.shape["sp"]
 
 
+def _build_sp_attn_h16(n: int, impl: str):
+    """Ring vs Ulysses, exact apples-to-apples: identical model (16 heads
+    so Ulysses can shard sp=16, hidden 1024, 12 layers), identical mesh
+    (sp=n), identical 8192 tokens/device — only the sequence-parallel
+    attention construction differs.  Prices the docs/scaling.md guidance
+    ("long-and-thin → ring; wide → Ulysses") instead of asserting it.
+    Ulysses caps sp at num_heads, so these run only at n ≤ 16 — that cap
+    IS one of the findings."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from __graft_entry__ import build_bert_train_step
+    from tensorflowonspark_tpu.models import BertConfig
+    from tensorflowonspark_tpu.parallel import (make_mesh,
+                                                ring_self_attention,
+                                                ulysses_self_attention)
+    from tensorflowonspark_tpu.parallel.mesh import MeshSpec
+
+    mesh = make_mesh(MeshSpec(sp=n, dp=1), devices=jax.devices()[:n])
+    attn = {"ring": ring_self_attention,
+            "ulysses": ulysses_self_attention}[impl]
+    seq = 8192 * n
+    cfg = BertConfig(num_layers=12, hidden_size=1024, num_heads=16,
+                     intermediate_size=4096, max_position_embeddings=seq,
+                     dtype=jnp.bfloat16, dropout_rate=0.0,
+                     attention_fn=partial(attn, mesh))
+    built = build_bert_train_step(mesh, cfg, chunk_size=4096, batch=1,
+                                  seq=seq)
+    ids = jax.ShapeDtypeStruct((1, seq), jnp.int32)
+    labels = jax.ShapeDtypeStruct((1, seq), jnp.int32)
+    trip = mesh.shape["sp"] if impl == "ring" else None
+    return mesh, built["step"], (*built["abstract"], ids, labels), trip
+
+
 WORKLOADS = {"resnet50_dp": _build_resnet_dp,
              "bert_tp_sp_dp": _build_bert_gspmd,
              "bert_fsdp8_dp": _build_bert_fsdp,
              "ring_longctx_sp": _build_ring_longctx,
              "ring_longctx_sp_t8k": functools.partial(_build_ring_longctx,
-                                                      per_device_seq=8192)}
+                                                      per_device_seq=8192),
+             "ring16_sp_t8k": functools.partial(_build_sp_attn_h16,
+                                                impl="ring"),
+             "ulysses16_sp_t8k": functools.partial(_build_sp_attn_h16,
+                                                   impl="ulysses")}
+
+# per-workload size limits (default: every MESH_SIZES entry).  Ulysses
+# shards heads over sp, so sp cannot exceed num_heads=16; the ring twin
+# runs the same sizes so the comparison stays exact.
+WORKLOAD_SIZES = {"ring16_sp_t8k": [8, 16],
+                  "ulysses16_sp_t8k": [8, 16]}
 
 
 def child(workload: str, n: int) -> None:
@@ -483,10 +612,17 @@ def child(workload: str, n: int) -> None:
         cost = cost[0]
     flops_per_device = float(cost.get("flops", 0.0))
     hlo = compiled.as_text()
-    colls = extract_collectives(hlo, dict(mesh.shape), loop_trip=loop_trip)
+    comps = _split_computations(hlo)
+    mult = _loop_multipliers(comps, loop_trip)
+    colls = extract_collectives(hlo, dict(mesh.shape), loop_trip=loop_trip,
+                                comps=comps, mult=mult)
+    loop_flops = _loop_dot_flops(comps, mult)
     print(json.dumps({
         "workload": workload, "n": n, "mesh": dict(mesh.shape),
-        "flops_per_device": flops_per_device, "loop_trip": loop_trip,
+        "flops_per_device": flops_per_device + loop_flops,
+        "flops_cost_analysis": flops_per_device,
+        "flops_loop_dot_correction": loop_flops,
+        "loop_trip": loop_trip,
         "collectives": colls,
     }))
 
@@ -535,7 +671,8 @@ def main() -> None:
     sizes = [int(v) for v in args.sizes.split(",")]
     results = []
     for workload in WORKLOADS:
-        for n in sizes:
+        for n in [s for s in sizes
+                  if s in WORKLOAD_SIZES.get(workload, sizes)]:
             env = {k: v for k, v in os.environ.items()
                    if k != "PALLAS_AXON_POOL_IPS"}
             env["JAX_PLATFORMS"] = "cpu"

@@ -110,9 +110,9 @@ class MeshStrategy:
         (see ``__graft_entry__.build_bert_train_step``).
         """
         def one(a):
-            if a.ndim == 0:  # scalars (losses, metrics) pass through
+            if jnp.ndim(a) == 0:  # scalars incl. python numbers pass through
                 return a
-            spec = P(sh.batch_pspec()[0], *([None] * (a.ndim - 1)))
+            spec = P(sh.batch_pspec()[0], *([None] * (jnp.ndim(a) - 1)))
             return jax.lax.with_sharding_constraint(
                 a, NamedSharding(self.mesh, spec))
 

@@ -69,6 +69,8 @@ MODEL_ASSUMPTIONS = {
         "ring_longctx_sp_t8k": 0.24,
         "ring16_sp_t8k": 0.24,
         "ulysses16_sp_t8k": 0.24,
+        "moe_ep8_dp": 0.24,
+        "gpipe_pp8_dp": 0.24,
     },
     "loop_collectives": "a collective inside a while-loop body appears "
                         "once in HLO but runs trip-count times; each "
@@ -580,6 +582,118 @@ def _build_sp_attn_h16(n: int, impl: str):
     return mesh, built["step"], (*built["abstract"], ids, labels), trip
 
 
+def _build_moe_ep8(n: int):
+    """Expert parallelism: 8 experts sharded over ep=8, dp = n/8, the
+    all_to_all dispatch path (``parallel/moe.py``) in a full train step —
+    GShard-style traffic: two all_to_alls (dispatch + return) per layer
+    over the ep axis, constant per device as dp grows."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel import (make_mesh, make_moe_layer,
+                                                moe_apply)
+    from tensorflowonspark_tpu.parallel.mesh import MeshSpec
+
+    mesh = make_mesh(MeshSpec(ep=8, dp=n // 8), devices=jax.devices()[:n])
+    hidden, ffn, experts = 768, 3072, 8
+    moe_fn, init_fn, specs = make_moe_layer(hidden, ffn, experts,
+                                            top_k=2, ep=8,
+                                            dtype=jnp.bfloat16)
+    tx = optax.adam(1e-3)
+    tokens = 2048 * n  # 2048 tokens per device
+    x = jax.ShapeDtypeStruct((tokens, hidden), jnp.bfloat16)
+
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda s: isinstance(s, P))
+    abstract_params = jax.eval_shape(lambda: init_fn(jax.random.key(0)))
+    abstract_opt = jax.eval_shape(tx.init, abstract_params)
+    # adam state mirrors params: leave unconstrained, propagation mirrors
+    data_sh = NamedSharding(mesh, P(("dp", "fsdp", "ep"), None))
+
+    def loss_fn(p, x):
+        y, aux = moe_apply(mesh, moe_fn, p, x, param_specs=specs)
+        return jnp.mean(y ** 2) + 0.01 * aux
+
+    def train_step(p, o, x):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1),
+                     in_shardings=(shardings, None, data_sh))
+    return mesh, jitted, (abstract_params, abstract_opt, x), None
+
+
+def _build_pipeline_pp8(n: int):
+    """Pipeline parallelism: 8 GPipe stages over pp=8, dp = n/8 — the
+    manual shard_map schedule (``parallel/pipeline.py``) with BERT-base
+    transformer stages; traffic is one activation tensor per microbatch
+    per stage hop, the cheapest bytes/step of any axis."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel import (make_mesh, pipeline_apply,
+                                                make_transformer_stage,
+                                                stack_stage_params)
+    from tensorflowonspark_tpu.parallel.mesh import MeshSpec
+
+    mesh = make_mesh(MeshSpec(pp=8, dp=n // 8), devices=jax.devices()[:n])
+    hidden, heads, ffn, seq, vocab = 768, 12, 3072, 512, 32768
+    num_mb = 16
+    batch = 2 * num_mb * mesh.shape["dp"]
+    stage_fn, init_fn, param_specs = make_transformer_stage(
+        hidden, heads, ffn, tp=1, causal=True, dtype=jnp.bfloat16)
+    tx = optax.adamw(1e-4)
+    data_spec = P(("dp", "fsdp"), "sp", None)  # sp=1; spec keeps the ring
+    # carries' varying-axes annotation consistent (as the dryrun does)
+
+    def init_params():
+        keys = jax.random.split(jax.random.key(0), 8)
+        return {
+            "emb": (jax.random.normal(jax.random.key(1), (vocab, hidden))
+                    * 0.02).astype(jnp.bfloat16),
+            "stages": stack_stage_params([init_fn(k) for k in keys]),
+        }
+
+    p_sh = {
+        "emb": NamedSharding(mesh, P()),
+        "stages": jax.tree.map(
+            lambda s: NamedSharding(mesh, P("pp", *s)), param_specs,
+            is_leaf=lambda s: isinstance(s, P)),
+    }
+    abstract_params = jax.eval_shape(init_params)
+    abstract_opt = jax.eval_shape(tx.init, abstract_params)
+    ids = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+
+    def loss_fn(p, ids):
+        x = p["emb"][ids]
+        y = pipeline_apply(mesh, stage_fn, p["stages"], x,
+                           num_microbatches=num_mb,
+                           param_specs=param_specs, data_spec=data_spec)
+        logits = jnp.einsum("bsh,vh->bsv", y, p["emb"])
+        labels = jnp.roll(ids, -1, axis=1)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    def train_step(p, o, ids):
+        loss, grads = jax.value_and_grad(loss_fn)(p, ids)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    jitted = jax.jit(
+        train_step, donate_argnums=(0, 1),
+        in_shardings=(p_sh, None,
+                      NamedSharding(mesh, P(("dp", "fsdp"), None))))
+    # GPipe microbatch schedule loops; bound parsed from HLO conditions,
+    # fallback = the schedule length if a condition is unreadable
+    return mesh, jitted, (abstract_params, abstract_opt, ids), \
+        num_mb + mesh.shape["pp"] - 1
+
+
 WORKLOADS = {"resnet50_dp": _build_resnet_dp,
              "bert_tp_sp_dp": _build_bert_gspmd,
              "bert_fsdp8_dp": _build_bert_fsdp,
@@ -589,7 +703,9 @@ WORKLOADS = {"resnet50_dp": _build_resnet_dp,
              "ring16_sp_t8k": functools.partial(_build_sp_attn_h16,
                                                 impl="ring"),
              "ulysses16_sp_t8k": functools.partial(_build_sp_attn_h16,
-                                                   impl="ulysses")}
+                                                   impl="ulysses"),
+             "moe_ep8_dp": _build_moe_ep8,
+             "gpipe_pp8_dp": _build_pipeline_pp8}
 
 # per-workload size limits (default: every MESH_SIZES entry).  Ulysses
 # shards heads over sp, so sp cannot exceed num_heads=16; the ring twin
@@ -662,6 +778,10 @@ def main() -> None:
     p.add_argument("--workload", default=None)
     p.add_argument("--n", type=int, default=None)
     p.add_argument("--sizes", default=",".join(map(str, MESH_SIZES)))
+    p.add_argument("--workloads", default=None,
+                   help="comma-separated subset to (re)run; their rows "
+                        "replace the matching rows of the existing full "
+                        "artifact (full sizes only)")
     args = p.parse_args()
 
     if args.child:
@@ -669,8 +789,14 @@ def main() -> None:
         return
 
     sizes = [int(v) for v in args.sizes.split(",")]
+    selected = list(WORKLOADS) if args.workloads is None else [
+        w for w in args.workloads.split(",")]
+    for w in selected:
+        if w not in WORKLOADS:
+            raise SystemExit(f"unknown workload {w!r}; "
+                             f"have {sorted(WORKLOADS)}")
     results = []
-    for workload in WORKLOADS:
+    for workload in selected:
         for n in [s for s in sizes
                   if s in WORKLOAD_SIZES.get(workload, sizes)]:
             env = {k: v for k, v in os.environ.items()
@@ -699,7 +825,7 @@ def main() -> None:
                   f"compute {full['t_compute_s']*1e3:.2f} ms)")
 
     # normalize efficiencies to the n=8 row (scaling efficiency 8->N)
-    for workload in WORKLOADS:
+    for workload in selected:
         rows = [r for r in results if r["workload"] == workload]
         if not rows:  # every compile for this workload failed
             continue
@@ -708,12 +834,19 @@ def main() -> None:
             for key in ("efficiency_no_overlap", "efficiency_full_overlap"):
                 r["scaling_" + key] = r[key] / base[key] if base[key] else None
 
-    out = {"assumptions": MODEL_ASSUMPTIONS, "results": results}
     os.makedirs(os.path.join(REPO, "bench_artifacts"), exist_ok=True)
     # partial sweeps (smoke / debugging) must not clobber the full artifact
     name = "scaling_model.json" if sizes == MESH_SIZES \
         else "scaling_model_partial.json"
     path = os.path.join(REPO, "bench_artifacts", name)
+    if args.workloads is not None and sizes == MESH_SIZES \
+            and os.path.exists(path):
+        # workload-subset rerun: merge over the existing full artifact
+        with open(path) as f:
+            prior = json.load(f).get("results", [])
+        results = [r for r in prior
+                   if r["workload"] not in selected] + results
+    out = {"assumptions": MODEL_ASSUMPTIONS, "results": results}
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(f"wrote {path}")

@@ -103,9 +103,11 @@ def main() -> None:
     with mesh:
         abstract = jax.eval_shape(init_fn)
         shardings = flax_shardings(mesh, abstract)
+        from tensorflowonspark_tpu.util import host_fetch_drain
+
         t0 = time.perf_counter()
         params, opt_state = jax.jit(init_fn, out_shardings=shardings)()
-        jax.block_until_ready(params)
+        host_fetch_drain(params)
         t_init = time.perf_counter() - t0
 
         # ---- memory accounting: sharded, never replicated ----
@@ -135,11 +137,11 @@ def main() -> None:
 
         step = jax.jit(train_step, donate_argnums=(0, 1))
         params, opt_state, loss = step(params, opt_state, ids, tgt)
-        jax.block_until_ready(loss)  # compile + 1 step
+        float(loss)  # compile + 1 step
         t0 = time.perf_counter()
         for _ in range(args.steps):
             params, opt_state, loss = step(params, opt_state, ids, tgt)
-        jax.block_until_ready(loss)
+        float(loss)
         dt = (time.perf_counter() - t0) / args.steps
         train_lookups_per_sec = args.batch / dt
 
@@ -148,11 +150,11 @@ def main() -> None:
         table_now = getattr(table_now, "value", table_now)
         look = jax.jit(lambda t, i: apply_sharded_lookup(mesh, t, i))
         out = look(table_now, ids)
-        jax.block_until_ready(out)
+        host_fetch_drain(out)
         t0 = time.perf_counter()
         for _ in range(args.steps):
             out = look(table_now, ids)
-        jax.block_until_ready(out)
+        host_fetch_drain(out)
         dt_look = (time.perf_counter() - t0) / args.steps
         lookup_only_per_sec = args.batch / dt_look
 

@@ -1,0 +1,142 @@
+"""Capture an xprof trace of the ResNet-50 train step and print where the
+time goes.
+
+The round-2 verdict's weakest number is 0.24 compute MFU on the b256 bf16
+train step (`bench_artifacts/resnet50_tpu_2026-07-29.json`); closing that gap
+needs evidence, not guesses.  This script jits the exact `stage_resnet` step
+from `scripts/tpu_sweep.py`, traces a few executions with `jax.profiler`, and
+converts the xplane with the installed `xprof` package into an HLO-level
+self-time table — the single-chip equivalent of opening the trace viewer.
+
+    python scripts/profile_resnet.py --batch 512 [--stem s2d] [--remat]
+
+Writes `bench_artifacts/resnet_profile_b<batch>[_s2d][_remat].json` with the
+top ops by self time plus category totals (convolution vs fusion vs
+data-formatting etc.), and prints the table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def capture(batch: int, stem: str, remat: bool) -> str:
+    """Run the sweep's resnet step under the profiler; return the logdir."""
+    import jax
+
+    from scripts import tpu_sweep
+
+    logdir = tempfile.mkdtemp(prefix="resnet_prof_")
+    # stage_resnet warms up and times; wrap just the timed window by tracing
+    # the whole call — compile happens outside the trace via its own warmup,
+    # so the trace is dominated by the steady-state steps.
+    with jax.profiler.trace(logdir):
+        tpu_sweep.stage_resnet(batch, remat=remat, stem=stem)
+    return logdir
+
+
+def summarize(logdir: str) -> dict:
+    """xplane → HLO self-time table via the xprof converter."""
+    from xprof.convert import raw_to_tool_data
+
+    paths = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        raise FileNotFoundError(f"no xplane under {logdir}")
+    data, _ = raw_to_tool_data.xspace_to_tool_data(paths, "hlo_stats", {})
+    if isinstance(data, bytes):
+        data = data.decode()
+    table = json.loads(data)
+    # gviz-ish {cols: [...], rows: [{c: [{v: ...}]}]} or plain — handle both.
+    if isinstance(table, dict) and "rows" in table:
+        cols = [c.get("label") or c.get("id") for c in table["cols"]]
+        rows = [[cell.get("v") if isinstance(cell, dict) else cell
+                 for cell in (r["c"] if isinstance(r, dict) else r)]
+                for r in table["rows"]]
+    else:  # list-of-lists with header
+        cols, rows = table[0], table[1:]
+    return {"cols": cols, "rows": rows}
+
+
+def report(tab: dict, top: int = 25) -> dict:
+    cols = [str(c).lower() for c in tab["cols"]]
+
+    def col(*names):
+        for n in names:
+            for i, c in enumerate(cols):
+                if n in c:
+                    return i
+        return None
+
+    i_cat = col("category")
+    i_name = col("hlo op name", "op name", "name")
+    i_self = col("total self time (us)", "self time")
+    i_frac = col("self time (%)", "%")
+    missing = [label for label, idx in
+               (("category", i_cat), ("op name", i_name),
+                ("self time", i_self)) if idx is None]
+    if missing:
+        raise RuntimeError(
+            f"hlo_stats table lacks expected column(s) {missing}; "
+            f"columns present: {tab['cols']}")
+    rows = tab["rows"]
+    by_cat: dict[str, float] = {}
+    for r in rows:
+        try:
+            by_cat[str(r[i_cat])] = by_cat.get(str(r[i_cat]), 0.0) + float(r[i_self])
+        except (TypeError, ValueError, IndexError):
+            continue
+    total = sum(by_cat.values()) or 1.0
+    cats = sorted(by_cat.items(), key=lambda kv: -kv[1])
+    top_rows = sorted(
+        (r for r in rows if isinstance(r[i_self], (int, float)) or
+         str(r[i_self]).replace(".", "", 1).isdigit()),
+        key=lambda r: -float(r[i_self]))[:top]
+    out = {
+        "category_pct": {k: round(100 * v / total, 1) for k, v in cats},
+        "top_ops": [{"category": r[i_cat], "op": str(r[i_name])[:120],
+                     "self_us": float(r[i_self]),
+                     "pct": (float(r[i_frac]) if i_frac is not None else
+                             round(100 * float(r[i_self]) / total, 2))}
+                    for r in top_rows],
+    }
+    print("== category self-time % ==")
+    for k, v in out["category_pct"].items():
+        print(f"  {v:6.1f}%  {k}")
+    print(f"== top {top} ops ==")
+    for o in out["top_ops"]:
+        print(f"  {o['pct']:6.2f}%  [{o['category']}] {o['op']}")
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=512)
+    p.add_argument("--stem", default="conv7", choices=("conv7", "s2d"))
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--logdir", default=None,
+                   help="summarize an existing trace instead of capturing")
+    args = p.parse_args()
+
+    logdir = args.logdir or capture(args.batch, args.stem, args.remat)
+    out = report(summarize(logdir))
+    tag = f"b{args.batch}" + ("_s2d" if args.stem == "s2d" else "") + \
+        ("_remat" if args.remat else "")
+    path = os.path.join(REPO, "bench_artifacts", f"resnet_profile_{tag}.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print("wrote", os.path.relpath(path, REPO))
+
+
+if __name__ == "__main__":
+    main()

@@ -774,9 +774,14 @@ def predict(rec: dict) -> dict:
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--child", action="store_true")
-    p.add_argument("--workload", default=None)
-    p.add_argument("--n", type=int, default=None)
+    p.add_argument("--child", action="store_true",
+                   help="internal: run one (workload, n) compile in this "
+                        "process and print its record")
+    p.add_argument("--workload", default=None,
+                   help="internal, --child only (use --workloads for a "
+                        "subset rerun)")
+    p.add_argument("--n", type=int, default=None,
+                   help="internal, --child only")
     p.add_argument("--sizes", default=",".join(map(str, MESH_SIZES)))
     p.add_argument("--workloads", default=None,
                    help="comma-separated subset to (re)run; their rows "
@@ -787,6 +792,9 @@ def main() -> None:
     if args.child:
         child(args.workload, args.n)
         return
+    if args.workload is not None or args.n is not None:
+        raise SystemExit("--workload/--n are child-internal flags; "
+                         "did you mean --workloads=<subset>?")
 
     sizes = [int(v) for v in args.sizes.split(",")]
     selected = list(WORKLOADS) if args.workloads is None else [
@@ -841,11 +849,27 @@ def main() -> None:
     path = os.path.join(REPO, "bench_artifacts", name)
     if args.workloads is not None and sizes == MESH_SIZES \
             and os.path.exists(path):
-        # workload-subset rerun: merge over the existing full artifact
+        # workload-subset rerun: merge per (workload, n) over the existing
+        # full artifact — a rerun row replaces its prior same-size row,
+        # prior rows survive any sizes the rerun failed at, and a failed
+        # rerun can never delete data already in the artifact.  Re-anchor
+        # the scaling_* normalization across the merged rows so every
+        # workload is consistently normalized to its smallest-n row.
         with open(path) as f:
             prior = json.load(f).get("results", [])
+        new_keys = {(r["workload"], r["n"]) for r in results}
         results = [r for r in prior
-                   if r["workload"] not in selected] + results
+                   if (r["workload"], r["n"]) not in new_keys] + results
+        for workload in selected:
+            rows = [r for r in results if r["workload"] == workload]
+            if not rows:
+                continue
+            base = min(rows, key=lambda r: r["n"])
+            for r in rows:
+                for key in ("efficiency_no_overlap",
+                            "efficiency_full_overlap"):
+                    r["scaling_" + key] = \
+                        r[key] / base[key] if base[key] else None
     out = {"assumptions": MODEL_ASSUMPTIONS, "results": results}
     with open(path, "w") as f:
         json.dump(out, f, indent=2)

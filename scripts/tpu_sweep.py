@@ -120,15 +120,17 @@ def stage_resnet(batch: int, remat: bool = False,
         cost = cost[0]
     flops = float(cost.get("flops", 0.0))
 
+    # Timing drains via host fetch, never block_until_ready — see
+    # tensorflowonspark_tpu.util.host_fetch_drain.
     for _ in range(warmup):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, x, y)
-    jax.block_until_ready(loss)
+    float(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, x, y)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = (time.perf_counter() - t0) / steps
     peak = 197e12 if "v5 lite" in dev.device_kind.lower() else None
     row = {
@@ -175,13 +177,15 @@ def stage_flash() -> dict:
         return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
     def timeit(fn, *args, iters=20):
+        from tensorflowonspark_tpu.util import host_fetch_drain
+
         f = jax.jit(fn)
         o = f(*args)
-        jax.block_until_ready(o)
+        host_fetch_drain(o)
         t0 = time.perf_counter()
         for _ in range(iters):
             o = f(*args)
-        jax.block_until_ready(o)
+        host_fetch_drain(o)
         return (time.perf_counter() - t0) / iters * 1e3  # ms
 
     out = {"shape": {"B": B, "T": T, "H": H, "D": D, "dtype": "bfloat16"},
@@ -252,12 +256,14 @@ def stage_decode() -> dict:
     gen = jax.jit(greedy_generate, static_argnums=(0, 3))
 
     def tps(cfg, params, iters=3):
+        # fetching the generated ids (a few KB) proves the decode loops
+        # actually ran on device — see util.host_fetch_drain.
         out = gen(cfg, params, prompt, NEW)
-        out.block_until_ready()
+        jax.device_get(out)
         t0 = time.perf_counter()
         for _ in range(iters):
             out = gen(cfg, params, prompt, NEW)
-        out.block_until_ready()
+        jax.device_get(out)
         return round(B * NEW / ((time.perf_counter() - t0) / iters), 1)
 
     kv_list = (12, 4, 1) if base.num_heads == 12 else tuple(sorted(

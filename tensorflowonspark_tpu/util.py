@@ -15,6 +15,42 @@ import logging
 logger = logging.getLogger(__name__)
 
 
+_drain_reduce = None  # built on first use; one function object => jit cache hits
+
+
+def host_fetch_drain(x) -> float:
+    """Force completion of every device op ``x`` depends on; returns the
+    fetched scalar.
+
+    Benchmark timing loops must end with this, NOT ``block_until_ready``:
+    through the axon TPU tunnel ``block_until_ready`` has been observed to
+    return before device execution completes (round 3 measured an impossible
+    >5 "MFU" on a chained train-step loop with it).  A host fetch cannot be
+    faked — the bytes must exist to cross the wire — so draining via a tiny
+    jitted reduction of the final output proves the whole dispatch chain
+    actually ran.  The jitted reduction is one module-level function, so
+    after the first call per shape/dtype a drain costs one cached small
+    kernel plus one scalar round trip.
+    """
+    global _drain_reduce
+    import jax
+    import jax.numpy as jnp
+
+    if not hasattr(x, "dtype"):
+        total = 0.0
+        for leaf in jax.tree_util.tree_leaves(x):
+            # plain Python numbers are their own tree leaves — fetch directly
+            # instead of recursing forever
+            total += float(leaf) if not hasattr(leaf, "dtype") \
+                else host_fetch_drain(leaf)
+        return total
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.int32)
+    if _drain_reduce is None:
+        _drain_reduce = jax.jit(lambda o: jnp.sum(o.astype(jnp.float32)))
+    return float(_drain_reduce(x))
+
+
 def enable_compilation_cache(cache_dir: str | None = None,
                              min_compile_secs: float = 1.0) -> str:
     """Turn on XLA's persistent compilation cache.

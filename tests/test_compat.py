@@ -95,3 +95,17 @@ def test_tfcluster_run_rejects_scless_signature():
 
     with pytest.raises(TypeError, match="SparkContext"):
         TFCluster.run(funcs.fn_noop, {}, 2, 0)
+
+
+def test_host_fetch_drain():
+    """Benchmark drain helper: fetches through arrays, numbers, pytrees
+    (the block_until_ready-is-unreliable-on-axon workaround; every timing
+    harness in bench.py / scripts/ ends its loops with this)."""
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.util import host_fetch_drain
+
+    assert host_fetch_drain(jnp.ones((3, 3))) == 9.0
+    assert host_fetch_drain(2.5) == 2.5
+    assert host_fetch_drain(
+        {"a": jnp.ones(4), "b": 1.0, "c": jnp.array(True)}) == 6.0

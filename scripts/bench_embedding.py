@@ -105,8 +105,13 @@ def main() -> None:
         shardings = flax_shardings(mesh, abstract)
         from tensorflowonspark_tpu.util import host_fetch_drain
 
+        # warm pass: compiles init_fn AND the drain's per-shape reductions
+        # (a full-table cross-shard sum) outside the timed window, so
+        # t_init is steady-state execute+drain, not compile time
+        init_jit = jax.jit(init_fn, out_shardings=shardings)
+        host_fetch_drain(init_jit())
         t0 = time.perf_counter()
-        params, opt_state = jax.jit(init_fn, out_shardings=shardings)()
+        params, opt_state = init_jit()
         host_fetch_drain(params)
         t_init = time.perf_counter() - t0
 

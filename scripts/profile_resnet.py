@@ -40,7 +40,7 @@ def capture(batch: int, stem: str, remat: bool) -> str:
     # the whole call — compile happens outside the trace via its own warmup,
     # so the trace is dominated by the steady-state steps.
     with jax.profiler.trace(logdir):
-        tpu_sweep.stage_resnet(batch, remat=remat, stem=stem)
+        tpu_sweep.stage_resnet(batch, remat=remat, stem=stem, write=False)
     return logdir
 
 
@@ -98,8 +98,9 @@ def report(tab: dict, top: int = 25) -> dict:
     total = sum(by_cat.values()) or 1.0
     cats = sorted(by_cat.items(), key=lambda kv: -kv[1])
     top_rows = sorted(
-        (r for r in rows if isinstance(r[i_self], (int, float)) or
-         str(r[i_self]).replace(".", "", 1).isdigit()),
+        (r for r in rows if len(r) > max(i_self, i_name, i_cat)
+         and (isinstance(r[i_self], (int, float)) or
+              str(r[i_self]).replace(".", "", 1).isdigit())),
         key=lambda r: -float(r[i_self]))[:top]
     out = {
         "category_pct": {k: round(100 * v / total, 1) for k, v in cats},

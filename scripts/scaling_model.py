@@ -832,16 +832,6 @@ def main() -> None:
                   f"(comm {full['t_comm_s']*1e3:.2f} ms, "
                   f"compute {full['t_compute_s']*1e3:.2f} ms)")
 
-    # normalize efficiencies to the n=8 row (scaling efficiency 8->N)
-    for workload in selected:
-        rows = [r for r in results if r["workload"] == workload]
-        if not rows:  # every compile for this workload failed
-            continue
-        base = min(rows, key=lambda r: r["n"])
-        for r in rows:
-            for key in ("efficiency_no_overlap", "efficiency_full_overlap"):
-                r["scaling_" + key] = r[key] / base[key] if base[key] else None
-
     os.makedirs(os.path.join(REPO, "bench_artifacts"), exist_ok=True)
     # partial sweeps (smoke / debugging) must not clobber the full artifact
     name = "scaling_model.json" if sizes == MESH_SIZES \
@@ -860,20 +850,27 @@ def main() -> None:
         new_keys = {(r["workload"], r["n"]) for r in results}
         results = [r for r in prior
                    if (r["workload"], r["n"]) not in new_keys] + results
-        for workload in selected:
-            rows = [r for r in results if r["workload"] == workload]
-            if not rows:
-                continue
-            base = min(rows, key=lambda r: r["n"])
-            for r in rows:
-                for key in ("efficiency_no_overlap",
-                            "efficiency_full_overlap"):
-                    r["scaling_" + key] = \
-                        r[key] / base[key] if base[key] else None
+    # normalize efficiencies to the n=8 row (scaling efficiency 8->N) —
+    # over the merged list when the merge path ran, else the fresh rows
+    _normalize_scaling(results, selected)
     out = {"assumptions": MODEL_ASSUMPTIONS, "results": results}
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(f"wrote {path}")
+
+
+def _normalize_scaling(results: list[dict], workloads) -> None:
+    """Anchor each workload's ``scaling_*`` fields to its smallest-n row
+    (scaling efficiency 8->N).  Shared by the fresh-sweep and
+    merge-into-prior-artifact paths so the two can't drift."""
+    for workload in workloads:
+        rows = [r for r in results if r["workload"] == workload]
+        if not rows:  # every compile for this workload failed
+            continue
+        base = min(rows, key=lambda r: r["n"])
+        for r in rows:
+            for key in ("efficiency_no_overlap", "efficiency_full_overlap"):
+                r["scaling_" + key] = r[key] / base[key] if base[key] else None
 
 
 def _summarize(colls: list[dict]) -> dict:

@@ -62,6 +62,19 @@ def _write(name: str, payload: dict) -> None:
 SMOKE = bool(os.environ.get("SWEEP_SMOKE"))  # tiny-shape CPU validation mode
 
 
+def _merge_row(name: str, row: dict, key) -> None:
+    """Merge ``row`` into the ``rows`` list of artifact ``name``: replaces
+    any prior row with the same ``key(row)``, keeps the rest, sorts."""
+    path = _path(name)
+    data = {"rows": []}
+    if os.path.exists(path):
+        with open(path) as f:
+            data = json.load(f)
+    data["rows"] = [r for r in data["rows"] if key(r) != key(row)] + [row]
+    data["rows"].sort(key=key)
+    _write(name, data)
+
+
 def _device():
     import jax
 
@@ -74,7 +87,12 @@ def _device():
 # Stage: resnet batch sweep
 # ---------------------------------------------------------------------------
 def stage_resnet(batch: int, remat: bool = False,
-                 stem: str = "conv7") -> dict:
+                 stem: str = "conv7", bn: str = "f32",
+                 write: bool = True) -> dict:
+    """One (batch, remat, stem, bn) point.  ``write=False`` (used by
+    scripts/profile_resnet.py, whose timed loop runs under the profiler's
+    trace overhead) skips the resnet_sweep.json merge so a profiling run
+    can never overwrite a clean-timing row."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -86,7 +104,8 @@ def stage_resnet(batch: int, remat: bool = False,
     image, steps, warmup = (64, 2, 1) if SMOKE else (224, 20, 3)
     if SMOKE:
         batch = min(batch, 8)
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem)
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem,
+                     norm_dtype=jnp.bfloat16 if bn == "bf16" else jnp.float32)
     tx = optax.sgd(0.1, momentum=0.9)
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal(
@@ -134,7 +153,7 @@ def stage_resnet(batch: int, remat: bool = False,
     dt = (time.perf_counter() - t0) / steps
     peak = 197e12 if "v5 lite" in dev.device_kind.lower() else None
     row = {
-        "batch": batch, "remat": remat, "stem": stem,
+        "batch": batch, "remat": remat, "stem": stem, "bn": bn,
         "images_per_sec": round(batch / dt, 1),
         "step_ms": round(dt * 1e3, 2),
         "flops_per_step": flops,
@@ -142,16 +161,10 @@ def stage_resnet(batch: int, remat: bool = False,
         "device": dev.device_kind,
     }
     print("sweep resnet:", json.dumps(row), flush=True)
-    # merge into the sweep artifact
-    path = _path("resnet_sweep.json")
-    data = {"rows": []}
-    if os.path.exists(path):
-        with open(path) as f:
-            data = json.load(f)
-    key = lambda r: (r["batch"], r["remat"], r.get("stem", "conv7"))  # noqa: E731
-    data["rows"] = [r for r in data["rows"] if key(r) != key(row)] + [row]
-    data["rows"].sort(key=key)
-    _write("resnet_sweep.json", data)
+    if write:
+        _merge_row("resnet_sweep.json", row,
+                   lambda r: (r["batch"], r["remat"], r.get("stem", "conv7"),
+                              r.get("bn", "f32")))
     return row
 
 
@@ -227,6 +240,106 @@ def stage_flash() -> dict:
                 lambda q, k, v, w=w: flash_attention(q, k, v, causal=True,
                                                      window=w), q, k, v)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Stage: GPT-124M training step MFU (the transformer-side headline)
+# ---------------------------------------------------------------------------
+def stage_gpt_train(batch: int, remat: bool = False,
+                    attn: str = "dense") -> dict:
+    """Train-step throughput/MFU for GPT-124M (768/12L/12H, T=1024, bf16,
+    tied chunked xent head, adamw).
+
+    MFU here uses the ANALYTIC FLOP count (6·P_matmul·tokens for the
+    matmul params + 12·L·B·T²·H for attention scores·values, fwd+bwd),
+    not ``cost_analysis()``: the chunked LM head runs under ``lax.scan``
+    whose body XLA's analysis counts once instead of ×trip-count
+    (the same undercount scripts/scaling_model.py corrects for), so the
+    XLA number is reported alongside but not used for MFU.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu.models import GPT, GPTConfig
+    from tensorflowonspark_tpu.ops import tied_softmax_xent
+    from tensorflowonspark_tpu.util import host_fetch_drain
+
+    dev = _device()
+    cfg = GPTConfig(vocab_size=50257, hidden_size=768, num_layers=12,
+                    num_heads=12, intermediate_size=3072,
+                    max_position_embeddings=1024, dtype=jnp.bfloat16,
+                    remat=remat)
+    T, steps, warmup = 1024, 10, 2
+    if SMOKE:
+        cfg = dataclasses.replace(cfg, vocab_size=512, hidden_size=64,
+                                  num_layers=2, num_heads=4,
+                                  intermediate_size=128,
+                                  max_position_embeddings=128)
+        T, steps, warmup, batch = 128, 2, 1, min(batch, 2)
+    if attn == "flash":
+        from tensorflowonspark_tpu.ops import flash_attention
+        cfg = dataclasses.replace(cfg, attention_fn=flash_attention)
+    model = GPT(cfg)
+    tx = optax.adamw(3e-4)
+    ids = jax.random.randint(jax.random.key(1), (batch, T + 1), 0,
+                             cfg.vocab_size)
+    x, y = ids[:, :-1], ids[:, 1:]
+    params = model.init(jax.random.key(0), x[:1])["params"]
+    opt_state = tx.init(params)
+
+    def loss_fn(p, x, y):
+        hidden = model.apply({"params": p}, x, method="hidden")
+        table = p["tok_emb"]["embedding"]
+        table = getattr(table, "value", table)
+        return tied_softmax_xent(hidden, table, y).mean()
+
+    def step_fn(p, o, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+        upd, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, upd), o, loss
+
+    step = jax.jit(step_fn, donate_argnums=(0, 1))
+    compiled = step.lower(params, opt_state, x, y).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    xla_flops = float(cost.get("flops", 0.0))
+
+    # analytic fwd+bwd FLOPs: matmul params (every 2D+ leaf; excludes
+    # norms/biases and the position table; includes the tied head via
+    # tok_emb) + attention
+    H, L = cfg.hidden_size, cfg.num_layers
+    p_matmul = sum(
+        leaf.size for path, leaf in
+        jax.tree_util.tree_leaves_with_path(params)
+        if getattr(leaf, "ndim", 0) >= 2
+        and not any(getattr(k, "key", None) == "pos_emb" for k in path))
+    flops = 6 * p_matmul * batch * T + 12 * L * batch * T * T * H
+
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    host_fetch_drain(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    host_fetch_drain(loss)
+    dt = (time.perf_counter() - t0) / steps
+    peak = 197e12 if "v5 lite" in dev.device_kind.lower() else None
+    row = {
+        "batch": batch, "seq": T, "remat": remat, "attn": attn,
+        "tokens_per_sec": round(batch * T / dt, 1),
+        "step_ms": round(dt * 1e3, 2),
+        "flops_analytic": flops, "flops_xla": xla_flops,
+        "mfu": round(flops / dt / peak, 4) if peak else None,
+        "device": dev.device_kind,
+    }
+    print("sweep gpt_train:", json.dumps(row), flush=True)
+    _merge_row("gpt_train_sweep.json", row,
+               lambda r: (r["batch"], r["remat"], r.get("attn", "dense")))
+    return row
 
 
 # ---------------------------------------------------------------------------
@@ -325,10 +438,15 @@ def main() -> None:
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--remat", action="store_true")
     p.add_argument("--stem", default="conv7", choices=("conv7", "s2d"))
+    p.add_argument("--bn", default="f32", choices=("f32", "bf16"))
+    p.add_argument("--attn", default="dense", choices=("dense", "flash"))
     args = p.parse_args()
 
     if args.stage == "resnet":
-        stage_resnet(args.batch, args.remat, args.stem)
+        stage_resnet(args.batch, args.remat, args.stem, args.bn)
+        return
+    if args.stage == "gpt_train":
+        stage_gpt_train(args.batch, args.remat, args.attn)
         return
     if args.stage == "flash":
         stage_flash()
@@ -361,7 +479,15 @@ def main() -> None:
                          "--batch", "128"], 900),
         ("resnet_b256_s2d", [sys.executable, me, "--stage", "resnet",
                              "--batch", "256", "--stem", "s2d"], 900),
+        ("resnet_b256_bnbf16", [sys.executable, me, "--stage", "resnet",
+                                "--batch", "256", "--bn", "bf16"], 900),
         ("flash_sweep", [sys.executable, me, "--stage", "flash"], 1200),
+        ("gpt_train_b8", [sys.executable, me, "--stage", "gpt_train",
+                          "--batch", "8"], 900),
+        ("gpt_train_b32_remat", [sys.executable, me, "--stage", "gpt_train",
+                                 "--batch", "32", "--remat"], 900),
+        ("gpt_train_b8_flash", [sys.executable, me, "--stage", "gpt_train",
+                                "--batch", "8", "--attn", "flash"], 900),
         ("decode_matrix", [sys.executable, me, "--stage", "decode"], 1800),
         # bench_overlap writes its own overlap_<platform>.json; skipped in
         # smoke so a CPU smoke run can't clobber the committed CPU artifact

@@ -56,11 +56,12 @@ class BasicBlock(nn.Module):
     strides: int = 1
     dtype: jnp.dtype = jnp.bfloat16
     norm: type = nn.BatchNorm
+    norm_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         norm = partial(self.norm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+                       momentum=0.9, epsilon=1e-5, dtype=self.norm_dtype)
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         residual = x
         y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
@@ -80,11 +81,12 @@ class Bottleneck(nn.Module):
     strides: int = 1
     dtype: jnp.dtype = jnp.bfloat16
     norm: type = nn.BatchNorm
+    norm_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         norm = partial(self.norm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+                       momentum=0.9, epsilon=1e-5, dtype=self.norm_dtype)
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         residual = x
         y = conv(self.filters, (1, 1))(x)
@@ -119,6 +121,13 @@ class ResNet(nn.Module):
     # ``cifar_stem`` is set.
     stem: str = "conv7"
     dtype: jnp.dtype = jnp.bfloat16
+    # BatchNorm compute/output dtype.  fp32 (default) keeps normalized
+    # activations at full precision but doubles the HBM bytes of every
+    # inter-conv tensor on the bandwidth-bound path; bf16 halves that
+    # traffic (flax still accumulates mean/var in fp32 internally, and
+    # params/batch_stats stay fp32 via param_dtype).  A/B'd on-chip by
+    # ``scripts/tpu_sweep.py --stage resnet --bn bf16``.
+    norm_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -137,7 +146,7 @@ class ResNet(nn.Module):
             x = nn.Conv(self.num_filters, (7, 7), strides=(2, 2),
                         padding=[(3, 3), (3, 3)], use_bias=False, dtype=self.dtype)(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=jnp.float32)(x)
+                         epsilon=1e-5, dtype=self.norm_dtype)(x)
         x = nn.relu(x)
         if not self.cifar_stem:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
@@ -145,7 +154,8 @@ class ResNet(nn.Module):
             for block_idx in range(num_blocks):
                 strides = 2 if stage > 0 and block_idx == 0 else 1
                 x = self.block(self.num_filters * 2 ** stage, strides=strides,
-                               dtype=self.dtype)(x, train=train)
+                               dtype=self.dtype,
+                               norm_dtype=self.norm_dtype)(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
 

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Probe the TPU tunnel every 2 minutes; the moment it answers, run the
+# given tpu_sweep.py stages (--only list passed as $1, or the full sweep
+# when omitted).  Exists because the axon tunnel flaps in windows shorter
+# than a full sweep: scripts/tpu_sweep.py aborts on a dead tunnel, this
+# wrapper brings the remaining stages back up.  Give up after $2 probes
+# (default 120 = ~4h).
+set -u
+cd "$(dirname "$0")/.."
+ONLY="${1:-}"
+MAX_PROBES="${2:-120}"
+for ((i = 1; i <= MAX_PROBES; i++)); do
+  if timeout 120 python -c \
+      "import jax; assert jax.devices()[0].platform == 'tpu'" \
+      >/dev/null 2>&1; then
+    echo "resume: tunnel up (probe $i), launching sweep"
+    if [ -n "$ONLY" ]; then
+      exec python scripts/tpu_sweep.py --only "$ONLY"
+    else
+      exec python scripts/tpu_sweep.py
+    fi
+  fi
+  echo "resume: probe $i/$MAX_PROBES failed; sleeping 120s"
+  sleep 120
+done
+echo "resume: giving up after $MAX_PROBES probes"
+exit 2

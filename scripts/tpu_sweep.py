@@ -88,11 +88,18 @@ def _device():
 # ---------------------------------------------------------------------------
 def stage_resnet(batch: int, remat: bool = False,
                  stem: str = "conv7", bn: str = "f32",
-                 write: bool = True) -> dict:
+                 write: bool = True, loop: bool = False) -> dict:
     """One (batch, remat, stem, bn) point.  ``write=False`` (used by
     scripts/profile_resnet.py, whose timed loop runs under the profiler's
     trace overhead) skips the resnet_sweep.json merge so a profiling run
-    can never overwrite a clean-timing row."""
+    can never overwrite a clean-timing row.
+
+    ``loop=True`` runs the whole timed window inside ONE jitted
+    ``lax.fori_loop`` (single dispatch) instead of one dispatch per step:
+    the difference between the two rows isolates host-dispatch overhead —
+    on this box every ``step()`` call is an RPC over the axon tunnel, so a
+    large loop-vs-eager gap means the eager MFU number undercounts what
+    the chip itself sustains (a real TPU-VM dispatches locally)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -139,21 +146,43 @@ def stage_resnet(batch: int, remat: bool = False,
         cost = cost[0]
     flops = float(cost.get("flops", 0.0))
 
-    # Timing drains via host fetch, never block_until_ready — see
-    # tensorflowonspark_tpu.util.host_fetch_drain.
-    for _ in range(warmup):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, x, y)
-    float(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, x, y)
-    float(loss)
-    dt = (time.perf_counter() - t0) / steps
+    if loop:
+        def megastep(p, bs, o, x, y, n):
+            def body(_, carry):
+                p, bs, o, _loss = carry
+                p, bs, o, loss = step_fn(p, bs, o, x, y)
+                return p, bs, o, loss
+            return jax.lax.fori_loop(
+                0, n, body, (p, bs, o, jnp.zeros((), jnp.float32)))
+
+        mega = jax.jit(megastep, static_argnums=(5,), donate_argnums=(0, 1, 2))
+        # same n for warmup and timed call — different n would be a fresh
+        # static arg, i.e. a second compile inside the timed window
+        params, batch_stats, opt_state, loss = mega(
+            params, batch_stats, opt_state, x, y, steps)
+        float(loss)
+        t0 = time.perf_counter()
+        params, batch_stats, opt_state, loss = mega(
+            params, batch_stats, opt_state, x, y, steps)
+        float(loss)
+        dt = (time.perf_counter() - t0) / steps
+    else:
+        # Timing drains via host fetch, never block_until_ready — see
+        # tensorflowonspark_tpu.util.host_fetch_drain.
+        for _ in range(warmup):
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state, x, y)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state, x, y)
+        float(loss)
+        dt = (time.perf_counter() - t0) / steps
     peak = 197e12 if "v5 lite" in dev.device_kind.lower() else None
     row = {
         "batch": batch, "remat": remat, "stem": stem, "bn": bn,
+        "loop": loop,
         "images_per_sec": round(batch / dt, 1),
         "step_ms": round(dt * 1e3, 2),
         "flops_per_step": flops,
@@ -164,7 +193,7 @@ def stage_resnet(batch: int, remat: bool = False,
     if write:
         _merge_row("resnet_sweep.json", row,
                    lambda r: (r["batch"], r["remat"], r.get("stem", "conv7"),
-                              r.get("bn", "f32")))
+                              r.get("bn", "f32"), r.get("loop", False)))
     return row
 
 
@@ -440,10 +469,18 @@ def main() -> None:
     p.add_argument("--stem", default="conv7", choices=("conv7", "s2d"))
     p.add_argument("--bn", default="f32", choices=("f32", "bf16"))
     p.add_argument("--attn", default="dense", choices=("dense", "flash"))
+    p.add_argument("--loop", action="store_true",
+                   help="time a single-dispatch jitted fori_loop window "
+                        "(isolates host-dispatch overhead)")
+    p.add_argument("--only", default=None,
+                   help="comma-separated stage-name filter for resuming an "
+                        "interrupted sweep (names as printed, e.g. "
+                        "'resnet_b256_bnbf16,flash_sweep')")
     args = p.parse_args()
 
     if args.stage == "resnet":
-        stage_resnet(args.batch, args.remat, args.stem, args.bn)
+        stage_resnet(args.batch, args.remat, args.stem, args.bn,
+                     loop=args.loop)
         return
     if args.stage == "gpt_train":
         stage_gpt_train(args.batch, args.remat, args.attn)
@@ -456,11 +493,6 @@ def main() -> None:
         return
 
     t_start = time.monotonic()
-    if not probe():
-        print("sweep: TPU probe failed — tunnel down, aborting", flush=True)
-        sys.exit(2)
-    print("sweep: TPU up, starting priority-ordered stages", flush=True)
-
     me = os.path.abspath(__file__)
     stages: list[tuple[str, list[str], int]] = [
         # bench.py writes real artifact names (gpt_decode.json,
@@ -498,7 +530,41 @@ def main() -> None:
                              "--batch-mb", "64"], 900)]),
         ("resnet_b1024_remat", [sys.executable, me, "--stage", "resnet",
                                 "--batch", "1024", "--remat"], 900),
+        # single-dispatch fori_loop window: isolates host-dispatch (tunnel
+        # RPC) overhead from what the chip itself sustains
+        ("resnet_b256_loop", [sys.executable, me, "--stage", "resnet",
+                              "--batch", "256", "--loop"], 900),
+        ("resnet_b128_loop", [sys.executable, me, "--stage", "resnet",
+                              "--batch", "128", "--loop"], 900),
+        # the decode artifact the performance ledger cites; bench.py's
+        # in-run extra can still be skipped by its own time budget
+        *([] if SMOKE else [
+            ("gpt_decode", [sys.executable, "-c",
+                            "from tensorflowonspark_tpu.util import ("
+                            "apply_jax_platforms_env, "
+                            "enable_compilation_cache); "
+                            "apply_jax_platforms_env(); "
+                            "enable_compilation_cache(); "
+                            "import bench; bench.bench_gpt_decode()"], 900),
+            ("embedding_native", [sys.executable,
+                                  os.path.join(REPO, "scripts",
+                                               "bench_embedding.py"),
+                                  "--platform", "native", "--ep", "1"],
+             900)]),
     ]
+    if args.only:
+        wanted = {s.strip() for s in args.only.split(",") if s.strip()}
+        unknown = wanted - {name for name, _, _ in stages}
+        if unknown:
+            raise SystemExit(f"--only names not in the stage list: "
+                             f"{sorted(unknown)}")
+        stages = [s for s in stages if s[0] in wanted]
+
+    if not probe():
+        print("sweep: TPU probe failed — tunnel down, aborting", flush=True)
+        sys.exit(2)
+    print("sweep: TPU up, starting priority-ordered stages", flush=True)
+
     summary = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                "stages": {}}
     consecutive_failures = 0
@@ -530,6 +596,16 @@ def main() -> None:
         else:
             consecutive_failures = 0
     summary["total_seconds"] = round(time.monotonic() - t_start, 1)
+    # a resumed sweep (--only) extends the prior run's stage record; a full
+    # sweep starts a fresh summary
+    prior_path = _path("sweep_summary.json")
+    if args.only and os.path.exists(prior_path):
+        with open(prior_path) as f:
+            prior = json.load(f)
+        prior_stages = prior.get("stages", {})
+        prior_stages.update(summary["stages"])
+        summary["stages"] = prior_stages
+        summary["started"] = prior.get("started", summary["started"])
     _write("sweep_summary.json", summary)
 
 

@@ -45,26 +45,50 @@ def capture(batch: int, stem: str, remat: bool) -> str:
 
 
 def summarize(logdir: str) -> dict:
-    """xplane → HLO self-time table via the xprof converter."""
+    """xplane → HLO self-time table via the xprof converter.
+
+    Tries ``hlo_stats`` (device-side, what we want on TPU) and falls back
+    to ``framework_op_stats``; raises rather than returning an empty table
+    so a trace that captured no device events (seen with the CPU backend)
+    fails loudly instead of writing a vacuous artifact."""
     from xprof.convert import raw_to_tool_data
 
     paths = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
                       recursive=True)
     if not paths:
         raise FileNotFoundError(f"no xplane under {logdir}")
-    data, _ = raw_to_tool_data.xspace_to_tool_data(paths, "hlo_stats", {})
-    if isinstance(data, bytes):
-        data = data.decode()
-    table = json.loads(data)
-    # gviz-ish {cols: [...], rows: [{c: [{v: ...}]}]} or plain — handle both.
-    if isinstance(table, dict) and "rows" in table:
-        cols = [c.get("label") or c.get("id") for c in table["cols"]]
-        rows = [[cell.get("v") if isinstance(cell, dict) else cell
-                 for cell in (r["c"] if isinstance(r, dict) else r)]
-                for r in table["rows"]]
-    else:  # list-of-lists with header
-        cols, rows = table[0], table[1:]
-    return {"cols": cols, "rows": rows}
+    tried = {}
+    for tool in ("hlo_stats", "framework_op_stats"):
+        data, _ = raw_to_tool_data.xspace_to_tool_data(paths, tool, {})
+        if isinstance(data, bytes):
+            data = data.decode()
+        table = json.loads(data)
+        # Shapes seen from the converter: one gviz dict
+        # ({cols: [...], rows: [{c: [{v}]}]}), a LIST of gviz dicts
+        # (framework_op_stats), or a plain list-of-lists with a header row.
+        candidates = table if isinstance(table, list) else [table]
+        cols, rows = [], []
+        if candidates and isinstance(candidates[0], dict):
+            for t in candidates:
+                if not (isinstance(t, dict) and t.get("rows")):
+                    continue
+                t_cols = [c.get("label") or c.get("id") for c in t["cols"]]
+                if cols and t_cols != cols:
+                    # different schema (e.g. a diagnostics side-table) —
+                    # its cells would be read under the wrong indices
+                    continue
+                cols = cols or t_cols
+                rows += [[cell.get("v") if isinstance(cell, dict) else cell
+                          for cell in (r["c"] if isinstance(r, dict) else r)]
+                         for r in t["rows"]]
+        elif candidates:  # list-of-lists with header
+            cols, rows = candidates[0], candidates[1:]
+        tried[tool] = len(rows)
+        if rows:
+            return {"tool": tool, "cols": cols, "rows": rows}
+    raise RuntimeError(
+        f"profiler trace under {logdir} yielded no rows from any tool "
+        f"({tried}); the backend likely emitted no device events")
 
 
 def report(tab: dict, top: int = 25) -> dict:
@@ -77,17 +101,21 @@ def report(tab: dict, top: int = 25) -> dict:
                     return i
         return None
 
-    i_cat = col("category")
-    i_name = col("hlo op name", "op name", "name")
-    i_self = col("total self time (us)", "self time")
-    i_frac = col("self time (%)", "%")
+    # hlo_stats: "HLO op name"/"category"/"Total self time (us)";
+    # framework_op_stats: "Operation Name"/"Operation Type"/
+    # "Total self-time (us)"
+    i_cat = col("category", "operation type")
+    i_name = col("hlo op name", "op name", "operation name", "name")
+    i_self = col("total self time (us)", "total self-time (us)",
+                 "self time", "self-time")
+    i_frac = col("self time (%)", "self-time on device (%)", "%")
     missing = [label for label, idx in
                (("category", i_cat), ("op name", i_name),
                 ("self time", i_self)) if idx is None]
     if missing:
         raise RuntimeError(
-            f"hlo_stats table lacks expected column(s) {missing}; "
-            f"columns present: {tab['cols']}")
+            f"{tab.get('tool', 'hlo_stats')} table lacks expected "
+            f"column(s) {missing}; columns present: {tab['cols']}")
     rows = tab["rows"]
     by_cat: dict[str, float] = {}
     for r in rows:

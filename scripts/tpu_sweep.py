@@ -606,6 +606,9 @@ def main() -> None:
         prior_stages.update(summary["stages"])
         summary["stages"] = prior_stages
         summary["started"] = prior.get("started", summary["started"])
+        # wall time accumulates across the original run and every resume
+        summary["total_seconds"] = round(
+            summary["total_seconds"] + prior.get("total_seconds", 0.0), 1)
     _write("sweep_summary.json", summary)
 
 

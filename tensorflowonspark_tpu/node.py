@@ -251,6 +251,22 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
             os.environ["TFOS_NUM_PROCESSES"] = str(env["num_processes"])
             os.environ["TFOS_PROCESS_ID"] = str(env["process_id"])
 
+            # Persistent XLA compile cache for the worker process: a
+            # relaunched worker (preemption recovery, run_with_recovery)
+            # reuses its predecessor's compiles instead of paying the
+            # tens-of-seconds TPU compile again.  Set via env (honored by
+            # jax at its first import) rather than enable_compilation_cache
+            # so no jax import happens before the user's map_fun — fn may
+            # set JAX_* env vars itself, and non-JAX workers shouldn't pay
+            # the import.  setdefault: explicit user env always wins.
+            os.environ.setdefault(
+                "JAX_COMPILATION_CACHE_DIR",
+                os.environ.get("TFOS_COMPILATION_CACHE",
+                               "/tmp/tfos_jax_cache"))
+            os.environ.setdefault(
+                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                os.environ.get("TFOS_CACHE_MIN_COMPILE_SECS", "1.0"))
+
             logger.info("node %d starting map_fun as %s:%d", executor_id, job_name, task_index)
             fn(tf_args, ctx)
             mgr.kv_set("state", "finished")

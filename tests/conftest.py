@@ -32,7 +32,18 @@ jax.config.update("jax_platforms", "cpu")
 from tensorflowonspark_tpu.util import enable_compilation_cache  # noqa: E402
 
 enable_compilation_cache(os.environ.get("TFOS_TEST_CACHE",
-                                        "/tmp/tfos_test_jax_cache"))
+                                        "/tmp/tfos_test_jax_cache"),
+                         min_compile_secs=0.2)
+# Worker processes spawned by cluster/agent/distributed tests bootstrap
+# their own jax; point their cache (node.run sets these env defaults too,
+# but inherited env must carry the test dir + the lower threshold — CPU
+# compiles of the tiny test models mostly fall in the 0.2-1.0s band the
+# 1.0s default would skip) at the same dir so multi-process tests are
+# warm on re-runs too.
+os.environ.setdefault("TFOS_COMPILATION_CACHE",
+                      os.environ.get("TFOS_TEST_CACHE",
+                                     "/tmp/tfos_test_jax_cache"))
+os.environ.setdefault("TFOS_CACHE_MIN_COMPILE_SECS", "0.2")
 
 
 @pytest.fixture(scope="session")

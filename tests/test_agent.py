@@ -14,7 +14,9 @@ import sys
 
 import pytest
 
-from tensorflowonspark_tpu.agent import AgentBackend, HostAgent, _AgentConn
+pytestmark = pytest.mark.integration  # spawns real agent daemons
+
+from tensorflowonspark_tpu.agent import AgentBackend, HostAgent, _AgentConn  # noqa: E402
 from tests import cluster_funcs as funcs
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

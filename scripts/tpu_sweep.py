@@ -550,7 +550,13 @@ def main() -> None:
                                   os.path.join(REPO, "scripts",
                                                "bench_embedding.py"),
                                   "--platform", "native", "--ep", "1"],
-             900)]),
+             900),
+            # xprof capture of the b256 train step: the category/self-time
+            # split that tells us where the ~0.24 MFU actually goes
+            ("resnet_profile", [sys.executable,
+                                os.path.join(REPO, "scripts",
+                                             "profile_resnet.py"),
+                                "--batch", "256"], 1200)]),
     ]
     if args.only:
         wanted = {s.strip() for s in args.only.split(",") if s.strip()}

@@ -1,0 +1,86 @@
+"""Smoke tests for the on-chip sweep orchestrator (``scripts/tpu_sweep.py``).
+
+The sweep is the evidence-capture path for every real-TPU number in
+``bench_artifacts/``; the axon tunnel is up only in short windows, so a
+regression that breaks a stage silently costs a whole window.  These smokes
+run the stages in ``SWEEP_SMOKE`` mode (tiny shapes, CPU, ``smoke_``-prefixed
+artifacts that can never clobber real-chip data) inside the example tier.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.example
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SWEEP = os.path.join(ROOT, "scripts", "tpu_sweep.py")
+
+
+def _smoke_env():
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env.update({"SWEEP_SMOKE": "1", "JAX_PLATFORMS": "cpu"})
+    return env
+
+
+def _run_stage(*argv, timeout=420):
+    proc = subprocess.run([sys.executable, SWEEP, *argv],
+                          capture_output=True, text=True, timeout=timeout,
+                          env=_smoke_env(), cwd=ROOT)
+    assert proc.returncode == 0, (
+        f"tpu_sweep {argv} failed:\n{proc.stdout}\n{proc.stderr}")
+    return proc.stdout
+
+
+def _remove_smoke_artifacts():
+    art = os.path.join(ROOT, "bench_artifacts")
+    for name in os.listdir(art):
+        if name.startswith("smoke_"):
+            os.remove(os.path.join(art, name))
+
+
+@pytest.fixture(autouse=True)
+def _clean_smoke_artifacts():
+    # before AND after: a killed prior run (teardown never ran) must not
+    # leave stale smoke rows for _merge_row to fold into this run's
+    _remove_smoke_artifacts()
+    yield
+    _remove_smoke_artifacts()
+
+
+def test_resnet_stage_loop_vs_eager():
+    """Eager and single-dispatch fori_loop rows both land in the artifact,
+    keyed separately."""
+    _run_stage("--stage", "resnet", "--batch", "8")
+    _run_stage("--stage", "resnet", "--batch", "8", "--loop")
+    with open(os.path.join(ROOT, "bench_artifacts",
+                           "smoke_resnet_sweep.json")) as f:
+        rows = json.load(f)["rows"]
+    keys = {(r["batch"], r["remat"], r["stem"], r["bn"], r["loop"])
+            for r in rows}
+    assert (8, False, "conv7", "f32", False) in keys
+    assert (8, False, "conv7", "f32", True) in keys
+    assert all(r["images_per_sec"] > 0 for r in rows)
+
+
+def test_gpt_train_stage():
+    _run_stage("--stage", "gpt_train", "--batch", "2")
+    with open(os.path.join(ROOT, "bench_artifacts",
+                           "smoke_gpt_train_sweep.json")) as f:
+        rows = json.load(f)["rows"]
+    assert rows and rows[0]["tokens_per_sec"] > 0
+    # the analytic count (the MFU numerator) must be populated
+    assert rows[0]["flops_analytic"] > 0
+
+
+def test_only_filter_validates_before_probe():
+    """A typo'd stage name fails fast — before the (slow) TPU probe."""
+    proc = subprocess.run(
+        [sys.executable, SWEEP, "--only", "definitely_not_a_stage"],
+        capture_output=True, text=True, timeout=60, env=_smoke_env(),
+        cwd=ROOT)
+    assert proc.returncode != 0
+    assert "not in the stage list" in proc.stderr

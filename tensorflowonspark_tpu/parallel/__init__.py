@@ -15,8 +15,9 @@ with named axes and every collective is emitted by XLA over ICI/DCN:
             ``num_ps`` reinterpretation)
 """
 
-from tensorflowonspark_tpu.parallel.mesh import (AXES, MeshSpec, make_mesh,
-                                                 mesh_from_num_ps)  # noqa: F401
+from tensorflowonspark_tpu.parallel.mesh import (AXES, MeshSpec,  # noqa: F401
+                                                 make_hybrid_mesh, make_mesh,
+                                                 mesh_from_num_ps)
 from tensorflowonspark_tpu.parallel.sharding import (PartitionRules, batch_pspec,
                                                      named_sharding, shard_batch,
                                                      shard_params)  # noqa: F401

@@ -86,6 +86,74 @@ def mesh_from_num_ps(num_ps: int, devices=None, **axis_sizes):
     return make_mesh(ep=max(1, num_ps), devices=devices, **axis_sizes)
 
 
+def make_hybrid_mesh(ici: MeshSpec | dict | None = None,
+                     dcn: dict | None = None, devices=None,
+                     slice_key=None):
+    """Build a mesh over multiple TPU slices: ICI axes inside each slice,
+    DCN axes across slices (SURVEY.md §7 step 4: "mesh construction over
+    the slice (ICI) and pods (DCN)").
+
+    Each canonical axis gets size ``dcn_k * ici_k``, laid out DCN-major:
+    moving one step along the axis stays inside a slice (ICI hop) until
+    the slice's extent is exhausted, then crosses slices (DCN hop).  Keep
+    high-traffic axes (``tp``/``sp``/``fsdp``) ICI-only and put only the
+    low-traffic-per-step axes (``dp``, ``pp``) in ``dcn`` — gradient
+    all-reduce and pipeline hops tolerate DCN latency; per-layer
+    collectives do not.
+
+        # 2 v5e slices x 8 chips: dp crosses DCN, fsdp*tp inside each slice
+        mesh = make_hybrid_mesh(ici=dict(fsdp=4, tp=2), dcn=dict(dp=2))
+
+    Slices are identified by ``device.slice_index`` (real multi-slice
+    TPU), falling back to ``process_index`` (multi-host CPU/test meshes);
+    ``slice_key`` overrides (a callable ``device -> group id``) for
+    single-process tests.  Every slice must contribute the same number of
+    devices; the ``dcn`` axis product must equal the slice count.
+    """
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    if slice_key is None:
+        def slice_key(d):  # noqa: ANN001 — jax Device
+            s = getattr(d, "slice_index", None)
+            return d.process_index if s is None else s
+    groups: dict = {}
+    for d in devices:
+        groups.setdefault(slice_key(d), []).append(d)
+    slice_ids = sorted(groups)
+    per_slice = [sorted(groups[s], key=lambda d: d.id) for s in slice_ids]
+    sizes = {len(g) for g in per_slice}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"uneven slices: {dict((s, len(g)) for s, g in groups.items())}")
+    n_slices, n_per = len(per_slice), sizes.pop()
+
+    dcn = dict(dcn or {})
+    unknown = set(dcn) - set(AXES)
+    if unknown:
+        raise ValueError(f"unknown dcn axes {sorted(unknown)}; valid: {AXES}")
+    try:
+        dcn_spec = MeshSpec(**{**{"dp": 1}, **dcn}).resolve(n_slices)
+    except ValueError as e:
+        raise ValueError(
+            f"dcn axis product must equal the slice count ({n_slices} "
+            f"slices of {n_per} devices): {e}") from None
+    if isinstance(ici, dict):
+        ici = MeshSpec(**{**{"dp": -1}, **ici})
+    ici_spec = (ici or MeshSpec()).resolve(n_per)
+
+    # [n_slices, n_per] -> dcn sizes + ici sizes -> interleave (dcn_k, ici_k)
+    # per canonical axis -> merge each pair into one axis of dcn_k * ici_k.
+    arr = np.empty((n_slices, n_per), dtype=object)
+    for i, g in enumerate(per_slice):
+        arr[i, :] = g
+    arr = arr.reshape(dcn_spec.sizes() + ici_spec.sizes())
+    order = [ax for k in range(len(AXES)) for ax in (k, len(AXES) + k)]
+    arr = arr.transpose(order).reshape(
+        tuple(d * i for d, i in zip(dcn_spec.sizes(), ici_spec.sizes())))
+    return jax.sharding.Mesh(arr, AXES)
+
+
 def local_mesh_devices(mesh) -> list:
     """Devices of this process within a (possibly multi-host) mesh."""
     import jax

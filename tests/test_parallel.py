@@ -40,6 +40,81 @@ def test_mesh_from_num_ps_maps_to_ep():
     assert mesh.shape["ep"] == 4 and mesh.shape["dp"] == 2
 
 
+def test_hybrid_mesh_dcn_axis_crosses_slices():
+    """dp over DCN, tp*sp inside each slice: every tp/sp neighbour pair
+    stays in one slice, the dp hop crosses slices (2 fake slices of 4)."""
+    from tensorflowonspark_tpu.parallel import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(ici=dict(tp=2, sp=2), dcn=dict(dp=2),
+                            slice_key=lambda d: d.id // 4)
+    assert mesh.shape == {"pp": 1, "dp": 2, "fsdp": 1, "ep": 1,
+                          "sp": 2, "tp": 2}
+    grid = mesh.devices  # [pp, dp, fsdp, ep, sp, tp]
+    slice_of = lambda d: d.id // 4  # noqa: E731
+    for dp in range(2):
+        block = grid[0, dp, 0, 0]  # [sp, tp] — one slice's worth
+        assert {slice_of(d) for d in block.flat} == {dp}
+
+
+def test_hybrid_mesh_axis_interleaves_dcn_major():
+    """A single axis sized across both link classes: dcn-major, so
+    consecutive entries along the axis stay in-slice until the slice's
+    ici extent is exhausted."""
+    from tensorflowonspark_tpu.parallel import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(ici=dict(dp=4), dcn=dict(dp=2),
+                            slice_key=lambda d: d.id // 4)
+    assert mesh.shape["dp"] == 8
+    dp_slices = [d.id // 4 for d in mesh.devices[0, :, 0, 0, 0, 0]]
+    assert dp_slices == [0, 0, 0, 0, 1, 1, 1, 1]
+
+
+def test_hybrid_mesh_single_slice_equals_make_mesh():
+    from tensorflowonspark_tpu.parallel import make_hybrid_mesh
+
+    # all 8 virtual devices are one process -> one slice; no dcn axes
+    hybrid = make_hybrid_mesh(ici=dict(dp=2, tp=4))
+    plain = make_mesh(dp=2, tp=4)
+    assert [d.id for d in hybrid.devices.flat] == \
+        [d.id for d in plain.devices.flat]
+
+
+def test_hybrid_mesh_validation_errors():
+    from tensorflowonspark_tpu.parallel import make_hybrid_mesh
+
+    with pytest.raises(ValueError, match="unknown dcn axes"):
+        make_hybrid_mesh(dcn=dict(bogus=2))
+    with pytest.raises(ValueError, match="slice count"):
+        make_hybrid_mesh(dcn=dict(dp=4), slice_key=lambda d: d.id // 4)
+    with pytest.raises(ValueError, match="uneven slices"):
+        make_hybrid_mesh(dcn=dict(dp=2),
+                         slice_key=lambda d: 0 if d.id < 3 else 1)
+
+
+def test_hybrid_mesh_dp_step_matches_single_device():
+    """A data-parallel mean-loss grad step over the hybrid mesh (dp
+    crossing the fake DCN boundary) equals the single-device value."""
+    from tensorflowonspark_tpu.parallel import make_hybrid_mesh
+    from jax.sharding import NamedSharding
+
+    mesh = make_hybrid_mesh(ici=dict(dp=2, tp=2), dcn=dict(dp=2),
+                            slice_key=lambda d: d.id // 4)
+    w = jnp.asarray(np.random.default_rng(0).standard_normal((8, 8)),
+                    jnp.float32)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((16, 8)),
+                    jnp.float32)
+
+    def loss(w, x):
+        return jnp.mean(jnp.tanh(x @ w) ** 2)
+
+    want = jax.grad(loss)(w, x)
+    xs = jax.device_put(x, NamedSharding(mesh, P(("dp",))))
+    ws = jax.device_put(w, NamedSharding(mesh, P()))
+    got = jax.jit(jax.grad(loss))(ws, xs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
 # -- sharding --------------------------------------------------------------
 
 def test_shard_batch_partitions_dim0():

@@ -54,14 +54,24 @@ MESH_SIZES = [8, 16, 32, 64, 128, 256]
 # ---------------------------------------------------------------------------
 MODEL_ASSUMPTIONS = {
     "topology": "TPU v5e pod, 2D ICI torus 16x16 (256 chips, one pod; no "
-                "DCN inside the modeled range)",
+                "DCN inside the modeled range).  The *_2slice workload "
+                "models TPU Multislice instead: 2 slices whose dp axis "
+                "crosses DCN (mesh built by parallel.make_hybrid_mesh)",
     "ici_GBps_per_link_per_direction": 45.0,
     "ici_links_per_axis": 1,       # one link each way along each torus axis
     "torus_axes": 2,               # a full-pod axis can ring over both
+    "dcn_GBps_per_chip_per_direction": 6.25,
+    "dcn_note": "per-chip share of slice DCN egress, assuming 50 GB/s per "
+                "8-chip v5e host (4x100 GbE); cross-slice collectives are "
+                "priced hierarchically — ICI phases at full group width, "
+                "the cross-slice phase on 1/k_ici of the payload at "
+                "per-chip DCN bandwidth (the standard multislice "
+                "reduce-scatter / DCN-transfer / all-gather decomposition)",
     "peak_bf16_flops_per_chip": 197e12,
     "mfu": {
         "resnet50_dp": 0.24,       # measured 2026-07-29 (bench_artifacts/
                                    # resnet50_tpu_2026-07-29.json) b256 bf16
+        "resnet50_dp_2slice": 0.24,  # same step, multislice layout
         "bert_tp_sp_dp": 0.24,     # assumed = measured ResNet MFU until a
                                    # BERT step is measured on-chip
         "bert_fsdp8_dp": 0.24,     # same assumption
@@ -110,10 +120,33 @@ def axis_bw_GBps(k: int) -> float:
     return a["ici_GBps_per_link_per_direction"] * 2 * axes
 
 
-def collective_time_s(op: str, bytes_: float, k: int) -> float:
-    bw = axis_bw_GBps(k) * 1e9
+def collective_time_s(op: str, bytes_: float, k: int,
+                      dcn: dict | None = None) -> float:
     if k <= 1:
         return 0.0
+    if dcn:
+        # Cross-slice group: hierarchical decomposition (see "dcn_note").
+        # ICI phases run at the in-slice width k_ici; the cross-slice
+        # phase moves each chip's 1/k_ici shard over per-chip DCN.
+        ki, kd = dcn["k_ici"], dcn["k_dcn"]
+        bw_i = axis_bw_GBps(ki) * 1e9
+        bw_d = MODEL_ASSUMPTIONS["dcn_GBps_per_chip_per_direction"] * 1e9
+        shard = bytes_ / max(ki, 1)
+        if op == "all-reduce":
+            # in-slice reduce-scatter + all-gather, cross-slice all-reduce
+            ici = 2 * bytes_ * (ki - 1) / ki / bw_i if ki > 1 else 0.0
+            return ici + 2 * shard * (kd - 1) / kd / bw_d
+        if op in ("reduce-scatter", "all-gather"):
+            ici = bytes_ * (ki - 1) / ki / bw_i if ki > 1 else 0.0
+            return ici + shard * (kd - 1) / kd / bw_d
+        if op == "all-to-all":
+            # (kd-1)/kd of the payload crosses slices; the rest stays ICI
+            return (bytes_ * (kd - 1) / kd / bw_d
+                    + (bytes_ / kd) * (ki - 1) / max(ki, 1) / bw_i)
+        if op == "collective-permute":
+            return bytes_ / bw_d  # the modeled hop crosses slices
+        raise ValueError(f"unmodeled collective op {op!r}")
+    bw = axis_bw_GBps(k) * 1e9
     if op == "all-reduce":
         return 2 * bytes_ * (k - 1) / k / bw
     if op in ("reduce-scatter", "all-gather", "all-to-all"):
@@ -140,6 +173,7 @@ _OP_RE = re.compile(
 _GROUPS_RE = re.compile(r"replica_groups=\{\{([\d,]+)\}")
 _IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
 _PERMUTE_RE = re.compile(r"source_target_pairs=\{\{(\d+),(\d+)\}")
+_PERMUTE_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)+)\}")
 
 
 def _shape_bytes(type_str: str) -> float:
@@ -357,12 +391,19 @@ def _loop_dot_flops(comps: dict[str, list[str]],
 def extract_collectives(hlo: str, axis_sizes: dict,
                         loop_trip: int | None = None,
                         comps: dict | None = None,
-                        mult: dict | None = None) -> list[dict]:
+                        mult: dict | None = None,
+                        dcn_extents: dict | None = None) -> list[dict]:
     """One record per collective op in the partitioned module: payload
     bytes (already multiplied by the enclosing loops' trip counts — see
     :func:`_loop_multipliers`), group size, and which mesh axes the
     group spans.  Pass precomputed ``comps``/``mult`` to avoid re-parsing
-    a large HLO text (the 2M-token ring modules run to hundreds of MB)."""
+    a large HLO text (the 2M-token ring modules run to hundreds of MB).
+
+    ``dcn_extents`` (multislice workloads): ``{axis: (k_dcn, k_ici)}`` for
+    every axis whose extent is dcn-major split across slices (the
+    ``make_hybrid_mesh`` layout).  A group whose coordinates on such an
+    axis cross a slice boundary gets a ``"dcn": {k_dcn, k_ici}`` field so
+    the pricing model can decompose it hierarchically."""
     import numpy as np
 
     sizes = tuple(axis_sizes.values())
@@ -394,28 +435,68 @@ def extract_collectives(hlo: str, axis_sizes: dict,
             coords = np.array(np.unravel_index(np.array(group), sizes)).T
             axes = [names[i] for i in range(len(names))
                     if len(set(coords[:, i])) > 1]
-            out.append({"op": op, "bytes": bytes_,
-                        "group_size": len(group), "axes": axes,
-                        "loop_multiplier": mult[comp]})
+            rec = {"op": op, "bytes": bytes_,
+                   "group_size": len(group), "axes": axes,
+                   "loop_multiplier": mult[comp]}
+            if dcn_extents:
+                def sid(row):
+                    # slice id = the dcn-major block along every
+                    # slice-split axis of the make_hybrid_mesh layout
+                    return tuple(
+                        row[names.index(ax)] // ici_k
+                        for ax, (_dcn_k, ici_k) in sorted(dcn_extents.items()))
+
+                if op == "collective-permute":
+                    # Hops run in parallel, so ONE cross-slice pair makes
+                    # DCN the op's bottleneck — classify from ALL pairs,
+                    # not the first (pairs are not symmetric like replica
+                    # groups).
+                    pm = _PERMUTE_PAIRS_RE.search(line)
+                    pairs = ([tuple(map(int, p)) for p in re.findall(
+                        r"\{(\d+),(\d+)\}", pm.group(1))]
+                        if pm else [tuple(group)])
+                    crosses = any(
+                        sid(np.unravel_index(a, sizes))
+                        != sid(np.unravel_index(b, sizes))
+                        for a, b in pairs)
+                    if crosses:
+                        rec["dcn"] = {"k_dcn": 2, "k_ici": 1}
+                else:
+                    # >1 distinct slice id among members -> crosses DCN
+                    slice_ids = {sid(row) for row in coords}
+                    k_dcn = len(slice_ids)
+                    if k_dcn > 1:
+                        rec["dcn"] = {"k_dcn": k_dcn,
+                                      "k_ici": len(group) // k_dcn}
+            out.append(rec)
     return out
 
 
 # ---------------------------------------------------------------------------
 # Workload builders (child side)
 # ---------------------------------------------------------------------------
-def _build_resnet_dp(n: int):
+def _build_resnet_dp(n: int, slices: int = 1):
     """North-star workload: ResNet-50, pure data parallel, bf16, per-chip
-    batch 256 (the measured bench configuration)."""
+    batch 256 (the measured bench configuration).  ``slices=2`` builds the
+    TPU-Multislice variant instead: the same step over a
+    ``make_hybrid_mesh`` whose dp axis is dcn-major across 2 slices, so
+    the gradient all-reduce is priced hierarchically (ICI + DCN)."""
     import jax
     import jax.numpy as jnp
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from tensorflowonspark_tpu.models.resnet import ResNet50
-    from tensorflowonspark_tpu.parallel import make_mesh
+    from tensorflowonspark_tpu.parallel import make_hybrid_mesh, make_mesh
     from tensorflowonspark_tpu.parallel.mesh import MeshSpec
 
-    mesh = make_mesh(MeshSpec(dp=n), devices=jax.devices()[:n])
+    if slices > 1:
+        per = n // slices
+        mesh = make_hybrid_mesh(ici=dict(dp=per), dcn=dict(dp=slices),
+                                devices=jax.devices()[:n],
+                                slice_key=lambda d: d.id // per)
+    else:
+        mesh = make_mesh(MeshSpec(dp=n), devices=jax.devices()[:n])
     model = ResNet50()
     per_chip = 256
     batch = per_chip * n
@@ -453,6 +534,9 @@ def _build_resnet_dp(n: int):
     jitted = jax.jit(
         train_step, donate_argnums=(0, 1),
         in_shardings=(var_sh, opt_sh, data_sh, data_sh))
+    if slices > 1:
+        return (mesh, jitted, (variables, abstract_opt, x, y), 1,
+                {"dp": (slices, n // slices)})
     return mesh, jitted, (variables, abstract_opt, x, y), 1
 
 
@@ -695,6 +779,8 @@ def _build_pipeline_pp8(n: int):
 
 
 WORKLOADS = {"resnet50_dp": _build_resnet_dp,
+             "resnet50_dp_2slice": functools.partial(_build_resnet_dp,
+                                                     slices=2),
              "bert_tp_sp_dp": _build_bert_gspmd,
              "bert_fsdp8_dp": _build_bert_fsdp,
              "ring_longctx_sp": _build_ring_longctx,
@@ -721,7 +807,9 @@ def child(workload: str, n: int) -> None:
     import jax
 
     assert len(jax.devices()) >= n, (len(jax.devices()), n)
-    mesh, jitted, abstract_args, loop_trip = WORKLOADS[workload](n)
+    built = WORKLOADS[workload](n)
+    mesh, jitted, abstract_args, loop_trip = built[:4]
+    dcn_extents = built[4] if len(built) > 4 else None
     compiled = jitted.lower(*abstract_args).compile()
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):
@@ -731,7 +819,8 @@ def child(workload: str, n: int) -> None:
     comps = _split_computations(hlo)
     mult = _loop_multipliers(comps, loop_trip)
     colls = extract_collectives(hlo, dict(mesh.shape), loop_trip=loop_trip,
-                                comps=comps, mult=mult)
+                                comps=comps, mult=mult,
+                                dcn_extents=dcn_extents)
     loop_flops = _loop_dot_flops(comps, mult)
     print(json.dumps({
         "workload": workload, "n": n, "mesh": dict(mesh.shape),
@@ -754,10 +843,13 @@ def predict(rec: dict) -> dict:
     per_op = {}
     per_axis_bytes = {}
     for c in rec["collectives"]:
-        t = collective_time_s(c["op"], c["bytes"], c["group_size"])
+        t = collective_time_s(c["op"], c["bytes"], c["group_size"],
+                              dcn=c.get("dcn"))
         t_comm += t
         per_op[c["op"]] = per_op.get(c["op"], 0.0) + t
         key = "x".join(c["axes"]) or "intra"
+        if c.get("dcn"):
+            key += "(xDCN)"
         per_axis_bytes[key] = per_axis_bytes.get(key, 0.0) + c["bytes"]
     return {
         **rec,

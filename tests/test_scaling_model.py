@@ -1,0 +1,86 @@
+"""Unit tests for scripts/scaling_model.py's HLO-text core.
+
+The heavy end (compiling workloads on virtual meshes) runs via the script
+itself; these cover the pure text-processing and pricing pieces that the
+artifact's numbers rest on — cheap enough for the fast tier.
+"""
+
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts.scaling_model import (MODEL_ASSUMPTIONS, axis_bw_GBps,
+                                   collective_time_s, extract_collectives)
+
+
+def _hlo(body: str) -> str:
+    return ("ENTRY %main (p0: bf16[128]) -> bf16[128] {\n"
+            "  %x = bf16[128]{0} parameter(0)\n" + body + "\n}\n")
+
+
+def test_allreduce_group_axes_and_dcn_split():
+    hlo = _hlo("  ROOT %ar = bf16[128]{0} all-reduce(%x), "
+               "replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add")
+    # dp=8 plain: spans dp, no dcn tag without extents
+    [rec] = extract_collectives(hlo, {"dp": 8}, loop_trip=1)
+    assert rec["axes"] == ["dp"] and "dcn" not in rec
+    # 2 slices of 4 (dcn-major): same group now crosses DCN
+    [rec] = extract_collectives(hlo, {"dp": 8}, loop_trip=1,
+                                dcn_extents={"dp": (2, 4)})
+    assert rec["dcn"] == {"k_dcn": 2, "k_ici": 4}
+
+
+def test_permute_classified_from_all_pairs():
+    """One cross-slice hop bottlenecks the (parallel) permute — the tag
+    must come from ALL source-target pairs, not the first."""
+    cross = _hlo("  ROOT %cp = bf16[128]{0} collective-permute(%x), "
+                 "source_target_pairs={{0,1},{1,2},{2,3},{3,0}}")
+    [rec] = extract_collectives(cross, {"dp": 4}, loop_trip=1,
+                                dcn_extents={"dp": (2, 2)})
+    assert rec["dcn"] == {"k_dcn": 2, "k_ici": 1}  # {1,2} crosses
+
+    inside = _hlo("  ROOT %cp = bf16[128]{0} collective-permute(%x), "
+                  "source_target_pairs={{0,1},{2,3}}")
+    [rec] = extract_collectives(inside, {"dp": 4}, loop_trip=1,
+                                dcn_extents={"dp": (2, 2)})
+    assert "dcn" not in rec
+
+
+def test_hierarchical_allreduce_price():
+    """all-reduce across 2 slices = in-slice ring phases at ICI width
+    k_ici + cross-slice phase on the 1/k_ici shard at per-chip DCN."""
+    B, ki, kd = 100e6, 4, 2
+    bw_i = axis_bw_GBps(ki) * 1e9
+    bw_d = MODEL_ASSUMPTIONS["dcn_GBps_per_chip_per_direction"] * 1e9
+    want = 2 * B * (ki - 1) / ki / bw_i + 2 * (B / ki) * (kd - 1) / kd / bw_d
+    got = collective_time_s("all-reduce", B, ki * kd,
+                            dcn={"k_ici": ki, "k_dcn": kd})
+    assert math.isclose(got, want, rel_tol=1e-12)
+    # and strictly more expensive than the same bytes all-ICI
+    assert got > collective_time_s("all-reduce", B, ki * kd)
+
+
+def test_loop_multiplier_scales_collective_bytes():
+    hlo = """
+%cond (c: (s32[], bf16[128])) -> pred[] {
+  %t = (s32[], bf16[128]) parameter(0)
+  %i = s32[] get-tuple-element(%t), index=0
+  %n = s32[] constant(7)
+  ROOT %lt = pred[] compare(%i, %n), direction=LT
+}
+%body (b: (s32[], bf16[128])) -> (s32[], bf16[128]) {
+  %t2 = (s32[], bf16[128]) parameter(0)
+  %v = bf16[128]{0} get-tuple-element(%t2), index=1
+  %ar = bf16[128]{0} all-reduce(%v), replica_groups={{0,1}}, to_apply=%add
+  ROOT %out = (s32[], bf16[128]) tuple(%t2)
+}
+ENTRY %main (p0: (s32[], bf16[128])) -> (s32[], bf16[128]) {
+  %p = (s32[], bf16[128]) parameter(0)
+  ROOT %w = (s32[], bf16[128]) while(%p), condition=%cond, body=%body
+}
+"""
+    [rec] = extract_collectives(hlo, {"dp": 2}, loop_trip=None)
+    assert rec["loop_multiplier"] == 7
+    assert rec["bytes"] == 7 * 128 * 2  # bf16

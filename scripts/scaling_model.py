@@ -54,9 +54,11 @@ MESH_SIZES = [8, 16, 32, 64, 128, 256]
 # ---------------------------------------------------------------------------
 MODEL_ASSUMPTIONS = {
     "topology": "TPU v5e pod, 2D ICI torus 16x16 (256 chips, one pod; no "
-                "DCN inside the modeled range).  The *_2slice workload "
-                "models TPU Multislice instead: 2 slices whose dp axis "
-                "crosses DCN (mesh built by parallel.make_hybrid_mesh)",
+                "DCN inside the modeled range).  The *_2slice workloads "
+                "model TPU Multislice instead (meshes built by "
+                "parallel.make_hybrid_mesh): resnet50_dp_2slice crosses "
+                "DCN on dp, gpipe_pp8_2slice on pp (4 contiguous stages "
+                "per slice)",
     "ici_GBps_per_link_per_direction": 45.0,
     "ici_links_per_axis": 1,       # one link each way along each torus axis
     "torus_axes": 2,               # a full-pod axis can ring over both
@@ -81,6 +83,7 @@ MODEL_ASSUMPTIONS = {
         "ulysses16_sp_t8k": 0.24,
         "moe_ep8_dp": 0.24,
         "gpipe_pp8_dp": 0.24,
+        "gpipe_pp8_2slice": 0.24,
     },
     "loop_collectives": "a collective inside a while-loop body appears "
                         "once in HLO but runs trip-count times; each "
@@ -710,22 +713,34 @@ def _build_moe_ep8(n: int):
     return mesh, jitted, (abstract_params, abstract_opt, x), None
 
 
-def _build_pipeline_pp8(n: int):
+def _build_pipeline_pp8(n: int, slices: int = 1):
     """Pipeline parallelism: 8 GPipe stages over pp=8, dp = n/8 — the
     manual shard_map schedule (``parallel/pipeline.py``) with BERT-base
     transformer stages; traffic is one activation tensor per microbatch
-    per stage hop, the cheapest bytes/step of any axis."""
+    per stage hop, the cheapest bytes/step of any axis.
+
+    ``slices=2``: the docs' recommended multislice layout — pp dcn-major
+    across 2 slices (4 contiguous stages per slice), so the mid-pipeline
+    hop and the ring wrap cross DCN while dp's gradient all-reduce and
+    the in-slice stage hops stay on ICI."""
     import jax
     import jax.numpy as jnp
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from tensorflowonspark_tpu.parallel import (make_mesh, pipeline_apply,
+    from tensorflowonspark_tpu.parallel import (make_hybrid_mesh, make_mesh,
+                                                pipeline_apply,
                                                 make_transformer_stage,
                                                 stack_stage_params)
     from tensorflowonspark_tpu.parallel.mesh import MeshSpec
 
-    mesh = make_mesh(MeshSpec(pp=8, dp=n // 8), devices=jax.devices()[:n])
+    if slices > 1:
+        per = n // slices
+        mesh = make_hybrid_mesh(
+            ici=dict(pp=8 // slices, dp=n // 8), dcn=dict(pp=slices),
+            devices=jax.devices()[:n], slice_key=lambda d: d.id // per)
+    else:
+        mesh = make_mesh(MeshSpec(pp=8, dp=n // 8), devices=jax.devices()[:n])
     hidden, heads, ffn, seq, vocab = 768, 12, 3072, 512, 32768
     num_mb = 16
     batch = 2 * num_mb * mesh.shape["dp"]
@@ -774,8 +789,11 @@ def _build_pipeline_pp8(n: int):
                       NamedSharding(mesh, P(("dp", "fsdp"), None))))
     # GPipe microbatch schedule loops; bound parsed from HLO conditions,
     # fallback = the schedule length if a condition is unreadable
-    return mesh, jitted, (abstract_params, abstract_opt, ids), \
-        num_mb + mesh.shape["pp"] - 1
+    trip = num_mb + mesh.shape["pp"] - 1
+    if slices > 1:
+        return (mesh, jitted, (abstract_params, abstract_opt, ids), trip,
+                {"pp": (slices, 8 // slices)})
+    return mesh, jitted, (abstract_params, abstract_opt, ids), trip
 
 
 WORKLOADS = {"resnet50_dp": _build_resnet_dp,
@@ -791,7 +809,9 @@ WORKLOADS = {"resnet50_dp": _build_resnet_dp,
              "ulysses16_sp_t8k": functools.partial(_build_sp_attn_h16,
                                                    impl="ulysses"),
              "moe_ep8_dp": _build_moe_ep8,
-             "gpipe_pp8_dp": _build_pipeline_pp8}
+             "gpipe_pp8_dp": _build_pipeline_pp8,
+             "gpipe_pp8_2slice": functools.partial(_build_pipeline_pp8,
+                                                   slices=2)}
 
 # per-workload size limits (default: every MESH_SIZES entry).  Ulysses
 # shards heads over sp, so sp cannot exceed num_heads=16; the ring twin

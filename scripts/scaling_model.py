@@ -58,7 +58,8 @@ MODEL_ASSUMPTIONS = {
                 "model TPU Multislice instead (meshes built by "
                 "parallel.make_hybrid_mesh): resnet50_dp_2slice crosses "
                 "DCN on dp, gpipe_pp8_2slice on pp (4 contiguous stages "
-                "per slice)",
+                "per slice), bert_fsdp8_2slice on fsdp (the deliberate "
+                "anti-pattern probe)",
     "ici_GBps_per_link_per_direction": 45.0,
     "ici_links_per_axis": 1,       # one link each way along each torus axis
     "torus_axes": 2,               # a full-pod axis can ring over both
@@ -77,6 +78,7 @@ MODEL_ASSUMPTIONS = {
         "bert_tp_sp_dp": 0.24,     # assumed = measured ResNet MFU until a
                                    # BERT step is measured on-chip
         "bert_fsdp8_dp": 0.24,     # same assumption
+        "bert_fsdp8_2slice": 0.24,
         "ring_longctx_sp": 0.24,   # same assumption
         "ring_longctx_sp_t8k": 0.24,
         "ring16_sp_t8k": 0.24,
@@ -463,7 +465,12 @@ def extract_collectives(hlo: str, axis_sizes: dict,
                         != sid(np.unravel_index(b, sizes))
                         for a, b in pairs)
                     if crosses:
-                        rec["dcn"] = {"k_dcn": 2, "k_ici": 1}
+                        # k_dcn = total slice count (pricing only uses
+                        # bytes/bw_d for permutes, but the metadata must
+                        # not hardcode 2); a hop links exactly 2 devices
+                        rec["dcn"] = {"k_dcn": math.prod(
+                            d for d, _ in dcn_extents.values()),
+                            "k_ici": 1}
                 else:
                     # >1 distinct slice id among members -> crosses DCN
                     slice_ids = {sid(row) for row in coords}
@@ -478,6 +485,24 @@ def extract_collectives(hlo: str, axis_sizes: dict,
 # ---------------------------------------------------------------------------
 # Workload builders (child side)
 # ---------------------------------------------------------------------------
+def _hybrid(n: int, ici: dict, dcn: dict):
+    """Build the 2+-slice hybrid mesh AND the matching ``dcn_extents``
+    from one spec, so the slice boundary used for mesh layout and the one
+    used for collective classification can never drift apart."""
+    import math as _math
+
+    import jax
+
+    from tensorflowonspark_tpu.parallel import make_hybrid_mesh
+
+    slices = _math.prod(dcn.values())
+    per = n // slices
+    mesh = make_hybrid_mesh(ici=ici, dcn=dcn, devices=jax.devices()[:n],
+                            slice_key=lambda d: d.id // per)
+    extents = {ax: (dcn[ax], ici.get(ax, 1)) for ax in dcn}
+    return mesh, extents
+
+
 def _build_resnet_dp(n: int, slices: int = 1):
     """North-star workload: ResNet-50, pure data parallel, bf16, per-chip
     batch 256 (the measured bench configuration).  ``slices=2`` builds the
@@ -490,14 +515,13 @@ def _build_resnet_dp(n: int, slices: int = 1):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from tensorflowonspark_tpu.models.resnet import ResNet50
-    from tensorflowonspark_tpu.parallel import make_hybrid_mesh, make_mesh
+    from tensorflowonspark_tpu.parallel import make_mesh
     from tensorflowonspark_tpu.parallel.mesh import MeshSpec
 
+    dcn_extents = None
     if slices > 1:
-        per = n // slices
-        mesh = make_hybrid_mesh(ici=dict(dp=per), dcn=dict(dp=slices),
-                                devices=jax.devices()[:n],
-                                slice_key=lambda d: d.id // per)
+        mesh, dcn_extents = _hybrid(n, ici=dict(dp=n // slices),
+                                    dcn=dict(dp=slices))
     else:
         mesh = make_mesh(MeshSpec(dp=n), devices=jax.devices()[:n])
     model = ResNet50()
@@ -537,9 +561,8 @@ def _build_resnet_dp(n: int, slices: int = 1):
     jitted = jax.jit(
         train_step, donate_argnums=(0, 1),
         in_shardings=(var_sh, opt_sh, data_sh, data_sh))
-    if slices > 1:
-        return (mesh, jitted, (variables, abstract_opt, x, y), 1,
-                {"dp": (slices, n // slices)})
+    if dcn_extents:
+        return mesh, jitted, (variables, abstract_opt, x, y), 1, dcn_extents
     return mesh, jitted, (variables, abstract_opt, x, y), 1
 
 
@@ -576,11 +599,16 @@ def _build_bert_gspmd(n: int):
         mesh.shape["sp"]
 
 
-def _build_bert_fsdp(n: int):
+def _build_bert_fsdp(n: int, slices: int = 1):
     """ZeRO-3 regime: BERT-base with weights auto-sharded over fsdp=8
     inside a host (the dryrun phase-4 overlay), dp = n/8 across — the
     traffic is per-layer weight all-gathers + grad reduce-scatters, the
-    scaling question FSDP users actually have."""
+    scaling question FSDP users actually have.
+
+    ``slices=2`` is the deliberate ANTI-PATTERN probe: fsdp dcn-major
+    across 2 slices, so every per-layer weight all-gather and grad
+    reduce-scatter crosses DCN — pricing exactly what the scaling guide
+    tells users not to do, so the advice carries a number."""
     import jax
     import jax.numpy as jnp
 
@@ -589,8 +617,14 @@ def _build_bert_fsdp(n: int):
     from tensorflowonspark_tpu.parallel import make_mesh
     from tensorflowonspark_tpu.parallel.mesh import MeshSpec
 
-    mesh = make_mesh(MeshSpec(dp=n // 8, fsdp=8),
-                     devices=jax.devices()[:n])
+    dcn_extents = None
+    if slices > 1:
+        mesh, dcn_extents = _hybrid(
+            n, ici=dict(fsdp=8 // slices, dp=n // 8),
+            dcn=dict(fsdp=slices))
+    else:
+        mesh = make_mesh(MeshSpec(dp=n // 8, fsdp=8),
+                         devices=jax.devices()[:n])
     cfg = BertConfig(num_layers=12, hidden_size=768, num_heads=12,
                      intermediate_size=3072, max_position_embeddings=512,
                      dtype=jnp.bfloat16, dropout_rate=0.0)
@@ -600,6 +634,9 @@ def _build_bert_fsdp(n: int):
     batch, seq = built["batch"], built["seq"]
     ids = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
     labels = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    if dcn_extents:
+        return (mesh, built["step"], (*built["abstract"], ids, labels), 1,
+                dcn_extents)
     return mesh, built["step"], (*built["abstract"], ids, labels), 1
 
 
@@ -728,17 +765,15 @@ def _build_pipeline_pp8(n: int, slices: int = 1):
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from tensorflowonspark_tpu.parallel import (make_hybrid_mesh, make_mesh,
-                                                pipeline_apply,
+    from tensorflowonspark_tpu.parallel import (make_mesh, pipeline_apply,
                                                 make_transformer_stage,
                                                 stack_stage_params)
     from tensorflowonspark_tpu.parallel.mesh import MeshSpec
 
+    dcn_extents = None
     if slices > 1:
-        per = n // slices
-        mesh = make_hybrid_mesh(
-            ici=dict(pp=8 // slices, dp=n // 8), dcn=dict(pp=slices),
-            devices=jax.devices()[:n], slice_key=lambda d: d.id // per)
+        mesh, dcn_extents = _hybrid(n, ici=dict(pp=8 // slices, dp=n // 8),
+                                    dcn=dict(pp=slices))
     else:
         mesh = make_mesh(MeshSpec(pp=8, dp=n // 8), devices=jax.devices()[:n])
     hidden, heads, ffn, seq, vocab = 768, 12, 3072, 512, 32768
@@ -790,9 +825,9 @@ def _build_pipeline_pp8(n: int, slices: int = 1):
     # GPipe microbatch schedule loops; bound parsed from HLO conditions,
     # fallback = the schedule length if a condition is unreadable
     trip = num_mb + mesh.shape["pp"] - 1
-    if slices > 1:
+    if dcn_extents:
         return (mesh, jitted, (abstract_params, abstract_opt, ids), trip,
-                {"pp": (slices, 8 // slices)})
+                dcn_extents)
     return mesh, jitted, (abstract_params, abstract_opt, ids), trip
 
 
@@ -801,6 +836,8 @@ WORKLOADS = {"resnet50_dp": _build_resnet_dp,
                                                      slices=2),
              "bert_tp_sp_dp": _build_bert_gspmd,
              "bert_fsdp8_dp": _build_bert_fsdp,
+             "bert_fsdp8_2slice": functools.partial(_build_bert_fsdp,
+                                                    slices=2),
              "ring_longctx_sp": _build_ring_longctx,
              "ring_longctx_sp_t8k": functools.partial(_build_ring_longctx,
                                                       per_device_seq=8192),

@@ -104,19 +104,31 @@ def make_hybrid_mesh(ici: MeshSpec | dict | None = None,
         # 2 v5e slices x 8 chips: dp crosses DCN, fsdp*tp inside each slice
         mesh = make_hybrid_mesh(ici=dict(fsdp=4, tp=2), dcn=dict(dp=2))
 
-    Slices are identified by ``device.slice_index`` (real multi-slice
-    TPU), falling back to ``process_index`` (multi-host CPU/test meshes);
-    ``slice_key`` overrides (a callable ``device -> group id``) for
-    single-process tests.  Every slice must contribute the same number of
-    devices; the ``dcn`` axis product must equal the slice count.
+    Slices are identified by ``device.slice_index`` on real hardware
+    (uniform 0 = one genuine slice, e.g. a single-slice multi-host pod);
+    on the CPU backend — where slice_index is meaningless filler — by
+    ``process_index``, so multi-process CPU test meshes treat the
+    process boundary as the DCN analogue.  ``slice_key`` overrides (a
+    callable ``device -> group id``) for single-process tests.  Every
+    slice must contribute the same number of devices; the ``dcn`` axis
+    product must equal the slice count.
     """
     import jax
 
     devices = list(devices if devices is not None else jax.devices())
     if slice_key is None:
-        def slice_key(d):  # noqa: ANN001 — jax Device
-            s = getattr(d, "slice_index", None)
-            return d.process_index if s is None else s
+        # slice_index is ground truth on TPU (uniform 0 = one real slice,
+        # e.g. a single-slice multi-host pod).  The CPU backend also
+        # reports a uniform slice_index=0 across processes, but there it
+        # is meaningless filler — in the simulated regime the process
+        # boundary plays the DCN role, so group by process instead.
+        slice_vals = {getattr(d, "slice_index", None) for d in devices}
+        if None not in slice_vals and devices[0].platform != "cpu":
+            def slice_key(d):  # noqa: ANN001 — jax Device
+                return d.slice_index
+        else:
+            def slice_key(d):  # noqa: ANN001
+                return d.process_index
     groups: dict = {}
     for d in devices:
         groups.setdefault(slice_key(d), []).append(d)

@@ -212,6 +212,24 @@ def fn_distributed_multidev_train(args, ctx):
         assert {d.process_index for d in outer[1]} == {1}
         w1_spec, data_spec = P("fsdp", "tp"), P(("dp", "fsdp"))
 
+    _mlp_train_and_write(args, ctx, mesh, w1_spec=w1_spec,
+                         data_spec=data_spec, out_prefix="mdev")
+
+
+def _mlp_train_and_write(args, ctx, mesh, *, w1_spec, data_spec,
+                         out_prefix):
+    """Shared tanh-MLP parity harness for the multi-process mesh workers:
+    same seeds/lr/shapes as ``tests.test_distributed._mlp_oracle``, so
+    every caller's output file compares against the one oracle.  Writes
+    ``<out_prefix>.<executor_id>`` with the loss trajectory + a replicated
+    parameter fingerprint (the sharded weights themselves are not
+    addressable from any single process)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
     rng = np.random.default_rng(0)
     X_np = rng.standard_normal((8, 4)).astype(np.float32)
     y_np = rng.standard_normal((8,)).astype(np.float32)
@@ -242,14 +260,43 @@ def fn_distributed_multidev_train(args, ctx):
     for _ in range(int(args.get("steps", 3))):
         W1, W2, loss = train_step(W1, W2, X, y)
         losses.append(float(loss))
-    # replicated scalar fingerprint (the sharded weights themselves are not
-    # addressable from any single process)
     fp = float(jax.jit(lambda a, b: jnp.sum(a ** 2) + jnp.sum(b ** 2))(W1, W2))
 
-    path = os.path.join(ctx.working_dir, f"mdev.{ctx.executor_id}")
+    path = os.path.join(ctx.working_dir, f"{out_prefix}.{ctx.executor_id}")
     with open(path, "w") as f:
         f.write(f"{jax.process_count()}:{len(devs)}:"
                 + ",".join(f"{v:.8f}" for v in losses) + f":{fp:.8f}")
+
+
+def fn_distributed_hybrid_mesh_train(args, ctx):
+    """``make_hybrid_mesh`` with its ``process_index`` slice fallback, on a
+    REAL process boundary: 2 processes × 4 CPU devices = 2 "slices", no
+    ``slice_key`` override — dp lands across the processes (the DCN
+    analogue), fsdp·tp inside each.  Same MLP math as
+    ``fn_distributed_multidev_train`` so the driver compares against the
+    same single-process oracle."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    ctx.initialize_distributed()
+
+    from jax.sharding import PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel import make_hybrid_mesh
+
+    assert jax.process_count() == 2, jax.process_count()
+    devs = jax.devices()
+    assert len(devs) == 8, f"need 2 procs x 4 devices, got {len(devs)}"
+    mesh = make_hybrid_mesh(ici=dict(fsdp=2, tp=2), dcn=dict(dp=2))
+    assert dict(mesh.shape) == {"pp": 1, "dp": 2, "fsdp": 2, "ep": 1,
+                                "sp": 1, "tp": 2}, dict(mesh.shape)
+    # each dp block must be exactly one process's devices (slice = process)
+    blocks = mesh.devices.reshape(2, -1)
+    assert {d.process_index for d in blocks[0]} == {0}
+    assert {d.process_index for d in blocks[1]} == {1}
+
+    _mlp_train_and_write(args, ctx, mesh, w1_spec=P("fsdp", "tp"),
+                         data_spec=P(("dp", "fsdp")), out_prefix="hybrid")
 
 
 def fn_distributed_pipeline_multidev(args, ctx):

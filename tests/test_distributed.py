@@ -162,6 +162,26 @@ def test_two_process_four_device_gspmd(tmp_path, span):
         np.testing.assert_allclose(float(fp), want_fp, rtol=1e-5)
 
 
+def test_two_process_hybrid_mesh(tmp_path):
+    """make_hybrid_mesh's process_index slice fallback across a REAL
+    process boundary: 2 procs × 4 devices, dp across the processes,
+    fsdp·tp inside; parity with the single-process oracle."""
+    cluster = TPUCluster.run(
+        cluster_funcs.fn_distributed_hybrid_mesh_train, {"steps": 3},
+        num_workers=2, working_dir=str(tmp_path), worker_env=MULTIDEV_ENV,
+        reservation_timeout=120)
+    cluster.shutdown(timeout=240)
+
+    want_losses, want_fp = _mlp_oracle(steps=3)
+    for i in range(2):
+        with open(f"{tmp_path}/hybrid.{i}") as f:
+            nproc, ndev, losses, fp = f.read().split(":")
+        assert (int(nproc), int(ndev)) == (2, 8)
+        got = [float(v) for v in losses.split(",")]
+        np.testing.assert_allclose(got, want_losses, rtol=1e-5)
+        np.testing.assert_allclose(float(fp), want_fp, rtol=1e-5)
+
+
 def _pipeline_multidev_oracle(steps: int = 2):
     """Sequential single-device replay of ``fn_distributed_pipeline_
     multidev``'s math: the SAME ``make_transformer_stage`` stages (tp=1,

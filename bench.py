@@ -268,6 +268,28 @@ def bench_resnet() -> dict:
         out["warning"] = (f"NOT a TPU measurement: ran on {platform}, "
                           f"{shapes}; vs_baseline is "
                           f"{platform}-vs-{platform}")
+        # ...but the round artifact should still carry the committed
+        # real-chip evidence, with provenance, so a dead tunnel at bench
+        # time doesn't erase it.  The cited row is the BEST-throughput
+        # eager row across the accumulated sweep artifact (rows merge by
+        # config key, so this is "best committed", not "most recent").
+        try:
+            with open(os.path.join(REPO, "bench_artifacts",
+                                   "resnet_sweep.json")) as f:
+                rows = [r for r in json.load(f)["rows"]
+                        if "TPU" in str(r.get("device", ""))
+                        and not r.get("loop") and not r.get("remat")]
+            if rows:
+                best = max(rows, key=lambda r: r["images_per_sec"])
+                out["best_committed_tpu"] = {
+                    "images_per_sec_per_chip": best["images_per_sec"],
+                    "mfu": best.get("mfu"),
+                    "config": {k: best[k] for k in
+                               ("batch", "stem", "bn") if k in best},
+                    "source": "bench_artifacts/resnet_sweep.json",
+                }
+        except Exception as e:  # noqa: BLE001 — resilience IS the point
+            log(f"bench: no prior TPU artifact to cite ({e!r})")
     return out
 
 

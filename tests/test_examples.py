@@ -144,6 +144,16 @@ def _check_gpt_tiny(out):
     assert m and float(m.group(1)) >= 0.5, out
 
 
+def test_multislice_train(tmp_path):
+    """Hybrid-mesh training: dp crossing 2 simulated slices, tp on ICI
+    (4 devices here -> 2 slices x 2)."""
+    out = _run("multislice/multislice_train.py", "--max_steps", "10",
+               "--batch_size", "8",
+               "--model_dir", str(tmp_path / "ms"), timeout=600)
+    assert "multislice: done" in out
+    assert "2 slices x 2" in out
+
+
 def test_gpt_tiny(tmp_path):
     _check_gpt_tiny(_run("gpt/gpt_tiny.py", "--max_steps", "40",
                          "--model_dir", str(tmp_path / "gpt"), timeout=600))

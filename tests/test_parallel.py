@@ -91,6 +91,48 @@ def test_hybrid_mesh_validation_errors():
                          slice_key=lambda d: 0 if d.id < 3 else 1)
 
 
+def test_mesh_strategy_composes_with_hybrid_mesh():
+    """The main training API accepts a multislice mesh: MeshStrategy over
+    make_hybrid_mesh (dp across 2 fake slices, tp inside) trains to the
+    same loss as plain single-device gradient descent."""
+    import optax
+
+    from tensorflowonspark_tpu.parallel import MeshStrategy, make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(ici=dict(dp=2, tp=2), dcn=dict(dp=2),
+                            slice_key=lambda d: d.id // 4)
+    strategy = MeshStrategy(mesh=mesh)
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((16, 4)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((16,)), jnp.float32)
+    tx = optax.sgd(0.1)
+
+    def init_fn():
+        return {"w": jnp.zeros((4,), jnp.float32)}
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+    state = strategy.init_state(init_fn, tx)
+    step = strategy.build_train_step(loss_fn)
+    batch = strategy.shard_batch({"x": X, "y": y})
+    for _ in range(3):
+        state, metrics = step(state, batch)
+
+    # plain single-device oracle: same trajectory, weights AND last loss
+    w = jnp.zeros((4,))
+    losses = []
+    for _ in range(3):
+        loss, g = jax.value_and_grad(
+            lambda w: jnp.mean((X @ w - y) ** 2))(w)
+        losses.append(float(loss))
+        w = w - 0.1 * g
+    got_w = np.asarray(jax.device_get(state.params["w"]))
+    np.testing.assert_allclose(got_w, np.asarray(w), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(metrics["loss"]), losses[-1],
+                               rtol=1e-5)
+
+
 def test_hybrid_mesh_dp_step_matches_single_device():
     """A data-parallel mean-loss grad step over the hybrid mesh (dp
     crossing the fake DCN boundary) equals the single-device value."""

@@ -29,5 +29,5 @@ from tensorflowonspark_tpu.models.gpt import (GPT, GPTConfig,  # noqa: F401
                                               beam_generate, greedy_generate,
                                               init_cache, sample_generate)
 from tensorflowonspark_tpu.models.convert import (  # noqa: F401
-    gpt2_config_from_hf, gpt2_params_from_hf, llama_config_from_hf,
-    llama_params_from_hf)
+    bert_config_from_hf, bert_params_from_hf, gpt2_config_from_hf,
+    gpt2_params_from_hf, llama_config_from_hf, llama_params_from_hf)

@@ -65,6 +65,11 @@ class BertConfig:
     # knobs as GPTConfig (params gain a leading ``layers`` axis).
     scan_layers: bool = False
     remat: bool = False
+    # Numerics knobs for checkpoint interchange (models/convert.py): HF
+    # BERT uses exact erf-gelu and LayerNorm eps 1e-12; the defaults keep
+    # this module's original behavior (tanh gelu, flax eps 1e-6).
+    norm_eps: float = 1e-6
+    gelu_exact: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -116,12 +121,14 @@ class EncoderLayer(nn.Module):
         cfg = self.cfg
         y = SelfAttention(cfg, name="attn")(x, mask, train=train)
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + y).astype(cfg.dtype)
+        x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.norm_eps,
+                         name="ln_attn")(x + y).astype(cfg.dtype)
         y = _dense(cfg.intermediate_size, (None, "tp"), cfg.dtype, "mlp_up")(x)
-        y = nn.gelu(y)
+        y = nn.gelu(y, approximate=not cfg.gelu_exact)
         y = _dense(cfg.hidden_size, ("tp", None), cfg.dtype, "mlp_down")(y)
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
-        return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y).astype(cfg.dtype)
+        return nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.norm_eps,
+                            name="ln_mlp")(x + y).astype(cfg.dtype)
 
 
 class _ScanEncoderLayer(EncoderLayer):
@@ -154,7 +161,8 @@ class Bert(nn.Module):
             x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
                              embedding_init=emb_init, dtype=cfg.dtype,
                              name="type_emb")(token_type_ids)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x).astype(cfg.dtype)
+        x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.norm_eps,
+                         name="ln_emb")(x).astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout_rate, deterministic=not train)(x)
         if cfg.scan_layers:
             block_cls = _ScanEncoderLayer

@@ -185,3 +185,88 @@ def llama_params_from_hf(state_dict: Mapping[str, Any], cfg: GPTConfig):
             "mlp_down": linear(f"{p}.mlp.down_proj.weight"),
         }
     return params
+
+
+def bert_config_from_hf(hf_cfg) -> "BertConfig":
+    """``transformers.BertConfig`` → :class:`~.bert.BertConfig`.
+
+    BERT-base SQuAD via the ML pipeline is ``BASELINE.json`` configs[3];
+    this is the weights on-ramp for it.  HF BERT's numerics: exact
+    erf-gelu and LayerNorm at the checkpoint's ``layer_norm_eps``
+    (1e-12 for the published models) — mapped onto the config's
+    ``gelu_exact`` / ``norm_eps`` knobs.
+    """
+    from tensorflowonspark_tpu.models.bert import BertConfig
+
+    act = getattr(hf_cfg, "hidden_act", "gelu")
+    if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(
+            f"unsupported hidden_act={act!r} (gelu variants only)")
+    if getattr(hf_cfg, "position_embedding_type", "absolute") != "absolute":
+        raise ValueError("only absolute position embeddings are supported")
+    if hf_cfg.hidden_dropout_prob != hf_cfg.attention_probs_dropout_prob:
+        # one dropout_rate knob here covers both HF rates; converting a
+        # checkpoint with split rates would silently change fine-tune
+        # numerics
+        raise ValueError(
+            f"hidden_dropout_prob ({hf_cfg.hidden_dropout_prob}) != "
+            f"attention_probs_dropout_prob "
+            f"({hf_cfg.attention_probs_dropout_prob}); BertConfig has one "
+            "dropout_rate for both — set them equal (or 0 for inference)")
+    return BertConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        intermediate_size=hf_cfg.intermediate_size,
+        max_position_embeddings=hf_cfg.max_position_embeddings,
+        type_vocab_size=hf_cfg.type_vocab_size,
+        dropout_rate=hf_cfg.hidden_dropout_prob,
+        dtype=np.float32,
+        norm_eps=hf_cfg.layer_norm_eps,
+        gelu_exact=(act == "gelu"),
+    )
+
+
+def bert_params_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
+    """HF ``BertModel`` state dict → params for :class:`~.bert.Bert`.
+
+    Torch ``Linear`` stores weights ``[out, in]`` → transposed into flax
+    kernels.  The pooler (when present) is ignored: the encoder trunk is
+    what SQuAD-style heads consume; classification variants re-initialize
+    their own pooler/head.
+    """
+    sd = {k.removeprefix("bert."): v for k, v in state_dict.items()}
+
+    def linear(prefix):
+        return {"kernel": _np(sd[f"{prefix}.weight"]).T,
+                "bias": _np(sd[f"{prefix}.bias"])}
+
+    def norm(prefix):
+        return {"scale": _np(sd[f"{prefix}.weight"]),
+                "bias": _np(sd[f"{prefix}.bias"])}
+
+    params = {
+        "tok_emb": {"embedding":
+                    _np(sd["embeddings.word_embeddings.weight"])},
+        "pos_emb": {"embedding":
+                    _np(sd["embeddings.position_embeddings.weight"])},
+        "type_emb": {"embedding":
+                     _np(sd["embeddings.token_type_embeddings.weight"])},
+        "ln_emb": norm("embeddings.LayerNorm"),
+    }
+    for i in range(cfg.num_layers):
+        p = f"encoder.layer.{i}"
+        params[f"layer_{i}"] = {
+            "attn": {
+                "query": linear(f"{p}.attention.self.query"),
+                "key": linear(f"{p}.attention.self.key"),
+                "value": linear(f"{p}.attention.self.value"),
+                "out": linear(f"{p}.attention.output.dense"),
+            },
+            "ln_attn": norm(f"{p}.attention.output.LayerNorm"),
+            "mlp_up": linear(f"{p}.intermediate.dense"),
+            "mlp_down": linear(f"{p}.output.dense"),
+            "ln_mlp": norm(f"{p}.output.LayerNorm"),
+        }
+    return params

@@ -123,3 +123,44 @@ def test_qwen2_window_layer_semantics():
     # mixed: no global equivalent
     with pytest.raises(ValueError, match="max_window_layers"):
         llama_config_from_hf(Qwen2Config(max_window_layers=1, **base))
+
+
+def test_bert_conversion_matches_hf_hidden_states():
+    """Random HF BertModel and the converted Bert agree on the encoder's
+    last hidden state (incl. padding-mask semantics and token types)."""
+    from tensorflowonspark_tpu.models import Bert
+    from tensorflowonspark_tpu.models.convert import (bert_config_from_hf,
+                                                      bert_params_from_hf)
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=97, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=48, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(0)
+    hf = transformers.BertModel(hf_cfg).eval()
+
+    B, T = 2, 16
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 97, (B, T))
+    types = rng.integers(0, 2, (B, T))
+    mask = np.ones((B, T), np.int64)
+    mask[0, 12:] = 0  # padded tail on row 0
+
+    with torch.no_grad():
+        want = hf(input_ids=torch.tensor(ids),
+                  attention_mask=torch.tensor(mask),
+                  token_type_ids=torch.tensor(types)
+                  ).last_hidden_state.numpy()
+
+    cfg = bert_config_from_hf(hf_cfg)
+    assert cfg.gelu_exact and cfg.norm_eps == hf_cfg.layer_norm_eps
+    params = bert_params_from_hf(hf.state_dict(), cfg)
+    got = Bert(cfg).apply({"params": params}, jnp.asarray(ids),
+                          attention_mask=jnp.asarray(mask, bool),
+                          token_type_ids=jnp.asarray(types))
+    # compare non-padded positions (padded-query rows are attention
+    # implementation detail on both sides)
+    keep = mask.astype(bool)
+    np.testing.assert_allclose(np.asarray(got)[keep], want[keep],
+                               rtol=2e-4, atol=2e-5)

@@ -145,8 +145,8 @@ def _check_gpt_tiny(out):
 
 
 def test_multislice_train(tmp_path):
-    """Hybrid-mesh training: dp crossing 2 simulated slices, tp on ICI
-    (4 devices here -> 2 slices x 2)."""
+    """Hybrid-mesh training: dp crossing 2 simulated slices, ZeRO-3 fsdp
+    sharding on ICI (4 devices here -> 2 slices x 2)."""
     out = _run("multislice/multislice_train.py", "--max_steps", "10",
                "--batch_size", "8",
                "--model_dir", str(tmp_path / "ms"), timeout=600)

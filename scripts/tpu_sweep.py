@@ -423,6 +423,11 @@ def stage_decode() -> dict:
                 dataclasses.replace(cfg, kv_cache_int8=True), qp)
         except Exception as e:  # noqa: BLE001 — partial rows still useful
             row["quant_error"] = repr(e)
+        try:
+            qp4 = jax.device_put(quantize_params(params, bits=4))
+            row["int4_tps"] = tps(cfg, qp4)
+        except Exception as e:  # noqa: BLE001
+            row["int4_error"] = repr(e)
         rows.append(row)
         print("sweep decode:", json.dumps(row), flush=True)
     # sliding-window + rolling cache decode (long-context regime)

@@ -220,25 +220,26 @@ _INT8_KEYS = frozenset({"q", "scale"})
 
 
 def _plainify_int8(params):
-    """Replace ``ops.quant.Int8Array`` leaves with ``{"q", "scale"}`` dicts
-    (serializable by jax.export and orbax alike).  Returns
+    """Replace quantized leaves (``ops.quant`` Int8Array/Int4Array) with
+    ``{"q", "scale"}`` dicts (serializable by jax.export and orbax
+    alike); the q dtype records which wrapper to rebuild.  Returns
     ``(tree, had_any)``."""
     import jax
 
     try:
-        from tensorflowonspark_tpu.ops.quant import Int8Array
+        from tensorflowonspark_tpu.ops.quant import _QuantArray
     except ImportError:  # pragma: no cover
         return params, False
     found = []
 
     def plain(leaf):
-        if isinstance(leaf, Int8Array):
+        if isinstance(leaf, _QuantArray):
             found.append(True)
             return {"q": leaf.q, "scale": leaf.scale}
         return leaf
 
     out = jax.tree.map(plain, params,
-                       is_leaf=lambda x: isinstance(x, Int8Array))
+                       is_leaf=lambda x: isinstance(x, _QuantArray))
     return out, bool(found)
 
 
@@ -248,18 +249,21 @@ def _requant_int8(params):
     import jax.numpy as jnp
     from collections.abc import Mapping
 
-    from tensorflowonspark_tpu.ops.quant import Int8Array
+    from tensorflowonspark_tpu.ops.quant import Int4Array, Int8Array
+
+    _wrappers = {jnp.dtype(jnp.int8): Int8Array,
+                 jnp.dtype(jnp.int4): Int4Array}
 
     def is_q(node):
         return (isinstance(node, Mapping) and set(node.keys()) == _INT8_KEYS
-                and getattr(node["q"], "dtype", None) == jnp.int8)
+                and getattr(node["q"], "dtype", None) in _wrappers)
 
     def walk(node):
         # inverse of _plainify_int8 over the containers a params tree can
         # hold: any Mapping (dict/FrozenDict/OrderedDict — rebuilt via the
         # same type), namedtuples, lists/tuples
         if is_q(node):
-            return Int8Array(node["q"], node["scale"])
+            return _wrappers[node["q"].dtype](node["q"], node["scale"])
         if isinstance(node, Mapping):
             return type(node)({k: walk(v) for k, v in node.items()})
         if isinstance(node, tuple) and hasattr(node, "_fields"):

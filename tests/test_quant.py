@@ -152,3 +152,123 @@ def test_int8_export_serves_without_model_code(tmp_path):
     # weights on disk / in memory stay int8 (check the restored tree)
     flat = jax.tree.leaves(loaded.params)
     assert any(getattr(l, "dtype", None) == jnp.int8 for l in flat)
+
+
+def test_quantize_int4_roundtrip_error_bounded():
+    from tensorflowonspark_tpu.ops import Int4Array, quantize_int4
+
+    w = jax.random.normal(jax.random.key(2), (64, 48), jnp.float32)
+    qa = quantize_int4(w)
+    assert isinstance(qa, Int4Array)
+    assert qa.q.shape == w.shape and qa.q.dtype == jnp.int4
+    assert qa.shape == w.shape and qa.ndim == 2
+    # worst-case error: half a step of the 15-level grid
+    step = jnp.max(jnp.abs(w), axis=-2, keepdims=True) / 7.0
+    assert float(jnp.max(jnp.abs(jnp.asarray(qa) - w) - step / 2)) <= 1e-6
+    # packed accounting: two weights per byte + fp32 scales
+    assert qa.nbytes == w.size // 2 + 48 * 4
+
+
+def test_int4_exact_for_representable_grid():
+    """Values already on the int4 grid dequantize exactly (incl.
+    negative values)."""
+    from tensorflowonspark_tpu.ops import quantize_int4
+
+    q = np.array([[-7, -3, 0, 1], [5, 7, -1, 2]], np.float32).T  # K=4, N=2
+    w = jnp.asarray(q) * 0.25
+    np.testing.assert_allclose(np.asarray(jnp.asarray(quantize_int4(w))),
+                               np.asarray(w), rtol=0, atol=1e-7)
+
+
+def test_int4array_jits_and_matmuls():
+    from tensorflowonspark_tpu.ops import quantize_int4
+
+    w = jax.random.normal(jax.random.key(3), (32, 16))
+    qa = quantize_int4(w)
+    assert len(jax.tree.leaves(qa)) == 2
+
+    @jax.jit
+    def matmul(qa, x):
+        return x @ jnp.asarray(qa)
+
+    x = jax.random.normal(jax.random.key(4), (4, 32))
+    got = matmul(qa, x)
+    # against the dequantized reference (quantization error already
+    # covered above); jit path must agree with eager dequant
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(x @ jnp.asarray(qa)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantize_params_bits4_targets_kernels():
+    from tensorflowonspark_tpu.ops import Int4Array, quantize_params
+
+    params = {"a": {"kernel": jnp.ones((8, 4))},
+              "odd": {"kernel": jnp.ones((7, 4))},  # odd K fine: native int4
+              "bias": jnp.ones((4,))}
+    qp = quantize_params(params, bits=4)
+    assert isinstance(qp["a"]["kernel"], Int4Array)
+    assert isinstance(qp["odd"]["kernel"], Int4Array)
+    assert not isinstance(qp["bias"], Int4Array)
+
+
+def test_gpt_decode_with_int4_params():
+    """End-to-end: greedy decode runs on int4-packed weights and emits
+    valid token ids; tree bytes ~half of int8."""
+    import dataclasses
+
+    from tensorflowonspark_tpu.models import GPT, GPTConfig, greedy_generate
+    from tensorflowonspark_tpu.ops import quantize_params, tree_nbytes
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64,
+                    max_position_embeddings=64, dtype=jnp.float32)
+    params = GPT(cfg).init(jax.random.key(0),
+                           jnp.ones((1, 4), jnp.int32))["params"]
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, 128)
+    q8 = quantize_params(params)
+    q4 = quantize_params(params, bits=4)
+    # kernel payloads halve (embeddings/norms stay fp and dominate this
+    # tiny model, so compare the quantized leaves, not the whole tree)
+    from tensorflowonspark_tpu.ops import Int4Array
+    from tensorflowonspark_tpu.ops.quant import Int8Array
+
+    def quantized_bytes(tree, cls):
+        return sum(l.nbytes for l in jax.tree.leaves(
+            tree, is_leaf=lambda x: isinstance(x, cls))
+            if isinstance(l, cls))
+
+    assert quantized_bytes(q4, Int4Array) < \
+        0.6 * quantized_bytes(q8, Int8Array)
+    assert tree_nbytes(q4) < tree_nbytes(q8)
+    out = greedy_generate(cfg, q4, prompt, 8)
+    assert out.shape == (2, 16)
+    assert bool(jnp.all((out >= 0) & (out < 128)))
+
+
+def test_int4_export_serves_without_model_code(tmp_path):
+    """bits=4 trees flow through export_model/ExportedModel like int8."""
+    import flax.linen as nn
+
+    from tensorflowonspark_tpu.checkpoint import ExportedModel, export_model
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(nn.relu(nn.Dense(32)(x)))
+
+    net = Net()
+    x = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+    params = net.init(jax.random.key(0), x)["params"]
+    qparams = quantize_params(params, bits=4)
+    want = net.apply({"params": qparams}, x)
+
+    export_dir = str(tmp_path / "export")
+    export_model(export_dir, lambda p, x: net.apply({"params": p}, x),
+                 qparams, [x])
+    loaded = ExportedModel.load(export_dir)
+    got = next(iter(loaded(x).values()))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    flat = jax.tree.leaves(loaded.params)
+    assert any(getattr(l, "dtype", None) == jnp.int4 for l in flat)

@@ -397,14 +397,16 @@ def stage_decode() -> dict:
                                 base.vocab_size)
     gen = jax.jit(greedy_generate, static_argnums=(0, 3))
 
-    def tps(cfg, params, iters=3):
+    def tps(cfg, params, iters=3, fn=None, ids=None):
         # fetching the generated ids (a few KB) proves the decode loops
         # actually ran on device — see util.host_fetch_drain.
-        out = gen(cfg, params, prompt, NEW)
+        fn = fn or gen
+        ids = prompt if ids is None else ids
+        out = fn(cfg, params, ids, NEW)
         jax.device_get(out)
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = gen(cfg, params, prompt, NEW)
+            out = fn(cfg, params, ids, NEW)
         jax.device_get(out)
         return round(B * NEW / ((time.perf_counter() - t0) / iters), 1)
 
@@ -441,6 +443,30 @@ def stage_decode() -> dict:
         print("sweep decode:", json.dumps(rows[-1]), flush=True)
     except Exception as e:  # noqa: BLE001
         rows.append({"window": 256, "error": repr(e)})
+    # prompt-lookup speculative decoding on a repetitive continuation —
+    # the regime it exists for (greedy-exact either way)
+    try:
+        import functools
+
+        from tensorflowonspark_tpu.models import lookup_generate
+
+        params = GPT(base).init(jax.random.key(0),
+                                jnp.ones((1, 8), jnp.int32))["params"]
+        # period <= T0/2 so the prompt really contains repeated n-grams
+        # (T0=8 in smoke: period 4)
+        period = min(16, max(2, T0 // 2))
+        rep = jnp.tile(jnp.arange(period), (B, T0 // period + 1))[:, :T0]
+        lk = jax.jit(functools.partial(lookup_generate, draft_len=8),
+                     static_argnums=(0, 3))
+        _, st = lookup_generate(base, params, rep, NEW, draft_len=8,
+                                return_stats=True)
+        rows.append({"spec_lookup": True,
+                     "greedy_tps": tps(base, params, ids=rep),
+                     "lookup_tps": tps(base, params, fn=lk, ids=rep),
+                     "forwards": int(st["forwards"]), "tokens": NEW})
+        print("sweep decode:", json.dumps(rows[-1]), flush=True)
+    except Exception as e:  # noqa: BLE001
+        rows.append({"spec_lookup": True, "error": repr(e)})
     out = {"batch": B, "prompt": T0, "new_tokens": NEW,
            "model": "gpt-124M-ish", "device": dev.device_kind, "rows": rows}
     _write("decode_matrix.json", out)

@@ -450,6 +450,118 @@ def greedy_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int):
                      lambda logits, i: jnp.argmax(logits, axis=-1))
 
 
+def lookup_generate(cfg: GPTConfig, params, prompt_ids,
+                    max_new_tokens: int, *, ngram: int = 3,
+                    draft_len: int = 8, return_stats: bool = False):
+    """Prompt-lookup speculative decoding — greedy-exact tokens in fewer
+    sequential forwards.
+
+    Single-chip decode is HBM-bound: every forward reads all the weights
+    to emit ONE token.  Speculation drafts ``draft_len`` candidate tokens
+    for free (the longest recent ``ngram`` context match inside the
+    sequence so far — no draft model), then verifies them in one cached
+    forward over the ``draft_len + 1`` block; the accepted prefix commits
+    several tokens per weight read.  Greedy verification accepts exactly
+    the tokens greedy decode would emit, so the output is **identical to**
+    :func:`greedy_generate` — only the forward count changes (it falls
+    toward ``max_new / (draft_len+1)`` on repetitive continuations —
+    extraction, code, summaries quoting the prompt — and degrades to one
+    token per forward on novel text).
+
+    Mechanics: the verify block is written into the static KV cache at
+    positions ``p..p+draft_len``, then the per-layer cache ``index`` is
+    REWOUND to the committed length; by-position causal masking plus the
+    next block's overlapping write keep rejected tail entries invisible.
+    With batches, the committed length is shared (one cache index), so
+    each step advances by the batch-minimum acceptance.
+
+    Returns ``[B, T0 + max_new_tokens]`` ids (+ a ``{"forwards": n}``
+    dict with ``return_stats=True``; ``forwards`` counts verify steps
+    after the prefill).
+    """
+    B, T0 = prompt_ids.shape
+    if max_new_tokens <= 0:
+        return (prompt_ids, {"forwards": jnp.zeros((), jnp.int32)}) \
+            if return_stats else prompt_ids
+    if ngram < 1 or draft_len < 1:
+        raise ValueError(f"ngram ({ngram}) and draft_len ({draft_len}) "
+                         "must be >= 1")
+    if cfg.rolling_kv_cache:
+        raise ValueError("lookup_generate does not support "
+                         "rolling_kv_cache (the rewind protocol assumes "
+                         "absolute cache slots)")
+    total = T0 + max_new_tokens
+    k = draft_len
+    if total + k > cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt + max_new_tokens + draft_len = {total + k} exceeds "
+            f"max_position_embeddings ({cfg.max_position_embeddings}); "
+            "the verify block needs draft_len slack past the sequence")
+    model = GPT(cfg, decode=True)
+    Lbuf = total + k  # committed tokens + scratch for one verify block
+    g = ngram
+
+    def rewind(cache, p):
+        # BOTH position counters: the per-layer attention write "index"
+        # AND the top-level learned-position counter "pos".  full_like:
+        # under scan_layers the index leaf is stacked [num_layers].
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: jnp.full_like(leaf, p) if any(
+                getattr(kk, "key", None) in ("index", "pos") for kk in path)
+            else leaf, cache)
+
+    def draft(toks, p):
+        """Longest-match prompt lookup: most recent window of the last
+        ``g`` tokens inside ``toks[:, :p+1]``; its continuation is the
+        draft, repeating the final token past the known prefix."""
+        starts = jnp.arange(Lbuf - g)
+        win = toks[:, starts[:, None] + jnp.arange(g)[None, :]]  # [B,S,g]
+        last = jax.lax.dynamic_slice(
+            toks, (0, p + 1 - g), (B, g))                        # [B, g]
+        hit = jnp.all(win == last[:, None, :], axis=-1)
+        # window fully inside committed tokens with its continuation at
+        # <= p — this also excludes the current suffix itself
+        hit &= (starts + g <= p)[None, :]
+        best = jnp.argmax(hit * (starts + 1)[None, :], axis=-1)  # [B]
+        has = jnp.any(hit, axis=-1)
+        src = best[:, None] + g + jnp.arange(k)[None, :]         # [B, k]
+        src = jnp.where(has[:, None], jnp.minimum(src, p), p)
+        return jnp.take_along_axis(toks, src, axis=1)            # [B, k]
+
+    def cond(carry):
+        _, p, _, _, _ = carry
+        return p < total
+
+    def body(carry):
+        toks, p, pending, cache, n_fwd = carry
+        toks = jax.lax.dynamic_update_slice(toks, pending[:, None], (0, p))
+        drafts = draft(toks, p)
+        x = jnp.concatenate([
+            jax.lax.dynamic_slice(toks, (0, p), (B, 1)), drafts], axis=1)
+        logits, vars_ = model.apply({"params": params, "cache": cache},
+                                    x, mutable=["cache"])
+        preds = jnp.argmax(logits, axis=-1)                      # [B, k+1]
+        agree = jnp.cumprod(
+            (preds[:, :-1] == drafts).astype(jnp.int32), axis=1)
+        a = jnp.min(jnp.sum(agree, axis=1))  # batch-min acceptance
+        toks = jax.lax.dynamic_update_slice(toks, drafts, (0, p + 1))
+        pending = preds[:, a]
+        p = p + 1 + a
+        return toks, p, pending, rewind(vars_["cache"], p), n_fwd + 1
+
+    cache = init_cache(cfg, params, B)
+    logits, vars_ = model.apply({"params": params, "cache": cache},
+                                prompt_ids, mutable=["cache"])
+    toks = jnp.zeros((B, Lbuf), prompt_ids.dtype)
+    toks = jax.lax.dynamic_update_slice(toks, prompt_ids, (0, 0))
+    carry = (toks, jnp.asarray(T0, jnp.int32),
+             jnp.argmax(logits[:, -1], axis=-1).astype(prompt_ids.dtype),
+             vars_["cache"], jnp.zeros((), jnp.int32))
+    toks, p, _, _, n_fwd = jax.lax.while_loop(cond, body, carry)
+    out = toks[:, :total]
+    return (out, {"forwards": n_fwd}) if return_stats else out
+
+
 def _select_beam(scores, lengths, length_penalty: float):
     """argmax over beams of ``score / generated_len**length_penalty`` —
     modern HF's ``BeamHypotheses`` normalization (transformers >= 4.38

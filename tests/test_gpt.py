@@ -660,3 +660,96 @@ class TestTopP:
         with pytest.raises(ValueError, match="top_p"):
             sample_generate(CFG, _params(), jnp.ones((1, 2), jnp.int32), 2,
                             jax.random.key(0), top_p=0.0)
+
+
+class TestLookupGenerate:
+    """Prompt-lookup speculative decoding: greedy-exact, fewer forwards."""
+
+    def _mk(self, **kw):
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            _cfg(), max_position_embeddings=128, **kw)
+        params = GPT(cfg).init(jax.random.key(0),
+                               jnp.ones((1, 4), jnp.int32))["params"]
+        return cfg, params
+
+    @pytest.mark.parametrize("pos_encoding", ["learned", "rope"])
+    @pytest.mark.parametrize("batch", [1, 3])
+    def test_matches_greedy_exactly(self, pos_encoding, batch):
+        from tensorflowonspark_tpu.models import lookup_generate
+
+        cfg, params = self._mk(
+            pos_encoding=pos_encoding,
+            norm="rmsnorm" if pos_encoding == "rope" else "layernorm")
+        prompt = jax.random.randint(jax.random.key(7), (batch, 10), 0,
+                                    cfg.vocab_size)
+        want = greedy_generate(cfg, params, prompt, 24)
+        got = lookup_generate(cfg, params, prompt, 24)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_matches_greedy_with_scan_layers(self):
+        """Stacked [num_layers] cache index leaves must rewind too."""
+        from tensorflowonspark_tpu.models import lookup_generate
+
+        cfg, params = self._mk(scan_layers=True)
+        prompt = jax.random.randint(jax.random.key(13), (2, 10), 0,
+                                    cfg.vocab_size)
+        want = greedy_generate(cfg, params, prompt, 20)
+        got = lookup_generate(cfg, params, prompt, 20)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_fewer_forwards_on_repetitive_text(self):
+        from tensorflowonspark_tpu.models import lookup_generate
+
+        cfg, params = self._mk()
+        rep = jnp.tile(jnp.arange(6), 5)[None, :]
+        want = greedy_generate(cfg, params, rep, 30)
+        got, stats = lookup_generate(cfg, params, rep, 30,
+                                     return_stats=True)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # the point of speculation: well under one forward per token
+        assert int(stats["forwards"]) <= 15
+
+    def test_composes_with_gqa_and_int8(self):
+        from tensorflowonspark_tpu.models import lookup_generate
+        from tensorflowonspark_tpu.ops import quantize_params
+
+        cfg, params = self._mk(num_kv_heads=2)
+        qp = quantize_params(params)
+        prompt = jax.random.randint(jax.random.key(11), (2, 8), 0,
+                                    cfg.vocab_size)
+        want = greedy_generate(cfg, qp, prompt, 16)
+        got = lookup_generate(cfg, qp, prompt, 16)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_jits_as_one_program(self):
+        import functools
+
+        from tensorflowonspark_tpu.models import lookup_generate
+
+        cfg, params = self._mk()
+        gen = jax.jit(functools.partial(lookup_generate, ngram=2,
+                                        draft_len=4),
+                      static_argnums=(0, 3))
+        prompt = jax.random.randint(jax.random.key(2), (1, 10), 0,
+                                    cfg.vocab_size)
+        want = greedy_generate(cfg, params, prompt, 12)
+        np.testing.assert_array_equal(
+            np.asarray(gen(cfg, params, prompt, 12)), np.asarray(want))
+
+    def test_guards(self):
+        import dataclasses
+
+        from tensorflowonspark_tpu.models import lookup_generate
+
+        cfg, params = self._mk()
+        prompt = jnp.ones((1, 8), jnp.int32)
+        with pytest.raises(ValueError, match="draft_len"):
+            lookup_generate(cfg, params, prompt, 8, draft_len=0)
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            lookup_generate(cfg, params, prompt, 124)
+        rcfg = dataclasses.replace(cfg, sliding_window=16,
+                                   rolling_kv_cache=True)
+        with pytest.raises(ValueError, match="rolling_kv_cache"):
+            lookup_generate(rcfg, params, prompt, 8)

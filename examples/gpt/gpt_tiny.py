@@ -94,6 +94,18 @@ def main(args):
         expect = [(7 + i) % args.vocab for i in range(8)]
         acc = np.mean([a == b for a, b in zip(seq, expect)])
         print(f"gpt_tiny: continuation accuracy {acc:.2f}", flush=True)
+
+        # prompt-lookup speculative decoding: identical tokens, fewer
+        # forwards (the count-up data is maximally repetitive)
+        from tensorflowonspark_tpu.models import lookup_generate
+
+        longp = (np.arange(8)[None, :] + 3).astype(np.int32) % args.vocab
+        want = greedy_generate(cfg, est.params, jnp.asarray(longp), 6)
+        got, stats = lookup_generate(cfg, est.params, jnp.asarray(longp), 6,
+                                     return_stats=True)
+        assert bool(jnp.all(got == want)), "speculative != greedy"
+        print(f"gpt_tiny: speculative decode matched greedy in "
+              f"{int(stats['forwards'])} forwards for 6 tokens", flush=True)
     print("gpt_tiny: done", flush=True)
 
 

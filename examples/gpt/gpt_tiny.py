@@ -99,13 +99,18 @@ def main(args):
         # forwards (the count-up data is maximally repetitive)
         from tensorflowonspark_tpu.models import lookup_generate
 
-        longp = (np.arange(8)[None, :] + 3).astype(np.int32) % args.vocab
-        want = greedy_generate(cfg, est.params, jnp.asarray(longp), 6)
-        got, stats = lookup_generate(cfg, est.params, jnp.asarray(longp), 6,
-                                     return_stats=True)
+        # sized from seq_len so small --seq_len runs fit the position
+        # table: prompt + new + draft_len <= 2*seq_len
+        t0 = max(4, args.seq_len // 2)
+        new, dl = max(2, args.seq_len // 4), max(2, args.seq_len // 2 - 2)
+        longp = (np.arange(t0)[None, :] + 3).astype(np.int32) % args.vocab
+        want = greedy_generate(cfg, est.params, jnp.asarray(longp), new)
+        got, stats = lookup_generate(cfg, est.params, jnp.asarray(longp),
+                                     new, draft_len=dl, return_stats=True)
         assert bool(jnp.all(got == want)), "speculative != greedy"
         print(f"gpt_tiny: speculative decode matched greedy in "
-              f"{int(stats['forwards'])} forwards for 6 tokens", flush=True)
+              f"{int(stats['forwards'])} forwards for {new} tokens",
+              flush=True)
     print("gpt_tiny: done", flush=True)
 
 

@@ -410,6 +410,19 @@ def init_cache(cfg: GPTConfig, params, batch: int):
     return jax.tree.map(jnp.zeros_like, vars_["cache"])
 
 
+def rewind_cache(cache, position):
+    """Set every cache position counter to ``position``: the per-layer
+    attention write ``index`` AND the top-level learned-position counter
+    ``pos`` (stacked ``[num_layers]`` leaves under ``scan_layers`` are
+    filled).  K/V payloads are untouched — callers rely on by-position
+    causal masking plus their next block write to retire entries past the
+    rewound position (see :func:`lookup_generate`)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: jnp.full_like(leaf, position) if any(
+            getattr(k, "key", None) in ("index", "pos") for k in path)
+        else leaf, cache)
+
+
 def _generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
               next_token_fn):
     """Shared decode loop: prefill once, then ``lax.scan`` single-token
@@ -501,15 +514,6 @@ def lookup_generate(cfg: GPTConfig, params, prompt_ids,
     Lbuf = total + k  # committed tokens + scratch for one verify block
     g = ngram
 
-    def rewind(cache, p):
-        # BOTH position counters: the per-layer attention write "index"
-        # AND the top-level learned-position counter "pos".  full_like:
-        # under scan_layers the index leaf is stacked [num_layers].
-        return jax.tree_util.tree_map_with_path(
-            lambda path, leaf: jnp.full_like(leaf, p) if any(
-                getattr(kk, "key", None) in ("index", "pos") for kk in path)
-            else leaf, cache)
-
     def draft(toks, p):
         """Longest-match prompt lookup: most recent window of the last
         ``g`` tokens inside ``toks[:, :p+1]``; its continuation is the
@@ -536,8 +540,7 @@ def lookup_generate(cfg: GPTConfig, params, prompt_ids,
         toks, p, pending, cache, n_fwd = carry
         toks = jax.lax.dynamic_update_slice(toks, pending[:, None], (0, p))
         drafts = draft(toks, p)
-        x = jnp.concatenate([
-            jax.lax.dynamic_slice(toks, (0, p), (B, 1)), drafts], axis=1)
+        x = jnp.concatenate([pending[:, None], drafts], axis=1)
         logits, vars_ = model.apply({"params": params, "cache": cache},
                                     x, mutable=["cache"])
         preds = jnp.argmax(logits, axis=-1)                      # [B, k+1]
@@ -545,9 +548,9 @@ def lookup_generate(cfg: GPTConfig, params, prompt_ids,
             (preds[:, :-1] == drafts).astype(jnp.int32), axis=1)
         a = jnp.min(jnp.sum(agree, axis=1))  # batch-min acceptance
         toks = jax.lax.dynamic_update_slice(toks, drafts, (0, p + 1))
-        pending = preds[:, a]
+        pending = preds[:, a].astype(toks.dtype)
         p = p + 1 + a
-        return toks, p, pending, rewind(vars_["cache"], p), n_fwd + 1
+        return toks, p, pending, rewind_cache(vars_["cache"], p), n_fwd + 1
 
     cache = init_cache(cfg, params, B)
     logits, vars_ = model.apply({"params": params, "cache": cache},

@@ -477,3 +477,14 @@ def fn_distributed_pipeline_train(args, ctx):
     path = os.path.join(ctx.working_dir, f"pipe.{ctx.executor_id}")
     with open(path, "w") as f:
         f.write(":".join(f"{v:.8f}" for v in losses))
+
+
+def fn_write_cache_env(args, ctx):
+    """Record the worker-side compile-cache env contract (node.run must
+    export the JAX cache vars before the user fn, honoring the TFOS_*
+    knobs)."""
+    path = os.path.join(ctx.working_dir, f"cacheenv.{ctx.executor_id}")
+    with open(path, "w") as f:
+        f.write(os.environ.get("JAX_COMPILATION_CACHE_DIR", "MISSING") + ":"
+                + os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                                 "MISSING"))

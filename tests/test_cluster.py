@@ -194,3 +194,20 @@ def test_run_with_recovery_gives_up_after_max_restarts(tmp_path):
             funcs.fn_crash, {}, num_workers=1, max_restarts=1,
             working_dir=str(tmp_path), worker_env={"JAX_PLATFORMS": "cpu"},
             reservation_timeout=60, shutdown_timeout=60)
+
+
+def test_worker_compile_cache_env_contract(tmp_path, monkeypatch):
+    """node.run exports the persistent-compile-cache env (honoring the
+    TFOS_COMPILATION_CACHE / TFOS_CACHE_MIN_COMPILE_SECS knobs) before
+    the user's map_fun — the relaunch-reuses-compiles contract."""
+    # a pre-set JAX_* env would win (by design); test from a clean slate
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    monkeypatch.delenv("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                       raising=False)
+    cluster = _run(funcs.fn_write_cache_env, 2, tmp_path,
+                   worker_env={"TFOS_COMPILATION_CACHE": "/tmp/tfos_ct_cache",
+                               "TFOS_CACHE_MIN_COMPILE_SECS": "0.7"})
+    cluster.shutdown(timeout=60)
+    for i in range(2):
+        with open(os.path.join(str(tmp_path), f"cacheenv.{i}")) as f:
+            assert f.read() == "/tmp/tfos_ct_cache:0.7"

@@ -100,17 +100,25 @@ def main(args):
         from tensorflowonspark_tpu.models import lookup_generate
 
         # sized from seq_len so small --seq_len runs fit the position
-        # table: prompt + new + draft_len <= 2*seq_len
+        # table: prompt + new + draft_len <= 2*seq_len (= the config's
+        # max_position_embeddings); skip the demo when it can't fit
         t0 = max(4, args.seq_len // 2)
-        new, dl = max(2, args.seq_len // 4), max(2, args.seq_len // 2 - 2)
-        longp = (np.arange(t0)[None, :] + 3).astype(np.int32) % args.vocab
-        want = greedy_generate(cfg, est.params, jnp.asarray(longp), new)
-        got, stats = lookup_generate(cfg, est.params, jnp.asarray(longp),
-                                     new, draft_len=dl, return_stats=True)
-        assert bool(jnp.all(got == want)), "speculative != greedy"
-        print(f"gpt_tiny: speculative decode matched greedy in "
-              f"{int(stats['forwards'])} forwards for {new} tokens",
-              flush=True)
+        new = max(2, args.seq_len // 4)
+        dl = 2 * args.seq_len - t0 - new
+        if dl < 1:
+            print("gpt_tiny: seq_len too small for the speculative-decode "
+                  "demo; skipping", flush=True)
+        else:
+            dl = min(dl, max(2, args.seq_len // 2 - 2))
+            longp = (np.arange(t0)[None, :] + 3).astype(np.int32) % args.vocab
+            want = greedy_generate(cfg, est.params, jnp.asarray(longp), new)
+            got, stats = lookup_generate(cfg, est.params, jnp.asarray(longp),
+                                         new, draft_len=dl,
+                                         return_stats=True)
+            assert bool(jnp.all(got == want)), "speculative != greedy"
+            print(f"gpt_tiny: speculative decode matched greedy in "
+                  f"{int(stats['forwards'])} forwards for {new} tokens",
+                  flush=True)
     print("gpt_tiny: done", flush=True)
 
 

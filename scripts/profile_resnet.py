@@ -130,12 +130,21 @@ def report(tab: dict, top: int = 25) -> dict:
          and (isinstance(r[i_self], (int, float)) or
               str(r[i_self]).replace(".", "", 1).isdigit())),
         key=lambda r: -float(r[i_self]))[:top]
+    def pct_of(r):
+        # the '%' column can be absent, short, or NULL in gviz rows; the
+        # computed fraction is always available as the fallback
+        if i_frac is not None and len(r) > i_frac:
+            try:
+                return float(r[i_frac])
+            except (TypeError, ValueError):
+                pass
+        return round(100 * float(r[i_self]) / total, 2)
+
     out = {
         "category_pct": {k: round(100 * v / total, 1) for k, v in cats},
         "top_ops": [{"category": r[i_cat], "op": str(r[i_name])[:120],
                      "self_us": float(r[i_self]),
-                     "pct": (float(r[i_frac]) if i_frac is not None else
-                             round(100 * float(r[i_self]) / total, 2))}
+                     "pct": pct_of(r)}
                     for r in top_rows],
     }
     print("== category self-time % ==")

@@ -437,6 +437,12 @@ def extract_collectives(hlo: str, axis_sizes: dict,
             if not group:
                 raise ValueError(
                     f"unparseable replica_groups in collective: {line!r}")
+            if op == "reduce-scatter":
+                # the HLO result type is the SCATTERED 1/k shard; the ring
+                # formula bytes*(k-1)/k prices the full pre-scatter input
+                # (all-gather needs no correction — its result IS the full
+                # gathered shape)
+                bytes_ *= len(group)
             coords = np.array(np.unravel_index(np.array(group), sizes)).T
             axes = [names[i] for i in range(len(names))
                     if len(set(coords[:, i])) > 1]

@@ -139,9 +139,12 @@ def stage_resnet(batch: int, remat: bool = False,
         upd, o = tx.update(grads, o, p)
         return optax.apply_updates(p, upd), bs, o, loss
 
-    step = jax.jit(step_fn, donate_argnums=(0, 1, 2))
-    compiled = step.lower(params, batch_stats, opt_state, x, y).compile()
-    cost = compiled.cost_analysis()
+    step_jit = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+    # AOT-compile once and EXECUTE the same executable: calling the jit
+    # wrapper after lower().compile() would trace+compile the identical
+    # program a second time (these subprocesses run cold over the tunnel)
+    step = step_jit.lower(params, batch_stats, opt_state, x, y).compile()
+    cost = step.cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0]
     flops = float(cost.get("flops", 0.0))
@@ -330,9 +333,10 @@ def stage_gpt_train(batch: int, remat: bool = False,
         upd, o = tx.update(grads, o, p)
         return optax.apply_updates(p, upd), o, loss
 
-    step = jax.jit(step_fn, donate_argnums=(0, 1))
-    compiled = step.lower(params, opt_state, x, y).compile()
-    cost = compiled.cost_analysis()
+    # AOT-compile once and execute that executable (see stage_resnet)
+    step = jax.jit(step_fn, donate_argnums=(0, 1)).lower(
+        params, opt_state, x, y).compile()
+    cost = step.cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0]
     xla_flops = float(cost.get("flops", 0.0))

@@ -176,7 +176,11 @@ class Dataset:
         ``IterDataset``.  Inside ``map_fun``, pass
         ``ctx.num_workers``/``ctx.task_index``.
         """
-        assert 0 <= index < num_shards, f"bad shard ({num_shards}, {index})"
+        if not 0 <= index < num_shards:
+            # fail at wiring time even under python -O: a silent empty or
+            # duplicated shard trains one host on the wrong data
+            raise ValueError(f"shard index {index} out of range for "
+                             f"num_shards={num_shards}")
         ds = map_dataset
         if shuffle:
             ds = ds.shuffle(seed=0 if seed is None else seed)
@@ -187,7 +191,11 @@ class Dataset:
         """Element-stride partition ``index`` of ``num_shards`` (exact and
         order-stable; reference: ``tf.data.Dataset.shard(num, worker_num)``
         in the TENSORFLOW-mode examples)."""
-        assert 0 <= index < num_shards, f"bad shard ({num_shards}, {index})"
+        if not 0 <= index < num_shards:
+            # fail at wiring time even under python -O: a silent empty or
+            # duplicated shard trains one host on the wrong data
+            raise ValueError(f"shard index {index} out of range for "
+                             f"num_shards={num_shards}")
         src = self._make
         return Dataset(lambda: (x for j, x in enumerate(src())
                                 if j % num_shards == index))

@@ -84,3 +84,18 @@ ENTRY %main (p0: (s32[], bf16[128])) -> (s32[], bf16[128]) {
     [rec] = extract_collectives(hlo, {"dp": 2}, loop_trip=None)
     assert rec["loop_multiplier"] == 7
     assert rec["bytes"] == 7 * 128 * 2  # bf16
+
+
+def test_reduce_scatter_priced_at_full_input_bytes():
+    """The HLO result of reduce-scatter is the 1/k shard; the ring price
+    bytes*(k-1)/k expects the full pre-scatter input — the extractor must
+    scale the payload back up by k (all-gather needs no correction)."""
+    hlo = _hlo("  ROOT %rs = bf16[256]{0} reduce-scatter(%x), "
+               "replica_groups={{0,1,2,3}}, dimensions={0}, to_apply=%add")
+    [rec] = extract_collectives(hlo, {"dp": 4}, loop_trip=1)
+    assert rec["bytes"] == 256 * 2 * 4  # shard elems * bf16 * group size
+
+    ag = _hlo("  ROOT %ag = bf16[1024]{0} all-gather(%x), "
+              "replica_groups={{0,1,2,3}}, dimensions={0}")
+    [rec] = extract_collectives(ag, {"dp": 4}, loop_trip=1)
+    assert rec["bytes"] == 1024 * 2  # already the full gathered size

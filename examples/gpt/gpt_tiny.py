@@ -87,11 +87,14 @@ def main(args):
               f"at step {final['global_step']}", flush=True)
 
         # generate: prompt [7, 8, 9] should continue 10, 11, ...
+        # (new-token count clamped so tiny --seq_len runs fit the
+        # 2*seq_len position table)
+        n_gen = min(5, 2 * args.seq_len - 3)
         prompt = (np.arange(3)[None, :] + 7).astype(np.int32) % args.vocab
-        out = greedy_generate(cfg, est.params, jnp.asarray(prompt), 5)
+        out = greedy_generate(cfg, est.params, jnp.asarray(prompt), n_gen)
         seq = np.asarray(out)[0].tolist()
         print(f"gpt_tiny: generated {seq}", flush=True)
-        expect = [(7 + i) % args.vocab for i in range(8)]
+        expect = [(7 + i) % args.vocab for i in range(3 + n_gen)]
         acc = np.mean([a == b for a, b in zip(seq, expect)])
         print(f"gpt_tiny: continuation accuracy {acc:.2f}", flush=True)
 

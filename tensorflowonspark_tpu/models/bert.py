@@ -22,9 +22,31 @@ import logging
 from typing import Callable
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 logger = logging.getLogger(__name__)
+
+
+def _context_mesh():
+    """The mesh from the enclosing ``jax.set_mesh`` / ``with mesh:`` scope,
+    or None when tracing outside any mesh context (single-device use,
+    ``eval_shape``) — where a bare-PartitionSpec sharding constraint would
+    raise."""
+    m = jax.sharding.get_abstract_mesh()
+    if not m.empty:
+        return m
+    try:
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            from jax.interpreters import pxla
+
+            m = pxla.thread_resources.env.physical_mesh
+    except Exception:
+        return None
+    return None if m.empty else m
 
 
 @functools.cache
@@ -60,6 +82,15 @@ class BertConfig:
     # shards vocab rows over tp; pass (("ep", "tp"), None) to also spread
     # tables over the embedding-shard axis (the num_ps analogue).
     emb_spec: tuple = ("tp", None)
+    # PartitionSpec entries for activations (batch, seq, feature).  When
+    # set, the embedding-lookup outputs are pinned with
+    # ``with_sharding_constraint`` so GSPMD partitions the gather
+    # index-parallel (each device looks up its own batch rows) instead of
+    # inheriting the table's sharding and paying an "involuntary full
+    # rematerialization" reshard when a table dim is weight-sharded (e.g.
+    # ZeRO-3/fsdp on the feature dim).  Requires tracing under a mesh
+    # context (``with mesh:``); leave None for single-device use.
+    act_spec: tuple | None = None
     # Stack encoder layers with nn.scan (+ nn.remat): one traced block,
     # O(1)-in-depth compile time, per-layer rematerialisation — the same
     # knobs as GPTConfig (params gain a leading ``layers`` axis).
@@ -151,16 +182,27 @@ class Bert(nn.Module):
         cfg = self.cfg
         T = input_ids.shape[1]
         emb_init = nn.with_partitioning(nn.initializers.normal(0.02), cfg.emb_spec)
-        tok = nn.Embed(cfg.vocab_size, cfg.hidden_size,
-                       embedding_init=emb_init, dtype=cfg.dtype, name="tok_emb")(input_ids)
-        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
-                       embedding_init=emb_init, dtype=cfg.dtype,
-                       name="pos_emb")(jnp.arange(T)[None, :])
+        if cfg.act_spec is not None and _context_mesh() is not None:
+            P = jax.sharding.PartitionSpec
+            anchor = lambda v: jax.lax.with_sharding_constraint(
+                v, P(*cfg.act_spec))
+            # pos lookup has batch dim 1 — only its seq/feature dims can
+            # carry the activation sharding
+            anchor_pos = lambda v: jax.lax.with_sharding_constraint(
+                v, P(None, *cfg.act_spec[1:]))
+        else:
+            anchor = anchor_pos = lambda v: v
+        tok = anchor(nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                              embedding_init=emb_init, dtype=cfg.dtype,
+                              name="tok_emb")(input_ids))
+        pos = anchor_pos(nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                                  embedding_init=emb_init, dtype=cfg.dtype,
+                                  name="pos_emb")(jnp.arange(T)[None, :]))
         x = tok + pos
         if token_type_ids is not None:
-            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
-                             embedding_init=emb_init, dtype=cfg.dtype,
-                             name="type_emb")(token_type_ids)
+            x = x + anchor(nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                                    embedding_init=emb_init, dtype=cfg.dtype,
+                                    name="type_emb")(token_type_ids))
         x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.norm_eps,
                          name="ln_emb")(x).astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout_rate, deterministic=not train)(x)

@@ -460,3 +460,36 @@ def test_gradient_accumulation_matches_big_batch():
     step3 = s.build_train_step(loss_rng, accum_steps=3)
     with pytest.raises(ValueError, match="not divisible"):
         step3(s.init_state(init, tx), s.shard_batch({"x": x, "y": y}))
+
+
+def test_auto_fsdp_overlay_prefers_dim0_extension():
+    """The ZeRO-3 overlay (``__graft_entry__.auto_fsdp_overlay``) must put
+    fsdp on the FIRST divisible dim, extending an already-sharded dim 0
+    (embedding vocab rows ``("tp",) -> ("tp", "fsdp")``) rather than
+    falling through to a later dim: fsdp on a gather operand's feature
+    dim makes GSPMD pay an involuntary-full-rematerialization reshard
+    (round-3 verdict item 4)."""
+    import __graft_entry__ as ge
+
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    overlay = ge.auto_fsdp_overlay(mesh)
+
+    def apply(shape, spec):
+        from jax.sharding import NamedSharding
+        leaf = jax.ShapeDtypeStruct(shape, jnp.float32)
+        return overlay(NamedSharding(mesh, P(*spec)), leaf).spec
+
+    # embedding-table pattern: vocab already tp-sharded -> extend dim 0
+    assert apply((128, 64), ("tp", None)) == P(("tp", "fsdp"), None)
+    # unsharded dim 0 takes fsdp alone
+    assert apply((64, 128), (None, "tp")) == P("fsdp", "tp")
+    # dim 0 not divisible by tp*fsdp -> falls through to dim 1
+    assert apply((126, 64), ("tp", None)) == P("tp", "fsdp")
+    # small leaves and already-fsdp leaves stay untouched
+    from jax.sharding import NamedSharding
+    small = jax.ShapeDtypeStruct((8,), jnp.float32)
+    sh = NamedSharding(mesh, P(None))
+    assert overlay(sh, small) is sh
+    done = NamedSharding(mesh, P("fsdp", None))
+    big = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    assert overlay(done, big) is done

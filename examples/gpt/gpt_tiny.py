@@ -88,15 +88,20 @@ def main(args):
 
         # generate: prompt [7, 8, 9] should continue 10, 11, ...
         # (new-token count clamped so tiny --seq_len runs fit the
-        # 2*seq_len position table)
+        # 2*seq_len position table; skipped outright when the 3-token
+        # prompt leaves no room — n_gen would go <= 0 and crash)
         n_gen = min(5, 2 * args.seq_len - 3)
-        prompt = (np.arange(3)[None, :] + 7).astype(np.int32) % args.vocab
-        out = greedy_generate(cfg, est.params, jnp.asarray(prompt), n_gen)
-        seq = np.asarray(out)[0].tolist()
-        print(f"gpt_tiny: generated {seq}", flush=True)
-        expect = [(7 + i) % args.vocab for i in range(3 + n_gen)]
-        acc = np.mean([a == b for a, b in zip(seq, expect)])
-        print(f"gpt_tiny: continuation accuracy {acc:.2f}", flush=True)
+        if n_gen < 1:
+            print("gpt_tiny: seq_len too small for the generation demo; "
+                  "skipping", flush=True)
+        else:
+            prompt = (np.arange(3)[None, :] + 7).astype(np.int32) % args.vocab
+            out = greedy_generate(cfg, est.params, jnp.asarray(prompt), n_gen)
+            seq = np.asarray(out)[0].tolist()
+            print(f"gpt_tiny: generated {seq}", flush=True)
+            expect = [(7 + i) % args.vocab for i in range(3 + n_gen)]
+            acc = np.mean([a == b for a, b in zip(seq, expect)])
+            print(f"gpt_tiny: continuation accuracy {acc:.2f}", flush=True)
 
         # prompt-lookup speculative decoding: identical tokens, fewer
         # forwards (the count-up data is maximally repetitive)

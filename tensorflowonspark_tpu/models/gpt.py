@@ -488,6 +488,10 @@ def lookup_generate(cfg: GPTConfig, params, prompt_ids,
     With batches, the committed length is shared (one cache index), so
     each step advances by the batch-minimum acceptance.
 
+    Prompts shorter than ``ngram`` work (output is still greedy-exact) but
+    draft quality is degraded for the first blocks: until ``ngram`` tokens
+    are committed the match window is clamped to start at position 0.
+
     Returns ``[B, T0 + max_new_tokens]`` ids (+ a ``{"forwards": n}``
     dict with ``return_stats=True``; ``forwards`` counts verify steps
     after the prefill).
@@ -520,8 +524,13 @@ def lookup_generate(cfg: GPTConfig, params, prompt_ids,
         draft, repeating the final token past the known prefix."""
         starts = jnp.arange(Lbuf - g)
         win = toks[:, starts[:, None] + jnp.arange(g)[None, :]]  # [B,S,g]
+        # short prompts: p+1-g goes negative until g tokens are committed;
+        # clamp explicitly (dynamic_slice would clamp silently) — the
+        # suffix window then starts at 0 and can include not-yet-committed
+        # buffer positions, degrading draft quality for those first blocks
+        # while the output stays greedy-exact (every draft is verified)
         last = jax.lax.dynamic_slice(
-            toks, (0, p + 1 - g), (B, g))                        # [B, g]
+            toks, (0, jnp.maximum(p + 1 - g, 0)), (B, g))        # [B, g]
         hit = jnp.all(win == last[:, None, :], axis=-1)
         # window fully inside committed tokens with its continuation at
         # <= p — this also excludes the current suffix itself

@@ -29,6 +29,7 @@ import logging
 import os
 import socket
 import sys
+import tempfile
 import traceback
 
 from tensorflowonspark_tpu import util
@@ -259,10 +260,16 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
             # so no jax import happens before the user's map_fun — fn may
             # set JAX_* env vars itself, and non-JAX workers shouldn't pay
             # the import.  setdefault: explicit user env always wins.
+            # default cache dir is per-user: a world-shared /tmp path
+            # breaks when another user owns it, and loading serialized
+            # executables from a dir any local user can pre-create is a
+            # trust surface (ADVICE r3)
             os.environ.setdefault(
                 "JAX_COMPILATION_CACHE_DIR",
-                os.environ.get("TFOS_COMPILATION_CACHE",
-                               "/tmp/tfos_jax_cache"))
+                os.environ.get(
+                    "TFOS_COMPILATION_CACHE",
+                    os.path.join(tempfile.gettempdir(),
+                                 f"tfos_jax_cache_{os.getuid()}")))
             os.environ.setdefault(
                 "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
                 os.environ.get("TFOS_CACHE_MIN_COMPILE_SECS", "1.0"))

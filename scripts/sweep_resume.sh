@@ -15,9 +15,9 @@ for ((i = 1; i <= MAX_PROBES; i++)); do
       >/dev/null 2>&1; then
     echo "resume: tunnel up (probe $i), launching sweep"
     if [ -n "$ONLY" ]; then
-      exec python scripts/tpu_sweep.py --only "$ONLY"
+      exec python scripts/tpu_sweep.py --git-commit --only "$ONLY"
     else
-      exec python scripts/tpu_sweep.py
+      exec python scripts/tpu_sweep.py --git-commit
     fi
   fi
   echo "resume: probe $i/$MAX_PROBES failed; sleeping 120s"

@@ -495,6 +495,45 @@ def probe(timeout_s: int = 120) -> bool:
         return False
 
 
+def _select_stages(stages: list, only: str) -> list:
+    """Filter + reorder stages to the ``--only`` list, IN ITS ORDER — a
+    resume can put diagnosis stages (profile, loop-dispatch) first so a
+    short tunnel window captures the highest-value artifacts first."""
+    wanted = {s.strip() for s in only.split(",") if s.strip()}
+    unknown = wanted - {name for name, _, _ in stages}
+    if unknown:
+        raise SystemExit(f"--only names not in the stage list: "
+                         f"{sorted(unknown)}")
+    by_name = {s[0]: s for s in stages}
+    order = [s.strip() for s in only.split(",") if s.strip()]
+    return [by_name[n] for n in dict.fromkeys(order)]
+
+
+def _commit_artifacts(stage_name: str) -> None:
+    """Commit bench_artifacts/ after a successful stage so a tunnel death
+    (or the round ending) mid-sweep can never lose captured on-chip data."""
+    try:
+        # pathspec-scope BOTH the check and the commit so anything the
+        # operator had staged for unrelated work can never be swept into
+        # an auto-generated artifact commit
+        subprocess.run(["git", "add", "bench_artifacts"], cwd=REPO,
+                       check=True, capture_output=True, timeout=60)
+        probe_r = subprocess.run(
+            ["git", "diff", "--cached", "--quiet", "--", "bench_artifacts"],
+            cwd=REPO, timeout=60)
+        if probe_r.returncode == 0:
+            return  # stage wrote nothing new
+        subprocess.run(
+            ["git", "commit", "-m",
+             f"sweep artifacts: on-chip capture of stage {stage_name}\n\n"
+             "No-Verification-Needed: benchmark artifact data only",
+             "--", "bench_artifacts"],
+            cwd=REPO, check=True, capture_output=True, timeout=60)
+        print(f"sweep: committed artifacts for {stage_name}", flush=True)
+    except Exception as e:  # noqa: BLE001 — capture must outlive git hiccups
+        print(f"sweep: artifact commit failed ({e!r})", flush=True)
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--stage", default=None,
@@ -511,6 +550,10 @@ def main() -> None:
                    help="comma-separated stage-name filter for resuming an "
                         "interrupted sweep (names as printed, e.g. "
                         "'resnet_b256_bnbf16,flash_sweep')")
+    p.add_argument("--git-commit", action="store_true",
+                   help="git-commit bench_artifacts/ after every "
+                        "successful stage, so a tunnel death (or round "
+                        "end) mid-sweep can never lose captured data")
     args = p.parse_args()
 
     if args.stage == "resnet":
@@ -594,12 +637,7 @@ def main() -> None:
                                 "--batch", "256"], 1200)]),
     ]
     if args.only:
-        wanted = {s.strip() for s in args.only.split(",") if s.strip()}
-        unknown = wanted - {name for name, _, _ in stages}
-        if unknown:
-            raise SystemExit(f"--only names not in the stage list: "
-                             f"{sorted(unknown)}")
-        stages = [s for s in stages if s[0] in wanted]
+        stages = _select_stages(stages, args.only)
 
     if not probe():
         print("sweep: TPU probe failed — tunnel down, aborting", flush=True)
@@ -636,6 +674,8 @@ def main() -> None:
                 break
         else:
             consecutive_failures = 0
+            if args.git_commit:
+                _commit_artifacts(name)
     summary["total_seconds"] = round(time.monotonic() - t_start, 1)
     # a resumed sweep (--only) extends the prior run's stage record; a full
     # sweep starts a fresh summary

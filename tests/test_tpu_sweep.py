@@ -76,6 +76,21 @@ def test_gpt_train_stage():
     assert rows[0]["flops_analytic"] > 0
 
 
+def test_only_filter_respects_given_order():
+    """--only runs stages in the order GIVEN, not list-definition order —
+    so a resume can put diagnosis stages first in a short tunnel window."""
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    try:
+        from tpu_sweep import _select_stages
+    finally:
+        sys.path.pop(0)
+    stages = [("a", ["x"], 1), ("b", ["y"], 2), ("c", ["z"], 3)]
+    assert [s[0] for s in _select_stages(stages, "c,a")] == ["c", "a"]
+    assert [s[0] for s in _select_stages(stages, "b, c ,b")] == ["b", "c"]
+    with pytest.raises(SystemExit):
+        _select_stages(stages, "c,nope")
+
+
 def test_only_filter_validates_before_probe():
     """A typo'd stage name fails fast — before the (slow) TPU probe."""
     proc = subprocess.run(

@@ -52,6 +52,69 @@ MESH_SIZES = [8, 16, 32, 64, 128, 256]
 # ---------------------------------------------------------------------------
 # Bandwidth / topology model (STATED ASSUMPTIONS — the artifact embeds these)
 # ---------------------------------------------------------------------------
+def _anchor_mfu():
+    """MFU table for t_compute, anchored on the best committed on-chip
+    measurement available at run time: conv workloads on
+    ``bench_artifacts/resnet_sweep.json``, transformer workloads on
+    ``bench_artifacts/gpt_train_sweep.json`` once the ``gpt_train`` sweep
+    stages have run on-chip (VERDICT r3 item 3).  Until a transformer
+    measurement exists the transformer rows fall back to the measured
+    ResNet MFU — the fallback is flagged in ``mfu_provenance`` so the
+    artifact can never silently present the proxy as a measurement."""
+    conv = xfmr = 0.24  # 2026-07-29 on-chip ResNet b256 bf16
+    prov = {"conv": "default 0.24 (measured 2026-07-29, b256 bf16)",
+            "transformer": "ASSUMED = conv MFU; no on-chip transformer "
+                           "measurement committed yet (gpt_train sweep "
+                           "stages pending)"}
+
+    def best_row(name, prefer=None):
+        """Config-matched row when available (``prefer``), else best-MFU —
+        the workloads model a specific per-chip batch, so the matched
+        row's MFU is the right anchor when it exists."""
+        with open(os.path.join(REPO, "bench_artifacts", name)) as f:
+            rows = [r for r in json.load(f)["rows"]
+                    if "TPU" in str(r.get("device", "")) and r.get("mfu")
+                    and not r.get("loop") and not r.get("remat")]
+        if prefer is not None:
+            matched = [r for r in rows if prefer(r)]
+            if matched:
+                rows = matched
+        return max(rows, key=lambda r: r["mfu"]) if rows else None
+
+    try:
+        # _build_resnet_dp models per-chip batch 256 with the conv7 stem
+        r = best_row("resnet_sweep.json",
+                     prefer=lambda r: r.get("batch") == 256
+                     and r.get("stem") == "conv7" and r.get("bn") == "f32")
+        if r:
+            conv = r["mfu"]
+            prov["conv"] = (f"measured {conv} (resnet_sweep.json "
+                            f"b{r['batch']} {r['stem']} bn={r['bn']})")
+            xfmr = conv  # proxy until a transformer row lands
+    except (OSError, ValueError, KeyError):
+        pass
+    try:
+        r = best_row("gpt_train_sweep.json")
+        if r:
+            xfmr = r["mfu"]
+            prov["transformer"] = (
+                f"measured {xfmr} (gpt_train_sweep.json b{r['batch']} "
+                f"T{r.get('seq')} attn={r.get('attn', 'dense')})")
+    except (OSError, ValueError, KeyError):
+        pass
+    table = {
+        "resnet50_dp": conv, "resnet50_dp_2slice": conv,
+        "bert_tp_sp_dp": xfmr, "bert_fsdp8_dp": xfmr,
+        "bert_fsdp8_2slice": xfmr,
+        "ring_longctx_sp": xfmr, "ring_longctx_sp_t8k": xfmr,
+        "ring16_sp_t8k": xfmr, "ulysses16_sp_t8k": xfmr,
+        "moe_ep8_dp": xfmr, "gpipe_pp8_dp": xfmr, "gpipe_pp8_2slice": xfmr,
+    }
+    return table, prov
+
+
+_MFU_TABLE, _MFU_PROVENANCE = _anchor_mfu()
+
 MODEL_ASSUMPTIONS = {
     "topology": "TPU v5e pod, 2D ICI torus 16x16 (256 chips, one pod; no "
                 "DCN inside the modeled range).  The *_2slice workloads "
@@ -71,22 +134,10 @@ MODEL_ASSUMPTIONS = {
                 "per-chip DCN bandwidth (the standard multislice "
                 "reduce-scatter / DCN-transfer / all-gather decomposition)",
     "peak_bf16_flops_per_chip": 197e12,
-    "mfu": {
-        "resnet50_dp": 0.24,       # measured 2026-07-29 (bench_artifacts/
-                                   # resnet50_tpu_2026-07-29.json) b256 bf16
-        "resnet50_dp_2slice": 0.24,  # same step, multislice layout
-        "bert_tp_sp_dp": 0.24,     # assumed = measured ResNet MFU until a
-                                   # BERT step is measured on-chip
-        "bert_fsdp8_dp": 0.24,     # same assumption
-        "bert_fsdp8_2slice": 0.24,
-        "ring_longctx_sp": 0.24,   # same assumption
-        "ring_longctx_sp_t8k": 0.24,
-        "ring16_sp_t8k": 0.24,
-        "ulysses16_sp_t8k": 0.24,
-        "moe_ep8_dp": 0.24,
-        "gpipe_pp8_dp": 0.24,
-        "gpipe_pp8_2slice": 0.24,
-    },
+    # anchored on committed on-chip artifacts at run time (_anchor_mfu);
+    # mfu_provenance records measurement vs proxy per workload family
+    "mfu": _MFU_TABLE,
+    "mfu_provenance": _MFU_PROVENANCE,
     "loop_collectives": "a collective inside a while-loop body appears "
                         "once in HLO but runs trip-count times; each "
                         "loop's trip is read from the constant bound in "
@@ -873,7 +924,12 @@ def child(workload: str, n: int) -> None:
     built = WORKLOADS[workload](n)
     mesh, jitted, abstract_args, loop_trip = built[:4]
     dcn_extents = built[4] if len(built) > 4 else None
-    compiled = jitted.lower(*abstract_args).compile()
+    # trace under the mesh context, exactly like the dryrun phases: model
+    # code gates mesh-dependent sharding anchors (e.g. Bert's act_spec
+    # embedding constraint) on a context mesh, and the scaling prediction
+    # must price the SAME program the dryrun executes
+    with mesh:
+        compiled = jitted.lower(*abstract_args).compile()
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0]
@@ -1009,6 +1065,19 @@ def main() -> None:
     # over the merged list when the merge path ran, else the fresh rows
     _normalize_scaling(results, selected)
     out = {"assumptions": MODEL_ASSUMPTIONS, "results": results}
+    # carry the measured-ground-truth section (validate_scaling_model.py)
+    # across artifact rewrites; a full rerun changes predictions, so the
+    # validation should be re-run too — mark it stale rather than drop it
+    try:
+        with open(path) as f:
+            prior_validation = json.load(f).get("validation")
+        if prior_validation:
+            prior_validation["stale"] = (
+                "predictions rewritten after this validation ran; re-run "
+                "scripts/validate_scaling_model.py")
+            out["validation"] = prior_validation
+    except (OSError, ValueError):
+        pass
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(f"wrote {path}")

@@ -52,6 +52,35 @@ MESH_SIZES = [8, 16, 32, 64, 128, 256]
 # ---------------------------------------------------------------------------
 # Bandwidth / topology model (STATED ASSUMPTIONS — the artifact embeds these)
 # ---------------------------------------------------------------------------
+# the row _build_resnet_dp models: per-chip batch 256, conv7 stem, f32 BN.
+# Shared with scripts/validate_scaling_model.py so the anchor and the
+# validation can never silently select different rows.
+def IS_MODELED_RESNET(r):
+    return (r.get("batch") == 256 and r.get("stem") == "conv7"
+            and r.get("bn") == "f32")
+
+
+def measured_rows(artifact_name: str) -> list:
+    """Committed on-chip eager rows (no remat/loop) with an MFU — the
+    single row-selection predicate for MFU anchoring AND validation."""
+    with open(os.path.join(REPO, "bench_artifacts", artifact_name)) as f:
+        return [r for r in json.load(f)["rows"]
+                if "TPU" in str(r.get("device", "")) and r.get("mfu")
+                and not r.get("loop") and not r.get("remat")]
+
+
+def best_measured_row(artifact_name: str, prefer=None):
+    """Config-matched row when available (``prefer``), else best-MFU —
+    the workloads model a specific per-chip batch, so the matched row's
+    MFU is the right anchor when it exists."""
+    rows = measured_rows(artifact_name)
+    if prefer is not None:
+        matched = [r for r in rows if prefer(r)]
+        if matched:
+            rows = matched
+    return max(rows, key=lambda r: r["mfu"]) if rows else None
+
+
 def _anchor_mfu():
     """MFU table for t_compute, anchored on the best committed on-chip
     measurement available at run time: conv workloads on
@@ -66,40 +95,27 @@ def _anchor_mfu():
             "transformer": "ASSUMED = conv MFU; no on-chip transformer "
                            "measurement committed yet (gpt_train sweep "
                            "stages pending)"}
-
-    def best_row(name, prefer=None):
-        """Config-matched row when available (``prefer``), else best-MFU —
-        the workloads model a specific per-chip batch, so the matched
-        row's MFU is the right anchor when it exists."""
-        with open(os.path.join(REPO, "bench_artifacts", name)) as f:
-            rows = [r for r in json.load(f)["rows"]
-                    if "TPU" in str(r.get("device", "")) and r.get("mfu")
-                    and not r.get("loop") and not r.get("remat")]
-        if prefer is not None:
-            matched = [r for r in rows if prefer(r)]
-            if matched:
-                rows = matched
-        return max(rows, key=lambda r: r["mfu"]) if rows else None
-
     try:
         # _build_resnet_dp models per-chip batch 256 with the conv7 stem
-        r = best_row("resnet_sweep.json",
-                     prefer=lambda r: r.get("batch") == 256
-                     and r.get("stem") == "conv7" and r.get("bn") == "f32")
+        r = best_measured_row("resnet_sweep.json", prefer=IS_MODELED_RESNET)
         if r:
-            conv = r["mfu"]
-            prov["conv"] = (f"measured {conv} (resnet_sweep.json "
-                            f"b{r['batch']} {r['stem']} bn={r['bn']})")
-            xfmr = conv  # proxy until a transformer row lands
+            # build the provenance text BEFORE assigning the value so a
+            # malformed row can never leave a measured number in the
+            # table with proxy provenance
+            text = (f"measured {r['mfu']} (resnet_sweep.json "
+                    f"b{r.get('batch')} {r.get('stem')} bn={r.get('bn')})")
+            conv = xfmr = r["mfu"]  # xfmr: proxy until a gpt row lands
+            prov["conv"] = text
     except (OSError, ValueError, KeyError):
         pass
     try:
-        r = best_row("gpt_train_sweep.json")
+        r = best_measured_row("gpt_train_sweep.json")
         if r:
+            text = (f"measured {r['mfu']} (gpt_train_sweep.json "
+                    f"b{r.get('batch')} T{r.get('seq')} "
+                    f"attn={r.get('attn', 'dense')})")
             xfmr = r["mfu"]
-            prov["transformer"] = (
-                f"measured {xfmr} (gpt_train_sweep.json b{r['batch']} "
-                f"T{r.get('seq')} attn={r.get('attn', 'dense')})")
+            prov["transformer"] = text
     except (OSError, ValueError, KeyError):
         pass
     table = {
@@ -1072,9 +1088,15 @@ def main() -> None:
         with open(path) as f:
             prior_validation = json.load(f).get("validation")
         if prior_validation:
-            prior_validation["stale"] = (
-                "predictions rewritten after this validation ran; re-run "
-                "scripts/validate_scaling_model.py")
+            # mark each SUBSECTION stale (not the section): a later
+            # partial validate run refreshes only the parts it re-ran,
+            # so per-part markers are the only ones that stay truthful
+            for part in prior_validation.values():
+                if isinstance(part, dict):
+                    part["stale"] = (
+                        "predictions rewritten after this validation "
+                        "part ran; re-run "
+                        "scripts/validate_scaling_model.py")
             out["validation"] = prior_validation
     except (OSError, ValueError):
         pass

@@ -4,16 +4,13 @@ VERDICT r3 item 5: 64 rows of predictions must not float free of
 measurement.  Two checks, each an independent joint between the model and
 reality:
 
-(a) **single-chip compute** — the model's ``t_compute`` for
-    ``resnet50_dp`` (per-chip batch 256: FLOPs from compiled
-    ``cost_analysis()`` + loop-dot corrections, divided by peak x the MFU
-    assumption) vs the measured on-chip step times in
-    ``bench_artifacts/resnet_sweep.json``: the b256 row is an exact
-    config match, the b128 row is compared FLOP-scaled.  The MFU
-    assumption itself came from an earlier on-chip run
-    (2026-07-29, b256), so the residual delta isolates what the model
-    adds on top of that anchor: its own FLOP accounting and the
-    batch-linearity assumption — not the anchor.
+(a) **single-chip compute** — two anchor-independent checks against
+    ``bench_artifacts/resnet_sweep.json`` (the model's MFU may be
+    anchored on the b256 row itself — ``scaling_model._anchor_mfu`` —
+    so a direct predicted-vs-measured at b256 would be circular):
+    the model's per-device FLOP count vs the FLOPs the bench implied at
+    the anchor row, and the b256→b128 batch-linearity prediction vs the
+    measured b128 row.
 
 (b) **collective bytes across a real process boundary** — the bytes the
     model prices are extracted from single-process HLO
@@ -54,42 +51,58 @@ DIST_N = 8  # 2 procs x 4 devices
 # (a) predicted t_compute vs the measured ResNet-50 step
 # ---------------------------------------------------------------------------
 def validate_single_chip() -> dict:
+    """Two NON-circular checks (the model's MFU may be anchored on the
+    very b256 row in the sweep artifact, so 'predicted vs measured at
+    b256' would validate nothing once the anchor updates):
+
+    - **FLOP accounting**: the model's per-device FLOPs (cost_analysis +
+      loop-dot corrections) vs the FLOPs the bench itself implied at the
+      anchor row (``measured_mfu x peak x step_ms``).  Independent of
+      which MFU number the model assumes.
+    - **Batch linearity**: predict the b128 step by scaling the
+      b256-anchored time by FLOPs ratio and compare against the measured
+      b128 row — a cross-config generalization the anchor can't absorb.
+    """
+    import scaling_model as sm
+
     with open(ARTIFACT) as f:
         art = json.load(f)
     row = next(r for r in art["results"]
                if r["workload"] == "resnet50_dp" and r["n"] == 8)
-    mfu = art["assumptions"]["mfu"]["resnet50_dp"]
-    pred_b256_ms = row["t_compute_s"] * 1e3
+    peak = art["assumptions"]["peak_bf16_flops_per_chip"]
 
-    with open(SWEEP) as f:
-        rows = json.load(f)["rows"]
-    eager = [r for r in rows
-             if r.get("stem") == "conv7" and r.get("bn") == "f32"
-             and not r.get("remat") and not r.get("loop")
-             and "TPU" in str(r.get("device", ""))]
-    comparisons = []
-    for batch in (256, 128):
-        meas = next((r for r in eager if r["batch"] == batch), None)
-        if meas is None:
-            continue
-        # dp workload: per-device FLOPs scale linearly with per-chip batch
-        pred_ms = pred_b256_ms * batch / 256
-        comparisons.append({
-            "batch_per_chip": batch,
-            "exact_config_match": batch == 256,
-            "predicted_step_ms": round(pred_ms, 2),
-            "measured_step_ms": meas["step_ms"],
-            "measured_mfu": meas.get("mfu"),
-            "delta_pct": round(100 * (pred_ms / meas["step_ms"] - 1), 2),
-        })
-    return {
+    rows = sm.measured_rows("resnet_sweep.json")
+    anchor = next((r for r in rows if sm.IS_MODELED_RESNET(r)), None)
+    b128 = next((r for r in rows if r.get("batch") == 128
+                 and r.get("stem") == "conv7" and r.get("bn") == "f32"),
+                None)
+    out = {
         "workload": "resnet50_dp",
-        "what": "model t_compute (cost_analysis FLOPs / (peak x assumed "
-                f"MFU {mfu})) vs measured on-chip step time",
-        "flops_per_device": row["flops_per_device"],
+        "flops_per_device_model": row["flops_per_device"],
         "measured_source": "bench_artifacts/resnet_sweep.json",
-        "comparisons": comparisons,
     }
+    if anchor:
+        bench_flops = anchor["mfu"] * peak * anchor["step_ms"] / 1e3
+        out["flop_accounting"] = {
+            "what": "model per-device FLOPs vs the FLOPs the bench "
+                    "implied at the anchor row (mfu x peak x step) — "
+                    "anchor-independent",
+            "anchor_row": {k: anchor.get(k) for k in
+                           ("batch", "stem", "bn", "step_ms", "mfu")},
+            "bench_implied_flops": round(bench_flops, 0),
+            "delta_pct": round(
+                100 * (row["flops_per_device"] / bench_flops - 1), 2),
+        }
+    if anchor and b128:
+        pred_ms = anchor["step_ms"] * 128 / 256  # dp: FLOPs ∝ batch
+        out["batch_linearity"] = {
+            "what": "b256-anchored time scaled by FLOPs ratio vs the "
+                    "measured b128 row — cross-config generalization",
+            "predicted_step_ms": round(pred_ms, 2),
+            "measured_step_ms": b128["step_ms"],
+            "delta_pct": round(100 * (pred_ms / b128["step_ms"] - 1), 2),
+        }
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -160,12 +173,20 @@ def validate_cross_process() -> dict:
         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True, env=env, cwd=REPO) for i in range(2)]
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=900)
-        if p.returncode != 0:
-            raise RuntimeError(f"dist child failed (rc={p.returncode}):\n"
-                               f"{err[-3000:]}")
-        outs.append(out)
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=900)
+            if p.returncode != 0:
+                raise RuntimeError(f"dist child failed "
+                                   f"(rc={p.returncode}):\n{err[-3000:]}")
+            outs.append(out)
+    finally:
+        # never orphan the peer: it would block in jax.distributed
+        # initialize/shutdown waiting for the failed process
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
     multi = json.loads(outs[0].strip().splitlines()[-1])
     assert multi["num_processes"] == 2 and multi["global_devices"] == 8
 
@@ -180,8 +201,10 @@ def validate_cross_process() -> dict:
         per_key[k] = {
             "single_process_bytes": bs,
             "two_process_bytes": bm,
-            "delta_pct": (round(100 * (bm / bs - 1), 2) if bs
-                          else None if not bm else float("inf")),
+            # strict-JSON safe: no float('inf') tokens in the artifact
+            "delta_pct": round(100 * (bm / bs - 1), 2) if bs else None,
+            **({"only_in": "two_process"} if bm and not bs else
+               {"only_in": "single_process"} if bs and not bm else {}),
         }
     return {
         "workload": DIST_WORKLOAD, "n": DIST_N,
@@ -215,11 +238,18 @@ def main() -> None:
 
     validation = {}
     if args.part in ("a", "all"):
-        validation["single_chip_compute"] = validate_single_chip()
-        for c in validation["single_chip_compute"]["comparisons"]:
-            print(f"(a) b{c['batch_per_chip']}: predicted "
-                  f"{c['predicted_step_ms']} ms vs measured "
-                  f"{c['measured_step_ms']} ms ({c['delta_pct']:+.2f}%)")
+        sc = validate_single_chip()
+        validation["single_chip_compute"] = sc
+        if "flop_accounting" in sc:
+            fa = sc["flop_accounting"]
+            print(f"(a) FLOP accounting: model {sc['flops_per_device_model']:.3e}"
+                  f" vs bench-implied {fa['bench_implied_flops']:.3e}"
+                  f" ({fa['delta_pct']:+.2f}%)")
+        if "batch_linearity" in sc:
+            bl = sc["batch_linearity"]
+            print(f"(a) batch linearity: predicted b128 "
+                  f"{bl['predicted_step_ms']} ms vs measured "
+                  f"{bl['measured_step_ms']} ms ({bl['delta_pct']:+.2f}%)")
     if args.part in ("b", "all"):
         validation["cross_process_collectives"] = validate_cross_process()
         v = validation["cross_process_collectives"]
@@ -233,8 +263,10 @@ def main() -> None:
         return
     with open(ARTIFACT) as f:
         art = json.load(f)
+    # subsection replacement: a fresh part carries no 'stale' marker; a
+    # part that was NOT re-run keeps the per-part marker scaling_model.py
+    # set on rewrite
     art.setdefault("validation", {}).update(validation)
-    art["validation"].pop("stale", None)  # fresh run supersedes the marker
     with open(ARTIFACT, "w") as f:
         json.dump(art, f, indent=2)
     print(f"wrote validation section into {ARTIFACT}")

@@ -1,0 +1,49 @@
+"""Sanity locks for the analytic MXU-ceiling/roofline model
+(``scripts/resnet_mxu_ceiling.py``) — the CPU-side half of the MFU-plateau
+diagnosis (VERDICT r3 item 2)."""
+
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+from resnet_mxu_ceiling import analyze, resnet50_convs  # noqa: E402
+
+
+def test_conv_inventory_matches_resnet50():
+    convs = resnet50_convs("conv7")
+    # 1 stem + per-stage (3,4,6,3) bottlenecks x 3 convs + 4 projections
+    assert len(convs) == 1 + 3 * (3 + 4 + 6 + 3) + 4
+    names = [c[0] for c in convs]
+    assert names[0] == "stem_conv7"
+    assert "s2b1_proj" in names and "s1b2_proj" not in names
+    # v1.5: the stride lives on the 3x3
+    by_name = {c[0]: c for c in convs}
+    assert by_name["s2b1_3x3"][5] == 2 and by_name["s2b1_1x1a"][5] == 1
+
+
+def test_flops_match_known_resnet50_count():
+    """Useful train FLOPs must land on the known ~24 GFLOP/img
+    (8.02 fwd x ~3 for train, minus the stem's absent dgrad) — the same
+    convention as the bench's MFU numerator."""
+    out = analyze(256, "conv7")
+    per_img = out["total_train_gflops_useful"] / 256
+    assert 21 < per_img < 26, per_img
+
+
+def test_bounds_are_bounds():
+    out = analyze(256, "conv7")
+    assert 0 < out["padding_ceiling_mfu"] <= 1
+    assert 0 < out["roofline_mfu"] <= out["padding_ceiling_mfu"] + 1e-9
+    # the measured plateau (0.232-0.246) must sit BELOW the optimistic
+    # roofline — if a code change ever drops the roofline under the
+    # measurement, the model's assumptions are broken
+    assert out["roofline_mfu"] > 0.25
+    # s2d and conv7 ceilings are near-equal once the stem has no dgrad —
+    # the analytic echo of the measured +0.8% s2d non-gain
+    s2d = analyze(256, "s2d")
+    assert abs(s2d["padding_ceiling_mfu"]
+               - out["padding_ceiling_mfu"]) < 0.05

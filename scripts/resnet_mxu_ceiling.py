@@ -203,8 +203,8 @@ def main() -> None:
                     "split (resnet_profile sweep stage) should localize "
                     "it"),
             }
-    except (OSError, ValueError):
-        pass
+    except (OSError, ValueError, KeyError, TypeError):
+        pass  # no sweep yet / malformed — write the bounds without verdict
 
     path = os.path.join(REPO, "bench_artifacts", "resnet_mxu_ceiling.json")
     os.makedirs(os.path.dirname(path), exist_ok=True)

@@ -71,11 +71,17 @@ def validate_single_chip() -> dict:
                if r["workload"] == "resnet50_dp" and r["n"] == 8)
     peak = art["assumptions"]["peak_bf16_flops_per_chip"]
 
-    rows = sm.measured_rows("resnet_sweep.json")
-    anchor = next((r for r in rows if sm.IS_MODELED_RESNET(r)), None)
-    b128 = next((r for r in rows if r.get("batch") == 128
-                 and r.get("stem") == "conv7" and r.get("bn") == "f32"),
-                None)
+    # the SAME selection the model's anchor uses (best-MFU among
+    # config-matched rows) — first-match would diverge once re-runs
+    # append a second matching row
+    anchor = sm.best_measured_row("resnet_sweep.json",
+                                  prefer=sm.IS_MODELED_RESNET)
+    b128 = sm.best_measured_row(
+        "resnet_sweep.json",
+        prefer=lambda r: r.get("batch") == 128
+        and r.get("stem") == "conv7" and r.get("bn") == "f32")
+    if b128 is not None and b128.get("batch") != 128:
+        b128 = None  # prefer-filter found nothing; best-MFU row is not b128
     out = {
         "workload": "resnet50_dp",
         "flops_per_device_model": row["flops_per_device"],

@@ -28,7 +28,9 @@ mask, and pads ragged sequence lengths to block multiples internally.
 from __future__ import annotations
 
 import functools
+import json
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -38,9 +40,32 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30  # large-negative mask value (avoids -inf − -inf = nan)
 _EPS = 1e-30
 
+#: committed on-chip block-size sweep (scripts/tpu_sweep.py stage_flash);
+#: module-level so tests can point it elsewhere
+_FLASH_SWEEP_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "bench_artifacts", "flash_sweep.json")
+
 
 def _on_tpu() -> bool:
     return jax.devices()[0].platform == "tpu"
+
+
+@functools.lru_cache(maxsize=1)
+def _tuned_blocks() -> tuple[int, int]:
+    """Default ``(block_q, block_k)``: the best point of the committed
+    on-chip block sweep when one exists, else (512, 512).  Read once per
+    process at first trace, so a sweep captured later takes effect on the
+    next start — the same artifact-anchoring pattern as the scaling
+    model's MFU table."""
+    try:
+        with open(_FLASH_SWEEP_PATH) as f:
+            best = json.load(f).get("best_block")
+        bq, bk = (int(x) for x in best.split("x"))
+        assert bq > 0 and bk > 0
+        return bq, bk
+    except Exception:  # no sweep yet / malformed — the measured-default
+        return 512, 512
 
 
 def _causal_mask(s, q_block, block_k, qi, j, window=None):
@@ -343,8 +368,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # ------------------------------------------------------------- public API
 
 def flash_attention(q, k, v, mask=None, causal: bool = False,
-                    scale: float | None = None, block_q: int = 512,
-                    block_k: int = 512, interpret: bool | None = None,
+                    scale: float | None = None, block_q: int | None = None,
+                    block_k: int | None = None, interpret: bool | None = None,
                     window: int | None = None):
     """Fused attention over ``[batch, seq, heads, head_dim]`` arrays.
 
@@ -364,7 +389,9 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
         so compute is O(T·window) instead of O(T²/2).
       scale: score scale, default ``1/sqrt(D)``.
       block_q, block_k: kernel tile sizes (clamped to the padded seq len).
-        Measured speedups vs XLA dense attention live in
+        Default None = the best point of the committed on-chip block
+        sweep (``bench_artifacts/flash_sweep.json``) when one exists,
+        else 512x512.  Measured speedups vs XLA dense attention live in
         ``bench_artifacts/flash_attention.json`` (produced by ``bench.py``
         on the real chip).
       interpret: force Pallas interpreter mode; default auto (on ≠ TPU).
@@ -380,6 +407,10 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
         window = int(window)
     scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
     interpret = (not _on_tpu()) if interpret is None else interpret
+    if block_q is None:
+        block_q = _tuned_blocks()[0]
+    if block_k is None:
+        block_k = _tuned_blocks()[1]
 
     # BTHD → BHTD, pad both sequence axes to block multiples.
     qt = jnp.transpose(q, (0, 2, 1, 3))

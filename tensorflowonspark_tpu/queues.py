@@ -12,7 +12,9 @@ pickled message per few hundred samples, so the Python/TCP boundary is off the
 per-sample hot path and the training process can slice chunks straight into
 device batches.
 
-Protocol (length-prefixed pickle, shared with ``reservation.MessageSocket``):
+Protocol (pickle-5 frames with out-of-band buffers for large arrays,
+shared with ``reservation.MessageSocket`` — see its module docstring for
+the wire format):
 
     {"op": "put",   "q": name, "data": obj, "timeout": t} -> "OK" | ("FULL",)
     {"op": "get",   "q": name, "timeout": t}              -> ("OK", obj) | ("EMPTY",)
@@ -85,6 +87,10 @@ class QueueServer(MessageSocket):
                 conn, _ = self._listener.accept()
             except OSError:
                 break
+            # the data plane writes header+payload as separate sendalls;
+            # without NODELAY, Nagle holds the small header back a full
+            # delayed-ACK period on some stacks
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
@@ -188,6 +194,7 @@ class QueueClient(MessageSocket):
         self.authkey = bytes(authkey)
         self._default_timeout = timeout
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock.settimeout(timeout)
         self._sock.connect(self.addr)
         self._lock = threading.Lock()

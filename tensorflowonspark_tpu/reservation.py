@@ -9,8 +9,12 @@ spec is assembled.  On TPU this rendezvous additionally carries the
 coordinator address used for ``jax.distributed.initialize`` (the reference's
 analogue is building ``TF_CONFIG`` in ``TFSparkNode.py::run``).
 
-Wire format: 4-byte big-endian length prefix + pickled payload
-(:class:`MessageSocket`), matching the reference's framing strategy.
+Wire format (:class:`MessageSocket`): an 8-byte header
+``[4B pickle_len][4B nbuf]``, then ``nbuf`` 8-byte out-of-band buffer
+lengths, the pickle-protocol-5 stream, and the raw buffers — large
+contiguous payloads (numpy batches) skip the pickle stream entirely.
+``nbuf`` is 0 for plain control messages.  Pre-auth hellos use the
+separate 4-byte-length raw framing (``send_raw``/``receive_raw``).
 """
 
 from __future__ import annotations
@@ -63,31 +67,100 @@ class Reservations:
 
 
 class MessageSocket:
-    """Length-prefixed pickled messages over a TCP socket.
+    """Pickled messages over a TCP socket, with large binary payloads
+    (numpy batches in the queue data plane) carried OUT-OF-BAND.
 
-    Reference: ``reservation.py::MessageSocket``.
+    Frame: ``[4B pickle_len][4B nbuf][nbuf x 8B buf_len][pickle][bufs...]``.
+    ``nbuf`` is 0 for plain control messages (the common case everywhere
+    but the data queues).  Pickle protocol 5's ``buffer_callback`` splits
+    each array's bytes out of the pickle stream, so a chunk of samples
+    crosses the wire with NO Python-side serialize/concat/join copies:
+    the sender writes each array buffer straight to the socket, the
+    receiver ``recv_into``s it straight into its final backing store and
+    reconstructs the arrays zero-copy (``pickle.loads(buffers=...)``).
+    This is the per-sample→chunk divergence's second half (SURVEY.md
+    §3.2): chunking took pickling off the per-sample path; out-of-band
+    framing takes the per-BYTE copies off the per-chunk path.
+
+    Reference: ``reservation.py::MessageSocket`` (framing strategy).
     """
 
+    #: out-of-band only pays when a buffer is big enough that the saved
+    #: pickle-stream copy beats its extra sendall/recv_into syscall pair;
+    #: below this, in-band (one contiguous stream) is faster — measured:
+    #: ungated OOB on a chunk of ~3 KB samples was 5x SLOWER than in-band
+    OOB_MIN_BYTES = 64 * 1024
+    #: hard cap on per-message OOB buffers (syscall-count bound)
+    MAX_OOB_BUFFERS = 256
+
+    #: per-OOB-buffer allocation cap — matches the old format's implicit
+    #: 4 GiB frame bound, so a desynced stream (payload bytes parsed as a
+    #: header) fails like a framing error, not an exabyte MemoryError
+    MAX_OOB_BUF_BYTES = 1 << 32
+
     def receive(self, sock: socket.socket):
-        header = self._recv_exact(sock, 4)
-        (length,) = struct.unpack(">I", header)
-        return pickle.loads(self._recv_exact(sock, length))
+        plen, nbuf = struct.unpack(">II", self._recv_exact(sock, 8))
+        if not nbuf:
+            return pickle.loads(self._recv_exact(sock, plen))
+        if nbuf > self.MAX_OOB_BUFFERS:
+            raise EOFError(f"frame desync: nbuf={nbuf} exceeds "
+                           f"MAX_OOB_BUFFERS={self.MAX_OOB_BUFFERS}")
+        lens = struct.unpack(f">{nbuf}Q",
+                             self._recv_exact(sock, 8 * nbuf))
+        if any(n > self.MAX_OOB_BUF_BYTES for n in lens):
+            raise EOFError(f"frame desync: oversized OOB buffer in {lens}")
+        pdata = self._recv_exact(sock, plen)
+        bufs = []
+        for n in lens:
+            ba = bytearray(n)  # writable: reconstructed arrays stay mutable
+            self._recv_exact_into(sock, memoryview(ba))
+            bufs.append(ba)
+        return pickle.loads(pdata, buffers=bufs)
 
     @staticmethod
-    def _recv_exact(sock: socket.socket, n: int) -> bytes:
-        chunks = []
+    def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
         got = 0
+        n = len(view)
         while got < n:
-            chunk = sock.recv(min(n - got, BUFSIZE))
-            if not chunk:
+            r = sock.recv_into(view[got:])
+            if not r:
                 raise EOFError("socket closed while receiving message")
-            chunks.append(chunk)
-            got += len(chunk)
-        return b"".join(chunks)
+            got += r
+
+    @classmethod
+    def _recv_exact(cls, sock: socket.socket, n: int) -> bytes:
+        ba = bytearray(n)
+        cls._recv_exact_into(sock, memoryview(ba))
+        return bytes(ba) if n < BUFSIZE else ba  # small frames: hashable
 
     def send(self, sock: socket.socket, msg) -> None:
-        data = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-        sock.sendall(struct.pack(">I", len(data)) + data)
+        bufs: list = []
+
+        def keep_large(pb):
+            # pickle semantics: a TRUE return serializes the buffer
+            # in-band; a false return means out-of-band (we captured it)
+            try:
+                v = pb.raw()
+            except BufferError:          # non-contiguous
+                return True
+            if (v.nbytes < self.OOB_MIN_BYTES
+                    or len(bufs) >= self.MAX_OOB_BUFFERS):
+                return True
+            bufs.append(v)
+            return False
+
+        data = pickle.dumps(msg, protocol=5, buffer_callback=keep_large)
+        header = struct.pack(">II", len(data), len(bufs))
+        if bufs:
+            header += struct.pack(f">{len(bufs)}Q",
+                                  *(v.nbytes for v in bufs))
+        if len(data) < BUFSIZE:
+            sock.sendall(header + data)
+        else:
+            sock.sendall(header)
+            sock.sendall(data)
+        for v in bufs:
+            sock.sendall(v)
 
     # Raw (non-pickle) frames, used for the pre-auth hello so that no
     # attacker-controlled bytes are ever unpickled before authentication.

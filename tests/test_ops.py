@@ -238,3 +238,29 @@ class TestSlidingWindow:
             flash_attention(x, x, x, window=4)
         with pytest.raises(ValueError, match=">= 1"):
             flash_attention(x, x, x, causal=True, window=0)
+
+
+def test_flash_blocks_anchor_on_sweep_artifact(tmp_path, monkeypatch):
+    """Default block sizes come from the committed on-chip block sweep
+    when one exists, and fall back to 512x512 otherwise."""
+    import importlib
+
+    # ops/__init__ shadows the submodule name with the function, so a
+    # plain `import ... as` would bind the function — load the module
+    fa_mod = importlib.import_module(
+        "tensorflowonspark_tpu.ops.flash_attention")
+
+    art = tmp_path / "flash_sweep.json"
+    monkeypatch.setattr(fa_mod, "_FLASH_SWEEP_PATH", str(art))
+
+    fa_mod._tuned_blocks.cache_clear()
+    assert fa_mod._tuned_blocks() == (512, 512)  # no artifact yet
+
+    art.write_text('{"best_block": "1024x256"}')
+    fa_mod._tuned_blocks.cache_clear()
+    assert fa_mod._tuned_blocks() == (1024, 256)
+
+    art.write_text('{"best_block": "garbage"}')
+    fa_mod._tuned_blocks.cache_clear()
+    assert fa_mod._tuned_blocks() == (512, 512)
+    fa_mod._tuned_blocks.cache_clear()  # leave no tmp-path state behind

@@ -82,3 +82,58 @@ def test_concurrent_feeders(server):
     for t in threads:
         t.join(5)
     assert sorted(got) == sorted([f"{t}-{i}" for t in "ab" for i in range(8)])
+
+
+def test_out_of_band_framing_roundtrip():
+    """MessageSocket's pickle-5 frame: large contiguous arrays travel
+    out-of-band (nbuf > 0), small/non-contiguous payloads stay in-band,
+    and every shape reconstructs equal and WRITABLE on the far side."""
+    import socket as _socket
+    import struct
+
+    import numpy as np
+
+    from tensorflowonspark_tpu.reservation import MessageSocket
+
+    ms = MessageSocket()
+
+    class FakeSock:
+        def __init__(self):
+            self.data = bytearray()
+
+        def sendall(self, b):
+            self.data += bytes(b)
+
+    def nbuf_of(msg):
+        fs = FakeSock()
+        ms.send(fs, msg)
+        _, nbuf = struct.unpack(">II", fs.data[:8])
+        return nbuf
+
+    def roundtrip(msg):
+        a, b = _socket.socketpair()
+        out = {}
+        try:
+            t = threading.Thread(
+                target=lambda: out.setdefault("v", ms.receive(b)))
+            t.start()
+            ms.send(a, msg)
+            t.join(10)
+            assert not t.is_alive(), "receive hung"
+            return out["v"]
+        finally:
+            a.close()
+            b.close()
+
+    big = np.arange(64 * 1024, dtype=np.float32)          # 256 KB -> OOB
+    small = np.arange(16, dtype=np.int32)                 # in-band
+    noncontig = np.ones((256, 512), np.float32)[:, ::2]   # in-band
+    msg = {"big": big, "small": small, "nc": noncontig, "s": "x"}
+    assert nbuf_of(msg) == 1, "exactly the big contiguous array goes OOB"
+    assert nbuf_of({"only_small": small, "n": 3}) == 0
+
+    out = roundtrip(msg)
+    np.testing.assert_array_equal(out["big"], big)
+    np.testing.assert_array_equal(out["small"], small)
+    np.testing.assert_array_equal(out["nc"], noncontig)
+    out["big"][0] = -1.0  # reconstructed-from-bytearray must stay mutable

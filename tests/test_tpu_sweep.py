@@ -91,6 +91,45 @@ def test_only_filter_respects_given_order():
         _select_stages(stages, "c,nope")
 
 
+def test_commit_artifacts_is_pathspec_scoped(tmp_path, monkeypatch):
+    """--git-commit must never sweep operator-staged files into the
+    auto-generated artifact commit, and must skip cleanly when the stage
+    wrote nothing."""
+    repo = tmp_path
+    def git(*a):
+        return subprocess.run(["git", *a], cwd=repo, capture_output=True,
+                              text=True, check=True)
+    git("init", "-q", ".")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (repo / "bench_artifacts").mkdir()
+    (repo / "f.txt").write_text("base")
+    git("add", ".")
+    git("commit", "-qm", "init")
+    # operator stages unrelated work; a stage writes a fresh artifact
+    (repo / "f.txt").write_text("wip")
+    git("add", "f.txt")
+    (repo / "bench_artifacts" / "a.json").write_text("{}")
+
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    try:
+        import tpu_sweep
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setattr(tpu_sweep, "REPO", str(repo))
+    tpu_sweep._commit_artifacts("teststage")
+
+    last = git("show", "--name-only", "--format=%s", "HEAD").stdout
+    assert "sweep artifacts" in last and "bench_artifacts/a.json" in last
+    assert "f.txt" not in last, "operator-staged file swept into commit"
+    staged = git("diff", "--cached", "--name-only").stdout.split()
+    assert staged == ["f.txt"], "operator's staged work must survive"
+    # idempotent: nothing new -> no commit
+    head = git("rev-parse", "HEAD").stdout
+    tpu_sweep._commit_artifacts("teststage")
+    assert git("rev-parse", "HEAD").stdout == head
+
+
 def test_only_filter_validates_before_probe():
     """A typo'd stage name fails fast — before the (slow) TPU probe."""
     proc = subprocess.run(

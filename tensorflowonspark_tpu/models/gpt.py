@@ -99,6 +99,13 @@ class GPTConfig:
     # outweighs the weights in HBM traffic, and decode is HBM-bound;
     # int8 halves it.  XLA fuses the dequantize into the attention reads.
     kv_cache_int8: bool = False
+    # Per-ROW cache positions (``index``/``pos`` become ``[B]`` vectors):
+    # each batch row decodes at its own offset, the substrate for
+    # continuous batching (``models.serving.ContinuousBatcher`` admits and
+    # retires requests mid-flight by operating on individual cache rows).
+    # Decode-path only; mutually exclusive with rolling_kv_cache (the
+    # rolling slot math assumes one shared write position).
+    per_row_positions: bool = False
 
     def __post_init__(self):
         if self.pos_encoding not in ("learned", "rope"):
@@ -117,6 +124,10 @@ class GPTConfig:
         if self.rolling_kv_cache and self.sliding_window is None:
             raise ValueError(
                 "rolling_kv_cache requires sliding_window to be set")
+        if self.per_row_positions and self.rolling_kv_cache:
+            raise ValueError(
+                "per_row_positions is incompatible with rolling_kv_cache "
+                "(rolling slot arithmetic assumes one shared position)")
         if self.pos_encoding == "rope" and self.head_dim % 2:
             raise ValueError(
                 f"rope needs an even head_dim, got {self.head_dim} "
@@ -129,14 +140,18 @@ class GPTConfig:
 
 def _rope(x, positions, base: float):
     """Rotary embedding: rotate feature pairs of ``x [B, T, H, D]`` by
-    position-dependent angles (``positions [T]``).  fp32 trig, result in
-    ``x.dtype``."""
+    position-dependent angles (``positions [T]``, or ``[B, T]`` when rows
+    decode at independent offsets — continuous batching).  fp32 trig,
+    result in ``x.dtype``."""
     D = x.shape[-1]
     half = D // 2
     freq = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    angles = positions.astype(jnp.float32)[:, None] * freq[None, :]  # [T, half]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    pos = positions.astype(jnp.float32)
+    if pos.ndim == 1:
+        pos = pos[None]                                  # [1, T]
+    angles = pos[:, :, None] * freq[None, None, :]       # [B|1, T, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
     return jnp.concatenate([x1 * cos - x2 * sin,
                             x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
@@ -162,13 +177,18 @@ class CausalSelfAttention(nn.Module):
         v = _dense(Hkv * D, (None, "tp"), cfg.dtype, "value")(x) \
             .reshape(B, T, Hkv, D)
 
-        ci = self.variable("cache", "index",
-                           lambda: jnp.zeros((), jnp.int32)) \
+        per_row = cfg.per_row_positions and self.decode
+        ci = self.variable(
+            "cache", "index",
+            lambda: jnp.zeros((B,) if per_row else (), jnp.int32)) \
             if self.decode else None
         if cfg.pos_encoding == "rope":
             # rotate q/k by absolute position; K is cached POST-rotation,
             # so incremental decode sees identical keys to the full forward
-            positions = (ci.value if ci is not None else 0) + jnp.arange(T)
+            if per_row:
+                positions = ci.value[:, None] + jnp.arange(T)[None, :]
+            else:
+                positions = (ci.value if ci is not None else 0) + jnp.arange(T)
             q = _rope(q, positions, cfg.rope_base)
             k = _rope(k, positions, cfg.rope_base)
 
@@ -179,7 +199,10 @@ class CausalSelfAttention(nn.Module):
             qg = q.reshape(B, T, Hkv, G, D).astype(jnp.float32)
             s = jnp.einsum("btkgd,bskd->bkgts", qg,
                            k_all.astype(jnp.float32)) * (D ** -0.5)
-            s = jnp.where(mask[None, None, None], s, -1e30)
+            # mask: [T, S] shared, or [B, T, S] per-row (per_row_positions)
+            m = mask[None, None, None] if mask.ndim == 2 \
+                else mask[:, None, None]
+            s = jnp.where(m, s, -1e30)
             p = nn.softmax(s, axis=-1)
             if not self.decode:
                 p = nn.Dropout(cfg.dropout_rate, deterministic=not train)(p)
@@ -199,8 +222,14 @@ class CausalSelfAttention(nn.Module):
             def store(ref, x):
                 """Write positions idx..idx+T-1 (keeping only the last C
                 under rolling; slot indices stay unique so the scatter is
-                well-defined)."""
+                well-defined).  Per-row mode scatters each row at its own
+                offset."""
                 Tw = x.shape[1]
+                if per_row:
+                    rows = jnp.arange(B)[:, None]
+                    slots = idx[:, None] + jnp.arange(Tw)[None, :]
+                    ref.value = ref.value.at[rows, slots].set(x)
+                    return ref.value
                 if not rolling:
                     ref.value = jax.lax.dynamic_update_slice(
                         ref.value, x, (0, idx, 0, 0))
@@ -242,15 +271,23 @@ class CausalSelfAttention(nn.Module):
                 k_all = store(ck, k.astype(cfg.dtype))
                 v_all = store(cv, v.astype(cfg.dtype))
             ci.value = idx + T
-            q_pos = (idx + jnp.arange(T))[:, None]                   # [T, 1]
-            if rolling:
+            if per_row:
+                q_pos = idx[:, None] + jnp.arange(T)[None, :]        # [B, T]
+                k_pos = jnp.arange(L)
+                visible = k_pos[None, None, :] <= q_pos[:, :, None]  # [B,T,L]
+                if cfg.sliding_window is not None:
+                    visible &= k_pos[None, None, :] \
+                        > q_pos[:, :, None] - cfg.sliding_window
+            elif rolling:
                 # slot s holds position p(s) = the latest pos == s (mod C);
                 # visible iff written, causal, and inside the window
+                q_pos = (idx + jnp.arange(T))[:, None]               # [T, 1]
                 p_end = idx + T - 1
                 p_slot = p_end - ((p_end - jnp.arange(C)[None, :]) % C)
                 visible = (p_slot >= 0) & (p_slot <= q_pos) \
                     & (p_slot > q_pos - cfg.sliding_window)
             else:
+                q_pos = (idx + jnp.arange(T))[:, None]               # [T, 1]
                 k_pos = jnp.arange(L)
                 visible = k_pos[None, :] <= q_pos                    # [T, L]
                 if cfg.sliding_window is not None:
@@ -354,9 +391,14 @@ class GPT(nn.Module):
             x = tok(input_ids)
         else:
             if self.decode:
-                start = self.variable("cache", "pos",
-                                      lambda: jnp.zeros((), jnp.int32))
-                positions = start.value + jnp.arange(T)
+                per_row = cfg.per_row_positions
+                start = self.variable(
+                    "cache", "pos",
+                    lambda: jnp.zeros((B,) if per_row else (), jnp.int32))
+                if per_row:
+                    positions = start.value[:, None] + jnp.arange(T)[None, :]
+                else:
+                    positions = start.value + jnp.arange(T)
                 start.value = start.value + T
             else:
                 positions = jnp.arange(T)

@@ -1,0 +1,208 @@
+"""Continuous batching for compiled KV-cache decode.
+
+The reference has no serving stack at all (SURVEY.md §2d stops at a
+SavedModel batch-inference utility); this module is part of the rebuild's
+beyond-parity inference story, alongside speculative decoding and int8/
+int4 quantization (``models/gpt.py``, ``ops/quant.py``).
+
+Static batching wastes the accelerator twice: a new request waits for the
+whole running batch to finish, and a finished row keeps occupying its
+batch slot until the stragglers drain.  Continuous batching fixes both by
+treating the decode batch as ``max_batch`` independent SLOTS over one
+static-shape KV cache:
+
+- every slot decodes at its own cache offset
+  (``GPTConfig.per_row_positions``: the per-layer ``index`` and
+  learned-position ``pos`` counters are ``[B]`` vectors);
+- a new request is PREFILLED alone on a fresh single-row cache, then its
+  cache row and counters are scattered into a free slot
+  (``dynamic_update_slice`` on the row axis) — running slots never
+  recompile, never stall, and never see the new prompt;
+- a finished slot is released immediately and can be re-admitted on the
+  very next step.
+
+Everything on the hot path is compiled exactly once: ONE decode-step
+executable for the whole lifetime (all shapes static), one prefill
+executable per distinct prompt length (callers that control their
+traffic can pad prompts to a few bucket lengths), and one scatter
+executable.  The decode loop itself is plain Python — admission decisions
+are host-side control flow, exactly what should NOT be traced.
+
+Output contract: every request's tokens are **greedy-exact** — identical
+to a solo ``greedy_generate`` run on that prompt — regardless of
+admission order, slot reuse, or what else shares the batch (locked by
+``tests/test_serving.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflowonspark_tpu.models.gpt import GPT, GPTConfig, init_cache
+
+
+@dataclass
+class _Slot:
+    request_id: int
+    remaining: int
+    tokens: list = field(default_factory=list)  # generated so far
+
+
+class ContinuousBatcher:
+    """Admit/step/retire greedy-decode requests over one compiled batch.
+
+    Usage::
+
+        b = ContinuousBatcher(cfg, params, max_batch=8, eos_id=50256)
+        for prompt, n in requests: b.submit(prompt, n)
+        results = b.run()          # {request_id: np.ndarray tokens}
+
+    or drive it manually: ``submit`` while ``b.has_free_slot()`` (it
+    counts queued-but-unadmitted requests against the free slots),
+    ``step()`` once per decode step (returns every request id finished
+    since the last call, including ones that completed at admission),
+    submit more as slots free up.
+    """
+
+    def __init__(self, cfg: GPTConfig, params, max_batch: int,
+                 eos_id: int | None = None):
+        if cfg.rolling_kv_cache:
+            raise ValueError("ContinuousBatcher requires a full-length "
+                             "cache (rolling_kv_cache=False)")
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.cfg = dataclasses.replace(cfg, per_row_positions=True)
+        # prefill runs single-row, where per-row == scalar semantics; one
+        # cfg keeps the two paths' traces structurally identical
+        self.params = params
+        self.max_batch = int(max_batch)
+        self.eos_id = eos_id
+        self.model = GPT(self.cfg, decode=True)
+        self.cache = init_cache(self.cfg, params, self.max_batch)
+        self.slots: list[_Slot | None] = [None] * self.max_batch
+        self._pending: list[tuple[int, np.ndarray, int]] = []
+        self._ids = itertools.count()
+        self._results: dict[int, np.ndarray] = {}
+        self._prefill_jit: dict[int, object] = {}
+
+        def step_fn(params, cache, tokens):
+            logits, vars_ = self.model.apply(
+                {"params": params, "cache": cache},
+                tokens[:, None], mutable=["cache"])
+            return jnp.argmax(logits[:, -1], axis=-1), vars_["cache"]
+
+        self._step = jax.jit(step_fn, donate_argnums=(1,))
+
+        def scatter_fn(cache, row, slot):
+            """Write the single-row prefill cache into slot ``slot``."""
+            def put(path, m, s):
+                is_counter = getattr(path[-1], "key", None) in ("index",
+                                                                "pos")
+                axis = (m.ndim - 1) if is_counter \
+                    else (1 if self.cfg.scan_layers else 0)
+                return jax.lax.dynamic_update_slice_in_dim(
+                    m, s.astype(m.dtype), slot, axis)
+            return jax.tree_util.tree_map_with_path(put, cache, row)
+
+        self._scatter = jax.jit(scatter_fn, donate_argnums=(0,))
+
+    # -- admission ---------------------------------------------------------
+    def has_free_slot(self) -> bool:
+        """True while another ``submit`` would find a slot: queued-but-
+        unadmitted requests count against the free slots, so a driver
+        looping ``while b.has_free_slot(): b.submit(...)`` terminates."""
+        free = sum(s is None for s in self.slots)
+        return len(self._pending) < free
+
+    def submit(self, prompt_ids, max_new_tokens: int) -> int:
+        """Queue a request; it is admitted into a slot on the next
+        ``step()`` with a free slot.  Returns the request id."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens} "
+                "(the greedy-exact contract has no 0-token decode)")
+        total = prompt.size + max_new_tokens
+        if total > self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) = {total} exceeds "
+                f"max_position_embeddings "
+                f"({self.cfg.max_position_embeddings})")
+        rid = next(self._ids)
+        self._pending.append((rid, prompt, int(max_new_tokens)))
+        return rid
+
+    def _prefill(self, prompt: np.ndarray):
+        T0 = prompt.size
+        if T0 not in self._prefill_jit:
+            def prefill_fn(params, prompt_row):
+                cache1 = init_cache(self.cfg, params, 1)
+                logits, vars_ = self.model.apply(
+                    {"params": params, "cache": cache1},
+                    prompt_row, mutable=["cache"])
+                return jnp.argmax(logits[:, -1], axis=-1), vars_["cache"]
+            self._prefill_jit[T0] = jax.jit(prefill_fn)
+        return self._prefill_jit[T0](self.params, prompt[None, :])
+
+    def _admit(self) -> list[int]:
+        """Fill free slots from the pending queue; returns the ids of
+        requests that finished AT admission (1-token budget or immediate
+        eos) so ``step()`` can report them."""
+        done = []
+        for i, slot in enumerate(self.slots):
+            if slot is not None or not self._pending:
+                continue
+            rid, prompt, budget = self._pending.pop(0)
+            first, row_cache = self._prefill(prompt)
+            tok = int(first[0])
+            self.cache = self._scatter(self.cache, row_cache, i)
+            s = _Slot(request_id=rid, remaining=budget - 1, tokens=[tok])
+            if s.remaining <= 0 or tok == self.eos_id:
+                self._finish(i, s)      # slot stays free for the next one
+                done.append(rid)
+            else:
+                self.slots[i] = s
+        return done
+
+    def _finish(self, i: int, s: _Slot) -> None:
+        self._results[s.request_id] = np.asarray(s.tokens, np.int32)
+        self.slots[i] = None
+
+    # -- decode ------------------------------------------------------------
+    def step(self) -> list[int]:
+        """Admit pending requests into free slots, run ONE decode step for
+        every active slot, and return every request id that finished —
+        whether during decode or already at admission."""
+        done = self._admit()
+        if not any(self.slots):
+            return done
+        tokens = jnp.asarray([s.tokens[-1] if s else 0
+                              for s in self.slots], jnp.int32)
+        nxt, self.cache = self._step(self.params, self.cache, tokens)
+        nxt = np.asarray(nxt)
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            tok = int(nxt[i])
+            s.tokens.append(tok)
+            s.remaining -= 1
+            if s.remaining <= 0 or tok == self.eos_id:
+                done.append(s.request_id)
+                self._finish(i, s)
+        return done
+
+    def run(self) -> dict[int, np.ndarray]:
+        """Drive ``step()`` until every submitted request has finished;
+        returns ``{request_id: generated tokens}`` (prompt excluded)."""
+        while self._pending or any(self.slots):
+            self.step()
+        return dict(self._results)

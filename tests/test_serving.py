@@ -1,0 +1,147 @@
+"""Continuous batching (``models/serving.py``): greedy-exact output per
+request regardless of admission order, slot reuse, or batch company.
+
+The oracle for every request is a SOLO ``greedy_generate`` run on its
+prompt (the scalar-index decode path) — so these tests also lock the
+per-row-position substrate (``GPTConfig.per_row_positions``) against the
+reference implementation.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.models import (GPT, GPTConfig, ContinuousBatcher,
+                                          greedy_generate)
+
+
+def _make(pos_encoding="rope", **kw):
+    cfg = GPTConfig(vocab_size=61, hidden_size=32, num_layers=2, num_heads=4,
+                    intermediate_size=64, max_position_embeddings=48,
+                    dtype=jnp.float32, pos_encoding=pos_encoding, **kw)
+    params = GPT(cfg).init(jax.random.key(0),
+                           jnp.ones((1, 4), jnp.int32))["params"]
+    return cfg, params
+
+
+def _oracle(cfg, params, prompt, n):
+    out = greedy_generate(cfg, params, jnp.asarray(prompt)[None, :], n)
+    return np.asarray(out)[0, len(prompt):]
+
+
+@pytest.mark.parametrize("pos_encoding", ["rope", "learned"])
+def test_staggered_requests_match_solo_greedy(pos_encoding):
+    """More requests than slots, different prompt lengths and budgets:
+    every request's tokens equal its solo greedy run."""
+    cfg, params = _make(pos_encoding)
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(0, cfg.vocab_size, (t,)).astype(np.int32), n)
+            for t, n in ((5, 7), (3, 12), (8, 4), (5, 9), (2, 6), (6, 1))]
+
+    b = ContinuousBatcher(cfg, params, max_batch=2)
+    rids = [b.submit(p, n) for p, n in reqs]
+    results = b.run()
+
+    assert sorted(results) == sorted(rids)
+    for rid, (prompt, n) in zip(rids, reqs):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(cfg, params, prompt, n))
+
+
+def test_mid_flight_admission_does_not_disturb_running_slots():
+    """Submit while another request is mid-decode; both stay exact."""
+    cfg, params = _make()
+    rng = np.random.default_rng(1)
+    p1 = rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+    p2 = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+
+    b = ContinuousBatcher(cfg, params, max_batch=2)
+    r1 = b.submit(p1, 10)
+    for _ in range(4):           # r1 alone for a few steps
+        b.step()
+    r2 = b.submit(p2, 5)         # admitted mid-flight of r1
+    results = b.run()
+
+    np.testing.assert_array_equal(results[r1], _oracle(cfg, params, p1, 10))
+    np.testing.assert_array_equal(results[r2], _oracle(cfg, params, p2, 5))
+
+
+def test_eos_frees_slot_early_and_slot_reuse_is_clean():
+    """A request stopping at eos releases its slot; the slot's next
+    tenant is unaffected by the leftover cache rows."""
+    cfg, params = _make()
+    rng = np.random.default_rng(2)
+    p1 = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+    # pick the eos id as the 3rd token the oracle would emit, so the
+    # request genuinely stops early
+    oracle1 = _oracle(cfg, params, p1, 10)
+    eos = int(oracle1[2])
+    p2 = rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+
+    b = ContinuousBatcher(cfg, params, max_batch=1, eos_id=eos)
+    r1 = b.submit(p1, 10)
+    r2 = b.submit(p2, 6)         # waits for the only slot
+    results = b.run()
+
+    # r1: truncated at (and including) the FIRST eos occurrence
+    first = list(oracle1).index(eos)
+    np.testing.assert_array_equal(results[r1], oracle1[:first + 1])
+    assert len(results[r1]) < len(oracle1), "eos did not stop early"
+    # r2 reused r1's slot; exactness = prefix-up-to-eos of its solo run
+    want2 = _oracle(cfg, params, p2, 6)
+    got2 = results[r2]
+    if eos in want2:
+        want2 = want2[:list(want2).index(eos) + 1]
+    np.testing.assert_array_equal(got2, want2)
+
+
+def test_single_step_budget_and_validation():
+    cfg, params = _make()
+    with pytest.raises(ValueError, match="max_batch"):
+        ContinuousBatcher(cfg, params, max_batch=0)
+    b = ContinuousBatcher(cfg, params, max_batch=2)
+    with pytest.raises(ValueError, match="empty prompt"):
+        b.submit(np.array([], np.int32), 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        b.submit(np.array([1, 2], np.int32), 0)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        b.submit(np.arange(40, dtype=np.int32), 20)
+    rid = b.submit(np.array([1, 2, 3], np.int32), 1)  # 1-token budget
+    # finishing AT admission must still be reported by step()
+    assert b.step() == [rid]
+    results = b.run()
+    np.testing.assert_array_equal(results[rid],
+                                  _oracle(cfg, params, [1, 2, 3], 1))
+
+
+def test_has_free_slot_counts_pending():
+    """The documented drive loop 'submit while has_free_slot()' must
+    terminate: queued requests count against free slots."""
+    cfg, params = _make()
+    b = ContinuousBatcher(cfg, params, max_batch=2)
+    n = 0
+    while b.has_free_slot():
+        b.submit(np.array([1, 2], np.int32), 3)
+        n += 1
+        assert n <= 2, "has_free_slot ignored the pending queue"
+    assert n == 2
+
+
+def test_one_decode_executable_for_the_lifetime():
+    """The decode step never recompiles across admissions/retirements."""
+    cfg, params = _make()
+    b = ContinuousBatcher(cfg, params, max_batch=2)
+    b.submit(np.array([1, 2], np.int32), 3)
+    b.submit(np.array([3, 4, 5], np.int32), 8)
+    b.submit(np.array([6], np.int32), 4)
+    b.run()
+    assert b._step._cache_size() == 1, "decode step recompiled"
+
+
+def test_rolling_cache_rejected():
+    cfg, params = _make(sliding_window=8, rolling_kv_cache=True)
+    with pytest.raises(ValueError, match="rolling_kv_cache"):
+        ContinuousBatcher(cfg, params, max_batch=2)

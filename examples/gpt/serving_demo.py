@@ -1,0 +1,90 @@
+"""Continuous-batching serving demo.
+
+Trains nothing — serving is about SCHEDULING, not weights.  A tiny GPT
+with random parameters handles a burst of mixed-length greedy requests
+through ``models.ContinuousBatcher`` (requests admit into and retire
+from batch slots mid-flight over one compiled decode step), and every
+response is asserted token-identical to a solo ``greedy_generate`` run
+on that prompt — the greedy-exact contract.
+
+Prints per-request status plus the decode-step comparison against
+arrival-order static batching (the hardware-independent scheduling win).
+
+Run: ``python examples/gpt/serving_demo.py [--cpu] [--requests 12]``
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--requests", type=int, default=12)
+    p.add_argument("--slots", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    if args.requests < 1 or args.slots < 1:
+        p.error("--requests and --slots must be >= 1")
+    if args.cpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import (GPT, GPTConfig,
+                                              ContinuousBatcher,
+                                              greedy_generate)
+
+    cfg = GPTConfig(vocab_size=97, hidden_size=48, num_layers=2, num_heads=4,
+                    intermediate_size=96, max_position_embeddings=64,
+                    dtype=jnp.float32, pos_encoding="rope")
+    params = GPT(cfg).init(jax.random.key(0),
+                           jnp.ones((1, 4), jnp.int32))["params"]
+
+    rng = np.random.default_rng(args.seed)
+    reqs = [(rng.integers(0, cfg.vocab_size,
+                          (int(rng.integers(3, 10)),)).astype(np.int32),
+             int(rng.integers(4, 25))) for _ in range(args.requests)]
+
+    b = ContinuousBatcher(cfg, params, max_batch=args.slots)
+    rids = [b.submit(prompt, budget) for prompt, budget in reqs]
+    remaining = set(rids)
+    steps = 0
+    while remaining:
+        finished = b.step()
+        steps += 1
+        for rid in finished:
+            print(f"serving_demo: request {rid} finished at step {steps}",
+                  flush=True)
+        remaining.difference_update(finished)
+    results = b.run()
+
+    for rid, (prompt, budget) in zip(rids, reqs):
+        want = np.asarray(greedy_generate(
+            cfg, params, jnp.asarray(prompt)[None, :],
+            budget))[0, len(prompt):]
+        assert (results[rid] == want).all(), f"request {rid} diverged"
+    print(f"serving_demo: {len(rids)} requests greedy-exact", flush=True)
+
+    # symmetric accounting: sequential device programs on the critical
+    # path.  Static = per group (1 prefill + max_budget-1 decode steps)
+    # = sum of group max budgets; continuous = its decode-loop steps plus
+    # ONE single-row prefill per request.
+    cont_dispatches = steps + len(reqs)
+    static_dispatches = sum(max(bgt for _, bgt in reqs[i:i + args.slots])
+                            for i in range(0, len(reqs), args.slots))
+    print(f"serving_demo: sequential dispatches {cont_dispatches} "
+          f"continuous (incl. {len(reqs)} prefills) vs "
+          f"{static_dispatches} static "
+          f"({static_dispatches / cont_dispatches:.2f}x)", flush=True)
+    print("serving_demo: done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -195,3 +195,8 @@ def test_streaming_train_driver_side_stop():
                "--stream_seconds", "2", "--batch_size", "8", timeout=300)
     assert "streaming_train: done" in out
     assert "stream ended after" in out
+
+
+def test_serving_demo():
+    out = _run("gpt/serving_demo.py", "--requests", "8", "--slots", "2")
+    assert "greedy-exact" in out and "serving_demo: done" in out

@@ -7,8 +7,6 @@ per-row-position substrate (``GPTConfig.per_row_positions``) against the
 reference implementation.
 """
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -145,3 +143,29 @@ def test_rolling_cache_rejected():
     cfg, params = _make(sliding_window=8, rolling_kv_cache=True)
     with pytest.raises(ValueError, match="rolling_kv_cache"):
         ContinuousBatcher(cfg, params, max_batch=2)
+
+
+@pytest.mark.parametrize("variant", ["int8", "gqa", "window"])
+def test_serving_composes_with_decode_features(variant):
+    """Continuous batching must stay greedy-exact under the decode
+    stack's other features: int8 weight-only quantization, grouped-query
+    attention, sliding-window attention (full-length cache)."""
+    kw = {}
+    if variant == "gqa":
+        kw["num_kv_heads"] = 2
+    if variant == "window":
+        kw["sliding_window"] = 8
+    cfg, params = _make("rope", **kw)
+    if variant == "int8":
+        from tensorflowonspark_tpu.ops import quantize_params
+
+        params = quantize_params(params)
+
+    rng = np.random.default_rng(3)
+    reqs = [(rng.integers(0, cfg.vocab_size, (t,)).astype(np.int32), n)
+            for t, n in ((4, 6), (7, 9), (3, 5))]
+    b = ContinuousBatcher(cfg, params, max_batch=2)
+    rids = [b.submit(p, n) for p, n in reqs]
+    results = b.run()
+    for rid, (p, n) in zip(rids, reqs):
+        np.testing.assert_array_equal(results[rid], _oracle(cfg, params, p, n))

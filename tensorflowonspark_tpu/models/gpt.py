@@ -740,6 +740,24 @@ def beam_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
     return out
 
 
+def nucleus_filter(logits, top_p):
+    """Top-p (nucleus) truncation on (already temperature-scaled) logits:
+    keep the smallest descending-sorted prefix whose mass reaches
+    ``top_p`` (HF order; the top token always survives), masking the rest
+    to ``-inf``.  Tokens TIED at the cutoff logit are all kept (threshold
+    semantics).  Shared by :func:`sample_generate` and the serving
+    batcher's per-row sampler (``models/serving.py``) so the two can
+    never drift.  Works on ``[..., V]``."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = cum - probs < top_p  # mass BEFORE this token
+    kept_min = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+        keepdims=True)
+    return jnp.where(logits < kept_min, -jnp.inf, logits)
+
+
 def sample_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
                     rng, *, temperature: float = 1.0,
                     top_k: int | None = None, top_p: float | None = None):
@@ -761,18 +779,7 @@ def sample_generate(cfg: GPTConfig, params, prompt_ids, max_new_tokens: int,
             return jnp.argmax(logits, axis=-1)
         logits = logits / temperature
         if top_p is not None and top_p < 1.0:
-            # nucleus on the TEMPERATURE-SCALED distribution (HF order):
-            # keep the smallest sorted prefix whose mass reaches top_p
-            # (the top token always survives)
-            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(sorted_logits, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            keep_sorted = cum - probs < top_p  # mass BEFORE this token
-            # threshold = smallest kept logit, mapped back per row
-            kept_min = jnp.min(
-                jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
-                keepdims=True)
-            logits = jnp.where(logits < kept_min, -jnp.inf, logits)
+            logits = nucleus_filter(logits, top_p)
         return jax.random.categorical(jax.random.fold_in(rng, i),
                                       logits, axis=-1)
 

@@ -28,10 +28,14 @@ traffic can pad prompts to a few bucket lengths), and one scatter
 executable.  The decode loop itself is plain Python — admission decisions
 are host-side control flow, exactly what should NOT be traced.
 
-Output contract: every request's tokens are **greedy-exact** — identical
-to a solo ``greedy_generate`` run on that prompt — regardless of
-admission order, slot reuse, or what else shares the batch (locked by
-``tests/test_serving.py``).
+Output contract (locked by ``tests/test_serving.py``): a request's
+tokens are a pure function of its own (params, prompt, budget,
+temperature, top_p, seed) — never of admission order, slot reuse, or
+what else shares the batch.  ``temperature=0`` (default) is
+**greedy-exact**: identical to a solo ``greedy_generate`` run on that
+prompt.  ``temperature>0`` samples the nucleus ``top_p`` (shared
+``nucleus_filter`` with ``sample_generate``), keyed
+``fold_in(key(seed), n)`` for the request's n-th token.
 """
 
 from __future__ import annotations
@@ -44,7 +48,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tensorflowonspark_tpu.models.gpt import GPT, GPTConfig, init_cache
+from tensorflowonspark_tpu.models.gpt import (GPT, GPTConfig, init_cache,
+                                              nucleus_filter)
 
 
 @dataclass
@@ -52,10 +57,34 @@ class _Slot:
     request_id: int
     remaining: int
     tokens: list = field(default_factory=list)  # generated so far
+    temperature: float = 0.0                    # 0 = greedy
+    top_p: float = 1.0
+    seed: int = 0
+
+
+def _select_tokens(logits, seeds, steps, temps, top_ps):
+    """Per-row next-token selection: greedy at temperature 0, else
+    nucleus (top-p) sampling at the given temperature.
+
+    Sampling is keyed ``fold_in(key(seed), step)`` where ``step`` is the
+    request's OWN generated-token count — so a request's n-th token
+    depends only on ``(seed, n)``, never on batch company, slot index, or
+    admission order (locked by tests/test_serving.py)."""
+    def pick(row, seed, step, temp, top_p):
+        key = jax.random.fold_in(jax.random.key(seed), step)
+        greedy = jnp.argmax(row)
+        scaled = row.astype(jnp.float32) / jnp.maximum(temp, 1e-6)
+        sampled = jax.random.categorical(key, nucleus_filter(scaled, top_p))
+        return jnp.where(temp <= 0.0, greedy, sampled)
+
+    return jax.vmap(pick)(logits, seeds, steps, temps, top_ps)
 
 
 class ContinuousBatcher:
-    """Admit/step/retire greedy-decode requests over one compiled batch.
+    """Admit/step/retire decode requests over one compiled batch —
+    greedy by default, per-request nucleus sampling via ``submit``'s
+    ``temperature``/``top_p``/``seed`` (deterministic per request,
+    independent of batch company).
 
     Usage::
 
@@ -86,18 +115,30 @@ class ContinuousBatcher:
         self.model = GPT(self.cfg, decode=True)
         self.cache = init_cache(self.cfg, params, self.max_batch)
         self.slots: list[_Slot | None] = [None] * self.max_batch
-        self._pending: list[tuple[int, np.ndarray, int]] = []
+        # (rid, prompt, budget, temperature, top_p, seed)
+        self._pending: list[tuple[int, np.ndarray, int,
+                                  float, float, int]] = []
         self._ids = itertools.count()
         self._results: dict[int, np.ndarray] = {}
-        self._prefill_jit: dict[int, object] = {}
+        self._prefill_jit: dict[int, object] = {}  # prompt_len -> jit
 
-        def step_fn(params, cache, tokens):
+        def step_greedy(params, cache, tokens):
             logits, vars_ = self.model.apply(
                 {"params": params, "cache": cache},
                 tokens[:, None], mutable=["cache"])
             return jnp.argmax(logits[:, -1], axis=-1), vars_["cache"]
 
-        self._step = jax.jit(step_fn, donate_argnums=(1,))
+        def step_sample(params, cache, tokens, seeds, steps, temps, top_ps):
+            logits, vars_ = self.model.apply(
+                {"params": params, "cache": cache},
+                tokens[:, None], mutable=["cache"])
+            nxt = _select_tokens(logits[:, -1], seeds, steps, temps, top_ps)
+            return nxt, vars_["cache"]
+
+        # two executables so all-greedy traffic (the common batch) never
+        # pays the per-row sort/sample computation
+        self._step = jax.jit(step_greedy, donate_argnums=(1,))
+        self._step_sample = jax.jit(step_sample, donate_argnums=(1,))
 
         def scatter_fn(cache, row, slot):
             """Write the single-row prefill cache into slot ``slot``."""
@@ -120,9 +161,17 @@ class ContinuousBatcher:
         free = sum(s is None for s in self.slots)
         return len(self._pending) < free
 
-    def submit(self, prompt_ids, max_new_tokens: int) -> int:
+    def submit(self, prompt_ids, max_new_tokens: int, *,
+               temperature: float = 0.0, top_p: float = 1.0,
+               seed: int = 0) -> int:
         """Queue a request; it is admitted into a slot on the next
-        ``step()`` with a free slot.  Returns the request id."""
+        ``step()`` with a free slot.  Returns the request id.
+
+        ``temperature=0`` (default) decodes greedily — token-identical to
+        a solo ``greedy_generate`` run.  ``temperature>0`` samples from
+        the nucleus ``top_p`` at that temperature, keyed by ``seed``:
+        the output is a pure function of (params, prompt, budget,
+        temperature, top_p, seed) — batch company never changes it."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -130,6 +179,12 @@ class ContinuousBatcher:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens} "
                 "(the greedy-exact contract has no 0-token decode)")
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if not 0 < top_p <= 1:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if not -2**31 <= seed < 2**31:
+            raise ValueError(f"seed must fit int32, got {seed}")
         total = prompt.size + max_new_tokens
         if total > self.cfg.max_position_embeddings:
             raise ValueError(
@@ -138,20 +193,32 @@ class ContinuousBatcher:
                 f"max_position_embeddings "
                 f"({self.cfg.max_position_embeddings})")
         rid = next(self._ids)
-        self._pending.append((rid, prompt, int(max_new_tokens)))
+        self._pending.append((rid, prompt, int(max_new_tokens),
+                              float(temperature), float(top_p), int(seed)))
         return rid
 
-    def _prefill(self, prompt: np.ndarray):
+    def _prefill(self, prompt: np.ndarray, temperature: float,
+                 top_p: float, seed: int):
+        # one executable per prompt length: _select_tokens reduces to
+        # argmax at temperature 0, so greedy needs no separate trace
+        # (prefill runs once per request — the sampling math is noise)
         T0 = prompt.size
         if T0 not in self._prefill_jit:
-            def prefill_fn(params, prompt_row):
+            def prefill_fn(params, prompt_row, seeds, temps, top_ps):
                 cache1 = init_cache(self.cfg, params, 1)
                 logits, vars_ = self.model.apply(
                     {"params": params, "cache": cache1},
                     prompt_row, mutable=["cache"])
-                return jnp.argmax(logits[:, -1], axis=-1), vars_["cache"]
+                first = _select_tokens(
+                    logits[:, -1], seeds, jnp.zeros((1,), jnp.int32),
+                    temps, top_ps)
+                return first, vars_["cache"]
             self._prefill_jit[T0] = jax.jit(prefill_fn)
-        return self._prefill_jit[T0](self.params, prompt[None, :])
+        return self._prefill_jit[T0](
+            self.params, prompt[None, :],
+            jnp.asarray([seed], jnp.int32),
+            jnp.asarray([temperature], jnp.float32),
+            jnp.asarray([top_p], jnp.float32))
 
     def _admit(self) -> list[int]:
         """Fill free slots from the pending queue; returns the ids of
@@ -161,11 +228,12 @@ class ContinuousBatcher:
         for i, slot in enumerate(self.slots):
             if slot is not None or not self._pending:
                 continue
-            rid, prompt, budget = self._pending.pop(0)
-            first, row_cache = self._prefill(prompt)
+            rid, prompt, budget, temp, top_p, seed = self._pending.pop(0)
+            first, row_cache = self._prefill(prompt, temp, top_p, seed)
             tok = int(first[0])
             self.cache = self._scatter(self.cache, row_cache, i)
-            s = _Slot(request_id=rid, remaining=budget - 1, tokens=[tok])
+            s = _Slot(request_id=rid, remaining=budget - 1, tokens=[tok],
+                      temperature=temp, top_p=top_p, seed=seed)
             if s.remaining <= 0 or tok == self.eos_id:
                 self._finish(i, s)      # slot stays free for the next one
                 done.append(rid)
@@ -187,7 +255,19 @@ class ContinuousBatcher:
             return done
         tokens = jnp.asarray([s.tokens[-1] if s else 0
                               for s in self.slots], jnp.int32)
-        nxt, self.cache = self._step(self.params, self.cache, tokens)
+        if any(s is not None and s.temperature > 0 for s in self.slots):
+            nxt, self.cache = self._step_sample(
+                self.params, self.cache, tokens,
+                jnp.asarray([s.seed if s else 0 for s in self.slots],
+                            jnp.int32),
+                jnp.asarray([len(s.tokens) if s else 0 for s in self.slots],
+                            jnp.int32),
+                jnp.asarray([s.temperature if s else 0.0
+                             for s in self.slots], jnp.float32),
+                jnp.asarray([s.top_p if s else 1.0 for s in self.slots],
+                            jnp.float32))
+        else:
+            nxt, self.cache = self._step(self.params, self.cache, tokens)
         nxt = np.asarray(nxt)
         for i, s in enumerate(self.slots):
             if s is None:

@@ -206,3 +206,75 @@ def test_serving_with_tp_sharded_params_under_mesh():
             want = np.asarray(greedy_generate(
                 cfg, sharded, jnp.asarray(p)[None, :], n))[0, len(p):]
             np.testing.assert_array_equal(results[rid], want)
+
+
+def test_sampling_deterministic_and_company_independent():
+    """A sampled request's tokens are a pure function of (seed, temp,
+    top_p) — identical alone, batched with greedy neighbors, or after
+    slot churn; and greedy neighbors stay greedy-exact next to it."""
+    cfg, params = _make()
+    rng = np.random.default_rng(5)
+    p = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+    pg = rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+
+    def sampled_run(extra_greedy):
+        b = ContinuousBatcher(cfg, params, max_batch=2)
+        rid = b.submit(p, 9, temperature=0.8, top_p=0.9, seed=123)
+        gids = [b.submit(pg, n) for n in extra_greedy]
+        res = b.run()
+        return res[rid], [res[g] for g in gids]
+
+    alone, _ = sampled_run([])
+    with_company, greedy_outs = sampled_run([6, 3, 7])
+    np.testing.assert_array_equal(alone, with_company)
+    for g in greedy_outs:
+        np.testing.assert_array_equal(
+            g, _oracle(cfg, params, pg, len(g)))
+
+    # a different seed must (overwhelmingly) change the trajectory
+    b = ContinuousBatcher(cfg, params, max_batch=1)
+    rid = b.submit(p, 9, temperature=0.8, top_p=0.9, seed=124)
+    other = b.run()[rid]
+    assert not np.array_equal(alone, other)
+
+
+def test_tiny_top_p_equals_greedy():
+    """top_p -> 0 keeps only the argmax token: sampling must reduce to
+    the greedy trajectory at any temperature."""
+    cfg, params = _make()
+    rng = np.random.default_rng(6)
+    p = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    b = ContinuousBatcher(cfg, params, max_batch=1)
+    rid = b.submit(p, 8, temperature=1.3, top_p=1e-6, seed=7)
+    np.testing.assert_array_equal(b.run()[rid], _oracle(cfg, params, p, 8))
+
+
+def test_sampling_validation():
+    cfg, params = _make()
+    b = ContinuousBatcher(cfg, params, max_batch=1)
+    with pytest.raises(ValueError, match="temperature"):
+        b.submit(np.array([1], np.int32), 2, temperature=-0.1)
+    with pytest.raises(ValueError, match="top_p"):
+        b.submit(np.array([1], np.int32), 2, top_p=0.0)
+    with pytest.raises(ValueError, match="top_p"):
+        b.submit(np.array([1], np.int32), 2, top_p=1.5)
+
+
+def test_seed_must_fit_int32():
+    cfg, params = _make()
+    b = ContinuousBatcher(cfg, params, max_batch=1)
+    with pytest.raises(ValueError, match="seed"):
+        b.submit(np.array([1], np.int32), 2, temperature=0.5, seed=2**35)
+
+
+def test_batcher_nucleus_matches_sample_generate_filter():
+    """Serving and sample_generate share nucleus_filter — same kept set
+    (ties included) on a crafted tied distribution."""
+    from tensorflowonspark_tpu.models.gpt import nucleus_filter
+
+    logits = jnp.asarray([3.0, 2.0, 2.0, 0.0, -1.0])
+    out = nucleus_filter(logits, 0.75)
+    # top token (p~0.58) kept; both TIED 2.0 tokens kept (threshold
+    # semantics), tail masked
+    assert np.isfinite(np.asarray(out[:3])).all()
+    assert np.isneginf(np.asarray(out[3:])).all()

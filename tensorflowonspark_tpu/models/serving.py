@@ -23,10 +23,12 @@ static-shape KV cache:
 
 Everything on the hot path is compiled exactly once: ONE decode-step
 executable for the whole lifetime (all shapes static), one prefill
-executable per distinct prompt length (callers that control their
-traffic can pad prompts to a few bucket lengths), and one scatter
-executable.  The decode loop itself is plain Python — admission decisions
-are host-side control flow, exactly what should NOT be traced.
+executable per power-of-two prompt BUCKET (prompts are right-padded
+internally and the pad positions provably never leak — see
+``_prefill``; arbitrary-length traffic costs O(log max_len) compiles,
+not one per length), and one scatter executable.  The decode loop
+itself is plain Python — admission decisions are host-side control
+flow, exactly what should NOT be traced.
 
 Output contract (locked by ``tests/test_serving.py``): a request's
 tokens are a pure function of its own (params, prompt, budget,
@@ -49,7 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tensorflowonspark_tpu.models.gpt import (GPT, GPTConfig, init_cache,
-                                              nucleus_filter)
+                                              nucleus_filter, rewind_cache)
 
 
 @dataclass
@@ -120,7 +122,7 @@ class ContinuousBatcher:
                                   float, float, int]] = []
         self._ids = itertools.count()
         self._results: dict[int, np.ndarray] = {}
-        self._prefill_jit: dict[int, object] = {}  # prompt_len -> jit
+        self._prefill_jit: dict[int, object] = {}  # pow2 bucket_len -> jit
 
         def step_greedy(params, cache, tokens):
             logits, vars_ = self.model.apply(
@@ -199,23 +201,42 @@ class ContinuousBatcher:
 
     def _prefill(self, prompt: np.ndarray, temperature: float,
                  top_p: float, seed: int):
-        # one executable per prompt length: _select_tokens reduces to
-        # argmax at temperature 0, so greedy needs no separate trace
-        # (prefill runs once per request — the sampling math is noise)
+        """Prefill one request on a fresh single-row cache — BUCKETED:
+        the prompt is right-padded to the next power-of-two length, so
+        the compile count is O(log max_len) instead of O(distinct prompt
+        lengths) (a TPU compile is tens of seconds; arbitrary serving
+        traffic must not pay one per length).
+
+        Why padding is exact: prefill attention is causal, so pad tokens
+        never influence the true last position's logits (selected at
+        ``true_len - 1``); the cache counters are then REWOUND to the
+        true length, after which the positional visibility mask hides
+        every pad slot (``k_pos > q_pos``) until the decode loop
+        overwrites it with a real token's K/V in the same forward that
+        first makes it visible.  (One executable also serves greedy and
+        sampled requests: ``_select_tokens`` reduces to argmax at
+        temperature 0, and prefill runs once per request.)"""
         T0 = prompt.size
-        if T0 not in self._prefill_jit:
-            def prefill_fn(params, prompt_row, seeds, temps, top_ps):
+        Tp = min(1 << (T0 - 1).bit_length(),
+                 self.cfg.max_position_embeddings)
+        padded = np.zeros((Tp,), np.int32)
+        padded[:T0] = prompt
+        if Tp not in self._prefill_jit:
+            def prefill_fn(params, prompt_row, true_len, seeds, temps,
+                           top_ps):
                 cache1 = init_cache(self.cfg, params, 1)
                 logits, vars_ = self.model.apply(
                     {"params": params, "cache": cache1},
                     prompt_row, mutable=["cache"])
+                last = jnp.take_along_axis(
+                    logits, (true_len - 1)[:, None, None], axis=1)[:, 0]
                 first = _select_tokens(
-                    logits[:, -1], seeds, jnp.zeros((1,), jnp.int32),
-                    temps, top_ps)
-                return first, vars_["cache"]
-            self._prefill_jit[T0] = jax.jit(prefill_fn)
-        return self._prefill_jit[T0](
-            self.params, prompt[None, :],
+                    last, seeds, jnp.zeros((1,), jnp.int32), temps, top_ps)
+                return first, rewind_cache(vars_["cache"], true_len[0])
+            self._prefill_jit[Tp] = jax.jit(prefill_fn)
+        return self._prefill_jit[Tp](
+            self.params, padded[None, :],
+            jnp.asarray([T0], jnp.int32),
             jnp.asarray([seed], jnp.int32),
             jnp.asarray([temperature], jnp.float32),
             jnp.asarray([top_p], jnp.float32))

@@ -278,3 +278,20 @@ def test_batcher_nucleus_matches_sample_generate_filter():
     # semantics), tail masked
     assert np.isfinite(np.asarray(out[:3])).all()
     assert np.isneginf(np.asarray(out[3:])).all()
+
+
+def test_prefill_bucketing_is_exact_and_bounds_compiles():
+    """Right-padded power-of-two prefill buckets: every prompt length in
+    3..9 stays greedy-exact, and the compile count is the bucket count
+    (4, 8, 16), not the length count."""
+    cfg, params = _make()
+    rng = np.random.default_rng(8)
+    b = ContinuousBatcher(cfg, params, max_batch=2)
+    reqs = [(rng.integers(0, cfg.vocab_size, (t,)).astype(np.int32), 6)
+            for t in range(3, 10)]
+    rids = [b.submit(p, n) for p, n in reqs]
+    results = b.run()
+    for rid, (p, n) in zip(rids, reqs):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(cfg, params, p, n))
+    assert set(b._prefill_jit) == {4, 8, 16}, sorted(b._prefill_jit)

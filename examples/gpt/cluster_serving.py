@@ -1,0 +1,143 @@
+"""Cluster-hosted continuous-batching inference.
+
+Ties the serving stack into the cluster runtime: the DRIVER pushes decode
+requests through the SPARK-mode data plane (``cluster.inference`` — push
+n items, collect n results, partition order preserved), and each WORKER
+hosts a ``ContinuousBatcher`` so requests stream through its slots
+mid-flight instead of waiting for a fixed batch to assemble.  This is
+the reference's ``TFCluster.inference`` usage pattern (SURVEY.md §3.3)
+with a modern serving engine behind the feed — the worker keeps ONE
+compiled decode step across every request it ever serves.
+
+Each request is ``(prompt tokens..., budget)`` encoded as one int list;
+each result is the generated continuation.  Every worker's results are
+asserted greedy-exact against solo ``greedy_generate`` runs driver-side.
+
+Run: ``python examples/gpt/cluster_serving.py [--cpu] [--requests 12]``
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+VOCAB, HIDDEN, LAYERS, HEADS, MAXLEN = 83, 32, 2, 4, 64
+
+
+def _cfg():
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import GPTConfig
+
+    return GPTConfig(vocab_size=VOCAB, hidden_size=HIDDEN,
+                     num_layers=LAYERS, num_heads=HEADS,
+                     intermediate_size=2 * HIDDEN,
+                     max_position_embeddings=MAXLEN,
+                     dtype=jnp.float32, pos_encoding="rope")
+
+
+def map_fun(args, ctx):
+    """Worker: host a ContinuousBatcher behind the DataFeed queues."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import GPT, ContinuousBatcher
+
+    cfg = _cfg()
+    params = GPT(cfg).init(jax.random.key(args["seed"]),
+                           jnp.ones((1, 4), jnp.int32))["params"]
+    batcher = ContinuousBatcher(cfg, params, max_batch=args["slots"])
+
+    from collections import deque
+
+    feed = ctx.get_data_feed()
+    order: deque = deque()     # request ids in arrival order
+    inflight: set = set()
+    finished: dict = {}        # request id -> tokens (pruned at emit)
+    emitted = 0
+    while not feed.should_stop() or inflight:
+        # admit as many arrivals as there are free slots, then step once;
+        # results are emitted IN ARRIVAL ORDER (the inference contract).
+        # Poll near-non-blocking while slots are decoding — a blocking
+        # wait here would stall every in-flight request; block only when
+        # fully idle.
+        while batcher.has_free_slot() and not feed.should_stop():
+            try:
+                batch = feed.next_batch(
+                    1, timeout=0.1 if inflight else 2)
+            except TimeoutError:
+                break          # nothing queued right now; keep decoding
+            if not batch:
+                break
+            req = list(batch[0])
+            prompt, budget = req[:-1], req[-1]
+            rid = batcher.submit(prompt, budget)
+            inflight.add(rid)
+            order.append(rid)
+        if not inflight:
+            continue
+        done = batcher.step()
+        inflight.difference_update(done)
+        finished.update(
+            {rid: batcher.result(rid, pop=True) for rid in done})
+        while order and order[0] in finished:
+            feed.batch_results([finished.pop(order.popleft()).tolist()])
+            emitted += 1
+    print(f"cluster_serving: node {ctx.task_index} served "
+          f"{emitted} requests", flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--requests", type=int, default=12)
+    p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--workers", type=int, default=2)
+    args = p.parse_args()
+    if args.cpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import TPUCluster
+
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(0, VOCAB, (int(rng.integers(3, 9)),)).tolist(),
+             int(rng.integers(3, 12))) for _ in range(args.requests)]
+    data = [p + [n] for p, n in reqs]
+
+    cluster = TPUCluster.run(map_fun, {"slots": args.slots, "seed": 0},
+                             num_workers=args.workers,
+                             worker_env={"JAX_PLATFORMS": "cpu"}
+                             if args.cpu else None,
+                             reservation_timeout=90)
+    results = cluster.inference(data)
+    cluster.shutdown(timeout=120)
+    assert len(results) == len(reqs), (len(results), len(reqs))
+
+    # driver-side oracle: same params (seeded init), solo greedy runs
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import GPT, greedy_generate
+
+    cfg = _cfg()
+    params = GPT(cfg).init(jax.random.key(0),
+                           jnp.ones((1, 4), jnp.int32))["params"]
+    # inference() preserves order: partitions are contiguous splits
+    # (util.split_evenly) concatenated back by partition index
+    for idx, got in enumerate(results):
+        prompt, budget = reqs[idx]
+        want = np.asarray(greedy_generate(
+            cfg, params, jnp.asarray(prompt, jnp.int32)[None, :],
+            budget))[0, len(prompt):]
+        assert list(got) == want.tolist(), f"request {idx} diverged"
+    print(f"cluster_serving: {len(results)} requests greedy-exact "
+          f"across {args.workers} workers", flush=True)
+    print("cluster_serving: done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

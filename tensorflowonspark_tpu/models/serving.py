@@ -301,6 +301,18 @@ class ContinuousBatcher:
                 self._finish(i, s)
         return done
 
+    def result(self, request_id: int, *, pop: bool = False) \
+            -> np.ndarray | None:
+        """Generated tokens of a FINISHED request (prompt excluded), or
+        None while it is still pending/decoding — the non-blocking
+        accessor for drivers that interleave ``step()`` with their own
+        event loop instead of calling ``run()``.  ``pop=True`` releases
+        the stored tokens, keeping a long-lived batcher's memory bounded
+        by the in-flight set instead of every request ever served."""
+        if pop:
+            return self._results.pop(request_id, None)
+        return self._results.get(request_id)
+
     def run(self) -> dict[int, np.ndarray]:
         """Drive ``step()`` until every submitted request has finished;
         returns ``{request_id: generated tokens}`` (prompt excluded)."""

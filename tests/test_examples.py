@@ -200,3 +200,10 @@ def test_streaming_train_driver_side_stop():
 def test_serving_demo():
     out = _run("gpt/serving_demo.py", "--requests", "8", "--slots", "2")
     assert "greedy-exact" in out and "serving_demo: done" in out
+
+
+def test_cluster_serving():
+    out = _run("gpt/cluster_serving.py", "--requests", "8", "--workers", "2",
+               timeout=420)
+    assert "greedy-exact across 2 workers" in out
+    assert "cluster_serving: done" in out

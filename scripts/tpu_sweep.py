@@ -221,6 +221,13 @@ def stage_flash() -> dict:
         p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
+    def dense_causal(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (D ** 0.5)
+        pos = jnp.arange(s.shape[-1])
+        s = jnp.where(pos[:, None] >= pos[None, :], s, -1e30)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
     def timeit(fn, *args, iters=20):
         from tensorflowonspark_tpu.util import host_fetch_drain
 
@@ -271,6 +278,26 @@ def stage_flash() -> dict:
         section(f"window{w}_ms",
                 lambda q, k, v, w=w: flash_attention(q, k, v, causal=True,
                                                      window=w), q, k, v)
+
+    # TRAINING regime: forward + backward through the custom VJP — the
+    # number that decides whether flash should be the training-attention
+    # default (fwd-only above decides the inference default)
+    def fwdbwd(attn_fn):
+        def loss(q, k, v):
+            return attn_fn(q, k, v).astype(jnp.float32).sum()
+        return jax.grad(loss, argnums=(0, 1, 2))
+
+    section("dense_fwdbwd_ms", fwdbwd(dense), q, k, v)
+    section("flash_fwdbwd_ms",
+            fwdbwd(lambda q, k, v: flash_attention(q, k, v, causal=True)),
+            q, k, v)
+    section("dense_causal_fwdbwd_ms",
+            fwdbwd(lambda q, k, v: dense_causal(q, k, v)), q, k, v)
+    if isinstance(out.get("flash_fwdbwd_ms"), float) \
+            and isinstance(out.get("dense_causal_fwdbwd_ms"), float):
+        out["fwdbwd_speedup_vs_dense_causal"] = round(
+            out["dense_causal_fwdbwd_ms"] / out["flash_fwdbwd_ms"], 3)
+        _write("flash_sweep.json", out)
     return out
 
 

@@ -27,8 +27,6 @@ from __future__ import annotations
 
 import logging
 import os
-import socket
-import sys
 import tempfile
 import traceback
 

@@ -25,10 +25,13 @@ Everything on the hot path is compiled exactly once: ONE decode-step
 executable for the whole lifetime (all shapes static), one prefill
 executable per power-of-two prompt BUCKET (prompts are right-padded
 internally and the pad positions provably never leak — see
-``_prefill``; arbitrary-length traffic costs O(log max_len) compiles,
-not one per length), and one scatter executable.  The decode loop
-itself is plain Python — admission decisions are host-side control
-flow, exactly what should NOT be traced.
+``_prefill_final``; arbitrary-length traffic costs O(log max_len)
+compiles, not one per length; with ``prefill_chunk`` long prompts add
+one fixed-chunk executable and stream through the cache with
+O(chunk x max_len) transient attention memory), and one scatter
+executable.  The decode loop itself is plain Python — admission
+decisions are host-side control flow, exactly what should NOT be
+traced.
 
 Output contract (locked by ``tests/test_serving.py``): a request's
 tokens are a pure function of its own (params, prompt, budget,
@@ -102,12 +105,23 @@ class ContinuousBatcher:
     """
 
     def __init__(self, cfg: GPTConfig, params, max_batch: int,
-                 eos_id: int | None = None):
+                 eos_id: int | None = None,
+                 prefill_chunk: int | None = None):
         if cfg.rolling_kv_cache:
             raise ValueError("ContinuousBatcher requires a full-length "
                              "cache (rolling_kv_cache=False)")
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, "
+                             f"got {prefill_chunk}")
+        #: long-context admission: prompts longer than this are prefilled
+        #: in fixed-size chunks through the SAME cached decode path (the
+        #: cache index advances per chunk), bounding the transient
+        #: attention-score memory at O(chunk x max_len) instead of
+        #: O(prompt x max_len) — the chunk loop adds executables only for
+        #: (one fixed chunk length + the bucketed final chunk)
+        self.prefill_chunk = prefill_chunk
         self.cfg = dataclasses.replace(cfg, per_row_positions=True)
         # prefill runs single-row, where per-row == scalar semantics; one
         # cfg keeps the two paths' traces structurally identical
@@ -122,7 +136,9 @@ class ContinuousBatcher:
                                   float, float, int]] = []
         self._ids = itertools.count()
         self._results: dict[int, np.ndarray] = {}
-        self._prefill_jit: dict[int, object] = {}  # pow2 bucket_len -> jit
+        # compiled-prefill registry: ("final", pow2_bucket) -> jit,
+        # ("chunk", chunk_len) -> jit, "row_zeros" -> cache allocator
+        self._prefill_jit: dict = {}
 
         def step_greedy(params, cache, tokens):
             logits, vars_ = self.model.apply(
@@ -199,44 +215,84 @@ class ContinuousBatcher:
                               float(temperature), float(top_p), int(seed)))
         return rid
 
+    def _fresh_row_cache(self):
+        """Zeroed single-row cache (compiled allocation, cached trace)."""
+        if "row_zeros" not in self._prefill_jit:
+            template = jax.eval_shape(
+                lambda: init_cache(self.cfg, self.params, 1))
+            self._prefill_jit["row_zeros"] = jax.jit(
+                lambda: jax.tree.map(
+                    lambda t: jnp.zeros(t.shape, t.dtype), template))
+        return self._prefill_jit["row_zeros"]()
+
     def _prefill(self, prompt: np.ndarray, temperature: float,
                  top_p: float, seed: int):
-        """Prefill one request on a fresh single-row cache — BUCKETED:
-        the prompt is right-padded to the next power-of-two length, so
-        the compile count is O(log max_len) instead of O(distinct prompt
-        lengths) (a TPU compile is tens of seconds; arbitrary serving
-        traffic must not pay one per length).
-
-        Why padding is exact: prefill attention is causal, so pad tokens
-        never influence the true last position's logits (selected at
-        ``true_len - 1``); the cache counters are then REWOUND to the
-        true length, after which the positional visibility mask hides
-        every pad slot (``k_pos > q_pos``) until the decode loop
-        overwrites it with a real token's K/V in the same forward that
-        first makes it visible.  (One executable also serves greedy and
-        sampled requests: ``_select_tokens`` reduces to argmax at
-        temperature 0, and prefill runs once per request.)"""
+        """Dispatch: whole-prompt prefill (bucketed), or the chunk loop
+        for prompts beyond ``prefill_chunk`` (long-context admission with
+        O(chunk x max_len) transient attention memory)."""
+        C = self.prefill_chunk
+        if C is None or prompt.size <= C:
+            # whole-prompt path: one bucketed final call on a fresh cache
+            return self._prefill_final(self._fresh_row_cache(), prompt,
+                                       prompt.size, temperature, top_p,
+                                       seed)
         T0 = prompt.size
-        Tp = min(1 << (T0 - 1).bit_length(),
+        if ("chunk", C) not in self._prefill_jit:
+            def chunk_fn(params, cache, tokens_row):
+                _, vars_ = self.model.apply(
+                    {"params": params, "cache": cache},
+                    tokens_row, mutable=["cache"])
+                return vars_["cache"]
+            self._prefill_jit[("chunk", C)] = jax.jit(
+                chunk_fn, donate_argnums=(1,))
+        cache = self._fresh_row_cache()
+        n_full = (T0 - 1) // C          # >= 1 token left for the final call
+        for i in range(n_full):
+            cache = self._prefill_jit[("chunk", C)](
+                self.params, cache, prompt[None, i * C:(i + 1) * C])
+        return self._prefill_final(cache, prompt[n_full * C:], T0,
+                                   temperature, top_p, seed)
+
+    def _prefill_final(self, cache, rest: np.ndarray, true_total: int,
+                       temperature: float, top_p: float, seed: int):
+        """THE bucketed prefill call — both the whole-prompt path (on a
+        fresh cache, ``true_total == rest.size``) and the last chunk of
+        a chunked prefill end here.
+
+        ``rest`` is right-padded to the next power-of-two length, so the
+        compile count is O(log max_len) instead of O(distinct lengths)
+        (a TPU compile is tens of seconds; arbitrary serving traffic
+        must not pay one per length).  Why padding is exact: prefill
+        attention is causal, so pad tokens never influence the true
+        last position's logits (selected at ``true_len - 1``); the
+        cache counters are then REWOUND to ``true_total``, after which
+        the positional visibility mask hides every pad slot
+        (``k_pos > q_pos``) until the decode loop overwrites it with a
+        real token's K/V in the same forward that first makes it
+        visible.  One executable serves greedy and sampled requests
+        (``_select_tokens`` reduces to argmax at temperature 0)."""
+        Tr = rest.size
+        Tp = min(1 << (Tr - 1).bit_length(),
                  self.cfg.max_position_embeddings)
         padded = np.zeros((Tp,), np.int32)
-        padded[:T0] = prompt
-        if Tp not in self._prefill_jit:
-            def prefill_fn(params, prompt_row, true_len, seeds, temps,
-                           top_ps):
-                cache1 = init_cache(self.cfg, params, 1)
+        padded[:Tr] = rest
+        key = ("final", Tp)
+        if key not in self._prefill_jit:
+            def final_fn(params, cache, tokens_row, true_len, true_tot,
+                         seeds, temps, top_ps):
                 logits, vars_ = self.model.apply(
-                    {"params": params, "cache": cache1},
-                    prompt_row, mutable=["cache"])
+                    {"params": params, "cache": cache},
+                    tokens_row, mutable=["cache"])
                 last = jnp.take_along_axis(
                     logits, (true_len - 1)[:, None, None], axis=1)[:, 0]
                 first = _select_tokens(
                     last, seeds, jnp.zeros((1,), jnp.int32), temps, top_ps)
-                return first, rewind_cache(vars_["cache"], true_len[0])
-            self._prefill_jit[Tp] = jax.jit(prefill_fn)
-        return self._prefill_jit[Tp](
-            self.params, padded[None, :],
-            jnp.asarray([T0], jnp.int32),
+                return first, rewind_cache(vars_["cache"], true_tot[0])
+            self._prefill_jit[key] = jax.jit(final_fn, donate_argnums=(1,))
+        return self._prefill_jit[key](
+            self.params, cache, padded[None, :],
+            jnp.asarray([Tr], jnp.int32),
+            jnp.asarray([true_total], jnp.int32),
             jnp.asarray([seed], jnp.int32),
             jnp.asarray([temperature], jnp.float32),
             jnp.asarray([top_p], jnp.float32))

@@ -294,4 +294,28 @@ def test_prefill_bucketing_is_exact_and_bounds_compiles():
     for rid, (p, n) in zip(rids, reqs):
         np.testing.assert_array_equal(results[rid],
                                       _oracle(cfg, params, p, n))
-    assert set(b._prefill_jit) == {4, 8, 16}, sorted(b._prefill_jit)
+    assert {k for k in b._prefill_jit if isinstance(k, tuple)} \
+        == {("final", 4), ("final", 8), ("final", 16)}, \
+        sorted(map(str, b._prefill_jit))
+
+
+@pytest.mark.parametrize("pos_encoding", ["rope", "learned"])
+def test_chunked_prefill_matches_whole(pos_encoding):
+    """Long-context admission: prompts prefilled in fixed chunks through
+    the cached decode path are greedy-exact vs the whole-prompt oracle,
+    and the chunk loop adds only (chunk + final-bucket) executables."""
+    cfg, params = _make(pos_encoding)
+    rng = np.random.default_rng(9)
+    b = ContinuousBatcher(cfg, params, max_batch=2, prefill_chunk=6)
+    reqs = [(rng.integers(0, cfg.vocab_size, (t,)).astype(np.int32), 5)
+            for t in (20, 23, 4)]   # 4 <= chunk -> whole-prompt path
+    rids = [b.submit(p, n) for p, n in reqs]
+    results = b.run()
+    for rid, (p, n) in zip(rids, reqs):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(cfg, params, p, n))
+    keys = set(b._prefill_jit)
+    assert ("chunk", 6) in keys
+    # chunked finals (rest 2, 5 -> buckets 2, 8) + the short whole prompt
+    assert {k for k in keys if isinstance(k, tuple) and k[0] == "final"} \
+        == {("final", 2), ("final", 8), ("final", 4)}

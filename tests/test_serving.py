@@ -145,21 +145,22 @@ def test_rolling_cache_rejected():
         ContinuousBatcher(cfg, params, max_batch=2)
 
 
-@pytest.mark.parametrize("variant", ["int8", "gqa", "window"])
+@pytest.mark.parametrize("variant", ["int8", "int4", "gqa", "window"])
 def test_serving_composes_with_decode_features(variant):
     """Continuous batching must stay greedy-exact under the decode
-    stack's other features: int8 weight-only quantization, grouped-query
-    attention, sliding-window attention (full-length cache)."""
+    stack's other features: int8/int4 weight-only quantization,
+    grouped-query attention, sliding-window attention (full cache)."""
     kw = {}
     if variant == "gqa":
         kw["num_kv_heads"] = 2
     if variant == "window":
         kw["sliding_window"] = 8
     cfg, params = _make("rope", **kw)
-    if variant == "int8":
+    if variant in ("int8", "int4"):
         from tensorflowonspark_tpu.ops import quantize_params
 
-        params = quantize_params(params)
+        params = quantize_params(params,
+                                 bits=4 if variant == "int4" else 8)
 
     rng = np.random.default_rng(3)
     reqs = [(rng.integers(0, cfg.vocab_size, (t,)).astype(np.int32), n)

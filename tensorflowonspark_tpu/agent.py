@@ -45,7 +45,9 @@ import tempfile
 import threading
 import time
 
-from tensorflowonspark_tpu.reservation import MessageSocket, get_ip_address
+from tensorflowonspark_tpu.reservation import (FrameFormatError,
+                                               MessageSocket, _peer_name,
+                                               get_ip_address)
 
 logger = logging.getLogger(__name__)
 
@@ -149,6 +151,11 @@ class HostAgent(MessageSocket):
                     try:
                         msg = self.receive(sock)
                         self._handle(sock, msg)
+                    except FrameFormatError as e:
+                        logger.error("dropping peer %s: %s",
+                                     _peer_name(sock), e)
+                        sock.close()
+                        conns.remove(sock)
                     except (EOFError, OSError, pickle.PickleError):
                         sock.close()
                         conns.remove(sock)

@@ -131,6 +131,13 @@ class ContinuousBatcher:
         self.model = GPT(self.cfg, decode=True)
         self.cache = init_cache(self.cfg, params, self.max_batch)
         self.slots: list[_Slot | None] = [None] * self.max_batch
+        #: set to the original error message the first time a device step
+        #: raises mid-flight; every executable donates the cache buffer
+        #: (``donate_argnums``), so after a failed dispatch the previous
+        #: cache is already consumed and slot/device state can no longer
+        #: be trusted — the instance refuses further use instead of
+        #: silently decoding from a poisoned cache
+        self._poisoned: str | None = None
         # (rid, prompt, budget, temperature, top_p, seed)
         self._pending: list[tuple[int, np.ndarray, int,
                                   float, float, int]] = []
@@ -171,6 +178,14 @@ class ContinuousBatcher:
 
         self._scatter = jax.jit(scatter_fn, donate_argnums=(0,))
 
+    def _check_usable(self) -> None:
+        if self._poisoned is not None:
+            raise RuntimeError(
+                "ContinuousBatcher is unusable: a device step failed "
+                "after its KV cache was donated, so in-flight requests "
+                "and the cache are unrecoverable. Build a new batcher "
+                f"and resubmit. Original error: {self._poisoned}")
+
     # -- admission ---------------------------------------------------------
     def has_free_slot(self) -> bool:
         """True while another ``submit`` would find a slot: queued-but-
@@ -190,6 +205,7 @@ class ContinuousBatcher:
         the nucleus ``top_p`` at that temperature, keyed by ``seed``:
         the output is a pure function of (params, prompt, budget,
         temperature, top_p, seed) — batch company never changes it."""
+        self._check_usable()
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -326,7 +342,21 @@ class ContinuousBatcher:
     def step(self) -> list[int]:
         """Admit pending requests into free slots, run ONE decode step for
         every active slot, and return every request id that finished —
-        whether during decode or already at admission."""
+        whether during decode or already at admission.
+
+        If a device dispatch raises (OOM, preemption, a dead tunnel),
+        the batcher is marked unusable — the failing executable had
+        already donated the cache buffer, so the instance cannot be
+        resumed — and every later call raises ``RuntimeError`` naming
+        the original failure."""
+        self._check_usable()
+        try:
+            return self._step_inner()
+        except Exception as e:
+            self._poisoned = f"{type(e).__name__}: {e}"
+            raise
+
+    def _step_inner(self) -> list[int]:
         done = self._admit()
         if not any(self.slots):
             return done

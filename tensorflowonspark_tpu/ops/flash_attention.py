@@ -55,9 +55,17 @@ def _on_tpu() -> bool:
 def _tuned_blocks() -> tuple[int, int]:
     """Default ``(block_q, block_k)``: the best point of the committed
     on-chip block sweep when one exists, else (512, 512).  Read once per
-    process at first trace, so a sweep captured later takes effect on the
-    next start — the same artifact-anchoring pattern as the scaling
-    model's MFU table."""
+    process at first trace (``lru_cache``), so a sweep captured later
+    takes effect on the next start — the same artifact-anchoring pattern
+    as the scaling model's MFU table.
+
+    Deliberately a single-point heuristic: the sweep tunes ONE shape
+    (the artifact's ``shape`` field — B8 T2048 H16 D64 bf16 forward) and
+    that best block is applied process-wide to every shape, window, and
+    the backward pass.  ``_pick_block`` clamps it for shorter sequences,
+    and callers with a known-different regime pass ``block_q``/``block_k``
+    explicitly; a per-(seq, mode) table is not worth the compile-cache
+    fragmentation until a measured shape shows the single point losing."""
     try:
         with open(_FLASH_SWEEP_PATH) as f:
             best = json.load(f).get("best_block")

@@ -34,7 +34,8 @@ import queue as _queue
 import socket
 import threading
 
-from tensorflowonspark_tpu.reservation import MessageSocket
+from tensorflowonspark_tpu.reservation import (FrameFormatError,
+                                               MessageSocket, _peer_name)
 
 logger = logging.getLogger(__name__)
 
@@ -107,6 +108,8 @@ class QueueServer(MessageSocket):
                     self._handle(conn, msg)
                 except KeyError as e:
                     self.send(conn, ("ERR", f"unknown queue {e}"))
+        except FrameFormatError as e:
+            logger.error("dropping peer %s: %s", _peer_name(conn), e)
         except (EOFError, OSError, ValueError):
             pass
         finally:

@@ -9,12 +9,15 @@ spec is assembled.  On TPU this rendezvous additionally carries the
 coordinator address used for ``jax.distributed.initialize`` (the reference's
 analogue is building ``TF_CONFIG`` in ``TFSparkNode.py::run``).
 
-Wire format (:class:`MessageSocket`): an 8-byte header
-``[4B pickle_len][4B nbuf]``, then ``nbuf`` 8-byte out-of-band buffer
-lengths, the pickle-protocol-5 stream, and the raw buffers — large
-contiguous payloads (numpy batches) skip the pickle stream entirely.
-``nbuf`` is 0 for plain control messages.  Pre-auth hellos use the
-separate 4-byte-length raw framing (``send_raw``/``receive_raw``).
+Wire format (:class:`MessageSocket`): a 10-byte header
+``[1B magic 0xA5][1B version][4B pickle_len][4B nbuf]``, then ``nbuf``
+8-byte out-of-band buffer lengths, the pickle-protocol-5 stream, and the
+raw buffers — large contiguous payloads (numpy batches) skip the pickle
+stream entirely.  ``nbuf`` is 0 for plain control messages.  A
+magic/version mismatch raises :class:`FrameFormatError` (logged by every
+receive loop) so a mixed-version peer is diagnosed on its first frame.
+Pre-auth hellos use the separate 4-byte-length raw framing
+(``send_raw``/``receive_raw``).
 """
 
 from __future__ import annotations
@@ -33,9 +36,25 @@ logger = logging.getLogger(__name__)
 
 BUFSIZE = 64 * 1024
 
+
+def _peer_name(sock: "socket.socket") -> str:
+    try:
+        return "%s:%s" % sock.getpeername()[:2]
+    except OSError:
+        return "<unknown peer>"
+
 # Challenge-frame magic for the mutual HMAC authkey handshake (below).
 AUTH_MAGIC = b"TFOSAUTH1"
 _NONCE_LEN = 32
+
+
+class FrameFormatError(EOFError):
+    """A peer's frame failed the magic/version check — it speaks a
+    different wire format (mixed-version cluster).  Subclasses
+    ``EOFError`` so every receive loop still treats it as a dead
+    connection, but loops log it explicitly first: without the log the
+    mismatch would look like a routine disconnect and the old peer
+    would silently hang re-polling."""
 
 
 class Reservations:
@@ -70,9 +89,13 @@ class MessageSocket:
     """Pickled messages over a TCP socket, with large binary payloads
     (numpy batches in the queue data plane) carried OUT-OF-BAND.
 
-    Frame: ``[4B pickle_len][4B nbuf][nbuf x 8B buf_len][pickle][bufs...]``.
-    ``nbuf`` is 0 for plain control messages (the common case everywhere
-    but the data queues).  Pickle protocol 5's ``buffer_callback`` splits
+    Frame: ``[1B magic 0xA5][1B version][4B pickle_len][4B nbuf]
+    [nbuf x 8B buf_len][pickle][bufs...]``.  ``nbuf`` is 0 for plain
+    control messages (the common case everywhere but the data queues).
+    The magic/version prefix exists so a mixed-version peer (e.g. one
+    still speaking an older framing) fails with an explicit diagnostic
+    on the first frame instead of a silent desync where its length
+    bytes get parsed as ours.  Pickle protocol 5's ``buffer_callback`` splits
     each array's bytes out of the pickle stream, so a chunk of samples
     crosses the wire with NO Python-side serialize/concat/join copies:
     the sender writes each array buffer straight to the socket, the
@@ -98,8 +121,19 @@ class MessageSocket:
     #: header) fails like a framing error, not an exabyte MemoryError
     MAX_OOB_BUF_BYTES = 1 << 32
 
+    #: frame magic + wire version; bump the version on any framing change
+    FRAME_MAGIC = 0xA5
+    FRAME_VERSION = 2
+
     def receive(self, sock: socket.socket):
-        plen, nbuf = struct.unpack(">II", self._recv_exact(sock, 8))
+        magic, ver, plen, nbuf = struct.unpack(
+            ">BBII", self._recv_exact(sock, 10))
+        if magic != self.FRAME_MAGIC or ver != self.FRAME_VERSION:
+            raise FrameFormatError(
+                f"frame magic/version mismatch: got (0x{magic:02x}, v{ver}),"
+                f" expected (0x{self.FRAME_MAGIC:02x}, "
+                f"v{self.FRAME_VERSION}) — peer speaks a different wire "
+                "format (mixed-version cluster?)")
         if not nbuf:
             return pickle.loads(self._recv_exact(sock, plen))
         if nbuf > self.MAX_OOB_BUFFERS:
@@ -150,7 +184,8 @@ class MessageSocket:
             return False
 
         data = pickle.dumps(msg, protocol=5, buffer_callback=keep_large)
-        header = struct.pack(">II", len(data), len(bufs))
+        header = struct.pack(">BBII", self.FRAME_MAGIC, self.FRAME_VERSION,
+                             len(data), len(bufs))
         if bufs:
             header += struct.pack(f">{len(bufs)}Q",
                                   *(v.nbytes for v in bufs))
@@ -279,6 +314,11 @@ class Server(MessageSocket):
                     try:
                         msg = self.receive(sock)
                         self._handle(sock, msg)
+                    except FrameFormatError as e:
+                        logger.error("dropping peer %s: %s",
+                                     _peer_name(sock), e)
+                        sock.close()
+                        conns.remove(sock)
                     except (EOFError, OSError, pickle.PickleError):
                         sock.close()
                         conns.remove(sock)

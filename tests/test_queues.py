@@ -107,7 +107,8 @@ def test_out_of_band_framing_roundtrip():
     def nbuf_of(msg):
         fs = FakeSock()
         ms.send(fs, msg)
-        _, nbuf = struct.unpack(">II", fs.data[:8])
+        magic, ver, _, nbuf = struct.unpack(">BBII", fs.data[:10])
+        assert (magic, ver) == (ms.FRAME_MAGIC, ms.FRAME_VERSION)
         return nbuf
 
     def roundtrip(msg):

@@ -73,3 +73,33 @@ def test_bootstrap_error_via_status():
     with pytest.raises(RuntimeError, match="worker 1 crashed"):
         server.await_reservations(timeout=5, status=status)
     server.stop()
+
+
+def test_frame_version_mismatch_is_diagnosed():
+    """A peer speaking a different wire format fails the FIRST frame with
+    an explicit magic/version diagnostic, not a silent desync."""
+    import socket as _socket
+    import struct
+    import threading
+
+    from tensorflowonspark_tpu.reservation import MessageSocket
+
+    ms = MessageSocket()
+    a, b = _socket.socketpair()
+    err = {}
+
+    def recv():
+        try:
+            ms.receive(b)
+        except Exception as e:  # noqa: BLE001 — capturing for assert
+            err["e"] = e
+
+    t = threading.Thread(target=recv)
+    t.start()
+    # old pre-OOB framing: plain 4-byte length prefix, no magic
+    a.sendall(struct.pack(">I", 11) + b"x" * 11)
+    t.join(10)
+    a.close()
+    b.close()
+    assert isinstance(err.get("e"), EOFError)
+    assert "magic/version mismatch" in str(err["e"])

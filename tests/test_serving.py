@@ -320,3 +320,23 @@ def test_chunked_prefill_matches_whole(pos_encoding):
     # chunked finals (rest 2, 5 -> buckets 2, 8) + the short whole prompt
     assert {k for k in keys if isinstance(k, tuple) and k[0] == "final"} \
         == {("final", 2), ("final", 8), ("final", 4)}
+
+
+def test_failed_step_poisons_the_batcher():
+    """A device failure mid-step leaves the donated cache unrecoverable:
+    the batcher must refuse further use with an error naming the original
+    failure, instead of silently decoding from a poisoned cache."""
+    cfg, params = _make()
+    b = ContinuousBatcher(cfg, params, max_batch=2)
+    b.submit(np.asarray([1, 2, 3], np.int32), 5)
+    b.step()
+    boom = RuntimeError("RESOURCE_EXHAUSTED: synthetic device OOM")
+
+    def raising_step(params, cache, tokens):
+        raise boom
+    b._step = raising_step
+    with pytest.raises(RuntimeError, match="synthetic device OOM"):
+        b.step()
+    for call in (b.step, b.run, lambda: b.submit([1], 1)):
+        with pytest.raises(RuntimeError, match="unusable(.|\n)*synthetic"):
+            call()

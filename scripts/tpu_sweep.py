@@ -509,11 +509,19 @@ def stage_decode() -> dict:
 # ---------------------------------------------------------------------------
 def stage_serving() -> dict:
     """ContinuousBatcher vs arrival-order static batching on mixed-length
-    traffic: aggregate tokens/sec over the whole request set, plus the
-    symmetric sequential-dispatch counts (hardware-independent).  Chip
-    time additionally includes the scatter overhead and the size
-    difference between single-row and full-batch prefills, which the
-    dispatch count treats as equal."""
+    traffic — measured under BOTH arrival regimes:
+
+    - ``steady``: every request queued upfront (the drain-a-backlog case);
+    - ``bursty``: requests arrive in waves mid-decode (the regime
+      continuous batching exists for — slots must be refilled while
+      others decode).
+
+    Per pattern: tokens/sec, slot occupancy (useful slot-steps /
+    capacity slot-steps — the utilization static batching wastes on
+    drained stragglers), prefill-admission overhead as a fraction of
+    wall time, and the prefill dispatch count (batched group admission:
+    O(buckets), not O(requests)).  Symmetric sequential-dispatch counts
+    stay as the hardware-independent check."""
     import dataclasses
 
     import jax
@@ -537,7 +545,7 @@ def stage_serving() -> dict:
     params = GPT(cfg).init(jax.random.key(0),
                            jnp.ones((1, 8), jnp.int32))["params"]
     rng = np.random.default_rng(0)
-    # one shared prompt length -> one prefill executable; budgets vary
+    # one shared prompt length -> one prefill bucket; budgets vary
     T0 = 16 if not SMOKE else 4
     reqs = [(rng.integers(0, cfg.vocab_size, (T0,)).astype(np.int32),
              int(rng.integers(lo, hi + 1))) for _ in range(n_req)]
@@ -545,23 +553,72 @@ def stage_serving() -> dict:
 
     # ONE batcher for warmup and timing: its decode/prefill/scatter
     # executables compile on the warm drain and are reused by the timed
-    # drain (a fresh instance would re-jit everything inside the timed
+    # drains (a fresh instance would re-jit everything inside the timed
     # window, distorting the comparison against the warmed static path)
     batcher = ContinuousBatcher(cfg, params, max_batch=slots)
 
-    def run_continuous(b):
-        remaining = {b.submit(p, n) for p, n in reqs}
-        steps = 0
-        while remaining:
-            remaining.difference_update(b.step())
-            steps += 1
-        return steps, b.run()            # already drained; fetch results
+    def run_continuous(b, schedule):
+        """Drive the batcher against an arrival ``schedule``
+        (``[(arrive_at_step, request), ...]``); admission wall time is
+        measured via a timed wrapper, dispatch counts come from the
+        batcher's own public counters."""
+        admit_s = [0.0]
+        orig_admit = b._admit
 
-    steps_cont, res = run_continuous(batcher)   # warm compiles
-    t0 = time.perf_counter()
-    steps_cont, res = run_continuous(batcher)
-    dt_cont = time.perf_counter() - t0
-    assert sum(len(v) for v in res.values()) == 2 * total_tokens  # 2 drains
+        def timed_admit():
+            t = time.perf_counter()
+            try:
+                return orig_admit()
+            finally:
+                admit_s[0] += time.perf_counter() - t
+
+        b._admit = timed_admit
+        prefills0 = b.prefill_dispatches
+        try:
+            pending = sorted(schedule, key=lambda x: x[0])
+            remaining, steps = set(), 0
+            while pending or remaining:
+                while pending and pending[0][0] <= steps:
+                    _, (p, n) = pending.pop(0)
+                    remaining.add(b.submit(p, n))
+                remaining.difference_update(b.step())
+                steps += 1
+            return (steps, admit_s[0],
+                    b.prefill_dispatches - prefills0, b.run())
+        finally:
+            b._admit = orig_admit
+
+    def measure(schedule, label):
+        run_continuous(batcher, schedule)            # warm compiles
+        t0 = time.perf_counter()
+        steps, admit_s, prefills, res = run_continuous(batcher, schedule)
+        dt = time.perf_counter() - t0
+        assert sum(len(v) for v in res.values()) >= total_tokens
+        return {
+            f"{label}_tps": round(total_tokens / dt, 1),
+            f"{label}_steps": steps,
+            # decode occupancy: each request's FIRST token comes from its
+            # prefill dispatch, so a budget-n request uses n-1 decode
+            # slot-steps — numerator excludes one token per request
+            f"{label}_occupancy": round(
+                (total_tokens - n_req) / (steps * slots), 3),
+            f"{label}_admission_frac": round(admit_s / dt, 4),
+            f"{label}_prefill_dispatches": prefills,
+        }
+
+    steady = [(0, r) for r in reqs]
+    # waves of `slots` requests landing every (lo+hi)//2 steps — past the
+    # minimum budget, so short-budget tenants have retired and freed
+    # slots while long ones still decode: admission genuinely lands
+    # mid-flight (each same-bucket wave is one batched prefill).  An
+    # interval below `lo` would degenerate to the steady backlog: no
+    # slot frees before every wave has queued.
+    bursty = [((lo + hi) // 2 * (i // slots), r)
+              for i, r in enumerate(reqs)]
+    row = {"requests": n_req, "slots": slots, "budgets": f"{lo}-{hi}",
+           "useful_tokens": total_tokens, "device": dev.device_kind}
+    row.update(measure(steady, "steady"))
+    row.update(measure(bursty, "bursty"))
 
     gen = jax.jit(greedy_generate, static_argnums=(0, 3))
 
@@ -584,24 +641,31 @@ def stage_serving() -> dict:
 
     # symmetric accounting — sequential device programs on the critical
     # path: static runs (1 group prefill + max_budget-1 decode steps) per
-    # group = sum of group max budgets; continuous runs one single-row
-    # prefill per REQUEST plus its decode-loop steps
+    # group = sum of group max budgets; continuous runs its decode steps
+    # plus its (batched) prefill dispatches
     stat_dispatches = sum(max(b for _, b in reqs[i:i + slots])
                           for i in range(0, n_req, slots))
-    row = {"requests": n_req, "slots": slots, "budgets": f"{lo}-{hi}",
-           "useful_tokens": total_tokens,
-           "continuous_tps": round(total_tokens / dt_cont, 1),
-           "static_tps": round(total_tokens / dt_stat, 1),
-           "speedup": round(dt_stat / dt_cont, 3),
-           # host-dispatch distortion guard: continuous pays one host
-           # round trip PER DISPATCH (an RPC over the axon tunnel) while
-           # static greedy runs each group inside one lax.scan program —
-           # the dispatch counts separate scheduling efficiency (what the
-           # batcher controls) from dispatch latency (what the deployment
-           # controls; a real TPU-VM dispatches locally)
-           "dispatches_continuous": steps_cont + n_req,  # + prefills
-           "dispatches_static": stat_dispatches,
-           "device": dev.device_kind}
+    static_tps = round(total_tokens / dt_stat, 1)
+    n_groups = (n_req + slots - 1) // slots
+    row.update({
+        "static_tps": static_tps,
+        # same decode-only accounting: each group's prefill emits the
+        # first token, so decode steps = stat_dispatches - n_groups
+        "static_occupancy": round(
+            (total_tokens - n_req)
+            / ((stat_dispatches - n_groups) * slots), 3),
+        "speedup_steady": round(row["steady_tps"] / static_tps, 3),
+        "speedup_bursty": round(row["bursty_tps"] / static_tps, 3),
+        # host-dispatch distortion guard: continuous pays one host
+        # round trip PER DISPATCH (an RPC over the axon tunnel) while
+        # static greedy runs each group inside one lax.scan program —
+        # the dispatch counts separate scheduling efficiency (what the
+        # batcher controls) from dispatch latency (what the deployment
+        # controls; a real TPU-VM dispatches locally)
+        "dispatches_continuous": row["steady_steps"]
+        + row["steady_prefill_dispatches"],
+        "dispatches_static": stat_dispatches,
+    })
     print("sweep serving:", json.dumps(row), flush=True)
     _write("serving_throughput.json", row)
     return row

@@ -14,24 +14,28 @@ static-shape KV cache:
 - every slot decodes at its own cache offset
   (``GPTConfig.per_row_positions``: the per-layer ``index`` and
   learned-position ``pos`` counters are ``[B]`` vectors);
-- a new request is PREFILLED alone on a fresh single-row cache, then its
-  cache row and counters are scattered into a free slot
-  (``dynamic_update_slice`` on the row axis) — running slots never
-  recompile, never stall, and never see the new prompt;
+- new requests are PREFILLED on a fresh side cache — same-bucket
+  arrivals admitted together share ONE batched prefill dispatch — then
+  their cache rows and counters are scattered into free slots in one
+  indexed scatter (running slots never recompile, never stall, and
+  never see the new prompts);
 - a finished slot is released immediately and can be re-admitted on the
   very next step.
 
 Everything on the hot path is compiled exactly once: ONE decode-step
 executable for the whole lifetime (all shapes static), one prefill
-executable per power-of-two prompt BUCKET (prompts are right-padded
-internally and the pad positions provably never leak — see
-``_prefill_final``; arbitrary-length traffic costs O(log max_len)
+executable per (power-of-two prompt BUCKET, power-of-two admission
+GROUP size) pair — prompts are right-padded internally and the pad
+positions provably never leak (see ``_prefill_final``), so
+arbitrary-length traffic costs O(log max_len x log max_batch)
 compiles, not one per length; with ``prefill_chunk`` long prompts add
-one fixed-chunk executable and stream through the cache with
-O(chunk x max_len) transient attention memory), and one scatter
-executable.  The decode loop itself is plain Python — admission
-decisions are host-side control flow, exactly what should NOT be
-traced.
+one fixed-chunk executable and stream through the cache solo with
+O(chunk x max_len) transient attention memory — and one scatter
+executable per group size.  A BURST of arrivals therefore costs
+O(distinct buckets) device dispatches, not O(requests): the admission
+regime continuous batching exists for.  The decode loop itself is
+plain Python — admission decisions are host-side control flow,
+exactly what should NOT be traced.
 
 Output contract (locked by ``tests/test_serving.py``): a request's
 tokens are a pure function of its own (params, prompt, budget,
@@ -55,6 +59,10 @@ import numpy as np
 
 from tensorflowonspark_tpu.models.gpt import (GPT, GPTConfig, init_cache,
                                               nucleus_filter, rewind_cache)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length()
 
 
 @dataclass
@@ -131,6 +139,13 @@ class ContinuousBatcher:
         self.model = GPT(self.cfg, decode=True)
         self.cache = init_cache(self.cfg, params, self.max_batch)
         self.slots: list[_Slot | None] = [None] * self.max_batch
+        #: lifetime dispatch counters — ``prefill_dispatches`` (a batched
+        #: group admission counts ONCE; chunk-loop calls excluded) and
+        #: ``decode_dispatches`` (one per decode step that had active
+        #: slots).  Public so benches/demos read them instead of patching
+        #: private methods.
+        self.prefill_dispatches = 0
+        self.decode_dispatches = 0
         #: set to the original error message the first time a device step
         #: raises mid-flight; every executable donates the cache buffer
         #: (``donate_argnums``), so after a failed dispatch the previous
@@ -143,8 +158,11 @@ class ContinuousBatcher:
                                   float, float, int]] = []
         self._ids = itertools.count()
         self._results: dict[int, np.ndarray] = {}
-        # compiled-prefill registry: ("final", pow2_bucket) -> jit,
-        # ("chunk", chunk_len) -> jit, "row_zeros" -> cache allocator
+        # compiled-prefill registry:
+        #   ("final", pow2_bucket, pow2_rows) -> batched prefill jit,
+        #   ("chunk", chunk_len) -> chunk jit,
+        #   ("zeros", rows) -> fresh side-cache allocator,
+        #   ("scatter", rows) -> indexed row scatter jit
         self._prefill_jit: dict = {}
 
         def step_greedy(params, cache, tokens):
@@ -165,18 +183,31 @@ class ContinuousBatcher:
         self._step = jax.jit(step_greedy, donate_argnums=(1,))
         self._step_sample = jax.jit(step_sample, donate_argnums=(1,))
 
-        def scatter_fn(cache, row, slot):
-            """Write the single-row prefill cache into slot ``slot``."""
-            def put(path, m, s):
-                is_counter = getattr(path[-1], "key", None) in ("index",
-                                                                "pos")
-                axis = (m.ndim - 1) if is_counter \
-                    else (1 if self.cfg.scan_layers else 0)
-                return jax.lax.dynamic_update_slice_in_dim(
-                    m, s.astype(m.dtype), slot, axis)
-            return jax.tree_util.tree_map_with_path(put, cache, row)
+    def _scatter_rows(self, row_cache, slot_idx: list[int]) -> None:
+        """Write a prefilled side cache's rows into the batch slots named
+        by ``slot_idx`` — ONE indexed-scatter dispatch regardless of how
+        many rows were admitted.  Pad rows (group padded to a power of
+        two) carry slot index ``max_batch``: out of bounds, dropped by
+        ``mode="drop"``, so their garbage prefill never lands."""
+        rp = len(slot_idx)
+        key = ("scatter", rp)
+        if key not in self._prefill_jit:
+            scan = self.cfg.scan_layers
 
-        self._scatter = jax.jit(scatter_fn, donate_argnums=(0,))
+            def scatter_fn(cache, rows, slots):
+                def put(path, m, s):
+                    is_counter = getattr(path[-1], "key", None) in ("index",
+                                                                    "pos")
+                    axis = (m.ndim - 1) if is_counter else (1 if scan else 0)
+                    mm = jnp.moveaxis(m, axis, 0)
+                    ss = jnp.moveaxis(s.astype(m.dtype), axis, 0)
+                    return jnp.moveaxis(mm.at[slots].set(ss, mode="drop"),
+                                        0, axis)
+                return jax.tree_util.tree_map_with_path(put, cache, rows)
+
+            self._prefill_jit[key] = jax.jit(scatter_fn, donate_argnums=(0,))
+        self.cache = self._prefill_jit[key](
+            self.cache, row_cache, jnp.asarray(slot_idx, jnp.int32))
 
     def _check_usable(self) -> None:
         if self._poisoned is not None:
@@ -231,27 +262,27 @@ class ContinuousBatcher:
                               float(temperature), float(top_p), int(seed)))
         return rid
 
-    def _fresh_row_cache(self):
-        """Zeroed single-row cache (compiled allocation, cached trace)."""
-        if "row_zeros" not in self._prefill_jit:
+    def _fresh_rows_cache(self, rows: int):
+        """Zeroed ``rows``-row side cache (compiled allocation, cached
+        trace per row count)."""
+        key = ("zeros", rows)
+        if key not in self._prefill_jit:
             template = jax.eval_shape(
-                lambda: init_cache(self.cfg, self.params, 1))
-            self._prefill_jit["row_zeros"] = jax.jit(
+                lambda: init_cache(self.cfg, self.params, rows))
+            self._prefill_jit[key] = jax.jit(
                 lambda: jax.tree.map(
                     lambda t: jnp.zeros(t.shape, t.dtype), template))
-        return self._prefill_jit["row_zeros"]()
+        return self._prefill_jit[key]()
 
-    def _prefill(self, prompt: np.ndarray, temperature: float,
-                 top_p: float, seed: int):
-        """Dispatch: whole-prompt prefill (bucketed), or the chunk loop
-        for prompts beyond ``prefill_chunk`` (long-context admission with
-        O(chunk x max_len) transient attention memory)."""
+    def _prefill_chunked(self, prompt: np.ndarray, temperature: float,
+                         top_p: float, seed: int):
+        """Long-context admission (prompt beyond ``prefill_chunk``):
+        stream the prompt through the cache in fixed-size chunks —
+        O(chunk x max_len) transient attention memory — then run the
+        bucketed final call on the remainder.  Always solo: a long
+        prompt's prefill cost dwarfs the dispatch overhead batching
+        saves."""
         C = self.prefill_chunk
-        if C is None or prompt.size <= C:
-            # whole-prompt path: one bucketed final call on a fresh cache
-            return self._prefill_final(self._fresh_row_cache(), prompt,
-                                       prompt.size, temperature, top_p,
-                                       seed)
         T0 = prompt.size
         if ("chunk", C) not in self._prefill_jit:
             def chunk_fn(params, cache, tokens_row):
@@ -261,77 +292,134 @@ class ContinuousBatcher:
                 return vars_["cache"]
             self._prefill_jit[("chunk", C)] = jax.jit(
                 chunk_fn, donate_argnums=(1,))
-        cache = self._fresh_row_cache()
+        cache = self._fresh_rows_cache(1)
         n_full = (T0 - 1) // C          # >= 1 token left for the final call
         for i in range(n_full):
             cache = self._prefill_jit[("chunk", C)](
                 self.params, cache, prompt[None, i * C:(i + 1) * C])
-        return self._prefill_final(cache, prompt[n_full * C:], T0,
-                                   temperature, top_p, seed)
+        return self._prefill_final(cache, [prompt[n_full * C:]], [T0],
+                                   [temperature], [top_p], [seed])
 
-    def _prefill_final(self, cache, rest: np.ndarray, true_total: int,
-                       temperature: float, top_p: float, seed: int):
-        """THE bucketed prefill call — both the whole-prompt path (on a
-        fresh cache, ``true_total == rest.size``) and the last chunk of
-        a chunked prefill end here.
+    def _prefill_final(self, cache, rests: list, true_totals: list,
+                       temps: list, top_ps: list, seeds: list):
+        """THE bucketed prefill call — a whole-prompt admission GROUP
+        (same power-of-two bucket, fresh ``len(rests)``-row side cache)
+        and the last chunk of a chunked prefill (1-row cache) both end
+        here.  Returns ``(first_tokens, row_caches)``; entries past
+        ``len(rests)`` are padding.
 
-        ``rest`` is right-padded to the next power-of-two length, so the
-        compile count is O(log max_len) instead of O(distinct lengths)
-        (a TPU compile is tens of seconds; arbitrary serving traffic
-        must not pay one per length).  Why padding is exact: prefill
-        attention is causal, so pad tokens never influence the true
-        last position's logits (selected at ``true_len - 1``); the
-        cache counters are then REWOUND to ``true_total``, after which
-        the positional visibility mask hides every pad slot
-        (``k_pos > q_pos``) until the decode loop overwrites it with a
-        real token's K/V in the same forward that first makes it
-        visible.  One executable serves greedy and sampled requests
+        Prompts are right-padded to the bucket length and the group to
+        the cache's power-of-two row count, so the compile count is
+        O(log max_len x log max_batch) instead of O(distinct lengths x
+        group sizes) (a TPU compile is tens of seconds; arbitrary
+        serving traffic must not pay one per shape).  Why padding is
+        exact: prefill attention is causal, so pad tokens never
+        influence a true last position's logits (selected per row at
+        ``true_len - 1``); each row's cache counters are then REWOUND
+        to its ``true_total``, after which the positional visibility
+        mask hides every pad slot (``k_pos > q_pos``) until the decode
+        loop overwrites it with a real token's K/V in the same forward
+        that first makes it visible; and pad ROWS never reach the
+        batch — their out-of-bounds slot index drops them at scatter.
+        One executable serves greedy and sampled requests
         (``_select_tokens`` reduces to argmax at temperature 0)."""
-        Tr = rest.size
-        Tp = min(1 << (Tr - 1).bit_length(),
+        R = len(rests)
+        rp = jax.tree.leaves(cache)[0].shape[
+            1 if self.cfg.scan_layers else 0]    # cache row count (pow2)
+        Tp = min(_next_pow2(max(r.size for r in rests)),
                  self.cfg.max_position_embeddings)
-        padded = np.zeros((Tp,), np.int32)
-        padded[:Tr] = rest
-        key = ("final", Tp)
+        padded = np.zeros((rp, Tp), np.int32)
+        true_len = np.ones((rp,), np.int32)
+        for j, r in enumerate(rests):
+            padded[j, :r.size] = r
+            true_len[j] = r.size
+        tot = np.ones((rp,), np.int32)
+        tot[:R] = true_totals
+        seed_a = np.zeros((rp,), np.int32)
+        seed_a[:R] = seeds
+        temp_a = np.zeros((rp,), np.float32)
+        temp_a[:R] = temps
+        top_a = np.ones((rp,), np.float32)
+        top_a[:R] = top_ps
+        key = ("final", Tp, rp)
         if key not in self._prefill_jit:
-            def final_fn(params, cache, tokens_row, true_len, true_tot,
+            def final_fn(params, cache, tokens, true_len, true_tot,
                          seeds, temps, top_ps):
                 logits, vars_ = self.model.apply(
                     {"params": params, "cache": cache},
-                    tokens_row, mutable=["cache"])
+                    tokens, mutable=["cache"])
                 last = jnp.take_along_axis(
                     logits, (true_len - 1)[:, None, None], axis=1)[:, 0]
                 first = _select_tokens(
-                    last, seeds, jnp.zeros((1,), jnp.int32), temps, top_ps)
-                return first, rewind_cache(vars_["cache"], true_tot[0])
+                    last, seeds, jnp.zeros_like(true_len), temps, top_ps)
+                return first, rewind_cache(vars_["cache"], true_tot)
             self._prefill_jit[key] = jax.jit(final_fn, donate_argnums=(1,))
+        self.prefill_dispatches += 1
         return self._prefill_jit[key](
-            self.params, cache, padded[None, :],
-            jnp.asarray([Tr], jnp.int32),
-            jnp.asarray([true_total], jnp.int32),
-            jnp.asarray([seed], jnp.int32),
-            jnp.asarray([temperature], jnp.float32),
-            jnp.asarray([top_p], jnp.float32))
+            self.params, cache, padded,
+            jnp.asarray(true_len), jnp.asarray(tot),
+            jnp.asarray(seed_a), jnp.asarray(temp_a), jnp.asarray(top_a))
 
     def _admit(self) -> list[int]:
         """Fill free slots from the pending queue; returns the ids of
         requests that finished AT admission (1-token budget or immediate
-        eos) so ``step()`` can report them."""
+        eos) so ``step()`` can report them.
+
+        Burst admission: requests taken this round are grouped by
+        power-of-two prompt bucket and each group shares ONE batched
+        prefill dispatch plus one scatter — O(distinct buckets) device
+        dispatches for the round, not O(requests).  Prompts beyond
+        ``prefill_chunk`` keep the solo chunked path.  The loop repeats
+        while finished-at-admission requests keep freeing slots."""
         done = []
-        for i, slot in enumerate(self.slots):
-            if slot is not None or not self._pending:
-                continue
-            rid, prompt, budget, temp, top_p, seed = self._pending.pop(0)
-            first, row_cache = self._prefill(prompt, temp, top_p, seed)
-            tok = int(first[0])
-            self.cache = self._scatter(self.cache, row_cache, i)
-            s = _Slot(request_id=rid, remaining=budget - 1, tokens=[tok],
-                      temperature=temp, top_p=top_p, seed=seed)
-            if s.remaining <= 0 or tok == self.eos_id:
-                self._finish(i, s)      # slot stays free for the next one
-                done.append(rid)
-            else:
-                self.slots[i] = s
+        while self._pending:
+            free = [i for i, s in enumerate(self.slots) if s is None]
+            if not free:
+                break
+            take = self._pending[:len(free)]
+            del self._pending[:len(take)]
+            C = self.prefill_chunk
+            groups: dict[int, list] = {}
+            solo = []
+            for req in take:
+                if C is not None and req[1].size > C:
+                    solo.append(req)
+                else:
+                    Tp = min(_next_pow2(req[1].size),
+                             self.cfg.max_position_embeddings)
+                    groups.setdefault(Tp, []).append(req)
+            free_iter = iter(free)
+            admitted = []   # (slot_index, req_tuple, first_token)
+            for rid, prompt, budget, temp, top_p, seed in solo:
+                first, row_cache = self._prefill_chunked(prompt, temp,
+                                                         top_p, seed)
+                slot = next(free_iter)
+                self._scatter_rows(row_cache, [slot])
+                admitted.append((slot, (rid, budget, temp, top_p, seed),
+                                 int(first[0])))
+            for reqs in groups.values():
+                rp = _next_pow2(len(reqs))
+                firsts, rows = self._prefill_final(
+                    self._fresh_rows_cache(rp),
+                    [r[1] for r in reqs], [r[1].size for r in reqs],
+                    [r[3] for r in reqs], [r[4] for r in reqs],
+                    [r[5] for r in reqs])
+                slots = [next(free_iter) for _ in reqs]
+                # pad rows target slot max_batch: out of bounds, dropped
+                self._scatter_rows(rows,
+                                   slots + [self.max_batch] * (rp - len(reqs)))
+                firsts = np.asarray(firsts)
+                for j, (rid, _, budget, temp, top_p, seed) in enumerate(reqs):
+                    admitted.append((slots[j], (rid, budget, temp, top_p,
+                                                seed), int(firsts[j])))
+            for slot, (rid, budget, temp, top_p, seed), tok in admitted:
+                s = _Slot(request_id=rid, remaining=budget - 1, tokens=[tok],
+                          temperature=temp, top_p=top_p, seed=seed)
+                if s.remaining <= 0 or tok == self.eos_id:
+                    self._finish(slot, s)   # slot stays free; loop refills
+                    done.append(rid)
+                else:
+                    self.slots[slot] = s
         return done
 
     def _finish(self, i: int, s: _Slot) -> None:
@@ -360,6 +448,7 @@ class ContinuousBatcher:
         done = self._admit()
         if not any(self.slots):
             return done
+        self.decode_dispatches += 1
         tokens = jnp.asarray([s.tokens[-1] if s else 0
                               for s in self.slots], jnp.int32)
         if any(s is not None and s.temperature > 0 for s in self.slots):

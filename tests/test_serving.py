@@ -283,8 +283,11 @@ def test_batcher_nucleus_matches_sample_generate_filter():
 
 def test_prefill_bucketing_is_exact_and_bounds_compiles():
     """Right-padded power-of-two prefill buckets: every prompt length in
-    3..9 stays greedy-exact, and the compile count is the bucket count
-    (4, 8, 16), not the length count."""
+    3..9 stays greedy-exact, and the prefill compile count is the
+    (bucket, group-size) count, not the length count.  Equal budgets make
+    slots free in pairs, so same-bucket pairs share batched executables:
+    (3,4)->bucket4 group2, (5,6) and (7,8)->bucket8 group2 (reused),
+    9->bucket16 solo."""
     cfg, params = _make()
     rng = np.random.default_rng(8)
     b = ContinuousBatcher(cfg, params, max_batch=2)
@@ -295,8 +298,8 @@ def test_prefill_bucketing_is_exact_and_bounds_compiles():
     for rid, (p, n) in zip(rids, reqs):
         np.testing.assert_array_equal(results[rid],
                                       _oracle(cfg, params, p, n))
-    assert {k for k in b._prefill_jit if isinstance(k, tuple)} \
-        == {("final", 4), ("final", 8), ("final", 16)}, \
+    assert {k for k in b._prefill_jit if k[0] == "final"} \
+        == {("final", 4, 2), ("final", 8, 2), ("final", 16, 1)}, \
         sorted(map(str, b._prefill_jit))
 
 
@@ -317,9 +320,10 @@ def test_chunked_prefill_matches_whole(pos_encoding):
                                       _oracle(cfg, params, p, n))
     keys = set(b._prefill_jit)
     assert ("chunk", 6) in keys
-    # chunked finals (rest 2, 5 -> buckets 2, 8) + the short whole prompt
-    assert {k for k in keys if isinstance(k, tuple) and k[0] == "final"} \
-        == {("final", 2), ("final", 8), ("final", 4)}
+    # chunked finals run solo (rest 2, 5 -> buckets 2, 8 at group 1) +
+    # the short whole prompt (bucket 4, admitted alone once slots free)
+    assert {k for k in keys if k[0] == "final"} \
+        == {("final", 2, 1), ("final", 8, 1), ("final", 4, 1)}
 
 
 def test_failed_step_poisons_the_batcher():
@@ -340,3 +344,47 @@ def test_failed_step_poisons_the_batcher():
     for call in (b.step, b.run, lambda: b.submit([1], 1)):
         with pytest.raises(RuntimeError, match="unusable(.|\n)*synthetic"):
             call()
+
+
+def test_burst_admission_shares_one_prefill_dispatch():
+    """A burst of same-bucket arrivals is admitted with ONE batched
+    prefill call and one scatter — and every request stays greedy-exact
+    vs its solo oracle (batching must not change numerics)."""
+    cfg, params = _make()
+    rng = np.random.default_rng(11)
+    b = ContinuousBatcher(cfg, params, max_batch=8)
+    calls = []
+    orig = b._prefill_final
+    b._prefill_final = lambda *a: calls.append(1) or orig(*a)
+    reqs = [(rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32), n)
+            for n in (4, 6, 3, 5, 7, 4, 6, 5)]
+    rids = [b.submit(p, n) for p, n in reqs]
+    results = b.run()
+    assert len(calls) == 1, f"expected one batched prefill, got {len(calls)}"
+    assert set(b._prefill_jit) >= {("final", 8, 8), ("scatter", 8)}
+    for rid, (p, n) in zip(rids, reqs):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(cfg, params, p, n))
+
+
+def test_group_padding_rows_never_land():
+    """A group of 3 pads to 4 prefill rows; the pad row's garbage cache
+    is dropped at scatter (out-of-bounds slot) and running slots are
+    untouched: all requests remain greedy-exact."""
+    cfg, params = _make()
+    rng = np.random.default_rng(12)
+    b = ContinuousBatcher(cfg, params, max_batch=4)
+    # occupy one slot first so the burst of 3 lands beside a live row
+    early_p = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    early = b.submit(early_p, 10)
+    b.step()
+    reqs = [(rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32), n)
+            for n in (4, 5, 6)]
+    rids = [b.submit(p, n) for p, n in reqs]
+    results = b.run()
+    assert ("final", 8, 4) in b._prefill_jit   # group of 3 padded to 4
+    np.testing.assert_array_equal(results[early],
+                                  _oracle(cfg, params, early_p, 10))
+    for rid, (p, n) in zip(rids, reqs):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(cfg, params, p, n))

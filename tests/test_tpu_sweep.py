@@ -138,3 +138,21 @@ def test_only_filter_validates_before_probe():
         cwd=ROOT)
     assert proc.returncode != 0
     assert "not in the stage list" in proc.stderr
+
+
+def test_serving_stage_dual_regime():
+    """The serving stage reports both arrival regimes (steady backlog +
+    bursty waves) with occupancy, admission fraction, and batched
+    prefill-dispatch counts."""
+    _run_stage("--stage", "serving", timeout=560)
+    with open(os.path.join(ROOT, "bench_artifacts",
+                           "smoke_serving_throughput.json")) as f:
+        row = json.load(f)
+    for label in ("steady", "bursty"):
+        assert row[f"{label}_tps"] > 0
+        assert 0 < row[f"{label}_occupancy"] <= 1
+        assert 0 <= row[f"{label}_admission_frac"] < 1
+        # batched group admission: fewer prefill dispatches than requests
+        assert row[f"{label}_prefill_dispatches"] < row["requests"]
+    assert row["static_occupancy"] <= 1
+    assert row["speedup_bursty"] > 0

@@ -156,3 +156,19 @@ def test_serving_stage_dual_regime():
         assert row[f"{label}_prefill_dispatches"] < row["requests"]
     assert row["static_occupancy"] <= 1
     assert row["speedup_bursty"] > 0
+
+
+def test_bert_squad_stage_l5_path():
+    """The BERT-SQuAD stage drives the real L5 pipeline (TFEstimator.fit
+    -> cluster -> queue feed) and reports a measured row via the result
+    file."""
+    _run_stage("--stage", "bert_squad", timeout=560)
+    with open(os.path.join(ROOT, "bench_artifacts",
+                           "smoke_bert_squad.json")) as f:
+        row = json.load(f)
+    assert row["examples_per_sec"] > 0
+    assert row["timed_steps"] >= 5
+    assert 0 <= row["feed_wait_frac"] < 1
+    assert "TFEstimator" in row["path"]
+    import math
+    assert math.isfinite(row["loss"])

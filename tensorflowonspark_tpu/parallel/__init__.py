@@ -24,8 +24,10 @@ from tensorflowonspark_tpu.parallel.sharding import (PartitionRules, batch_pspec
 from tensorflowonspark_tpu.parallel.strategy import (DataParallelStrategy,
                                                      FSDPStrategy, MeshStrategy,
                                                      MultiWorkerMirroredStrategy)  # noqa: F401
-from tensorflowonspark_tpu.parallel.embedding import (ShardedEmbedding,
-                                                      sharded_embedding_lookup)  # noqa: F401
+from tensorflowonspark_tpu.parallel.embedding import (
+    ShardedEmbedding, apply_sharded_lookup,
+    build_sparse_embedding_train_step,
+    sharded_embedding_lookup)  # noqa: F401
 from tensorflowonspark_tpu.parallel.ring_attention import (ring_attention,
                                                            ring_self_attention)  # noqa: F401
 from tensorflowonspark_tpu.parallel.pipeline import (PipelineStrategy,

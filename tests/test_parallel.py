@@ -493,3 +493,72 @@ def test_auto_fsdp_overlay_prefers_dim0_extension():
     done = NamedSharding(mesh, P("fsdp", None))
     big = jax.ShapeDtypeStruct((64, 64), jnp.float32)
     assert overlay(done, big) is done
+
+
+def test_sparse_embedding_sgd_matches_dense_oracle():
+    """Sparse SGD (touch only the batch's rows) must equal the dense-path
+    oracle exactly: scatter-added duplicate gradients == the dense table
+    gradient, and untouched rows must be bit-identical."""
+    from tensorflowonspark_tpu.parallel import build_sparse_embedding_train_step
+
+    mesh = make_mesh(ep=4)
+    V, F, lr = 32, 8, 0.1
+    table0 = jax.random.normal(jax.random.key(0), (V, F))
+    ids = jnp.array([3, 17, 3, 31, 0, 3])     # duplicates on purpose
+    tgt = jax.random.normal(jax.random.key(1), (ids.size, F))
+
+    def loss_fn(emb, tgt):
+        return jnp.mean((emb - tgt) ** 2)
+
+    step = build_sparse_embedding_train_step(mesh, loss_fn, lr=lr,
+                                             optimizer="sgd")
+    table, _, loss = step(table0, table0, ids, tgt)
+
+    # dense oracle: gradient through the gather, plain SGD
+    def dense_loss(t):
+        return loss_fn(jnp.take(t, ids, axis=0), tgt)
+    g = jax.grad(dense_loss)(table0)
+    want = table0 - lr * g
+    np.testing.assert_allclose(np.asarray(table), np.asarray(want),
+                               rtol=1e-6, atol=1e-7)
+    untouched = [i for i in range(V) if i not in set(np.asarray(ids))]
+    np.testing.assert_array_equal(np.asarray(table)[untouched],
+                                  np.asarray(table0)[untouched])
+    assert np.isfinite(float(loss))
+
+
+def test_sparse_embedding_adagrad_semantics():
+    """Adagrad sparse semantics: acc += sum of squared per-occurrence row
+    gradients; update = -lr * summed gradient / sqrt(acc_new); rows the
+    batch never touches keep zero accumulator and original values
+    (TF SparseApplyAdagrad semantics, made deterministic for dups)."""
+    from tensorflowonspark_tpu.parallel import build_sparse_embedding_train_step
+
+    mesh = make_mesh(ep=4)
+    V, F, lr, eps = 16, 4, 0.5, 1e-8
+    table0 = jax.random.normal(jax.random.key(2), (V, F))
+    acc0 = jnp.zeros((V, F))
+    ids = jnp.array([1, 9, 1, 14])
+    tgt = jax.random.normal(jax.random.key(3), (ids.size, F))
+
+    def loss_fn(emb, tgt):
+        return jnp.sum((emb - tgt) ** 2)
+
+    step = build_sparse_embedding_train_step(mesh, loss_fn, lr=lr,
+                                             optimizer="adagrad")
+    table, acc, _ = step(table0, acc0, ids, tgt)
+
+    # numpy oracle with the documented semantics
+    t0 = np.asarray(table0)
+    emb = t0[np.asarray(ids)]
+    g_rows = 2.0 * (emb - np.asarray(tgt))      # d/demb of sum((e-t)^2)
+    want_t, want_a = t0.copy(), np.zeros((V, F))
+    for r in set(np.asarray(ids).tolist()):
+        occ = [j for j, i in enumerate(np.asarray(ids)) if i == r]
+        want_a[r] += sum(g_rows[j] ** 2 for j in occ)
+        want_t[r] -= lr * sum(g_rows[j] for j in occ) \
+            / np.sqrt(want_a[r] + eps)
+    np.testing.assert_allclose(np.asarray(table), want_t,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(acc), want_a,
+                               rtol=1e-5, atol=1e-6)

@@ -29,9 +29,11 @@ GROUP size) pair — prompts are right-padded internally and the pad
 positions provably never leak (see ``_prefill_final``), so
 arbitrary-length traffic costs O(log max_len x log max_batch)
 compiles, not one per length; with ``prefill_chunk`` long prompts add
-one fixed-chunk executable and stream through the cache solo with
-O(chunk x max_len) transient attention memory — and one scatter
-executable per group size.  A BURST of arrivals therefore costs
+one fixed-chunk executable and stream through the cache solo,
+TIME-SLICED one chunk per step so running slots keep decoding while a
+long admission is in flight, with O(chunk x max_len) transient
+attention memory — and one scatter executable per group size.  A
+BURST of arrivals therefore costs
 O(distinct buckets) device dispatches, not O(requests): the admission
 regime continuous batching exists for.  The decode loop itself is
 plain Python — admission decisions are host-side control flow,
@@ -156,6 +158,12 @@ class ContinuousBatcher:
         # (rid, prompt, budget, temperature, top_p, seed)
         self._pending: list[tuple[int, np.ndarray, int,
                                   float, float, int]] = []
+        #: the at-most-one chunked admission in flight: its prefill is
+        #: TIME-SLICED — one chunk per ``step()`` — so admitting a long
+        #: prompt never stalls running slots for the whole chunk loop;
+        #: the target slot is reserved until the final chunk scatters
+        self._inflight: dict | None = None
+        self._reserved: set[int] = set()
         self._ids = itertools.count()
         self._results: dict[int, np.ndarray] = {}
         # compiled-prefill registry:
@@ -220,9 +228,11 @@ class ContinuousBatcher:
     # -- admission ---------------------------------------------------------
     def has_free_slot(self) -> bool:
         """True while another ``submit`` would find a slot: queued-but-
-        unadmitted requests count against the free slots, so a driver
+        unadmitted requests (and the slot reserved by an in-flight
+        chunked admission) count against the free slots, so a driver
         looping ``while b.has_free_slot(): b.submit(...)`` terminates."""
-        free = sum(s is None for s in self.slots)
+        free = sum(s is None and i not in self._reserved
+                   for i, s in enumerate(self.slots))
         return len(self._pending) < free
 
     def submit(self, prompt_ids, max_new_tokens: int, *,
@@ -274,16 +284,8 @@ class ContinuousBatcher:
                     lambda t: jnp.zeros(t.shape, t.dtype), template))
         return self._prefill_jit[key]()
 
-    def _prefill_chunked(self, prompt: np.ndarray, temperature: float,
-                         top_p: float, seed: int):
-        """Long-context admission (prompt beyond ``prefill_chunk``):
-        stream the prompt through the cache in fixed-size chunks —
-        O(chunk x max_len) transient attention memory — then run the
-        bucketed final call on the remainder.  Always solo: a long
-        prompt's prefill cost dwarfs the dispatch overhead batching
-        saves."""
+    def _chunk_jit(self):
         C = self.prefill_chunk
-        T0 = prompt.size
         if ("chunk", C) not in self._prefill_jit:
             def chunk_fn(params, cache, tokens_row):
                 _, vars_ = self.model.apply(
@@ -292,13 +294,41 @@ class ContinuousBatcher:
                 return vars_["cache"]
             self._prefill_jit[("chunk", C)] = jax.jit(
                 chunk_fn, donate_argnums=(1,))
-        cache = self._fresh_rows_cache(1)
-        n_full = (T0 - 1) // C          # >= 1 token left for the final call
-        for i in range(n_full):
-            cache = self._prefill_jit[("chunk", C)](
-                self.params, cache, prompt[None, i * C:(i + 1) * C])
-        return self._prefill_final(cache, [prompt[n_full * C:]], [T0],
-                                   [temperature], [top_p], [seed])
+        return self._prefill_jit[("chunk", C)]
+
+    def _advance_inflight(self) -> list[int]:
+        """Advance the in-flight chunked admission by ONE chunk (the
+        time slice), or finish it: run the bucketed final call on the
+        remainder and scatter into the reserved slot.  Long-context
+        admission therefore costs one extra dispatch per decode step
+        instead of stalling every running slot for the whole chunk
+        loop — O(chunk x max_len) transient attention memory per slice,
+        same as before."""
+        inf = self._inflight
+        C = self.prefill_chunk
+        rid, prompt, budget, temp, top_p, seed = inf["req"]
+        n_full = (prompt.size - 1) // C   # >= 1 token left for the final
+        i = inf["done_chunks"]
+        if i < n_full:
+            inf["cache"] = self._chunk_jit()(
+                self.params, inf["cache"], prompt[None, i * C:(i + 1) * C])
+            inf["done_chunks"] += 1
+            return []
+        first, row_cache = self._prefill_final(
+            inf["cache"], [prompt[n_full * C:]], [prompt.size],
+            [temp], [top_p], [seed])
+        slot = inf["slot"]
+        self._reserved.discard(slot)
+        self._scatter_rows(row_cache, [slot])
+        self._inflight = None
+        tok = int(np.asarray(first)[0])
+        s = _Slot(request_id=rid, remaining=budget - 1, tokens=[tok],
+                  temperature=temp, top_p=top_p, seed=seed)
+        if s.remaining <= 0 or tok == self.eos_id:
+            self._finish(slot, s)
+            return [rid]
+        self.slots[slot] = s
+        return []
 
     def _prefill_final(self, cache, rests: list, true_totals: list,
                        temps: list, top_ps: list, seeds: list):
@@ -369,34 +399,58 @@ class ContinuousBatcher:
         power-of-two prompt bucket and each group shares ONE batched
         prefill dispatch plus one scatter — O(distinct buckets) device
         dispatches for the round, not O(requests).  Prompts beyond
-        ``prefill_chunk`` keep the solo chunked path.  The loop repeats
-        while finished-at-admission requests keep freeing slots."""
+        ``prefill_chunk`` stream through the at-most-one in-flight
+        chunked admission, one chunk per step (``_advance_inflight``),
+        with their slot reserved until the final chunk lands.  The loop
+        repeats while finished-at-admission requests keep freeing
+        slots."""
         done = []
+        if self._inflight is not None:
+            done.extend(self._advance_inflight())
         while self._pending:
-            free = [i for i, s in enumerate(self.slots) if s is None]
+            free = [i for i, s in enumerate(self.slots)
+                    if s is None and i not in self._reserved]
             if not free:
                 break
-            take = self._pending[:len(free)]
-            del self._pending[:len(take)]
             C = self.prefill_chunk
-            groups: dict[int, list] = {}
-            solo = []
-            for req in take:
+            taken_idx = []
+            whole = []
+            for j, req in enumerate(self._pending):
+                if len(free) - len(whole) == 0:  # every free slot claimed
+                    break
                 if C is not None and req[1].size > C:
-                    solo.append(req)
+                    if self._inflight is not None:
+                        # one chunked admission at a time; SKIP (don't
+                        # stall the queue): short requests behind a
+                        # second long prompt still admit into free slots
+                        # while the first streams — relative order
+                        # within each class is preserved
+                        continue
+                    slot = free.pop()        # reserve from the tail
+                    self._reserved.add(slot)
+                    self._inflight = {
+                        "req": req, "slot": slot,
+                        "cache": self._fresh_rows_cache(1),
+                        "done_chunks": 0}
+                    taken_idx.append(j)
+                    # first slice; a chunked prompt always has >= 1 full
+                    # chunk before the final call, so it cannot finish
+                    # (or produce a token) on this slice
+                    self._advance_inflight()
                 else:
-                    Tp = min(_next_pow2(req[1].size),
-                             self.cfg.max_position_embeddings)
-                    groups.setdefault(Tp, []).append(req)
+                    taken_idx.append(j)
+                    whole.append(req)
+            if not taken_idx:
+                break
+            for j in reversed(taken_idx):
+                del self._pending[j]
+            groups: dict[int, list] = {}
+            for req in whole:
+                Tp = min(_next_pow2(req[1].size),
+                         self.cfg.max_position_embeddings)
+                groups.setdefault(Tp, []).append(req)
             free_iter = iter(free)
             admitted = []   # (slot_index, req_tuple, first_token)
-            for rid, prompt, budget, temp, top_p, seed in solo:
-                first, row_cache = self._prefill_chunked(prompt, temp,
-                                                         top_p, seed)
-                slot = next(free_iter)
-                self._scatter_rows(row_cache, [slot])
-                admitted.append((slot, (rid, budget, temp, top_p, seed),
-                                 int(first[0])))
             for reqs in groups.values():
                 rp = _next_pow2(len(reqs))
                 firsts, rows = self._prefill_final(
@@ -491,6 +545,7 @@ class ContinuousBatcher:
     def run(self) -> dict[int, np.ndarray]:
         """Drive ``step()`` until every submitted request has finished;
         returns ``{request_id: generated tokens}`` (prompt excluded)."""
-        while self._pending or any(self.slots):
+        while self._pending or self._inflight is not None \
+                or any(self.slots):
             self.step()
         return dict(self._results)

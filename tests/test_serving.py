@@ -388,3 +388,61 @@ def test_group_padding_rows_never_land():
     for rid, (p, n) in zip(rids, reqs):
         np.testing.assert_array_equal(results[rid],
                                       _oracle(cfg, params, p, n))
+
+
+def test_chunked_admission_is_time_sliced():
+    """Admitting a long (chunked) prompt must NOT stall running slots:
+    each step advances the in-flight prefill by one chunk while active
+    requests keep decoding, the target slot stays reserved until the
+    final chunk lands, and both outputs remain greedy-exact."""
+    cfg, params = _make()
+    rng = np.random.default_rng(13)
+    b = ContinuousBatcher(cfg, params, max_batch=2, prefill_chunk=4)
+    short = rng.integers(0, cfg.vocab_size, (3,)).astype(np.int32)
+    r1 = b.submit(short, 20)
+    b.step()                                  # r1 active
+    slot1 = next(i for i, s in enumerate(b.slots) if s is not None)
+
+    long_p = rng.integers(0, cfg.vocab_size, (18,)).astype(np.int32)
+    r2 = b.submit(long_p, 5)                  # 18 > 4: chunked, 4+final
+    for _ in range(4):                        # chunk slices 1..4
+        n_before = len(b.slots[slot1].tokens)
+        b.step()
+        assert b._inflight is not None, "inflight finished too early"
+        assert b._reserved, "target slot not reserved during chunking"
+        assert len(b.slots[slot1].tokens) == n_before + 1, \
+            "running slot stalled during chunked admission"
+    b.step()                                  # final chunk: scatter+admit
+    assert b._inflight is None and not b._reserved
+    results = b.run()
+    np.testing.assert_array_equal(results[r1],
+                                  _oracle(cfg, params, short, 20))
+    np.testing.assert_array_equal(results[r2],
+                                  _oracle(cfg, params, long_p, 5))
+
+
+def test_short_requests_bypass_blocked_chunked_head():
+    """A second long prompt queued behind an active chunked admission
+    must not stall short requests: they admit into free slots while the
+    first long prompt streams; all outputs stay greedy-exact."""
+    cfg, params = _make()
+    rng = np.random.default_rng(14)
+    b = ContinuousBatcher(cfg, params, max_batch=3, prefill_chunk=4)
+    longs = [rng.integers(0, cfg.vocab_size, (t,)).astype(np.int32)
+             for t in (18, 14)]
+    shorts = [rng.integers(0, cfg.vocab_size, (3,)).astype(np.int32)
+              for _ in range(2)]
+    r_l1 = b.submit(longs[0], 5)
+    r_l2 = b.submit(longs[1], 5)
+    r_s = [b.submit(p, 8) for p in shorts]
+    b.step()
+    # long-1 is streaming; long-2 blocked; both shorts must be in slots
+    assert b._inflight is not None
+    active = {s.request_id for s in b.slots if s is not None}
+    assert set(r_s) <= active, (active, r_s)
+    results = b.run()
+    for rid, (p, n) in zip([r_l1, r_l2] + r_s,
+                           [(longs[0], 5), (longs[1], 5)]
+                           + [(p, 8) for p in shorts]):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(cfg, params, p, n))

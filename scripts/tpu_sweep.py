@@ -638,6 +638,56 @@ def stage_serving() -> dict:
     row.update(measure(steady, "steady"))
     row.update(measure(bursty, "bursty"))
 
+    # ---- speculative continuous batching: same slot machinery, each
+    # step drafts per-slot from the request's own history and ONE verify
+    # dispatch commits per-row accepted lengths.  Repetitive prompts
+    # (the lookup regime: extraction/quoting/code) so acceptance fires;
+    # the tokens-per-dispatch ratio is the win a chip realizes as
+    # latency (decode is weight-read-bound, k+1 positions ride along).
+    rng_s = np.random.default_rng(7)
+    rep_reqs = [(np.tile(rng_s.integers(0, cfg.vocab_size,
+                                        (4,)).astype(np.int32), 4),
+                 int(rng_s.integers(lo, hi + 1))) for _ in range(n_req)]
+    rep_tokens = sum(n for _, n in rep_reqs)
+
+    def run_spec(spec_k):
+        # warm and time the SAME instance (executables are per-instance
+        # closures; a fresh batcher would recompile inside the window),
+        # accounting by counter deltas
+        b = ContinuousBatcher(cfg, params, max_batch=slots,
+                              speculative_k=spec_k)
+        for p, n in rep_reqs:
+            b.submit(p, n)
+        b.run()                                  # warm compiles
+        d0, a0, p0 = (b.decode_dispatches, b.spec_accepted,
+                      b.spec_proposed)
+        rids = [b.submit(p, n) for p, n in rep_reqs]
+        t0 = time.perf_counter()
+        res = b.run()
+        dt = time.perf_counter() - t0
+        got = sum(len(res[r]) for r in rids)
+        assert got == rep_tokens, (got, rep_tokens)
+        return (dt, b.decode_dispatches - d0, b.spec_accepted - a0,
+                b.spec_proposed - p0)
+
+    dt_spec, disp_spec, acc, prop = run_spec(4)
+    dt_nospec, _, _, _ = run_spec(None)
+    row.update({
+        "spec_tps": round(rep_tokens / dt_spec, 1),
+        "nospec_tps_same_traffic": round(rep_tokens / dt_nospec, 1),
+        "spec_speedup": round(dt_nospec / dt_spec, 3),
+        # decode-only accounting, mirroring the occupancy formula:
+        # each request's first token comes from its prefill dispatch
+        "spec_tokens_per_dispatch": round(
+            (rep_tokens - n_req) / max(disp_spec, 1), 3),
+        "spec_acceptance": round(acc / max(prop, 1), 3),
+        "spec_note": "tokens_per_dispatch is the transferable number: "
+                     "CPU forwards are compute-bound so k+1 positions "
+                     "cost ~(k+1)x and spec_speedup < 1 here; on TPU "
+                     "decode is weight-read-bound and the same "
+                     "acceptance turns into real speedup",
+    })
+
     gen = jax.jit(greedy_generate, static_argnums=(0, 3))
 
     def run_static():

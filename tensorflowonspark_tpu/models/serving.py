@@ -116,7 +116,9 @@ class ContinuousBatcher:
 
     def __init__(self, cfg: GPTConfig, params, max_batch: int,
                  eos_id: int | None = None,
-                 prefill_chunk: int | None = None):
+                 prefill_chunk: int | None = None,
+                 speculative_k: int | None = None,
+                 speculative_ngram: int = 3):
         if cfg.rolling_kv_cache:
             raise ValueError("ContinuousBatcher requires a full-length "
                              "cache (rolling_kv_cache=False)")
@@ -125,6 +127,29 @@ class ContinuousBatcher:
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, "
                              f"got {prefill_chunk}")
+        if speculative_k is not None and speculative_k < 1:
+            raise ValueError(f"speculative_k must be >= 1, "
+                             f"got {speculative_k}")
+        if speculative_ngram < 1:
+            raise ValueError(f"speculative_ngram must be >= 1, "
+                             f"got {speculative_ngram}")
+        #: prompt-lookup speculative decoding INSIDE continuous batching:
+        #: every decode step drafts up to ``speculative_k`` tokens per
+        #: greedy slot from that request's own history (the most recent
+        #: ``speculative_ngram`` context match — no draft model) and one
+        #: fused verify dispatch processes ``k+1`` positions for all
+        #: slots.  Unlike ``lookup_generate``'s shared cache index (whose
+        #: batch advances by the MINIMUM acceptance), the per-row position
+        #: substrate lets every slot commit ITS OWN accepted length.
+        #: Greedy-exact: drafts are only accepted where they equal the
+        #: model's own argmax; sampled slots simply draft 0 and take the
+        #: usual nucleus sample from the boundary logits.
+        self.spec_k = speculative_k
+        self.spec_ngram = speculative_ngram
+        #: speculation accounting: tokens proposed/accepted and committed
+        #: per verify dispatch (tokens_per_dispatch > 1 is the win)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         #: long-context admission: prompts longer than this are prefilled
         #: in fixed-size chunks through the SAME cached decode path (the
         #: cache index advances per chunk), bounding the transient
@@ -166,6 +191,9 @@ class ContinuousBatcher:
         self._reserved: set[int] = set()
         self._ids = itertools.count()
         self._results: dict[int, np.ndarray] = {}
+        #: prompt per live request (speculative drafting needs the full
+        #: history); dropped at finish so memory tracks the in-flight set
+        self._prompts: dict[int, np.ndarray] = {}
         # compiled-prefill registry:
         #   ("final", pow2_bucket, pow2_rows) -> batched prefill jit,
         #   ("chunk", chunk_len) -> chunk jit,
@@ -270,6 +298,7 @@ class ContinuousBatcher:
         rid = next(self._ids)
         self._pending.append((rid, prompt, int(max_new_tokens),
                               float(temperature), float(top_p), int(seed)))
+        self._prompts[rid] = prompt
         return rid
 
     def _fresh_rows_cache(self, rows: int):
@@ -478,6 +507,7 @@ class ContinuousBatcher:
 
     def _finish(self, i: int, s: _Slot) -> None:
         self._results[s.request_id] = np.asarray(s.tokens, np.int32)
+        self._prompts.pop(s.request_id, None)
         self.slots[i] = None
 
     # -- decode ------------------------------------------------------------
@@ -498,10 +528,126 @@ class ContinuousBatcher:
             self._poisoned = f"{type(e).__name__}: {e}"
             raise
 
+    def _draft(self, s: "_Slot", prompt: np.ndarray) -> np.ndarray:
+        """Prompt-lookup draft for one slot: continuation of the most
+        recent occurrence of the request's final ``spec_ngram`` tokens in
+        its own (prompt + generated) history; empty when no match.  Host-
+        side numpy — drafting is control flow, not device work."""
+        g, k = self.spec_ngram, self.spec_k
+        h = np.concatenate([prompt, np.asarray(s.tokens, np.int32)])
+        if h.size <= g:
+            return h[:0]
+        pat = h[-g:]
+        win = np.lib.stride_tricks.sliding_window_view(h, g)[:-1]
+        hits = np.flatnonzero((win == pat).all(axis=1))
+        if hits.size == 0:
+            return h[:0]
+        start = int(hits[-1]) + g
+        cont = h[start:start + k]
+        if 0 < cont.size < k:       # repeat the tail past known history
+            cont = np.concatenate(
+                [cont, np.full(k - cont.size, cont[-1], h.dtype)])
+        return cont.astype(np.int32)
+
+    def _verify_jit(self):
+        """ONE fused verify executable for the lifetime: ``k+1``
+        positions per row at per-row cache offsets.  Per-row acceptance
+        ``a_i`` = leading drafted tokens equal to the model's own argmax
+        (restricted to that row's valid draft length ``d_i``); the
+        boundary logits then yield the bonus token through the same
+        greedy/nucleus selector as the plain step.  Cache counters come
+        back adjusted to each row's committed position — stale K/V past
+        it stays masked by positional visibility until overwritten (the
+        ``rewind_cache`` contract, per-row)."""
+        if "verify" in self._prefill_jit:
+            return self._prefill_jit["verify"]
+        K = self.spec_k
+
+        def verify_fn(params, cache, toks, d, seeds, steps0, temps,
+                      top_ps):
+            logits, vars_ = self.model.apply(
+                {"params": params, "cache": cache}, toks,
+                mutable=["cache"])                       # [B, K+1, V]
+            greedy = jnp.argmax(logits, axis=-1)
+            ok = (toks[:, 1:] == greedy[:, :-1]) \
+                & (jnp.arange(K)[None, :] < d[:, None])
+            a = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1),
+                        axis=1)                          # [B] accepted
+            bound = jnp.take_along_axis(
+                logits, a[:, None, None], axis=1)[:, 0]  # [B, V]
+            bonus = _select_tokens(bound, seeds, steps0 + a, temps,
+                                   top_ps)
+            # counters advanced K+1 in apply; commit = pre + a + 1
+            cache = jax.tree_util.tree_map_with_path(
+                lambda p, leaf: leaf + (a - K)
+                if getattr(p[-1], "key", None) in ("index", "pos")
+                else leaf, vars_["cache"])
+            return a, bonus, cache
+
+        self._prefill_jit["verify"] = jax.jit(verify_fn,
+                                              donate_argnums=(1,))
+        return self._prefill_jit["verify"]
+
+    def _spec_step(self) -> list[int]:
+        """One speculative decode step for every active slot."""
+        K = self.spec_k
+        B = self.max_batch
+        toks = np.zeros((B, K + 1), np.int32)
+        d = np.zeros((B,), np.int32)
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            toks[i, :] = s.tokens[-1]
+            if s.temperature <= 0 and s.remaining > 1:
+                dr = self._draft(s, self._prompts[s.request_id])
+                di = min(dr.size, s.remaining - 1)
+                if di > 0:
+                    toks[i, 1:1 + dr.size] = dr
+                    d[i] = di
+        if not d.any():
+            # nothing drafted anywhere (all-sampled traffic, novel text,
+            # or every slot at its last token): fall through to the plain
+            # step — the (K+1)-position verify would pay ~(K+1)x compute
+            # to commit exactly one token per slot
+            return self._plain_step()
+        self.decode_dispatches += 1
+        a, bonus, self.cache = self._verify_jit()(
+            self.params, self.cache, jnp.asarray(toks), jnp.asarray(d),
+            jnp.asarray([s.seed if s else 0 for s in self.slots],
+                        jnp.int32),
+            jnp.asarray([len(s.tokens) if s else 0 for s in self.slots],
+                        jnp.int32),
+            jnp.asarray([s.temperature if s else 0.0 for s in self.slots],
+                        jnp.float32),
+            jnp.asarray([s.top_p if s else 1.0 for s in self.slots],
+                        jnp.float32))
+        a, bonus = np.asarray(a), np.asarray(bonus)
+        self.spec_proposed += int(d.sum())
+        self.spec_accepted += int(a.sum())
+        done = []
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            new = list(toks[i, 1:1 + a[i]]) + [int(bonus[i])]
+            for tok in new:
+                s.tokens.append(int(tok))
+                s.remaining -= 1
+                if s.remaining <= 0 or tok == self.eos_id:
+                    done.append(s.request_id)
+                    self._finish(i, s)
+                    break
+        return done
+
     def _step_inner(self) -> list[int]:
         done = self._admit()
         if not any(self.slots):
             return done
+        if self.spec_k is not None:
+            return done + self._spec_step()
+        return done + self._plain_step()
+
+    def _plain_step(self) -> list[int]:
+        done: list[int] = []
         self.decode_dispatches += 1
         tokens = jnp.asarray([s.tokens[-1] if s else 0
                               for s in self.slots], jnp.int32)

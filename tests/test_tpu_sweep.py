@@ -156,6 +156,9 @@ def test_serving_stage_dual_regime():
         assert row[f"{label}_prefill_dispatches"] < row["requests"]
     assert row["static_occupancy"] <= 1
     assert row["speedup_bursty"] > 0
+    # speculative row: repetitive traffic must actually accept drafts
+    assert row["spec_acceptance"] > 0
+    assert row["spec_tokens_per_dispatch"] > 1
 
 
 def test_bert_squad_stage_l5_path():

@@ -118,7 +118,8 @@ class ContinuousBatcher:
                  eos_id: int | None = None,
                  prefill_chunk: int | None = None,
                  speculative_k: int | None = None,
-                 speculative_ngram: int = 3):
+                 speculative_ngram: int = 3,
+                 speculative_window: int = 2048):
         if cfg.rolling_kv_cache:
             raise ValueError("ContinuousBatcher requires a full-length "
                              "cache (rolling_kv_cache=False)")
@@ -133,6 +134,9 @@ class ContinuousBatcher:
         if speculative_ngram < 1:
             raise ValueError(f"speculative_ngram must be >= 1, "
                              f"got {speculative_ngram}")
+        if speculative_window < speculative_ngram + 1:
+            raise ValueError(f"speculative_window must be > "
+                             f"speculative_ngram, got {speculative_window}")
         #: prompt-lookup speculative decoding INSIDE continuous batching:
         #: every decode step drafts up to ``speculative_k`` tokens per
         #: greedy slot from that request's own history (the most recent
@@ -146,6 +150,11 @@ class ContinuousBatcher:
         #: usual nucleus sample from the boundary logits.
         self.spec_k = speculative_k
         self.spec_ngram = speculative_ngram
+        #: drafting scans only the trailing ``speculative_window`` tokens
+        #: of a request's history, so per-step host cost is O(window),
+        #: not O(history) — a 100k-token context must not make the decode
+        #: loop host-bound (recent context is also where lookup hits live)
+        self.spec_window = speculative_window
         #: speculation accounting: tokens proposed/accepted and committed
         #: per verify dispatch (tokens_per_dispatch > 1 is the win)
         self.spec_proposed = 0
@@ -298,7 +307,8 @@ class ContinuousBatcher:
         rid = next(self._ids)
         self._pending.append((rid, prompt, int(max_new_tokens),
                               float(temperature), float(top_p), int(seed)))
-        self._prompts[rid] = prompt
+        if self.spec_k is not None:   # only drafting reads the history
+            self._prompts[rid] = prompt
         return rid
 
     def _fresh_rows_cache(self, rows: int):
@@ -535,6 +545,7 @@ class ContinuousBatcher:
         side numpy — drafting is control flow, not device work."""
         g, k = self.spec_ngram, self.spec_k
         h = np.concatenate([prompt, np.asarray(s.tokens, np.int32)])
+        h = h[-self.spec_window:]
         if h.size <= g:
             return h[:0]
         pat = h[-g:]

@@ -307,8 +307,9 @@ class ContinuousBatcher:
         rid = next(self._ids)
         self._pending.append((rid, prompt, int(max_new_tokens),
                               float(temperature), float(top_p), int(seed)))
-        if self.spec_k is not None:   # only drafting reads the history
-            self._prompts[rid] = prompt
+        if self.spec_k is not None:   # only drafting reads the history,
+            # and only its trailing window of it
+            self._prompts[rid] = prompt[-self.spec_window:]
         return rid
 
     def _fresh_rows_cache(self, rows: int):
@@ -544,8 +545,12 @@ class ContinuousBatcher:
         its own (prompt + generated) history; empty when no match.  Host-
         side numpy — drafting is control flow, not device work."""
         g, k = self.spec_ngram, self.spec_k
-        h = np.concatenate([prompt, np.asarray(s.tokens, np.int32)])
-        h = h[-self.spec_window:]
+        # slice BEFORE concatenating: the window bound must hold for the
+        # copies too, or a 100k-token context still pays O(history)/step
+        W = self.spec_window
+        tail = np.asarray(s.tokens[-W:], np.int32)
+        need = W - tail.size
+        h = tail if need <= 0 else np.concatenate([prompt[-need:], tail])
         if h.size <= g:
             return h[:0]
         pat = h[-g:]

@@ -30,9 +30,9 @@ from tensorflowonspark_tpu.parallel.embedding import (
     sharded_embedding_lookup)  # noqa: F401
 from tensorflowonspark_tpu.parallel.ring_attention import (ring_attention,
                                                            ring_self_attention)  # noqa: F401
-from tensorflowonspark_tpu.parallel.pipeline import (PipelineStrategy,
-                                                     pipeline_apply,
-                                                     stack_stage_params)  # noqa: F401
+from tensorflowonspark_tpu.parallel.pipeline import (
+    PipelineStrategy, pipeline_apply, pipeline_value_and_grad,
+    stack_stage_params)  # noqa: F401
 from tensorflowonspark_tpu.parallel.transformer import make_transformer_stage  # noqa: F401
 from tensorflowonspark_tpu.parallel.moe import make_moe_layer, moe_apply  # noqa: F401
 from tensorflowonspark_tpu.parallel.ulysses import (ulysses_attention,

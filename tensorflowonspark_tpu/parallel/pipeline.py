@@ -31,6 +31,17 @@ Design (TPU-first, not a port of any GPU schedule runner):
 
 Bubble fraction is the GPipe bound (S-1)/(M+S-1); pick
 ``num_microbatches >= 4 * num_stages`` to keep it under ~20%.
+
+Two schedules share this layout:
+
+- :func:`pipeline_apply` + ``jax.grad`` — GPipe: simplest composition,
+  but differentiating the forward scan retains one boundary activation
+  per tick, O(M + S) per stage, so memory caps the microbatch count.
+- :func:`pipeline_value_and_grad` — interleaved (1F1B-style): one
+  forward AND one backward microbatch per tick with the loss head
+  evaluated in-schedule, so a stage holds at most ``2S-1`` saved inputs
+  regardless of M.  Raise M to shrink the bubble without growing
+  activation memory.
 """
 
 from __future__ import annotations
@@ -159,6 +170,296 @@ def pipeline_apply(mesh, stage_fn, stage_params, x, *,
         schedule, mesh=mesh,
         in_specs=(params_spec, x_spec), out_specs=x_spec)
     return mapped(stage_params, x)
+
+
+def pipeline_value_and_grad(mesh, stage_fn, head_fn, stage_params,
+                            head_params, x, targets, *,
+                            num_microbatches: int, axis_name: str = "pp",
+                            param_specs=None, data_spec=None,
+                            head_specs=None, target_spec=None):
+    """Interleaved (1F1B-style) pipelined train pass: loss AND grads in
+    one schedule, with O(num_stages) in-flight activation residuals
+    instead of :func:`pipeline_apply` + ``jax.grad``'s O(num_microbatches).
+
+    Why a second schedule exists: differentiating the GPipe forward
+    saves one boundary activation per tick — O(M + S) per stage — so
+    the microbatch count that amortises the bubble is capped by memory.
+    Here every tick runs ONE forward and ONE backward microbatch per
+    stage (the 1F1B interleaving), so a stage only holds the inputs of
+    microbatches whose backward hasn't caught up yet: a static circular
+    buffer of ``2S-1`` — the lockstep-SPMD bound; the textbook S comes
+    from asynchronous stage timing that a single compiled program cannot
+    express — regardless of M.  Raising M then shrinks the bubble,
+    (2S-2)/(M+2S-2), without growing activation memory.  Backward
+    recomputes the stage forward from the saved input (the same remat
+    GPipe mode uses), so compute per microbatch is identical.
+
+    Masking is free by linearity: out-of-range ticks run the stage on
+    garbage with a ZERO gradient seed, and ``vjp(0) == 0`` means they
+    contribute nothing to parameter grads — no per-leaf ``where``.
+
+    Args:
+      stage_fn: ``(params, x) -> y`` for one stage, ``y.shape == x.shape``
+        (runs inside ``shard_map``; tensor parallelism inside the stage
+        uses explicit collectives, as in :func:`pipeline_apply`).
+      head_fn: ``(head_params, y, target) -> scalar`` — the per-
+        microbatch loss head, evaluated ON the last stage (its gradient
+        seeds the backward).  The returned loss/grads are the MEAN over
+        microbatches.
+      stage_params: stacked per-stage tree (leading axis S).
+      x: ``[B, ...]`` activations entering stage 0 (e.g. embedded ids);
+        ``B`` must divide by ``num_microbatches`` x data shards.
+      targets: ``[B, ...]`` per-sample targets consumed by ``head_fn``.
+
+    Returns ``(loss, stage_grads, head_grads, dx)``: ``stage_grads``
+    stacked like ``stage_params``, ``head_grads`` like ``head_params``
+    (summed over the pipeline — replicated head), ``dx`` like ``x``
+    (the gradient entering stage 0, for the embedding backward).
+    """
+    n_stages = mesh.shape[axis_name]
+    if num_microbatches < 1:
+        raise ValueError(
+            f"num_microbatches must be >= 1, got {num_microbatches}")
+    M = num_microbatches
+    batch = x.shape[0]
+    data_shards = 1
+    for ax in sh.DATA_AXES:
+        data_shards *= mesh.shape.get(ax, 1)
+    if batch % (M * data_shards):
+        raise ValueError(
+            f"global batch {batch} must divide by num_microbatches "
+            f"({M}) x data shards ({data_shards})")
+
+    if param_specs is None:
+        params_spec = pipeline_spec(stage_params)
+    else:
+        params_spec = jax.tree.map(lambda s: P(axis_name, *s), param_specs,
+                                   is_leaf=lambda s: isinstance(s, P))
+    x_spec = data_spec if data_spec is not None \
+        else P(sh.DATA_AXES, *([None] * (x.ndim - 1)))
+    # targets must shard like the activations they are compared against
+    # in the in-schedule head (e.g. sequence over sp when data_spec
+    # shards it); default: batch over the data axes only
+    t_spec = target_spec if target_spec is not None \
+        else P(sh.DATA_AXES, *([None] * (targets.ndim - 1)))
+    h_spec = head_specs if head_specs is not None \
+        else jax.tree.map(lambda _: P(), head_params)
+
+    S = n_stages
+    BUF = 2 * S - 1
+
+    def schedule(block, hp, x_local, tgt_local):
+        my_params = jax.tree.map(lambda p: jnp.squeeze(p, 0), block)
+        stage = jax.lax.axis_index(axis_name)
+        mb = x_local.shape[0] // M
+        x_mb = x_local.reshape((M, mb) + x_local.shape[1:])
+        t_mb = tgt_local.reshape((M, mb) + tgt_local.shape[1:])
+        n_ticks = M + 2 * (S - 1)
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+        last = S - 1
+
+        def head_loss(hp, y, t):
+            return head_fn(hp, y, t) / M
+
+        # pp (the schedule), the data axes (the batch), the axes the
+        # activations are DECLARED sharded over (e.g. sp from a
+        # sequence-sharding data_spec — the per-shard loss then averages
+        # over them), and every SIZE-1 axis: forcing a size-1 axis
+        # varying is semantically free and lets a stage's internal
+        # collectives (e.g. the ring-attention scan's ppermute over sp
+        # at sp=1) type-check — their carries inherit the input's vma.
+        declared = set()
+        for s in (x_spec, t_spec):
+            for e in s:
+                if isinstance(e, tuple):
+                    declared |= set(e)
+                elif e is not None:
+                    declared.add(e)
+        vary_axes = (axis_name,) + tuple(
+            a for a in mesh.axis_names
+            if a != axis_name and (a in sh.DATA_AXES or a in declared
+                                   or mesh.shape[a] == 1))
+
+        def pvary(z):
+            # mark values varying over the axes the schedule makes them
+            # vary on — pp plus the data axes — skipping axes a leaf
+            # already varies over (the scan's vma check requires carry
+            # input/output types to match exactly)
+            def one(a):
+                have = getattr(jax.typeof(a), "vma", frozenset())
+                need = tuple(ax for ax in vary_axes if ax not in have)
+                return jax.lax.pcast(a, need, to="varying") if need else a
+            return jax.tree.map(one, z)
+
+        # differentiate w.r.t. FULLY-VARYING copies of the parameters:
+        # the vma transpose rule for an unvarying input consumed in a
+        # varying computation is an implicit psum over the missing axes,
+        # which would (a) mix every stage's (mostly-garbage) head
+        # gradient into each device's dhp before the seed_ok mask can
+        # gate it, and (b) pre-SUM stage grads over the data shards,
+        # turning the explicit pmean below into a no-op on already-equal
+        # values (an n_data-times-too-large gradient)
+        hp = pvary(hp)
+        my_params = pvary(my_params)
+
+        def tick(carry, t):
+            act, grad, buf, dp, dhp, dx_out, loss = carry
+            f = t - stage                       # fwd microbatch index
+            b = t - 2 * (S - 1) + stage         # bwd microbatch index
+            f_ok = jnp.logical_and(f >= 0, f < M)
+            b_ok = jnp.logical_and(b >= 0, b < M)
+            f_c = jnp.clip(f, 0, M - 1)
+            b_c = jnp.clip(b, 0, M - 1)
+
+            # ---- forward: stage 0 injects, others take the ppermuted act
+            inp = jnp.where(stage == 0,
+                            jax.lax.dynamic_index_in_dim(
+                                x_mb, f_c, 0, keepdims=False), act)
+            y = stage_fn(my_params, inp)
+            # guard the residual write: drain ticks (f >= M, clipped to
+            # M-1) would otherwise clobber slot (M-1) % BUF before its
+            # backward has read it
+            buf = jnp.where(
+                f_ok,
+                jax.lax.dynamic_update_index_in_dim(buf, inp, f_c % BUF, 0),
+                buf)
+
+            # ---- last stage: loss + gradient seed for THIS microbatch
+            tgt = jax.lax.dynamic_index_in_dim(t_mb, f_c, 0, keepdims=False)
+            (l_mb, (dhp_mb, dy)) = jax.value_and_grad(
+                head_loss, argnums=(0, 1))(hp, y, tgt)
+            seed_ok = jnp.logical_and(stage == last, f_ok)
+            loss = loss + jnp.where(seed_ok, l_mb, 0.0)
+            dhp = jax.tree.map(
+                lambda a, g: a + jnp.where(seed_ok, g, 0), dhp, dhp_mb)
+
+            # ---- backward: vjp of the recomputed stage forward on the
+            # saved input; zero gradient seed on invalid ticks makes the
+            # whole contribution vanish (linearity)
+            x_in = jax.lax.dynamic_index_in_dim(buf, b_c % BUF, 0,
+                                                keepdims=False)
+            g_in = jnp.where(stage == last, dy, grad)
+            g_in = jnp.where(b_ok, g_in, jnp.zeros_like(g_in))
+            _, vjp_fn = jax.vjp(stage_fn, my_params, x_in)
+            dp_mb, dx_mb = vjp_fn(g_in)
+            dp = jax.tree.map(jnp.add, dp, dp_mb)
+            write_dx = jnp.logical_and(stage == 0, b_ok)
+            dx_out = jnp.where(
+                write_dx,
+                jax.lax.dynamic_update_index_in_dim(dx_out, dx_mb, b_c, 0),
+                dx_out)
+
+            act = jax.lax.ppermute(y, axis_name, fwd_perm)
+            grad = jax.lax.ppermute(dx_mb, axis_name, bwd_perm)
+            out = (act, grad, buf, dp, dhp, dx_out, loss)
+            # normalize carry types: a stage collective can mark an
+            # output varying over an axis the carry does not declare
+            # (e.g. the ring-attention leg's ppermute marks sp-varying
+            # even at sp=1, where no psum restores invariance).  A
+            # size-1 psum is the identity and exactly cancels the vma
+            # artifact; a size>1 leak is a REAL unreduced partial and
+            # must be declared instead.
+            return jax.tree.map(_norm, out, ref_vma), None
+
+        def _norm(o, ref):
+            extra = tuple(a for a in getattr(jax.typeof(o), "vma",
+                                             frozenset()) if a not in ref)
+            for a in extra:
+                if mesh.shape[a] != 1:
+                    raise ValueError(
+                        f"1f1b carry became varying over mesh axis {a!r} "
+                        f"(size {mesh.shape[a]}) — a stage collective "
+                        "produced an unreduced partial; declare the axis "
+                        "in param_specs/data_spec or reduce it inside "
+                        "stage_fn")
+            return jax.lax.psum(o, extra) if extra else o
+
+        carry0 = (
+            pvary(jnp.zeros_like(x_mb[0])),                    # act
+            pvary(jnp.zeros_like(x_mb[0])),                    # grad
+            pvary(jnp.zeros((BUF, mb) + x_local.shape[1:],
+                            x_local.dtype)),                   # buf
+            pvary(jax.tree.map(jnp.zeros_like, my_params)),    # dp
+            pvary(jax.tree.map(lambda h: jnp.zeros(h.shape, h.dtype),
+                               hp)),                           # dhp
+            pvary(jnp.zeros_like(x_mb)),                       # dx_out
+            pvary(jnp.zeros((), jnp.float32)),                 # loss
+        )
+        ref_vma = jax.tree.map(
+            lambda a: getattr(jax.typeof(a), "vma", frozenset()), carry0)
+        (_, _, _, dp, dhp, dx_out, loss), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(n_ticks))
+
+        # loss lives on the last stage, dx on stage 0, head grads on the
+        # last stage; psum the masked values over pp so every shard
+        # agrees.  The reductions the outer autodiff would normally
+        # insert are explicit here: every output is pmean'd over exactly
+        # the axes it still varies on beyond what its out_spec shards
+        # over — the data axes (the global batch mean); any OTHER leaked
+        # axis (a stage collective's vma artifact) must be size 1, where
+        # the pmean is a no-op.  dx stays per-shard (each shard's own
+        # rows) but scales by the same 1/n_data the global mean applies.
+        def spec_axes(s):
+            axes = set()
+            for e in s:
+                if isinstance(e, tuple):
+                    axes |= set(e)
+                elif e is not None:
+                    axes.add(e)
+            return axes
+
+        def fit(g, allowed):
+            have = getattr(jax.typeof(g), "vma", frozenset())
+            extra = tuple(a for a in have if a not in allowed)
+            for a in extra:
+                # data axes and declared activation axes average away
+                # (equal-sized shards of a row-mean loss); anything else
+                # of size > 1 is an unreduced partial — a bug
+                if (a not in sh.DATA_AXES and a not in declared
+                        and mesh.shape[a] != 1):
+                    raise ValueError(
+                        f"1f1b output varies over mesh axis {a!r} "
+                        f"(size {mesh.shape[a]}) that its out_spec does "
+                        "not shard over — declare it in param_specs/"
+                        "data_spec/head_specs, or keep that axis out of "
+                        "the stage")
+            return jax.lax.pmean(g, extra) if extra else g
+
+        def fit_tree(tree, specs, extra_allowed=frozenset()):
+            flat_g, tdef = jax.tree.flatten(tree)
+            flat_s = jax.tree.flatten(
+                specs, is_leaf=lambda s: isinstance(s, P))[0]
+            return jax.tree.unflatten(
+                tdef, [fit(g, spec_axes(s) | extra_allowed)
+                       for g, s in zip(flat_g, flat_s)])
+
+        loss = fit(jax.lax.psum(
+            jnp.where(stage == last, loss, 0.0), axis_name), set())
+        dhp = fit_tree(
+            jax.tree.map(
+                lambda g: jax.lax.psum(
+                    jnp.where(stage == last, g, jnp.zeros_like(g)),
+                    axis_name),
+                dhp),
+            h_spec)
+        dp = jax.tree.map(
+            lambda g: g[None],
+            fit_tree(dp, jax.tree.map(
+                lambda s: P(*s[1:]), params_spec,
+                is_leaf=lambda s: isinstance(s, P)),   # specs sans pp...
+                extra_allowed=frozenset((axis_name,))))  # ...but pp stays
+        dx = fit(jax.lax.psum(
+            jnp.where(stage == 0, dx_out, jnp.zeros_like(dx_out)),
+            axis_name), spec_axes(x_spec)).reshape(x_local.shape) \
+            / data_shards
+        return loss, dp, dhp, dx
+
+    mapped = jax.shard_map(
+        schedule, mesh=mesh,
+        in_specs=(params_spec, h_spec, x_spec, t_spec),
+        out_specs=(P(), params_spec, h_spec, x_spec))
+    return mapped(stage_params, head_params, x, targets)
 
 
 class _PipelineRules:

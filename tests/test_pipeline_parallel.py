@@ -134,3 +134,148 @@ def test_pipeline_strategy_trains_and_matches_single_device():
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
         jax.device_get(state2.params), params_ref)
     assert int(state2.step) == 1
+
+
+def _head_fn(hp, y, tgt):
+    """Per-microbatch loss head: linear projection + mse."""
+    return jnp.mean((y @ hp["wo"] - tgt) ** 2)
+
+
+def _oracle_value_and_grad(stacked, hp, x, tgt):
+    """Serial single-device oracle for loss + every gradient."""
+    def loss_fn(stacked, hp, x):
+        return _head_fn(hp, _sequential(stacked, x), tgt)
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+        stacked, hp, x)
+    return loss, *grads
+
+
+@pytest.mark.parametrize("pp,dp,num_mb", [(4, 1, 8), (2, 2, 4), (4, 2, 5),
+                                          (2, 1, 1)])
+def test_1f1b_matches_serial_value_and_grad(pp, dp, num_mb):
+    """The interleaved (1F1B-style) schedule must reproduce the serial
+    oracle's loss, stage grads, head grads, and input grad — the whole
+    train pass, not just the forward."""
+    from tensorflowonspark_tpu.parallel import pipeline_value_and_grad
+
+    mesh = make_mesh(MeshSpec(pp=pp, dp=dp), devices=jax.devices()[:pp * dp])
+    stacked = _make_stage_params(jax.random.key(0), pp)
+    hp = {"wo": jax.random.normal(jax.random.key(2), (HID, HID)) * 0.2}
+    B = 2 * num_mb * dp
+    x = jax.random.normal(jax.random.key(1), (B, HID))
+    tgt = jax.random.normal(jax.random.key(3), (B, HID))
+
+    # NOTE the oracle loss is the mean over microbatches of per-mb means,
+    # which equals the full-batch mean here because microbatches are
+    # equal-sized
+    loss, dstages, dhp, dx = jax.jit(
+        lambda s, h, x, t: pipeline_value_and_grad(
+            mesh, _stage_fn, _head_fn, s, h, x, t,
+            num_microbatches=num_mb))(stacked, hp, x, tgt)
+    want_loss, want_ds, want_dh, want_dx = _oracle_value_and_grad(
+        stacked, hp, x, tgt)
+
+    np.testing.assert_allclose(float(loss), float(want_loss),
+                               rtol=1e-5, atol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
+        dstages, want_ds)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5), dhp, want_dh)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(want_dx),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_1f1b_residual_buffer_is_stage_bound_not_microbatch_bound():
+    """The schedule's activation residual buffer is 2S-1 slots regardless
+    of the microbatch count — the memory contract that lets M grow to
+    shrink the bubble.  Checked structurally from the jaxpr: the scan
+    carry holds one [2S-1, mb, ...] buffer and no [M, ...]-sized residual
+    (M=32 >> 2S-1=3 here)."""
+    from tensorflowonspark_tpu.parallel import pipeline_value_and_grad
+
+    pp, M = 2, 32
+    mesh = make_mesh(MeshSpec(pp=pp, dp=1), devices=jax.devices()[:pp])
+    stacked = _make_stage_params(jax.random.key(0), pp)
+    hp = {"wo": jnp.eye(HID)}
+    B = M * 2
+    x = jnp.ones((B, HID))
+    tgt = jnp.zeros((B, HID))
+    jaxpr = jax.make_jaxpr(
+        lambda s, h, x, t: pipeline_value_and_grad(
+            mesh, _stage_fn, _head_fn, s, h, x, t, num_microbatches=M))(
+        stacked, hp, x, tgt)
+    scans = [e for e in str(jaxpr).split("scan[")[1:]]
+    assert scans, "schedule did not lower to a scan"
+    # the residual buffer appears with leading dim 2S-1; nothing in the
+    # carry may scale with M beyond the fixed dx/x collectors
+    buf_sig = f"{2 * pp - 1},{B // M},{HID}"
+    assert buf_sig in str(jaxpr).replace(" ", ""), \
+        f"no {2 * pp - 1}-slot (2S-1) buffer found"
+
+
+def test_1f1b_composes_with_tensor_parallel_stage():
+    """The interleaved schedule with a Megatron-tp transformer stage
+    (collectives INSIDE stage_fn) on a pp2·tp2 mesh matches the serial
+    single-device oracle for loss and stage grads."""
+    from tensorflowonspark_tpu.parallel import (make_transformer_stage,
+                                                pipeline_value_and_grad)
+
+    pp, tp, num_mb = 2, 2, 4
+    hidden, heads, ffn = 16, 2, 32
+    mesh = make_mesh(MeshSpec(pp=pp, tp=tp), devices=jax.devices()[:pp * tp])
+    stage_fn, init_fn, param_specs = make_transformer_stage(
+        hidden, heads, ffn, tp=tp, causal=True)
+    keys = jax.random.split(jax.random.key(0), pp)
+    stacked = stack_stage_params([init_fn(k) for k in keys])
+    hp = {"wo": jax.random.normal(jax.random.key(2), (hidden, hidden)) * 0.2}
+    B, T = 2 * num_mb, 8
+    x = jax.random.normal(jax.random.key(1), (B, T, hidden))
+    tgt = jax.random.normal(jax.random.key(3), (B, T, hidden))
+
+    def head(hp, y, t):
+        return jnp.mean((y @ hp["wo"] - t) ** 2)
+
+    loss, ds, dh, dx = jax.jit(
+        lambda s, h, x, t: pipeline_value_and_grad(
+            mesh, stage_fn, head, s, h, x, t, num_microbatches=num_mb,
+            param_specs=param_specs))(stacked, hp, x, tgt)
+
+    # serial oracle: single-device mesh of the same tp width is not
+    # available inside one test process; instead run the stages serially
+    # UNDER the same mesh (tp collectives active, pp folded away)
+    def serial_loss(stacked, hp, x):
+        n = jax.tree.leaves(stacked)[0].shape[0]
+        y = x
+        for i in range(n):
+            pi = jax.tree.map(lambda p: p[i], stacked)
+            y = _tp_serial_stage(mesh, stage_fn, pi, y, param_specs)
+        return head(hp, y, tgt)
+
+    want_loss, (want_ds, want_dh, want_dx) = jax.value_and_grad(
+        serial_loss, argnums=(0, 1, 2))(stacked, hp, x)
+    np.testing.assert_allclose(float(loss), float(want_loss),
+                               rtol=1e-5, atol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5), ds, want_ds)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5), dh, want_dh)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(want_dx),
+                               rtol=5e-4, atol=5e-5)
+
+
+def _tp_serial_stage(mesh, stage_fn, params_i, x, param_specs):
+    """Run ONE stage under shard_map over tp only (pp replicated).
+
+    The ring-attention leg's internal scan needs sp-varying inputs to
+    type-check even at sp=1; the size-1 pcast/psum pair is the identity.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def wrapped(p, x):
+        x = jax.lax.pcast(x, ("sp",), to="varying")
+        return jax.lax.psum(stage_fn(p, x), ("sp",))
+
+    return jax.shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(param_specs, P()), out_specs=P())(params_i, x)

@@ -125,6 +125,7 @@ def _anchor_mfu():
         "ring_longctx_sp": xfmr, "ring_longctx_sp_t8k": xfmr,
         "ring16_sp_t8k": xfmr, "ulysses16_sp_t8k": xfmr,
         "moe_ep8_dp": xfmr, "gpipe_pp8_dp": xfmr, "gpipe_pp8_2slice": xfmr,
+        "pp8_1f1b_m64_dp": xfmr,
     }
     return table, prov
 
@@ -904,6 +905,80 @@ def _build_pipeline_pp8(n: int, slices: int = 1):
     return mesh, jitted, (abstract_params, abstract_opt, ids), trip
 
 
+def _build_pipeline_pp8_1f1b(n: int):
+    """The interleaved (1F1B-style) schedule at 4x GPipe's microbatches:
+    ``pipeline_value_and_grad`` holds only 2S-1 in-flight stage inputs,
+    so m=64 fits where GPipe+autodiff's O(m+S) boundary storage caps the
+    row above at m=16 — the bubble fraction drops (2S-2)/(m+2S-2):
+    14/78 = 18% of ticks vs GPipe's 7/23 = 30%.  Same stages, same
+    per-microbatch traffic; the comparison against ``gpipe_pp8_dp``
+    quantifies what the memory bound buys."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel import (make_mesh,
+                                                make_transformer_stage,
+                                                pipeline_value_and_grad,
+                                                stack_stage_params)
+    from tensorflowonspark_tpu.parallel.mesh import MeshSpec
+
+    mesh = make_mesh(MeshSpec(pp=8, dp=n // 8), devices=jax.devices()[:n])
+    hidden, heads, ffn, seq, vocab = 768, 12, 3072, 512, 32768
+    num_mb = 64
+    batch = num_mb * mesh.shape["dp"]      # 1 sample/mb/shard at m=64
+    stage_fn, init_fn, param_specs = make_transformer_stage(
+        hidden, heads, ffn, tp=1, causal=True, dtype=jnp.bfloat16)
+    tx = optax.adamw(1e-4)
+    data_spec = P(("dp", "fsdp"), "sp", None)
+
+    def init_params():
+        keys = jax.random.split(jax.random.key(0), 8)
+        return {
+            "emb": (jax.random.normal(jax.random.key(1), (vocab, hidden))
+                    * 0.02).astype(jnp.bfloat16),
+            "stages": stack_stage_params([init_fn(k) for k in keys]),
+        }
+
+    p_sh = {
+        "emb": NamedSharding(mesh, P()),
+        "stages": jax.tree.map(
+            lambda s: NamedSharding(mesh, P("pp", *s)), param_specs,
+            is_leaf=lambda s: isinstance(s, P)),
+    }
+    abstract_params = jax.eval_shape(init_params)
+    abstract_opt = jax.eval_shape(tx.init, abstract_params)
+    ids = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+
+    def head(hp, y, tgt):
+        logits = jnp.einsum("bsh,vh->bsv", y, hp["emb"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).mean()
+
+    def train_step(p, o, ids):
+        x = p["emb"][ids]
+        tgt = jnp.roll(ids, -1, axis=1)
+        loss, ds, dh, dxe = pipeline_value_and_grad(
+            mesh, stage_fn, head, p["stages"], {"emb": p["emb"]},
+            x, tgt, num_microbatches=num_mb,
+            param_specs=param_specs, data_spec=data_spec,
+            target_spec=P(("dp", "fsdp"), None))
+        # embedding grad = tied-head grad + the lookup's scatter-add
+        demb = dh["emb"] + jnp.zeros_like(p["emb"]).at[ids].add(
+            dxe.astype(p["emb"].dtype))
+        grads = {"emb": demb, "stages": ds}
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    jitted = jax.jit(
+        train_step, donate_argnums=(0, 1),
+        in_shardings=(p_sh, None,
+                      NamedSharding(mesh, P(("dp", "fsdp"), None))))
+    trip = num_mb + 2 * (mesh.shape["pp"] - 1)
+    return mesh, jitted, (abstract_params, abstract_opt, ids), trip
+
+
 WORKLOADS = {"resnet50_dp": _build_resnet_dp,
              "resnet50_dp_2slice": functools.partial(_build_resnet_dp,
                                                      slices=2),
@@ -920,6 +995,7 @@ WORKLOADS = {"resnet50_dp": _build_resnet_dp,
                                                    impl="ulysses"),
              "moe_ep8_dp": _build_moe_ep8,
              "gpipe_pp8_dp": _build_pipeline_pp8,
+             "pp8_1f1b_m64_dp": _build_pipeline_pp8_1f1b,
              "gpipe_pp8_2slice": functools.partial(_build_pipeline_pp8,
                                                    slices=2)}
 

@@ -449,10 +449,19 @@ def pipeline_value_and_grad(mesh, stage_fn, head_fn, stage_params,
                 lambda s: P(*s[1:]), params_spec,
                 is_leaf=lambda s: isinstance(s, P)),   # specs sans pp...
                 extra_allowed=frozenset((axis_name,))))  # ...but pp stays
+        # dx keeps every axis x is declared sharded over, so unlike the
+        # pmean'd grads it must apply the FULL global-mean divisor
+        # itself: data shards times any declared non-data shards (e.g.
+        # sp sequence shards — the per-shard head is a local mean and
+        # the global loss averages over those shards too)
+        dx_div = data_shards
+        for a in spec_axes(x_spec):
+            if a not in sh.DATA_AXES and a != axis_name \
+                    and a in mesh.axis_names:
+                dx_div *= mesh.shape[a]
         dx = fit(jax.lax.psum(
             jnp.where(stage == 0, dx_out, jnp.zeros_like(dx_out)),
-            axis_name), spec_axes(x_spec)).reshape(x_local.shape) \
-            / data_shards
+            axis_name), spec_axes(x_spec)).reshape(x_local.shape) / dx_div
         return loss, dp, dhp, dx
 
     mapped = jax.shard_map(

@@ -61,7 +61,13 @@ def main_fun(args, ctx):
     print(f"node {ctx.executor_id}: mesh {dict(mesh.shape)}", flush=True)
 
     model = WideDeep(vocab_sizes=vocab_sizes, embed_dim=args.embed_dim)
-    tx = optax.adagrad(args.lr)  # the reference example's optimizer family
+    # the reference example's optimizer family, applied DENSE here (the
+    # whole model trains in one step fn).  For Criteo-scale tables where
+    # the O(vocab) dense sweeps dominate, train the tables with
+    # parallel.build_sparse_embedding_train_step instead (TF SparseApply
+    # semantics, rows-touched-only; measured 3-5x the dense step —
+    # bench_artifacts/embedding_cpu.json)
+    tx = optax.adagrad(args.lr)
     rng = np.random.default_rng(17 + ctx.executor_id)
     dense, cat, label = _batch(rng, vocab_sizes, args.batch_size)
 

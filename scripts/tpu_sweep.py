@@ -1055,7 +1055,7 @@ def main() -> None:
         ("gpt_train_b8_flash", [sys.executable, me, "--stage", "gpt_train",
                                 "--batch", "8", "--attn", "flash"], 900),
         ("decode_matrix", [sys.executable, me, "--stage", "decode"], 1800),
-        ("serving", [sys.executable, me, "--stage", "serving"], 900),
+        ("serving", [sys.executable, me, "--stage", "serving"], 1500),
         # bench_overlap writes its own overlap_<platform>.json; skipped in
         # smoke so a CPU smoke run can't clobber the committed CPU artifact
         *([] if SMOKE else [

@@ -175,3 +175,48 @@ def test_bert_squad_stage_l5_path():
     assert "TFEstimator" in row["path"]
     import math
     assert math.isfinite(row["loss"])
+
+
+def test_mfu_attack_join(tmp_path, monkeypatch):
+    """mfu_attack joins profile + roofline + flag rows into a ranked
+    verdict, and degrades to named pendings when captures are missing."""
+    import importlib.util as ilu
+
+    spec = ilu.spec_from_file_location(
+        "mfu_attack", os.path.join(ROOT, "scripts", "mfu_attack.py"))
+    mod = ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    art = tmp_path / "bench_artifacts"
+    art.mkdir()
+    monkeypatch.setattr(mod, "ART", str(art))
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))
+
+    (art / "resnet_profile_b256.json").write_text(json.dumps({
+        "category_pct": {"convolution fusion": 60.0, "copy": 25.0,
+                         "all-reduce": 15.0},
+        "top_ops": [{"category": "copy", "op": "copy.1", "self_us": 90.0,
+                     "pct": 25.0}]}))
+    (art / "resnet_mxu_ceiling.json").write_text(json.dumps({
+        "configs": [{"batch": 256, "padding_ceiling_mfu": 0.73,
+                     "worst_tile_layers": [{"layer": "s1b1_1x1a",
+                                            "tile_efficiency": 0.3}]}]}))
+    (art / "resnet_sweep.json").write_text(json.dumps({"rows": [
+        {"batch": 256, "remat": False, "stem": "conv7", "bn": "f32",
+         "loop": False, "xla": "", "images_per_sec": 2000.0, "mfu": 0.24},
+        {"batch": 256, "remat": False, "stem": "conv7", "bn": "f32",
+         "loop": False, "xla": "vmem96", "images_per_sec": 2100.0,
+         "mfu": 0.252},
+        {"batch": 256, "remat": False, "stem": "conv7", "bn": "f32",
+         "loop": False, "xla": "nolhs", "images_per_sec": 1900.0,
+         "mfu": 0.228}]}))
+
+    import sys as _sys
+    monkeypatch.setattr(_sys, "argv", ["mfu_attack.py"])
+    mod.main()
+    out = json.loads((art / "mfu_attack.json").read_text())
+    assert out["pending"] == []
+    assert out["non_conv_pct"] == 40.0
+    assert out["flag_attack"][0]["xla"] == "vmem96"
+    assert out["flag_attack"][0]["speedup_vs_control"] == 1.05
+    assert "vmem96" in out["verdict"] and "1.050x" in out["verdict"]
+    assert "40.0%" in out["verdict"]

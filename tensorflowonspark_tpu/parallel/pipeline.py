@@ -531,6 +531,59 @@ class PipelineStrategy(MeshStrategy):
                               num_microbatches=self.num_microbatches,
                               remat=self.remat)
 
+    def build_train_step_1f1b(self, head_fn, tx=None, donate: bool = True,
+                              *, param_specs=None, data_spec=None,
+                              head_specs=None, target_spec=None):
+        """Compile ``state, (x, targets) -> state, metrics`` on the
+        interleaved (1F1B-style) schedule.
+
+        Unlike :meth:`build_train_step` (GPipe trunk + free-form
+        ``loss_fn`` differentiated by AD), the interleaved schedule must
+        evaluate the loss IN-SCHEDULE, so the loss factors as
+        ``head_fn(head_params, y, targets)`` on the final activations —
+        ``head_params`` is every entry of ``state.params`` except
+        ``"stages"``.  The payoff: O(2S-1) in-flight residuals instead
+        of O(M+S), so ``num_microbatches`` scales at fixed memory.
+        The batch is the tuple ``(x, targets)`` with leading batch
+        dims; returned grads update stages AND head through the usual
+        optax transform."""
+        import optax
+
+        tx = tx or getattr(self, "_tx", None)
+        assert tx is not None, "pass tx= or call init_state first"
+        if param_specs is None and any(
+                self.mesh.shape.get(a, 1) > 1 for a in ("tp", "sp", "ep")):
+            raise ValueError(
+                "the mesh has within-stage axes "
+                f"({dict(self.mesh.shape)}) but no param_specs/data_spec "
+                "were given: a stage's collectives would run on replicated "
+                "parameters and silently overcount — pass the stage's "
+                "specs (e.g. make_transformer_stage's param_specs)")
+
+        def step(state, batch):
+            x, targets = batch
+
+            def split(params):
+                head = {k: v for k, v in params.items() if k != "stages"}
+                return params["stages"], head
+
+            stages, head = split(state.params)
+            loss, d_stages, d_head, _ = pipeline_value_and_grad(
+                self.mesh, self.stage_fn, head_fn, stages, head, x,
+                targets, num_microbatches=self.num_microbatches,
+                param_specs=param_specs, data_spec=data_spec,
+                head_specs=head_specs, target_spec=target_spec)
+            grads = {"stages": d_stages, **d_head}
+            updates, opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+            params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(params=params, opt_state=opt_state,
+                                   step=state.step + 1,
+                                   extras=state.extras)
+            return new_state, {"loss": loss}
+
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
     @property
     def bubble_fraction(self) -> float:
         """GPipe idle fraction: (S-1)/(M+S-1)."""

@@ -555,3 +555,41 @@ def test_speculative_with_tp_sharded_params_under_mesh():
             cfg, sharded, jnp.asarray(rep)[None, :], 12))[0, len(rep):]
     np.testing.assert_array_equal(results[rid], want)
     assert b.spec_accepted > 0
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_fuzz_random_schedules_stay_greedy_exact(seed):
+    """Randomized drive: arbitrary submit/step interleavings, mixed
+    prompt lengths (short, bucketed, chunked), mixed budgets, random
+    slot counts, speculation on/off — every request must equal its solo
+    greedy oracle regardless of schedule."""
+    cfg, params = _make()
+    rng = np.random.default_rng(seed)
+    spec = int(rng.integers(0, 2))
+    b = ContinuousBatcher(
+        cfg, params, max_batch=int(rng.integers(1, 5)),
+        prefill_chunk=int(rng.integers(4, 9)),
+        speculative_k=(3 if spec else None))
+    reqs, rids = [], []
+    n_req = int(rng.integers(4, 9))
+    submitted = 0
+    while submitted < n_req:       # run() drains whatever remains after
+        if rng.random() < 0.5:
+            t = int(rng.integers(2, 20))
+            if rng.random() < 0.4:      # repetitive: speculation bites
+                p = np.tile(rng.integers(0, cfg.vocab_size,
+                                         (2,)).astype(np.int32),
+                            (t + 1) // 2)[:t]
+            else:
+                p = rng.integers(0, cfg.vocab_size, (t,)).astype(np.int32)
+            n = int(rng.integers(1, 9))
+            reqs.append((p, n))
+            rids.append(b.submit(p, n))
+            submitted += 1
+        for _ in range(int(rng.integers(1, 4))):
+            b.step()
+    results = b.run()
+    for rid, (p, n) in zip(rids, reqs):
+        np.testing.assert_array_equal(
+            results[rid], _oracle(cfg, params, p, n),
+            err_msg=f"seed={seed} spec={spec} rid={rid}")

@@ -145,11 +145,9 @@ def test_rolling_cache_rejected():
         ContinuousBatcher(cfg, params, max_batch=2)
 
 
-@pytest.mark.parametrize("variant", ["int8", "int4", "gqa", "window"])
-def test_serving_composes_with_decode_features(variant):
-    """Continuous batching must stay greedy-exact under the decode
-    stack's other features: int8/int4 weight-only quantization,
-    grouped-query attention, sliding-window attention (full cache)."""
+def _variant_setup(variant):
+    """(cfg, params) for one decode-feature variant — shared by the plain
+    and speculative composition matrices so the two cannot drift."""
     kw = {}
     if variant == "gqa":
         kw["num_kv_heads"] = 2
@@ -161,6 +159,15 @@ def test_serving_composes_with_decode_features(variant):
 
         params = quantize_params(params,
                                  bits=4 if variant == "int4" else 8)
+    return cfg, params
+
+
+@pytest.mark.parametrize("variant", ["int8", "int4", "gqa", "window"])
+def test_serving_composes_with_decode_features(variant):
+    """Continuous batching must stay greedy-exact under the decode
+    stack's other features: int8/int4 weight-only quantization,
+    grouped-query attention, sliding-window attention (full cache)."""
+    cfg, params = _variant_setup(variant)
 
     rng = np.random.default_rng(3)
     reqs = [(rng.integers(0, cfg.vocab_size, (t,)).astype(np.int32), n)
@@ -593,3 +600,22 @@ def test_fuzz_random_schedules_stay_greedy_exact(seed):
         np.testing.assert_array_equal(
             results[rid], _oracle(cfg, params, p, n),
             err_msg=f"seed={seed} spec={spec} rid={rid}")
+
+
+@pytest.mark.parametrize("variant", ["int8", "int4", "gqa", "window"])
+def test_speculative_composes_with_decode_features(variant):
+    """The fused verify path must stay greedy-exact under quantized
+    weights, grouped-query attention, and sliding windows — same
+    matrix the plain batcher is locked against."""
+    cfg, params = _variant_setup(variant)
+    rng = np.random.default_rng(24)
+    reqs = [(np.tile(rng.integers(0, cfg.vocab_size,
+                                  (3,)).astype(np.int32), 4), n)
+            for n in (7, 9, 5)]
+    b = ContinuousBatcher(cfg, params, max_batch=2, speculative_k=3)
+    rids = [b.submit(p, n) for p, n in reqs]
+    results = b.run()
+    for rid, (p, n) in zip(rids, reqs):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(cfg, params, p, n))
+    assert b.spec_accepted > 0

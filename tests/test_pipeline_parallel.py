@@ -400,3 +400,41 @@ def test_pipeline_strategy_1f1b_guards_within_stage_axes():
                  "wo": jnp.eye(HID)}, optax.sgd(0.1))
     with pytest.raises(ValueError, match="within-stage axes"):
         strat.build_train_step_1f1b(lambda hp, y, t: jnp.mean(y))
+
+
+@pytest.mark.parametrize("seed", [31, 32, 33])
+def test_1f1b_fuzz_random_configs_match_serial(seed):
+    """Random (pp, dp, microbatches, batch multiple) configurations all
+    reproduce the serial oracle's loss and gradients — the schedule's
+    index arithmetic must hold off the hand-picked test points too."""
+    from tensorflowonspark_tpu.parallel import pipeline_value_and_grad
+
+    rng = np.random.default_rng(seed)
+    pp = int(rng.choice([2, 4]))
+    dp = int(rng.choice([1, 2]))
+    if pp * dp > len(jax.devices()):
+        pp, dp = 2, 1
+    num_mb = int(rng.integers(1, 9))
+    per = int(rng.integers(1, 4))
+    B = per * num_mb * dp
+    mesh = make_mesh(MeshSpec(pp=pp, dp=dp), devices=jax.devices()[:pp * dp])
+    stacked = _make_stage_params(jax.random.key(seed), pp)
+    hp = {"wo": jax.random.normal(jax.random.key(seed + 1),
+                                  (HID, HID)) * 0.2}
+    x = jax.random.normal(jax.random.key(seed + 2), (B, HID))
+    tgt = jax.random.normal(jax.random.key(seed + 3), (B, HID))
+
+    loss, ds, dh, dx = jax.jit(
+        lambda s, h, x, t: pipeline_value_and_grad(
+            mesh, _stage_fn, _head_fn, s, h, x, t,
+            num_microbatches=num_mb))(stacked, hp, x, tgt)
+    want_loss, want_ds, want_dh, want_dx = _oracle_value_and_grad(
+        stacked, hp, x, tgt)
+    msg = f"seed={seed} pp={pp} dp={dp} mb={num_mb} B={B}"
+    np.testing.assert_allclose(float(loss), float(want_loss),
+                               rtol=1e-5, atol=1e-6, err_msg=msg)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+        err_msg=msg), (ds, dh), (want_ds, want_dh))
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(want_dx),
+                               rtol=2e-4, atol=2e-5, err_msg=msg)

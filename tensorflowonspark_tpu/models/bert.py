@@ -148,7 +148,9 @@ class EncoderLayer(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, *, train: bool = False):
+    def __call__(self, x, mask=None, train: bool = False):
+        # ``train`` positional-or-keyword so the loop-branch remat can
+        # mark it static (checkpoint kwargs are traced; see gpt.py)
         cfg = self.cfg
         y = SelfAttention(cfg, name="attn")(x, mask, train=train)
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
@@ -221,10 +223,14 @@ class Bert(nn.Module):
             )(cfg, name="layers")
             x, _ = blocks(x, attention_mask, train)
         else:
-            block_cls = nn.remat(EncoderLayer) if cfg.remat else EncoderLayer
+            # ``train`` static (argnum 3: module, x, mask, train) and
+            # positional — a traced kwarg breaks ``not train`` dropout
+            # toggles; default prevent_cse=True holds outside lax.scan
+            block_cls = (nn.remat(EncoderLayer, static_argnums=(3,))
+                         if cfg.remat else EncoderLayer)
             for i in range(cfg.num_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(x, attention_mask,
-                                                      train=train)
+                                                      train)
         return x
 
 

@@ -334,7 +334,11 @@ class DecoderBlock(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, *, train: bool = False):
+    def __call__(self, x, train: bool = False):
+        # ``train`` is positional-or-keyword (not keyword-only) so the
+        # remat wrapper below can mark it static via ``static_argnums``
+        # — jax.checkpoint traces kwargs, and a traced ``train`` breaks
+        # the ``not train`` dropout toggle (TracerBoolConversionError).
         cfg = self.cfg
         y = _norm(cfg, "ln1")(x).astype(cfg.dtype)
         y = CausalSelfAttention(cfg, self.decode, name="attn")(y, train=train)
@@ -428,11 +432,15 @@ class GPT(nn.Module):
             block_cls = DecoderBlock
             if cfg.remat:
                 # remat is independent of the stacking choice: the loop
-                # branch rematerialises per layer too
-                block_cls = nn.remat(DecoderBlock)
+                # branch rematerialises per layer too; ``train`` must be
+                # static (argnum 2, counting the module as 0) and passed
+                # positionally — checkpoint kwargs are traced.  Default
+                # prevent_cse=True: outside lax.scan, CSE would undo the
+                # rematerialisation and restore no-remat peak memory
+                block_cls = nn.remat(DecoderBlock, static_argnums=(2,))
             for i in range(cfg.num_layers):
                 x = block_cls(cfg, self.decode, name=f"layer_{i}")(
-                    x, train=train)
+                    x, train)
         return _norm(cfg, "ln_f")(x)
 
     def __call__(self, input_ids, *, train: bool = False):

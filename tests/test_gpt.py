@@ -100,6 +100,36 @@ def test_train_gradients_flow(scan_layers):
 
 
 @pytest.mark.parametrize("scan_layers", [False, True])
+@pytest.mark.parametrize("train", [False, True])
+def test_remat_gradients_both_stackings(scan_layers, train):
+    """Regression for the loop-branch remat bug (r5 sweep
+    ``gpt_train_b32_remat``): ``nn.remat(DecoderBlock)`` without
+    ``static_argnums`` traced the ``train`` kwarg, and the ``not train``
+    dropout toggle raised ``TracerBoolConversionError`` under jit.
+    ``remat=True`` must differentiate on BOTH stacking branches, with
+    ``train`` taking both static values."""
+    import dataclasses
+
+    cfg = dataclasses.replace(_cfg(scan_layers), remat=True)
+    params = _params(cfg)
+    ids = jax.random.randint(jax.random.key(5), (2, 8), 0, cfg.vocab_size)
+
+    def loss_fn(p):
+        import optax
+
+        logits = GPT(cfg).apply(
+            {"params": p}, ids, train=train,
+            rngs={"dropout": jax.random.key(7)} if train else None)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], ids[:, 1:]).mean()
+
+    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+    assert np.isfinite(float(loss))
+    norms = [float(jnp.abs(g).sum()) for g in jax.tree.leaves(grads)]
+    assert all(n > 0 for n in norms), "dead gradient leaf"
+
+
+@pytest.mark.parametrize("scan_layers", [False, True])
 def test_cached_prefill_matches_full_forward(scan_layers):
     """Prefill through the decode path (whole prompt at once) == full."""
     CFG = _cfg(scan_layers)

@@ -149,6 +149,30 @@ def test_bert_scan_layers_stacked_params_and_grads():
     assert not np.allclose(np.asarray(full[:, :6]), np.asarray(out[:, :6]))
 
 
+@pytest.mark.parametrize("train", [False, True])
+def test_bert_loop_remat_gradients(train):
+    """Regression (same class as the GPT r5 fix): the loop branch's
+    ``nn.remat(EncoderLayer)`` must mark ``train`` static — a traced
+    kwarg breaks ``deterministic=not train`` with
+    ``TracerBoolConversionError`` under jit."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY_BERT, scan_layers=False, remat=True)
+    ids = jnp.ones((2, 8), jnp.int32)
+    mask = jnp.array([[1] * 6 + [0] * 2] * 2, bool)
+    trunk = Bert(cfg)
+    params = trunk.init(jax.random.key(0), ids, mask)
+
+    def loss(p):
+        out = trunk.apply(
+            p, ids, mask, train=train,
+            rngs={"dropout": jax.random.key(3)} if train else None)
+        return jnp.mean(out.astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.grad(loss))(params)
+    assert all(float(jnp.abs(x).sum()) > 0 for x in jax.tree.leaves(g))
+
+
 def test_bert_attention_mask_blocks_padding():
     ids = jnp.ones((1, 8), jnp.int32)
     trunk = Bert(TINY_BERT)

@@ -219,60 +219,134 @@ def _abstract(tree):
 _INT8_KEYS = frozenset({"q", "scale"})
 
 
-def _plainify_int8(params):
-    """Replace quantized leaves (``ops.quant`` Int8Array/Int4Array) with
-    ``{"q", "scale"}`` dicts (serializable by jax.export and orbax
-    alike); the q dtype records which wrapper to rebuild.  Returns
-    ``(tree, had_any)``."""
-    import jax
+def _walk_containers(node, path, visit):
+    """Shared container walk for :func:`_plainify_int8` /
+    :func:`_requant_int8` — the two must build IDENTICAL tree paths, so
+    the dispatch lives in one place.  ``visit(node, path)`` returns a
+    replacement subtree, or None to recurse into the standard containers
+    (any Mapping — rebuilt via its own type — namedtuples, lists,
+    tuples); unknown node types are returned unchanged."""
+    from collections.abc import Mapping
 
+    out = visit(node, path)
+    if out is not None:
+        return out
+    if isinstance(node, Mapping):
+        return type(node)({k: _walk_containers(v, path + (k,), visit)
+                           for k, v in node.items()})
+    if isinstance(node, tuple) and hasattr(node, "_fields"):
+        return type(node)(*(_walk_containers(v, path + (i,), visit)
+                            for i, v in enumerate(node)))
+    if isinstance(node, (list, tuple)):
+        return type(node)(_walk_containers(v, path + (i,), visit)
+                          for i, v in enumerate(node))
+    return node
+
+
+def _plainify_int8(params):
+    """Replace quantized leaves (``ops.quant`` Int8Array/Int4Array/
+    Int4PackedArray) with ``{"q", "scale"[, "lshape"]}`` dicts
+    (serializable by jax.export and orbax alike); the q dtype records
+    which wrapper to rebuild.  Returns ``(tree, had_any, lshapes)`` —
+    ``lshapes`` maps each packed-int4 dict's tree path to its static
+    logical shape, the side channel :func:`_requant_int8` needs when it
+    runs under a tracer.
+
+    Runs BEFORE ``meta.unbox`` in :func:`export_model` (Int4PackedArray
+    is itself an AxisMetadata box whose ``unbox()`` dequantizes), so
+    non-quant flax boxes (``Partitioned`` etc.) may still be present:
+    they are unboxed inline here, keeping the walked paths identical to
+    the post-unbox tree :func:`_requant_int8` sees at load/trace time."""
     try:
         from tensorflowonspark_tpu.ops.quant import _QuantArray
     except ImportError:  # pragma: no cover
-        return params, False
+        return params, False, {}
+    try:
+        from flax.core import meta as _fmeta
+        _axis_meta = _fmeta.AxisMetadata
+    except ImportError:  # pragma: no cover
+        _axis_meta = ()
     found = []
+    lshapes = {}
 
-    def plain(leaf):
-        if isinstance(leaf, _QuantArray):
+    def visit(node, path):
+        unboxed = node
+        while isinstance(unboxed, _axis_meta) \
+                and not isinstance(unboxed, _QuantArray):
+            unboxed = unboxed.unbox()
+        if isinstance(unboxed, _QuantArray):
             found.append(True)
-            return {"q": leaf.q, "scale": leaf.scale}
-        return leaf
+            out = {"q": unboxed.q, "scale": unboxed.scale}
+            lshape = getattr(unboxed, "logical_shape", None)
+            if lshape is not None:  # packed int4: uint8 q loses the
+                # logical last dim — record it
+                lshapes[path] = tuple(lshape)
+                out["lshape"] = np.asarray(lshape, np.int64)
+            return out
+        if unboxed is not node:  # stripped a non-quant box: walk the
+            return _walk_containers(unboxed, path, visit)  # contents
+        return None
 
-    out = jax.tree.map(plain, params,
-                       is_leaf=lambda x: isinstance(x, _QuantArray))
-    return out, bool(found)
+    out = _walk_containers(params, (), visit)
+    # a quantized leaf inside a container the walk doesn't know (e.g. a
+    # flax.struct dataclass) would otherwise slip past and be silently
+    # DEQUANTIZED by export_model's later meta.unbox — fail loudly instead
+    import jax
+
+    stragglers = [l for l in jax.tree_util.tree_leaves(
+        out, is_leaf=lambda x: isinstance(x, _QuantArray))
+        if isinstance(l, _QuantArray)]
+    if stragglers:
+        raise ValueError(
+            f"{len(stragglers)} quantized leaf/leaves sit inside a "
+            "container type _plainify_int8 does not traverse (only "
+            "Mapping/namedtuple/list/tuple are supported); exporting "
+            "would silently write dequantized full-precision weights. "
+            "Restructure the params tree or unbox the custom node first.")
+    return out, bool(found), lshapes
 
 
-def _requant_int8(params):
+def _requant_int8(params, lshapes=None):
     """Inverse of :func:`_plainify_int8`: rebuild lazy-dequant wrappers so
-    unmodified model code consumes the int8 weights."""
+    unmodified model code consumes the int8 weights.
+
+    ``lshapes`` (the path-keyed dict :func:`_plainify_int8` returns) supplies
+    the packed-int4 logical shapes when ``params`` leaves are TRACERS —
+    inside a traced export signature the ``lshape`` leaf's values are not
+    readable, but the shapes were concrete at export time."""
     import jax.numpy as jnp
     from collections.abc import Mapping
 
-    from tensorflowonspark_tpu.ops.quant import Int4Array, Int8Array
+    from tensorflowonspark_tpu.ops.quant import (Int4Array, Int4PackedArray,
+                                                 Int8Array)
 
     _wrappers = {jnp.dtype(jnp.int8): Int8Array,
                  jnp.dtype(jnp.int4): Int4Array}
+    _packed_keys = _INT8_KEYS | {"lshape"}
 
     def is_q(node):
         return (isinstance(node, Mapping) and set(node.keys()) == _INT8_KEYS
                 and getattr(node["q"], "dtype", None) in _wrappers)
 
-    def walk(node):
-        # inverse of _plainify_int8 over the containers a params tree can
-        # hold: any Mapping (dict/FrozenDict/OrderedDict — rebuilt via the
-        # same type), namedtuples, lists/tuples
+    def is_packed(node):
+        return (isinstance(node, Mapping)
+                and set(node.keys()) == _packed_keys
+                and getattr(node["q"], "dtype", None) == jnp.dtype(jnp.uint8))
+
+    def visit(node, path):
+        # inverse of _plainify_int8's visit; container dispatch (and the
+        # path convention) shared via _walk_containers
+        if is_packed(node):
+            if lshapes is not None:
+                lshape = lshapes[path]
+            else:
+                lshape = tuple(int(d) for d in np.asarray(node["lshape"]))
+            return Int4PackedArray(node["q"], node["scale"], lshape)
         if is_q(node):
             return _wrappers[node["q"].dtype](node["q"], node["scale"])
-        if isinstance(node, Mapping):
-            return type(node)({k: walk(v) for k, v in node.items()})
-        if isinstance(node, tuple) and hasattr(node, "_fields"):
-            return type(node)(*(walk(v) for v in node))
-        if isinstance(node, (list, tuple)):
-            return type(node)(walk(v) for v in node)
-        return node
+        return None
 
-    return walk(params)
+    return _walk_containers(params, (), visit)
 
 
 def export_model(export_dir: str,
@@ -309,6 +383,16 @@ def export_model(export_dir: str,
     export_dir = os.path.abspath(export_dir)
     os.makedirs(os.path.join(export_dir, _SIGNATURES_DIR), exist_ok=True)
 
+    # int8-quantized exports: jax.export can't serialize the Int8Array
+    # pytreedef (custom node) and orbax round-trips it as a plain dict
+    # anyway, so store {"q", "scale"} dicts and rebuild the lazy-dequant
+    # wrapper inside each traced signature — the serving artifact stays
+    # self-contained and the weights stay int8 on disk and in HBM.
+    # MUST run before meta.unbox: Int4PackedArray is itself an
+    # AxisMetadata box whose unbox() DEQUANTIZES (the flax param-read
+    # protocol) — unboxing first would export fp weights.
+    params, had_quant, lshapes = _plainify_int8(params)
+
     # strip flax Partitioned/etc. metadata boxes — sharding annotations are
     # training-time concerns; jax.export can't serialize the box pytreedefs
     try:
@@ -317,13 +401,6 @@ def export_model(export_dir: str,
         params = _flax_meta.unbox(params)
     except ImportError:
         pass
-
-    # int8-quantized exports: jax.export can't serialize the Int8Array
-    # pytreedef (custom node) and orbax round-trips it as a plain dict
-    # anyway, so store {"q", "scale"} dicts and rebuild the lazy-dequant
-    # wrapper inside each traced signature — the serving artifact stays
-    # self-contained and the weights stay int8 on disk and in HBM.
-    params, had_quant = _plainify_int8(params)
 
     # parameters (orbax pytree) — loadable standalone
     import orbax.checkpoint as ocp
@@ -336,7 +413,8 @@ def export_model(export_dir: str,
     signatures.update(extra_signatures or {})
     if had_quant:
         signatures = {
-            name: ((lambda f: lambda p, *a: f(_requant_int8(p), *a))(sig_fn),
+            name: ((lambda f: lambda p, *a: f(
+                _requant_int8(p, lshapes), *a))(sig_fn),
                    sig_inputs)
             for name, (sig_fn, sig_inputs) in signatures.items()}
 

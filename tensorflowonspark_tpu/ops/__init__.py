@@ -7,13 +7,13 @@ where fusion beyond XLA's pays: attention (the O(T²) memory hog) first.
 """
 
 from tensorflowonspark_tpu.ops.flash_attention import flash_attention
-from tensorflowonspark_tpu.ops.quant import (Int4Array, Int8Array,
-                                             quantize_int4, quantize_int8,
-                                             quantize_params,
+from tensorflowonspark_tpu.ops.quant import (Int4Array, Int4PackedArray,
+                                             Int8Array, quantize_int4,
+                                             quantize_int8, quantize_params,
                                              shard_quantized, tree_nbytes)
 from tensorflowonspark_tpu.ops.xent import tied_softmax_xent
 
-__all__ = ["flash_attention", "Int4Array", "Int8Array",
+__all__ = ["flash_attention", "Int4Array", "Int4PackedArray", "Int8Array",
            "quantize_int4", "quantize_int8",
            "quantize_params", "shard_quantized", "tree_nbytes",
            "tied_softmax_xent"]

@@ -119,6 +119,67 @@ def test_int8_decode_composes_with_tensor_parallelism(jax_cpu_mesh_devices):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_export_plainifies_quant_inside_flax_boxes(tmp_path):
+    """A quantized leaf wrapped in a flax ``Partitioned`` box must still
+    export as quantized: _plainify_int8 unboxes non-quant AxisMetadata
+    inline (it runs before export_model's meta.unbox, which would
+    DEQUANTIZE an Int4PackedArray — its unbox() is the flax param-read
+    dequant)."""
+    from flax.core import meta
+
+    from tensorflowonspark_tpu.checkpoint import ExportedModel, export_model
+    from tensorflowonspark_tpu.ops import quantize_int4, quantize_int8
+
+    w = jax.random.normal(jax.random.key(0), (16, 8))
+    params = {"a": meta.Partitioned(quantize_int8(w), names=(None, "tp")),
+              "b": meta.Partitioned(quantize_int4(w), names=(None, "tp"))}
+    x = np.ones((4, 16), np.float32)
+
+    def fn(p, x):
+        return x @ jnp.asarray(p["a"]) + x @ jnp.asarray(p["b"])
+
+    want = fn({"a": quantize_int8(w), "b": quantize_int4(w)}, x)
+    d = str(tmp_path / "e")
+    export_model(d, fn, params, [x])
+    loaded = ExportedModel.load(d)
+    got = next(iter(loaded(x).values()))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    qdtypes = []
+
+    def walk(n):
+        if isinstance(n, dict):
+            if "q" in n:
+                qdtypes.append(str(n["q"].dtype))
+            for v in n.values():
+                walk(v)
+
+    walk(loaded.params)
+    assert sorted(qdtypes) == ["int8", "uint8"]  # both stayed quantized
+
+
+def test_int4_packed_tp_indivisible_axis_replicates(jax_cpu_mesh_devices):
+    """A spec valid for the LOGICAL kernel shape may not divide the packed
+    buffer's halved last dim (logical out=4 over tp=4 -> packed dim 2, or
+    odd packed dims).  shard_quantized must replicate that axis instead of
+    raising, and the dequant must stay exact."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.ops import quantize_params, shard_quantized
+
+    mesh = Mesh(np.array(jax_cpu_mesh_devices[:4]).reshape(4), ("tp",))
+    params = {"d": {"kernel": jax.random.normal(jax.random.key(0), (16, 4))}}
+    sh = {"d": {"kernel": NamedSharding(mesh, P(None, "tp"))}}
+    q4 = quantize_params(params, bits=4)
+    placed = shard_quantized(q4, sh)
+    leaf = placed["d"]["kernel"]
+    assert leaf.q.sharding.spec == P(None, None)  # replicated, not raised
+    np.testing.assert_array_equal(
+        np.asarray(jnp.asarray(leaf)),
+        np.asarray(jnp.asarray(q4["d"]["kernel"])))
+
+
 def test_int8_export_serves_without_model_code(tmp_path):
     """Quantize -> export_model -> ExportedModel: the serving artifact
     stores int8 weights and replies like the in-process quantized model."""
@@ -154,19 +215,41 @@ def test_int8_export_serves_without_model_code(tmp_path):
     assert any(getattr(l, "dtype", None) == jnp.int8 for l in flat)
 
 
-def test_quantize_int4_roundtrip_error_bounded():
-    from tensorflowonspark_tpu.ops import Int4Array, quantize_int4
+@pytest.mark.parametrize("storage", ["packed", "native"])
+def test_quantize_int4_roundtrip_error_bounded(storage):
+    from tensorflowonspark_tpu.ops import (Int4Array, Int4PackedArray,
+                                           quantize_int4)
 
     w = jax.random.normal(jax.random.key(2), (64, 48), jnp.float32)
-    qa = quantize_int4(w)
-    assert isinstance(qa, Int4Array)
-    assert qa.q.shape == w.shape and qa.q.dtype == jnp.int4
+    qa = quantize_int4(w, storage=storage)
+    if storage == "native":
+        assert isinstance(qa, Int4Array)
+        assert qa.q.shape == w.shape and qa.q.dtype == jnp.int4
+    else:
+        assert isinstance(qa, Int4PackedArray)
+        assert qa.q.shape == (64, 24) and qa.q.dtype == jnp.uint8
     assert qa.shape == w.shape and qa.ndim == 2
     # worst-case error: half a step of the 15-level grid
     step = jnp.max(jnp.abs(w), axis=-2, keepdims=True) / 7.0
     assert float(jnp.max(jnp.abs(jnp.asarray(qa) - w) - step / 2)) <= 1e-6
     # packed accounting: two weights per byte + fp32 scales
     assert qa.nbytes == w.size // 2 + 48 * 4
+
+
+def test_int4_packed_matches_native_dequant():
+    """The uint8 nibble packing is a pure storage change: packed and
+    native int4 dequantize to IDENTICAL arrays, including odd last dims
+    (padding sliced back off) and negative values (nibble sign
+    extension)."""
+    from tensorflowonspark_tpu.ops import quantize_int4
+
+    for shape in ((64, 48), (5, 7), (3, 4, 9)):
+        w = jax.random.normal(jax.random.key(9), shape, jnp.float32)
+        native = jnp.asarray(quantize_int4(w, storage="native"))
+        packed_arr = quantize_int4(w, storage="packed")
+        assert packed_arr.shape == shape
+        np.testing.assert_array_equal(np.asarray(jnp.asarray(packed_arr)),
+                                      np.asarray(native))
 
 
 def test_int4_exact_for_representable_grid():
@@ -180,11 +263,12 @@ def test_int4_exact_for_representable_grid():
                                np.asarray(w), rtol=0, atol=1e-7)
 
 
-def test_int4array_jits_and_matmuls():
+@pytest.mark.parametrize("storage", ["packed", "native"])
+def test_int4array_jits_and_matmuls(storage):
     from tensorflowonspark_tpu.ops import quantize_int4
 
     w = jax.random.normal(jax.random.key(3), (32, 16))
-    qa = quantize_int4(w)
+    qa = quantize_int4(w, storage=storage)
     assert len(jax.tree.leaves(qa)) == 2
 
     @jax.jit
@@ -201,15 +285,16 @@ def test_int4array_jits_and_matmuls():
 
 
 def test_quantize_params_bits4_targets_kernels():
-    from tensorflowonspark_tpu.ops import Int4Array, quantize_params
+    from tensorflowonspark_tpu.ops import Int4PackedArray, quantize_params
 
     params = {"a": {"kernel": jnp.ones((8, 4))},
-              "odd": {"kernel": jnp.ones((7, 4))},  # odd K fine: native int4
+              "odd": {"kernel": jnp.ones((7, 5))},  # odd dims both axes
               "bias": jnp.ones((4,))}
     qp = quantize_params(params, bits=4)
-    assert isinstance(qp["a"]["kernel"], Int4Array)
-    assert isinstance(qp["odd"]["kernel"], Int4Array)
-    assert not isinstance(qp["bias"], Int4Array)
+    assert isinstance(qp["a"]["kernel"], Int4PackedArray)
+    assert isinstance(qp["odd"]["kernel"], Int4PackedArray)
+    assert qp["odd"]["kernel"].shape == (7, 5)
+    assert not isinstance(qp["bias"], Int4PackedArray)
 
 
 def test_gpt_decode_with_int4_params():
@@ -230,7 +315,7 @@ def test_gpt_decode_with_int4_params():
     q4 = quantize_params(params, bits=4)
     # kernel payloads halve (embeddings/norms stay fp and dominate this
     # tiny model, so compare the quantized leaves, not the whole tree)
-    from tensorflowonspark_tpu.ops import Int4Array
+    from tensorflowonspark_tpu.ops import Int4PackedArray
     from tensorflowonspark_tpu.ops.quant import Int8Array
 
     def quantized_bytes(tree, cls):
@@ -238,7 +323,7 @@ def test_gpt_decode_with_int4_params():
             tree, is_leaf=lambda x: isinstance(x, cls))
             if isinstance(l, cls))
 
-    assert quantized_bytes(q4, Int4Array) < \
+    assert quantized_bytes(q4, Int4PackedArray) < \
         0.6 * quantized_bytes(q8, Int8Array)
     assert tree_nbytes(q4) < tree_nbytes(q8)
     out = greedy_generate(cfg, q4, prompt, 8)
@@ -270,5 +355,21 @@ def test_int4_export_serves_without_model_code(tmp_path):
     got = next(iter(loaded(x).values()))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
-    flat = jax.tree.leaves(loaded.params)
-    assert any(getattr(l, "dtype", None) == jnp.int4 for l in flat)
+    # the restored tree is the plain orbax form: packed uint8 nibble
+    # buffers (halved last dim) + lshape records; the dequant lives in
+    # the traced StableHLO, so the disk/HBM payload stays packed
+    def find(node, key, acc):
+        if isinstance(node, dict):
+            if key in node:
+                acc.append(node[key])
+            for v in node.values():
+                find(v, key, acc)
+        return acc
+
+    qs = [q for q in find(loaded.params, "q", [])
+          if q.dtype == jnp.uint8]
+    lshapes = [tuple(int(d) for d in ls)
+               for ls in find(loaded.params, "lshape", [])]
+    assert len(qs) == 2 and len(lshapes) == 2
+    assert sorted(q.shape for q in qs) == [(16, 16), (32, 2)]
+    assert sorted(lshapes) == [(16, 32), (32, 4)]

@@ -89,7 +89,8 @@ def _device():
 def stage_resnet(batch: int, remat: bool = False,
                  stem: str = "conv7", bn: str = "f32",
                  write: bool = True, loop: bool = False,
-                 xla_label: str = "") -> dict:
+                 xla_label: str = "",
+                 compiler_options: dict | None = None) -> dict:
     """One (batch, remat, stem, bn) point.  ``write=False`` (used by
     scripts/profile_resnet.py, whose timed loop runs under the profiler's
     trace overhead) skips the resnet_sweep.json merge so a profiling run
@@ -143,8 +144,13 @@ def stage_resnet(batch: int, remat: bool = False,
     step_jit = jax.jit(step_fn, donate_argnums=(0, 1, 2))
     # AOT-compile once and EXECUTE the same executable: calling the jit
     # wrapper after lower().compile() would trace+compile the identical
-    # program a second time (these subprocesses run cold over the tunnel)
-    step = step_jit.lower(params, batch_stats, opt_state, x, y).compile()
+    # program a second time (these subprocesses run cold over the tunnel).
+    # compiler_options is the MFU flag-attack lever: the axon client's
+    # XLA_FLAGS parser rejects server-side xla_tpu_* names outright
+    # ("Unknown flag", r5 vmem stage postmortem), but PJRT compile
+    # options ship through the tunnel to the real compiler.
+    step = step_jit.lower(params, batch_stats, opt_state, x, y).compile(
+        compiler_options=compiler_options or None)
     cost = step.cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0]
@@ -159,15 +165,20 @@ def stage_resnet(batch: int, remat: bool = False,
             return jax.lax.fori_loop(
                 0, n, body, (p, bs, o, jnp.zeros((), jnp.float32)))
 
-        mega = jax.jit(megastep, static_argnums=(5,), donate_argnums=(0, 1, 2))
-        # same n for warmup and timed call — different n would be a fresh
-        # static arg, i.e. a second compile inside the timed window
+        # AOT like the eager path so compiler_options apply to the program
+        # actually timed (a jit __call__ would compile without them)
+        mega = jax.jit(
+            megastep, static_argnums=(5,), donate_argnums=(0, 1, 2)
+        ).lower(params, batch_stats, opt_state, x, y, steps).compile(
+            compiler_options=compiler_options or None)
+        # the compiled executable bakes the static n (same for warmup and
+        # the timed call — a different n would be a fresh compile)
         params, batch_stats, opt_state, loss = mega(
-            params, batch_stats, opt_state, x, y, steps)
+            params, batch_stats, opt_state, x, y)
         float(loss)
         t0 = time.perf_counter()
         params, batch_stats, opt_state, loss = mega(
-            params, batch_stats, opt_state, x, y, steps)
+            params, batch_stats, opt_state, x, y)
         float(loss)
         dt = (time.perf_counter() - t0) / steps
     else:
@@ -195,6 +206,8 @@ def stage_resnet(batch: int, remat: bool = False,
     }
     if xla_label:
         row["xla_flags"] = os.environ.get("XLA_FLAGS", "")
+    if compiler_options:  # provenance regardless of labeling
+        row["compiler_options"] = dict(compiler_options)
     print("sweep resnet:", json.dumps(row), flush=True)
     if write:
         _merge_row("resnet_sweep.json", row,
@@ -1000,7 +1013,22 @@ def main() -> None:
     p.add_argument("--xla-label", default="",
                    help="short row label for an --xla-flags experiment "
                         "(part of the resnet_sweep merge key)")
+    p.add_argument("--compiler-options", default=None,
+                   help="comma-separated key=value PJRT compile options "
+                        "(e.g. xla_tpu_scoped_vmem_limit_kib=98304) — "
+                        "unlike --xla-flags these reach the server-side "
+                        "TPU compiler through the axon tunnel")
     args = p.parse_args()
+    copts = None
+    if args.compiler_options:
+        copts = dict(kv.split("=", 1)
+                     for kv in args.compiler_options.split(","))
+        if not args.xla_label:
+            # never let a flag-modified row collide with the baseline's
+            # merge key (xla="") — that would silently overwrite the
+            # control measurement with no provenance
+            args.xla_label = "copts:" + ",".join(
+                f"{k}={v}" for k, v in sorted(copts.items()))
 
     if args.xla_flags:
         os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
@@ -1008,7 +1036,8 @@ def main() -> None:
 
     if args.stage == "resnet":
         stage_resnet(args.batch, args.remat, args.stem, args.bn,
-                     loop=args.loop, xla_label=args.xla_label)
+                     loop=args.loop, xla_label=args.xla_label,
+                     compiler_options=copts)
         return
     if args.stage == "gpt_train":
         stage_gpt_train(args.batch, args.remat, args.attn)
@@ -1047,6 +1076,16 @@ def main() -> None:
                              "--batch", "256", "--stem", "s2d"], 900),
         ("resnet_b256_bnbf16", [sys.executable, me, "--stage", "resnet",
                                 "--batch", "256", "--bn", "bf16"], 900),
+        # stack the two r5 wins: bf16 BN (+28% at b256) on the best batch
+        # (b128) and under the single-dispatch loop window
+        ("resnet_b128_bnbf16", [sys.executable, me, "--stage", "resnet",
+                                "--batch", "128", "--bn", "bf16"], 900),
+        ("resnet_b128_bnbf16_loop",
+         [sys.executable, me, "--stage", "resnet", "--batch", "128",
+          "--bn", "bf16", "--loop"], 900),
+        ("resnet_b256_bnbf16_loop",
+         [sys.executable, me, "--stage", "resnet", "--batch", "256",
+          "--bn", "bf16", "--loop"], 900),
         ("flash_sweep", [sys.executable, me, "--stage", "flash"], 1200),
         ("gpt_train_b8", [sys.executable, me, "--stage", "gpt_train",
                           "--batch", "8"], 900),
@@ -1102,16 +1141,18 @@ def main() -> None:
         *([] if SMOKE else [
             ("resnet_b256_vmem96",
              [sys.executable, me, "--stage", "resnet", "--batch", "256",
-              "--xla-flags=--xla_tpu_scoped_vmem_limit_kib=98304",
+              "--compiler-options",
+              "xla_tpu_scoped_vmem_limit_kib=98304",
               "--xla-label", "vmem96"], 900),
             ("resnet_b256_vmem128",
              [sys.executable, me, "--stage", "resnet", "--batch", "256",
-              "--xla-flags=--xla_tpu_scoped_vmem_limit_kib=131072",
+              "--compiler-options",
+              "xla_tpu_scoped_vmem_limit_kib=131072",
               "--xla-label", "vmem128"], 900),
             ("resnet_b256_nolhs",
              [sys.executable, me, "--stage", "resnet", "--batch", "256",
-              "--xla-flags=--xla_tpu_enable_latency_hiding_scheduler"
-              "=false",
+              "--compiler-options",
+              "xla_tpu_enable_latency_hiding_scheduler=false",
               "--xla-label", "nolhs"], 900)]),
         # BASELINE configs[3]: the L5 pipeline path's first perf row —
         # deliberately LAST (VERDICT r4 item 9: only after the chip

@@ -31,11 +31,19 @@ def main():
                    help="draft K tokens per slot via prompt lookup and "
                         "verify them in one fused dispatch (per-row "
                         "acceptance); repetitive prompts accept well")
+    p.add_argument("--block-steps", type=int, default=None, metavar="K",
+                   help="scan up to K decode steps per dispatch when no "
+                        "admission can be delayed (identical tokens, K-x "
+                        "fewer host round trips; excludes --speculative)")
     args = p.parse_args()
     if args.requests < 1 or args.slots < 1:
         p.error("--requests and --slots must be >= 1")
     if args.speculative is not None and args.speculative < 1:
         p.error("--speculative must be >= 1")
+    if args.block_steps is not None and args.block_steps < 2:
+        p.error("--block-steps must be >= 2")
+    if args.block_steps is not None and args.speculative is not None:
+        p.error("--block-steps and --speculative are mutually exclusive")
     if args.cpu:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -65,7 +73,8 @@ def main():
                  int(rng.integers(4, 25))) for _ in range(args.requests)]
 
     b = ContinuousBatcher(cfg, params, max_batch=args.slots,
-                          speculative_k=args.speculative)
+                          speculative_k=args.speculative,
+                          decode_block_steps=args.block_steps)
     rids = [b.submit(prompt, budget) for prompt, budget in reqs]
     remaining = set(rids)
     steps = 0
@@ -104,6 +113,12 @@ def main():
               f"{total} tokens in {b.decode_dispatches} decode dispatches "
               f"({total / max(b.decode_dispatches, 1):.2f} tok/dispatch)",
               flush=True)
+    if args.block_steps is not None:
+        print(f"serving_demo: block-steps k={args.block_steps}: "
+              f"{b.decode_steps} decode steps in {b.decode_dispatches} "
+              f"dispatches "
+              f"({b.decode_steps / max(b.decode_dispatches, 1):.2f} "
+              f"steps/dispatch)", flush=True)
     print("serving_demo: done", flush=True)
 
 

@@ -592,6 +592,7 @@ def stage_serving() -> dict:
         b._admit = timed_admit
         prefills0 = b.prefill_dispatches
         decodes0 = b.decode_dispatches
+        dsteps0 = b.decode_steps
         try:
             pending = sorted(schedule, key=lambda x: x[0])
             rids, remaining, steps = [], set(), 0
@@ -611,30 +612,33 @@ def stage_serving() -> dict:
             got = sum(len(res[r]) for r in rids)
             assert got == total_tokens, (got, total_tokens)
             return (steps, admit_s[0], b.prefill_dispatches - prefills0,
-                    b.decode_dispatches - decodes0)
+                    b.decode_dispatches - decodes0,
+                    b.decode_steps - dsteps0)
         finally:
             b._admit = orig_admit
 
-    def measure(schedule, label):
-        run_continuous(batcher, schedule)            # warm compiles
+    def measure(schedule, label, b=None):
+        b = batcher if b is None else b
+        run_continuous(b, schedule)                  # warm compiles
         t0 = time.perf_counter()
-        steps, admit_s, prefills, decodes = run_continuous(batcher,
-                                                           schedule)
+        steps, admit_s, prefills, decodes, dsteps = run_continuous(
+            b, schedule)
         dt = time.perf_counter() - t0
         return {
             f"{label}_tps": round(total_tokens / dt, 1),
             f"{label}_steps": steps,
             # decode occupancy: each request's FIRST token comes from its
             # prefill dispatch, so a budget-n request uses n-1 decode
-            # slot-steps; the denominator counts DECODE DISPATCHES, not
-            # loop iterations — a bursty gap where all slots drained and
-            # the host just spins toward the next arrival is not chip
-            # capacity
+            # slot-steps; the denominator counts DECODE STEPS (== decode
+            # dispatches without blocking), not loop iterations — a
+            # bursty gap where all slots drained and the host just spins
+            # toward the next arrival is not chip capacity
             f"{label}_occupancy": round(
-                (total_tokens - n_req) / (decodes * slots), 3),
+                (total_tokens - n_req) / (dsteps * slots), 3),
             f"{label}_admission_frac": round(admit_s / dt, 4),
             f"{label}_prefill_dispatches": prefills,
             f"{label}_decode_dispatches": decodes,
+            f"{label}_decode_steps": dsteps,
         }
 
     steady = [(0, r) for r in reqs]
@@ -650,6 +654,19 @@ def stage_serving() -> dict:
            "useful_tokens": total_tokens, "device": dev.device_kind}
     row.update(measure(steady, "steady"))
     row.update(measure(bursty, "bursty"))
+
+    # ---- multi-step decode blocks: same steady backlog, but each
+    # dispatch scans up to 16 decode steps (`decode_block_steps`) — the
+    # amortization lever for per-dispatch latency.  Over the axon
+    # tunnel every dispatch is a ~25 ms RPC, so this is where continuous
+    # batching's wall-clock should close on static's lax.scan groups
+    # while keeping slot-level admission (occupancy unchanged).
+    blocked_b = ContinuousBatcher(cfg, params, max_batch=slots,
+                                  decode_block_steps=16)
+    row.update(measure(steady, "blocked", b=blocked_b))
+    row["blocked_steps_per_dispatch"] = round(
+        row["blocked_decode_steps"]
+        / max(row["blocked_decode_dispatches"], 1), 2)
 
     # ---- speculative continuous batching: same slot machinery, each
     # step drafts per-slot from the request's own history and ONE verify

@@ -573,10 +573,12 @@ def test_fuzz_random_schedules_stay_greedy_exact(seed):
     cfg, params = _make()
     rng = np.random.default_rng(seed)
     spec = int(rng.integers(0, 2))
+    block = None if spec else [None, 4, 8][int(rng.integers(0, 3))]
     b = ContinuousBatcher(
         cfg, params, max_batch=int(rng.integers(1, 5)),
         prefill_chunk=int(rng.integers(4, 9)),
-        speculative_k=(3 if spec else None))
+        speculative_k=(3 if spec else None),
+        decode_block_steps=block)
     reqs, rids = [], []
     n_req = int(rng.integers(4, 9))
     submitted = 0
@@ -619,3 +621,138 @@ def test_speculative_composes_with_decode_features(variant):
         np.testing.assert_array_equal(results[rid],
                                       _oracle(cfg, params, p, n))
     assert b.spec_accepted > 0
+
+
+# -- multi-step decode blocks ---------------------------------------------
+
+def test_block_decode_matches_solo_greedy():
+    """decode_block_steps: identical tokens to per-step decode (the scan
+    body IS the plain step), across staggered budgets and eos-free
+    traffic."""
+    cfg, params = _make()
+    rng = np.random.default_rng(7)
+    reqs = [(rng.integers(0, cfg.vocab_size, (t,)).astype(np.int32), n)
+            for t, n in ((5, 16), (3, 9), (8, 4), (2, 13))]
+    b = ContinuousBatcher(cfg, params, max_batch=2, decode_block_steps=8)
+    rids = [b.submit(p, n) for p, n in reqs]
+    results = b.run()
+    for rid, (p, n) in zip(rids, reqs):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(cfg, params, p, n))
+
+
+def test_block_decode_amortizes_dispatches():
+    """One request, budget 32, block 8: the decode dispatch count must
+    collapse well below the step count (pow2 blocks bounded by remaining
+    budget), with decode_steps still counting every step."""
+    cfg, params = _make()
+    p = np.arange(4, dtype=np.int32) + 1
+    b = ContinuousBatcher(cfg, params, max_batch=2, decode_block_steps=8)
+    rid = b.submit(p, 33)        # 1 at prefill + 32 decode steps
+    res = b.run()
+    assert res[rid].size == 33
+    assert b.decode_steps == 32
+    # 32 steps in 8-blocks: 4 dispatches (+0..2 tail singles depending on
+    # pow2 flooring) — far below 32
+    assert b.decode_dispatches <= 6, b.decode_dispatches
+    np.testing.assert_array_equal(res[rid], _oracle(cfg, params, p, 33))
+
+
+def test_block_decode_sampled_rows_match_per_step():
+    """Sampled requests under blocks: output is the same pure function
+    of (seed, step) as the per-step batcher — the in-scan step counter
+    must line up exactly."""
+    cfg, params = _make()
+    rng = np.random.default_rng(9)
+    p1 = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+    p2 = rng.integers(0, cfg.vocab_size, (3,)).astype(np.int32)
+
+    def drive(block):
+        b = ContinuousBatcher(cfg, params, max_batch=2,
+                              decode_block_steps=block)
+        r1 = b.submit(p1, 12, temperature=0.8, top_p=0.9, seed=11)
+        r2 = b.submit(p2, 7)                      # greedy alongside
+        out = b.run()
+        return out[r1], out[r2]
+
+    a1, a2 = drive(None)
+    b1, b2 = drive(8)
+    np.testing.assert_array_equal(a1, b1)
+    np.testing.assert_array_equal(a2, b2)
+    np.testing.assert_array_equal(b2, _oracle(cfg, params, p2, 7))
+
+
+def test_block_decode_eos_truncates_and_slot_reuses():
+    """A row hitting eos mid-block: later scanned tokens are discarded,
+    the slot frees, and a follow-up request admitted into that slot
+    stays exact."""
+    cfg, params = _make()
+    p = np.arange(5, dtype=np.int32) + 1
+    ref = _oracle(cfg, params, p, 24)
+    eos = int(ref[2])
+    # the oracle-with-eos stops at the FIRST occurrence of that token
+    cut = int(np.flatnonzero(ref == eos)[0])
+    b = ContinuousBatcher(cfg, params, max_batch=1, eos_id=eos,
+                          decode_block_steps=8)
+    r1 = b.submit(p, 24)
+    got = b.run()[r1]
+    np.testing.assert_array_equal(got, ref[:cut + 1])
+    p2 = np.arange(4, dtype=np.int32) + 2
+    r2 = b.submit(p2, 6)
+    out = b.run()
+    ref2 = _oracle(cfg, params, p2, 6)
+    cut2 = np.flatnonzero(ref2 == eos)
+    if cut2.size:                 # same eos id applies to the follow-up
+        ref2 = ref2[:int(cut2[0]) + 1]
+    np.testing.assert_array_equal(out[r2], ref2)
+
+
+def test_block_decode_admission_latency_policy():
+    """Admission precedes the block decision inside one step(), so a
+    queued request with a free slot admits immediately.  For a request
+    that CANNOT admit yet (no free slot): with ``eos_id`` set, an eos
+    could free a slot any step, so the batcher must single-step; without
+    eos, no slot can free before the minimum remaining budget, so
+    blocking up to that bound delays the queued request by zero steps
+    and MUST be taken."""
+    cfg, params = _make()
+    rng = np.random.default_rng(3)
+    p1 = rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+    p2 = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+
+    # eos set -> conservative single steps while a request waits
+    b = ContinuousBatcher(cfg, params, max_batch=1, decode_block_steps=8,
+                          eos_id=cfg.vocab_size + 1)   # never fires
+    r1 = b.submit(p1, 20)
+    b.step()                     # admit r1; r1 owns the only slot
+    steps_before = b.decode_steps
+    r2 = b.submit(p2, 5)         # cannot admit: no free slot
+    b.step()
+    assert b.decode_steps - steps_before == 1  # single, not a block
+    out = b.run()
+    np.testing.assert_array_equal(out[r1], _oracle(cfg, params, p1, 20))
+    np.testing.assert_array_equal(out[r2], _oracle(cfg, params, p2, 5))
+
+    # no eos -> blocks keep running while the request waits (zero-delay
+    # bound) and amortization survives a full backlog drain
+    b2 = ContinuousBatcher(cfg, params, max_batch=1, decode_block_steps=8)
+    q1 = b2.submit(p1, 20)
+    b2.step()
+    q2 = b2.submit(p2, 5)
+    b2.step()
+    assert b2.decode_steps > b2.decode_dispatches  # a block ran
+    out2 = b2.run()
+    np.testing.assert_array_equal(out2[q1], _oracle(cfg, params, p1, 20))
+    np.testing.assert_array_equal(out2[q2], _oracle(cfg, params, p2, 5))
+    # first tokens come from the prefills: 19 + 4 decode steps total
+    assert b2.decode_steps == 23
+    assert b2.decode_dispatches < 12           # ... in far fewer dispatches
+
+
+def test_block_decode_validation():
+    cfg, params = _make()
+    with pytest.raises(ValueError, match="decode_block_steps"):
+        ContinuousBatcher(cfg, params, max_batch=2, decode_block_steps=1)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ContinuousBatcher(cfg, params, max_batch=2, decode_block_steps=4,
+                          speculative_k=2)

@@ -712,10 +712,12 @@ def stage_serving() -> dict:
             (rep_tokens - n_req) / max(disp_spec, 1), 3),
         "spec_acceptance": round(acc / max(prop, 1), 3),
         "spec_note": "tokens_per_dispatch is the transferable number: "
-                     "CPU forwards are compute-bound so k+1 positions "
-                     "cost ~(k+1)x and spec_speedup < 1 here; on TPU "
-                     "decode is weight-read-bound and the same "
-                     "acceptance turns into real speedup",
+                     "on this deployment each dispatch is a host RPC "
+                     "over the axon tunnel (and on CPU each forward is "
+                     "compute-bound), so spec_speedup here understates "
+                     "what a local-dispatch TPU serving stack gets — "
+                     "there the (k+1)-position verify rides the same "
+                     "weight reads and acceptance converts to latency",
     })
 
     gen = jax.jit(greedy_generate, static_argnums=(0, 3))

@@ -76,10 +76,14 @@ def validate_single_chip() -> dict:
     # append a second matching row
     anchor = sm.best_measured_row("resnet_sweep.json",
                                   prefer=sm.IS_MODELED_RESNET)
+    # the b128 row must match the anchor's config in everything but
+    # batch (bn follows IS_MODELED_RESNET — comparing a bf16-BN anchor
+    # against an f32-BN b128 row would fold the BN-dtype delta into the
+    # linearity check)
     b128 = sm.best_measured_row(
         "resnet_sweep.json",
         prefer=lambda r: r.get("batch") == 128
-        and r.get("stem") == "conv7" and r.get("bn") == "f32")
+        and sm.IS_MODELED_RESNET({**r, "batch": 256}))
     if b128 is not None and b128.get("batch") != 128:
         b128 = None  # prefer-filter found nothing; best-MFU row is not b128
     out = {

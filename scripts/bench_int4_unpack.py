@@ -1,0 +1,110 @@
+"""A/B the int4 nibble-unpack formulations feeding a decode matmul.
+
+The r5 ``decode_matrix`` found packed-int4 decode at 0.2–0.5x bf16 with
+the original ``stack -> reshape -> slice`` unpack: it does not fuse into
+the consuming matmul on XLA:TPU, so the dequantized weight materializes
+every step.  This microbench times the formulations on a decode-shaped
+problem (x[B,K] @ W[K,N], B small); the ``repeat`` winner IS the shipped
+``Int4PackedArray.__jax_array__`` (called directly, so the numbers can
+never drift from production):
+
+- ``stack``:   RETIRED pre-r5 form, kept as the historical baseline
+- ``repeat``:  the production unpack — repeat bytes 2x, parity-select
+               the shift (pure elementwise; fuses on TPU)
+- ``int8``:    Int8Array-style dequant (the weight-only fusion ceiling)
+- ``bf16``:    plain bf16 weight (no quantization at all)
+
+Writes ``bench_artifacts/int4_unpack.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tensorflowonspark_tpu.ops.quant import (Int4PackedArray,  # noqa: E402
+                                             _pack_nibbles)
+
+
+def unpack_stack(p, scale, n):
+    """The RETIRED pre-r5 formulation, inlined as the historical
+    baseline (stack/reshape broke operand fusion)."""
+    low = (p & jnp.uint8(0xF)).astype(jnp.int8)
+    high = (p >> jnp.uint8(4)).astype(jnp.int8)
+    low = low - jnp.int8(16) * (low > jnp.int8(7)).astype(jnp.int8)
+    high = high - jnp.int8(16) * (high > jnp.int8(7)).astype(jnp.int8)
+    full = jnp.stack([low, high], axis=-1).reshape(*p.shape[:-1], -1)
+    return full[..., :n].astype(scale.dtype) * scale
+
+
+def unpack_production(p, scale, n):
+    """The SHIPPED unpack — goes through Int4PackedArray.__jax_array__
+    itself, so this benchmark can never drift from the production
+    path."""
+    k = p.shape[0]
+    return jnp.asarray(Int4PackedArray(p, scale, (k, n)))
+
+
+def main() -> None:
+    B, K, N, iters = 8, 768, 3072, 200
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((K, N)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((B, K)), jnp.bfloat16)
+
+    amax = jnp.max(jnp.abs(w), axis=-2, keepdims=True)
+    scale = (amax / 7.0).astype(jnp.bfloat16)
+    q = jnp.clip(jnp.round(w / scale.astype(jnp.float32)), -7, 7)
+    qi = q.astype(jnp.int8)
+    packed = jax.device_put(_pack_nibbles(qi))  # the production packer
+    i8 = jax.device_put(qi)
+    wb = jax.device_put(w.astype(jnp.bfloat16))
+    scale = jax.device_put(scale)
+
+    fns = {
+        "stack": jax.jit(lambda x, p, s: x @ unpack_stack(p, s, N)),
+        "repeat": jax.jit(lambda x, p, s: x @ unpack_production(p, s, N)),
+        "int8": jax.jit(lambda x, p, s: x @ (p.astype(s.dtype) * s)),
+        "bf16": jax.jit(lambda x, p, s: x @ p),
+    }
+    args = {"stack": (x, packed, scale), "repeat": (x, packed, scale),
+            "int8": (x, i8, scale), "bf16": (x, wb, scale)}
+
+    # correctness first: both unpacks must equal the int8-style dequant
+    ref = np.asarray(jnp.asarray(x, jnp.float32)
+                     @ (qi.astype(jnp.float32)
+                        * scale.astype(jnp.float32)))
+    for name in ("stack", "repeat"):
+        got = np.asarray(fns[name](*args[name]), np.float32)
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-1)
+
+    out = {"B": B, "K": K, "N": N, "iters": iters,
+           "device": jax.devices()[0].device_kind}
+    for name, fn in fns.items():
+        a = args[name]
+        fn(*a).block_until_ready()
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(iters):
+            r = fn(*a)
+        r.block_until_ready()
+        out[f"{name}_us"] = round((time.perf_counter() - t0) / iters * 1e6,
+                                  1)
+    out["stack_vs_bf16"] = round(out["bf16_us"] / out["stack_us"], 3)
+    out["repeat_vs_bf16"] = round(out["bf16_us"] / out["repeat_us"], 3)
+    print(json.dumps(out))
+    path = os.path.join(REPO, "bench_artifacts", "int4_unpack.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def capture(batch: int, stem: str, remat: bool) -> str:
+def capture(batch: int, stem: str, remat: bool, bn: str = "f32") -> str:
     """Run the sweep's resnet step under the profiler; return the logdir."""
     import jax
 
@@ -40,7 +40,8 @@ def capture(batch: int, stem: str, remat: bool) -> str:
     # the whole call — compile happens outside the trace via its own warmup,
     # so the trace is dominated by the steady-state steps.
     with jax.profiler.trace(logdir):
-        tpu_sweep.stage_resnet(batch, remat=remat, stem=stem, write=False)
+        tpu_sweep.stage_resnet(batch, remat=remat, stem=stem, bn=bn,
+                               write=False)
     return logdir
 
 
@@ -161,14 +162,19 @@ def main() -> None:
     p.add_argument("--batch", type=int, default=512)
     p.add_argument("--stem", default="conv7", choices=("conv7", "s2d"))
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--bn", default="f32", choices=("f32", "bf16"),
+                   help="BatchNorm dtype — profile the tuned bf16-BN "
+                        "operating point with --bn bf16")
     p.add_argument("--logdir", default=None,
                    help="summarize an existing trace instead of capturing")
     args = p.parse_args()
 
-    logdir = args.logdir or capture(args.batch, args.stem, args.remat)
+    logdir = args.logdir or capture(args.batch, args.stem, args.remat,
+                                    args.bn)
     out = report(summarize(logdir))
     tag = f"b{args.batch}" + ("_s2d" if args.stem == "s2d" else "") + \
-        ("_remat" if args.remat else "")
+        ("_remat" if args.remat else "") + \
+        ("_bnbf16" if args.bn == "bf16" else "")
     path = os.path.join(REPO, "bench_artifacts", f"resnet_profile_{tag}.json")
     os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(path, "w") as f:

@@ -322,9 +322,9 @@ def stage_flash() -> dict:
 # Stage: GPT-124M training step MFU (the transformer-side headline)
 # ---------------------------------------------------------------------------
 def stage_gpt_train(batch: int, remat: bool = False,
-                    attn: str = "dense") -> dict:
-    """Train-step throughput/MFU for GPT-124M (768/12L/12H, T=1024, bf16,
-    tied chunked xent head, adamw).
+                    attn: str = "dense", model: str = "124m") -> dict:
+    """Train-step throughput/MFU for GPT-124M (768/12L/12H) or GPT-350M
+    (1024/24L/16H) at T=1024, bf16, tied chunked xent head, adamw.
 
     MFU here uses the ANALYTIC FLOP count (6·P_matmul·tokens for the
     matmul params + 12·L·B·T²·H for attention scores·values, fwd+bwd),
@@ -344,8 +344,12 @@ def stage_gpt_train(batch: int, remat: bool = False,
     from tensorflowonspark_tpu.util import host_fetch_drain
 
     dev = _device()
-    cfg = GPTConfig(vocab_size=50257, hidden_size=768, num_layers=12,
-                    num_heads=12, intermediate_size=3072,
+    size = model  # `model` is rebound to the GPT module below
+    dims = {"124m": (768, 12, 12, 3072),
+            "350m": (1024, 24, 16, 4096)}[size]
+    H_, L_, heads_, ffn_ = dims
+    cfg = GPTConfig(vocab_size=50257, hidden_size=H_, num_layers=L_,
+                    num_heads=heads_, intermediate_size=ffn_,
                     max_position_embeddings=1024, dtype=jnp.bfloat16,
                     remat=remat)
     T, steps, warmup = 1024, 10, 2
@@ -407,6 +411,7 @@ def stage_gpt_train(batch: int, remat: bool = False,
     peak = 197e12 if "v5 lite" in dev.device_kind.lower() else None
     row = {
         "batch": batch, "seq": T, "remat": remat, "attn": attn,
+        "model": size,
         "tokens_per_sec": round(batch * T / dt, 1),
         "step_ms": round(dt * 1e3, 2),
         "flops_analytic": flops, "flops_xla": xla_flops,
@@ -415,7 +420,8 @@ def stage_gpt_train(batch: int, remat: bool = False,
     }
     print("sweep gpt_train:", json.dumps(row), flush=True)
     _merge_row("gpt_train_sweep.json", row,
-               lambda r: (r["batch"], r["remat"], r.get("attn", "dense")))
+               lambda r: (r["batch"], r["remat"], r.get("attn", "dense"),
+                          r.get("model", "124m")))
     return row
 
 
@@ -1012,6 +1018,8 @@ def main() -> None:
     p.add_argument("--stem", default="conv7", choices=("conv7", "s2d"))
     p.add_argument("--bn", default="f32", choices=("f32", "bf16"))
     p.add_argument("--attn", default="dense", choices=("dense", "flash"))
+    p.add_argument("--model", default="124m", choices=("124m", "350m"),
+                   help="gpt_train model size (350m: 1024/24L/16H)")
     p.add_argument("--loop", action="store_true",
                    help="time a single-dispatch jitted fori_loop window "
                         "(isolates host-dispatch overhead)")
@@ -1059,7 +1067,7 @@ def main() -> None:
                      compiler_options=copts)
         return
     if args.stage == "gpt_train":
-        stage_gpt_train(args.batch, args.remat, args.attn)
+        stage_gpt_train(args.batch, args.remat, args.attn, args.model)
         return
     if args.stage == "flash":
         stage_flash()
@@ -1112,6 +1120,11 @@ def main() -> None:
                                  "--batch", "32", "--remat"], 900),
         ("gpt_train_b8_flash", [sys.executable, me, "--stage", "gpt_train",
                                 "--batch", "8", "--attn", "flash"], 900),
+        # MFU at 3x the parameters (flash+remat; no-remat 350m at b8
+        # does not fit): keeps the 350m ledger row reproducible
+        ("gpt_train_350m_b8_flash_remat",
+         [sys.executable, me, "--stage", "gpt_train", "--batch", "8",
+          "--attn", "flash", "--remat", "--model", "350m"], 1500),
         ("decode_matrix", [sys.executable, me, "--stage", "decode"], 1800),
         ("serving", [sys.executable, me, "--stage", "serving"], 1500),
         # bench_overlap writes its own overlap_<platform>.json; skipped in

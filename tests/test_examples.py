@@ -205,6 +205,13 @@ def test_serving_demo():
     assert "greedy-exact" in out and "serving_demo: done" in out
 
 
+def test_serving_demo_block_steps():
+    out = _run("gpt/serving_demo.py", "--requests", "6", "--slots", "2",
+               "--block-steps", "8")
+    assert "greedy-exact" in out and "serving_demo: done" in out
+    assert "block-steps k=8" in out and "steps/dispatch" in out
+
+
 def test_cluster_serving():
     out = _run("gpt/cluster_serving.py", "--requests", "8", "--workers", "2",
                timeout=420)

@@ -102,6 +102,7 @@ def main() -> None:
     out["repeat_vs_bf16"] = round(out["bf16_us"] / out["repeat_us"], 3)
     print(json.dumps(out))
     path = os.path.join(REPO, "bench_artifacts", "int4_unpack.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)  # fresh checkout
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
 

@@ -1009,6 +1009,34 @@ def _commit_artifacts(stage_name: str) -> None:
         print(f"sweep: artifact commit failed ({e!r})", flush=True)
 
 
+def _parse_compiler_options(spec: str) -> dict:
+    """``k=v,k2=v2`` → dict with int/float/bool-looking values coerced to
+    their Python types: PJRT option plumbing on some backends rejects a
+    stringly-typed value for a typed option at compile time with an
+    opaque error (ADVICE r5 item 3), so ``...=98304`` must arrive as an
+    int and ``...=true`` as a bool.  Anything else stays a string."""
+    def coerce(v: str):
+        low = v.strip().lower()
+        if low in ("true", "false"):
+            return low == "true"
+        try:
+            return int(v)
+        except ValueError:
+            pass
+        try:
+            return float(v)
+        except ValueError:
+            return v
+
+    out = {}
+    for kv in spec.split(","):
+        k, _, v = kv.partition("=")
+        if not _ or not k.strip():
+            raise ValueError(f"--compiler-options entry {kv!r} is not k=v")
+        out[k.strip()] = coerce(v)
+    return out
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--stage", default=None,
@@ -1048,14 +1076,15 @@ def main() -> None:
     args = p.parse_args()
     copts = None
     if args.compiler_options:
-        copts = dict(kv.split("=", 1)
-                     for kv in args.compiler_options.split(","))
+        copts = _parse_compiler_options(args.compiler_options)
         if not args.xla_label:
             # never let a flag-modified row collide with the baseline's
             # merge key (xla="") — that would silently overwrite the
-            # control measurement with no provenance
-            args.xla_label = "copts:" + ",".join(
-                f"{k}={v}" for k, v in sorted(copts.items()))
+            # control measurement with no provenance.  Label from the raw
+            # strings so bools render as typed on the wire but stable in
+            # the merge key.
+            args.xla_label = "copts:" + ",".join(sorted(
+                kv.strip() for kv in args.compiler_options.split(",")))
 
     if args.xla_flags:
         os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")

@@ -211,6 +211,14 @@ class HostAgent(MessageSocket):
                 pass
             env = dict(msg.get("env") or {})
             env["TFOS_WORKER_LOG"] = log_path  # fd-level capture, see _worker_entry
+            # host-level shm opt-out propagates to workers AND overrides a
+            # driver-supplied value: the agent's operator knows this host's
+            # /dev/shm situation (size, tenancy) better than the remote
+            # driver does
+            from tensorflowonspark_tpu import shm as _shm
+
+            if _shm.DISABLE_ENV in os.environ:
+                env[_shm.DISABLE_ENV] = os.environ[_shm.DISABLE_ENV]
             ctx = mp.get_context("spawn")  # fork is unsafe after jax/XLA init
             p = ctx.Process(
                 target=_worker_entry,

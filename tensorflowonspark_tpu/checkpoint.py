@@ -131,7 +131,9 @@ class CheckpointManager:
                 return {"f": 0.0, "b": False, "c": 0j}.get(kind, 0)
             return np.zeros(meta_leaf.shape, meta_leaf.dtype)
 
-        meta = self._mngr.item_metadata(step).tree
+        meta = self._mngr.item_metadata(step)
+        meta = getattr(meta, "tree", meta)  # orbax drift: newer returns the
+        # CompositeItemMetadata-style object, older the tree dict itself
         host_target = jax.tree.map(_to_host_target, meta)
         return self._mngr.restore(step, args=self._ocp.args.StandardRestore(host_target))
 
@@ -195,7 +197,9 @@ def _restore_host_tree(path: str):
         return ocp.RestoreArgs(restore_type=np.ndarray)
 
     with ocp.PyTreeCheckpointer() as ckptr:
-        meta = ckptr.metadata(path).item_metadata.tree
+        meta = ckptr.metadata(path)
+        meta = getattr(meta, "item_metadata", meta)  # orbax drift (see
+        meta = getattr(meta, "tree", meta)           # CheckpointManager.restore)
         return ckptr.restore(path, restore_args=jax.tree.map(_args, meta))
 
 
@@ -232,8 +236,15 @@ def _walk_containers(node, path, visit):
     if out is not None:
         return out
     if isinstance(node, Mapping):
-        return type(node)({k: _walk_containers(v, path + (k,), visit)
-                           for k, v in node.items()})
+        items = {k: _walk_containers(v, path + (k,), visit)
+                 for k, v in node.items()}
+        try:
+            return type(node)(items)
+        except TypeError:
+            # Mapping subclasses whose constructor doesn't take a mapping
+            # (defaultdict wants its factory first) fall back to a plain
+            # dict — the docstring's dict/FrozenDict/OrderedDict intent
+            return items
     if isinstance(node, tuple) and hasattr(node, "_fields"):
         return type(node)(*(_walk_containers(v, path + (i,), visit)
                             for i, v in enumerate(node)))

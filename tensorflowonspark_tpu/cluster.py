@@ -157,7 +157,7 @@ class TPUCluster:
             driver_ps_nodes: bool = False, reservation_timeout: float = 600.0,
             queues=DEFAULT_QUEUES, backend=None, worker_env: dict | None = None,
             working_dir: str | None = None, queue_depth: int = 64,
-            default_fs: str = "",
+            default_fs: str = "", queue_shm: bool | None = None,
             tensorboard_logdir: str | None = None) -> "TPUCluster":
         """Boot the cluster and block until every node has registered.
 
@@ -204,6 +204,10 @@ class TPUCluster:
             "working_dir": working_dir,
             "queue_mode": "remote",
             "queue_depth": queue_depth,
+            # None = auto: each feeder↔node connection negotiates the
+            # zero-copy shm transport when it proves same-host (shm.py);
+            # False pins every connection to the socket protocol.
+            "queue_shm": queue_shm,
             "reservation_timeout": reservation_timeout,
             "tensorboard": tensorboard,
             "tensorboard_logdir": tensorboard_logdir,
@@ -240,7 +244,9 @@ class TPUCluster:
     def _client_for(self, executor_id: int) -> QueueClient:
         if executor_id not in self._clients:
             info = next(n for n in self.cluster_info if n["executor_id"] == executor_id)
-            self._clients[executor_id] = QueueClient(info["addr"], info["authkey"])
+            self._clients[executor_id] = QueueClient(
+                info["addr"], info["authkey"],
+                shm=self.cluster_meta.get("queue_shm"))
         return self._clients[executor_id]
 
     def train(self, data, num_epochs: int = 1, qname: str = "input",
@@ -329,7 +335,8 @@ class TPUCluster:
         def _feed_and_collect(node_idx: int, parts: list[tuple[int, list]]) -> None:
             try:
                 target = nodes[node_idx]
-                client = QueueClient(target["addr"], target["authkey"])
+                client = QueueClient(target["addr"], target["authkey"],
+                                     shm=self.cluster_meta.get("queue_shm"))
                 try:
                     for pidx, part in parts:
                         # Interleave feeding with result collection: with

@@ -602,7 +602,14 @@ def _copy_tree(x):
 def device_prefetch(it: Iterator, depth: int = 2, sharding=None):
     """Yield items from ``it`` with ``depth`` ``jax.device_put`` transfers in
     flight — host→device copy of batch k+1 overlaps compute on batch k
-    (device_put is async; the deque holds uncommitted arrays)."""
+    (device_put is async; the deque holds uncommitted arrays).
+
+    Composes with the shm data plane: an iterator over
+    ``DataFeed.next_chunk`` items hands ``device_put`` numpy views backed
+    directly by the producer's shared-memory segments, so a SPARK-mode
+    batch goes producer→shm→HBM with exactly one host-side copy (the
+    producer's segment write).  Once ``device_put`` commits, the host view
+    is dropped and the segment recycles into the producer's ring."""
     import jax
 
     assert depth > 0

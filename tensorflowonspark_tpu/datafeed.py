@@ -99,6 +99,41 @@ class DataFeed:
             self._buffer.extend(samples)
         return batch
 
+    def next_chunk(self, timeout: float = 600.0):
+        """Next raw queue chunk, zero-copy — the batched-array hot path.
+
+        For feeds that push pre-batched device-sized arrays (the
+        streamed-ImageNet regime), re-slicing through :meth:`next_batch`'s
+        sample buffer would only add Python-side copies; this returns each
+        queue item as-is.  Over the same-host shm transport (``shm.py``)
+        the item's arrays are zero-copy views straight into the producer's
+        shared-memory segments, ready for ``jax.device_put`` /
+        :func:`~tensorflowonspark_tpu.data.device_prefetch` — dropping the
+        returned chunk releases its segment back to the producer's ring.
+
+        Partition markers are skipped (a pre-batched chunk is already
+        batch-aligned); returns ``None`` once the feed has terminated.
+        Don't mix with :meth:`next_batch` on the same queue: this method
+        bypasses (and would reorder against) its carry-over buffer.
+        """
+        if self.done_feeding:
+            return None
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                item = self.mgr.queue_get(
+                    self.qname_in,
+                    timeout=max(0.1, deadline - time.monotonic()))
+            except (_queue.Empty, TimeoutError):
+                raise TimeoutError(
+                    f"no data on '{self.qname_in}' after {timeout}s")
+            if isinstance(item, EndOfFeed):
+                self.done_feeding = True
+                return None
+            if isinstance(item, Marker):
+                continue
+            return item
+
     def next_batch_arrays(self, batch_size: int, timeout: float = 600.0):
         """``next_batch`` + column-wise stacking into numpy arrays.
 

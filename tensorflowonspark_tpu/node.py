@@ -189,9 +189,14 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
 
             # 1. data-plane queue server (TFManager.start equivalent);
             #    'remote' lets the driver/feeders connect from another host.
+            #    Same-host feeders (the LocalProcessBackend shape, or a
+            #    driver co-located with this worker) negotiate the
+            #    zero-copy shm transport per connection (queues.py/shm.py);
+            #    cross-host feeders keep the socket protocol automatically.
             mgr = QueueServer(authkey=cluster_meta["authkey"], qnames=queues,
                               mode=cluster_meta.get("queue_mode", "remote"),
-                              maxsize=cluster_meta.get("queue_depth", 64))
+                              maxsize=cluster_meta.get("queue_depth", 64),
+                              shm=cluster_meta.get("queue_shm"))
             addr = mgr.start()
 
             # 2. ports: one for the (unused-on-TPU) server slot, one that

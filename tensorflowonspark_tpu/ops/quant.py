@@ -201,11 +201,23 @@ class Int4PackedArray(_QuantArray, _AxisMetadataBase):
     def replace_boxed(self, val):
         return val
 
-    def add_axis(self, index, params):  # lifted-transform protocol —
-        return self  # packing is per-leaf; axes don't change it
+    # Lifted-transform protocol: a transform that actually adds/removes a
+    # param axis (nn.scan / nn.vmap param lifting) would leave
+    # ``logical_shape`` stale, and the unpack would silently dequantize
+    # the wrong dim.  Quantize AFTER lifting instead (ADVICE r5 item 1).
+    def add_axis(self, index, params):
+        raise NotImplementedError(
+            "Int4PackedArray cannot be lifted across an axis-adding "
+            "transform (nn.scan/nn.vmap over params): its packed buffer "
+            "and logical_shape are per-leaf static.  Quantize the params "
+            "AFTER applying the lifted transform.")
 
     def remove_axis(self, index, params):
-        return self
+        raise NotImplementedError(
+            "Int4PackedArray cannot be lifted across an axis-removing "
+            "transform (nn.scan/nn.vmap over params): its packed buffer "
+            "and logical_shape are per-leaf static.  Quantize the params "
+            "AFTER applying the lifted transform.")
 
 
 register_pytree_with_keys(

@@ -25,6 +25,15 @@ the wire format):
 
 Auth: an ``authkey`` hello on connect, mirroring the reference's
 ``multiprocessing`` authkey handshake.
+
+Same-host zero-copy mode (``shm.py``): right after the authkey hello the
+client offers a shared-memory probe; if the server proves it can read it
+(the two processes genuinely share ``/dev/shm``), the connection switches
+to :class:`~tensorflowonspark_tpu.shm.ShmChannel` framing — large ndarray
+payloads are written once into a shm segment ring and received as
+zero-copy numpy views, with the socket retained as the control channel.
+Cross-host peers, probe failures, and ``TFOS_TPU_NO_SHM=1`` keep the plain
+socket protocol; either way the op surface below is unchanged.
 """
 
 from __future__ import annotations
@@ -34,6 +43,7 @@ import queue as _queue
 import socket
 import threading
 
+from tensorflowonspark_tpu import shm as _shm
 from tensorflowonspark_tpu.reservation import (FrameFormatError,
                                                MessageSocket, _peer_name)
 
@@ -50,7 +60,7 @@ class QueueServer(MessageSocket):
     """
 
     def __init__(self, authkey: bytes, qnames=DEFAULT_QUEUES, mode: str = "local",
-                 maxsize: int = 64):
+                 maxsize: int = 64, shm: bool | None = None):
         self.authkey = bytes(authkey)
         self.mode = mode
         self.queues = {name: _queue.Queue(maxsize=maxsize) for name in qnames}
@@ -58,6 +68,9 @@ class QueueServer(MessageSocket):
         self._kv_lock = threading.Lock()
         self.done = threading.Event()
         self._listener: socket.socket | None = None
+        # None = auto (accept shm when the env allows it); False = refuse
+        self.shm = _shm.shm_resolve(shm)
+        self.shm_conns = 0  # connections that negotiated the shm transport
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> tuple[str, int]:
@@ -95,6 +108,7 @@ class QueueServer(MessageSocket):
             threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        chan: _shm.ShmChannel | None = None
         try:
             # Mutual HMAC challenge-response (reservation.MessageSocket):
             # the key never crosses the wire and an unauthenticated peer
@@ -103,51 +117,65 @@ class QueueServer(MessageSocket):
             if not self.auth_verify(conn, self.authkey, nonce):
                 return
             while not self.done.is_set():
-                msg = self.receive(conn)
+                msg = chan.receive() if chan is not None else self.receive(conn)
+                if isinstance(msg, dict) and msg.get("op") == "shm_hello":
+                    # same-host negotiation: the client proves shared memory
+                    # by a probe segment we must read back (shm.verify_probe)
+                    ok = (chan is None and self.shm
+                          and _shm.verify_probe(msg.get("seg"), msg.get("tok")))
+                    self.send(conn, ("SHM", bool(ok)))
+                    if ok:
+                        chan = _shm.ShmChannel(self, conn)
+                        self.shm_conns += 1
+                    continue
+                reply = chan.send if chan is not None else \
+                    (lambda obj: self.send(conn, obj))
                 try:
-                    self._handle(conn, msg)
+                    self._handle(reply, msg)
                 except KeyError as e:
-                    self.send(conn, ("ERR", f"unknown queue {e}"))
+                    reply(("ERR", f"unknown queue {e}"))
         except FrameFormatError as e:
             logger.error("dropping peer %s: %s", _peer_name(conn), e)
         except (EOFError, OSError, ValueError):
             pass
         finally:
+            if chan is not None:
+                chan.close()
             try:
                 conn.close()
             except OSError:
                 pass
 
-    def _handle(self, conn: socket.socket, msg: dict) -> None:
+    def _handle(self, reply, msg: dict) -> None:
         op = msg.get("op")
         if op == "put":
             try:
                 self.queues[msg["q"]].put(msg["data"], block=True,
                                           timeout=msg.get("timeout", 600))
-                self.send(conn, "OK")
+                reply("OK")
             except _queue.Full:
-                self.send(conn, ("FULL",))
+                reply(("FULL",))
         elif op == "get":
             try:
                 item = self.queues[msg["q"]].get(block=True, timeout=msg.get("timeout", 600))
                 self.queues[msg["q"]].task_done()
-                self.send(conn, ("OK", item))
+                reply(("OK", item))
             except _queue.Empty:
-                self.send(conn, ("EMPTY",))
+                reply(("EMPTY",))
         elif op == "qsize":
-            self.send(conn, self.queues[msg["q"]].qsize())
+            reply(self.queues[msg["q"]].qsize())
         elif op == "set":
             with self._kv_lock:
                 self.kv[msg["k"]] = msg["v"]
-            self.send(conn, "OK")
+            reply("OK")
         elif op == "getk":
             with self._kv_lock:
-                self.send(conn, self.kv.get(msg["k"]))
+                reply(self.kv.get(msg["k"]))
         elif op == "stop":
-            self.send(conn, "OK")
+            reply("OK")
             self.done.set()
         else:
-            self.send(conn, ("ERR", f"unknown op {op!r}"))
+            reply(("ERR", f"unknown op {op!r}"))
 
     # -- in-process access (training side, no TCP hop) ---------------------
     def get_queue(self, qname: str) -> _queue.Queue:
@@ -192,7 +220,8 @@ class QueueClient(MessageSocket):
     ``TFSparkNode.py::_train/_inference``.
     """
 
-    def __init__(self, addr: tuple[str, int], authkey: bytes, timeout: float = 600.0):
+    def __init__(self, addr: tuple[str, int], authkey: bytes, timeout: float = 600.0,
+                 shm: bool | None = None):
         self.addr = tuple(addr)
         self.authkey = bytes(authkey)
         self._default_timeout = timeout
@@ -206,6 +235,32 @@ class QueueClient(MessageSocket):
         except (PermissionError, EOFError, OSError) as e:
             # a bad key shows up as the server silently closing on us
             raise ConnectionError(f"queue server rejected connection: {e!r}")
+        self._chan: _shm.ShmChannel | None = None
+        if _shm.shm_resolve(shm):
+            self._negotiate_shm()
+
+    def _negotiate_shm(self) -> None:
+        """Offer the zero-copy transport as part of the connect hello; any
+        failure (cross-host server, full /dev/shm, old peer) is a silent
+        downgrade to the socket protocol."""
+        try:
+            probe = _shm.Probe()
+        except (OSError, ValueError) as e:
+            logger.debug("shm probe creation failed (%s); using socket", e)
+            return
+        try:
+            self.send(self._sock,
+                      {"op": "shm_hello", "seg": probe.name, "tok": probe.token})
+            resp = self.receive(self._sock)
+        finally:
+            probe.close()
+        if resp == ("SHM", True):
+            self._chan = _shm.ShmChannel(self, self._sock)
+
+    @property
+    def shm_active(self) -> bool:
+        """True when this connection negotiated the zero-copy transport."""
+        return self._chan is not None
 
     def _request(self, msg, op_timeout: float | None = None):
         with self._lock:
@@ -215,6 +270,9 @@ class QueueClient(MessageSocket):
                 # (but correct) reply never desynchronizes the connection.
                 self._sock.settimeout(op_timeout + 30.0)
             try:
+                if self._chan is not None:
+                    self._chan.send(msg)
+                    return self._chan.receive()
                 self.send(self._sock, msg)
                 return self.receive(self._sock)
             finally:
@@ -272,6 +330,9 @@ class QueueClient(MessageSocket):
     kv_get = get_key
 
     def close(self) -> None:
+        if self._chan is not None:
+            self._chan.close()  # closes + unlinks this side's segment ring
+            self._chan = None
         try:
             self._sock.close()
         except OSError:  # pragma: no cover

@@ -167,7 +167,11 @@ class MessageSocket:
         cls._recv_exact_into(sock, memoryview(ba))
         return bytes(ba) if n < BUFSIZE else ba  # small frames: hashable
 
-    def send(self, sock: socket.socket, msg) -> None:
+    def split_oob(self, msg) -> tuple[bytes, list]:
+        """Pickle ``msg`` with the large-contiguous-buffer split applied:
+        returns ``(pickle5_stream, oob_buffers)``.  Shared by the socket
+        framing below and the shm transport (``shm.ShmChannel``), which
+        routes the same buffers into shared memory instead."""
         bufs: list = []
 
         def keep_large(pb):
@@ -183,7 +187,10 @@ class MessageSocket:
             bufs.append(v)
             return False
 
-        data = pickle.dumps(msg, protocol=5, buffer_callback=keep_large)
+        return pickle.dumps(msg, protocol=5, buffer_callback=keep_large), bufs
+
+    def send(self, sock: socket.socket, msg) -> None:
+        data, bufs = self.split_oob(msg)
         header = struct.pack(">BBII", self.FRAME_MAGIC, self.FRAME_VERSION,
                              len(data), len(bufs))
         if bufs:

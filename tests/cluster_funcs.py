@@ -488,3 +488,19 @@ def fn_write_cache_env(args, ctx):
         f.write(os.environ.get("JAX_COMPILATION_CACHE_DIR", "MISSING") + ":"
                 + os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
                                  "MISSING"))
+
+
+def shm_crash_server(pipe):
+    """test_shm consumer-crash fixture: serve a queue (shm negotiation on),
+    acknowledge the feed, then die HARD — no finally blocks, no atexit —
+    simulating a worker crash while it still holds zero-copy leases."""
+    from tensorflowonspark_tpu.queues import QueueServer
+
+    srv = QueueServer(authkey=b"k" * 16, qnames=("input",), mode="local")
+    addr = srv.start()
+    pipe.send(addr)
+    # hold the fed item's views so the lease is live at crash time
+    item = srv.queue_get("input", timeout=30)
+    pipe.send(int(item[0, 0]))  # prove the shm payload arrived intact
+    pipe.recv()              # wait for the driver's kill order
+    os._exit(1)

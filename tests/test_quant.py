@@ -236,6 +236,21 @@ def test_quantize_int4_roundtrip_error_bounded(storage):
     assert qa.nbytes == w.size // 2 + 48 * 4
 
 
+def test_int4_packed_lifted_axis_transform_fails_loudly():
+    """add_axis/remove_axis (flax lifted-transform protocol) must raise,
+    not return self: a transform that really changes a param axis would
+    leave logical_shape stale and dequantize the wrong dim silently
+    (ADVICE r5 item 1)."""
+    from tensorflowonspark_tpu.ops import quantize_int4
+
+    qa = quantize_int4(jax.random.normal(jax.random.key(3), (8, 6)),
+                       storage="packed")
+    with pytest.raises(NotImplementedError, match="lifted"):
+        qa.add_axis(0, {})
+    with pytest.raises(NotImplementedError, match="lifted"):
+        qa.remove_axis(0, {})
+
+
 def test_int4_packed_matches_native_dequant():
     """The uint8 nibble packing is a pure storage change: packed and
     native int4 dequantize to IDENTICAL arrays, including odd last dims

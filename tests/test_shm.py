@@ -1,0 +1,291 @@
+"""Zero-copy shared-memory data plane tests (``shm.py`` + its
+``queues.py`` negotiation).  All fast-tier: CPU only, loopback + /dev/shm.
+
+Leak assertions track the EXACT segment names a test created (via the
+channel's ring) rather than global /dev/shm state, so pre-existing
+segments from other tenants never flake these tests.
+"""
+
+import gc
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import shm as shm_mod
+from tensorflowonspark_tpu.queues import QueueClient, QueueServer
+
+AUTH = b"k" * 16
+
+# payload comfortably above MessageSocket.OOB_MIN_BYTES so it takes the
+# out-of-band (and, when negotiated, the shm) path
+BIG_SHAPE = (512, 128)  # f32 = 256 KB
+
+
+def _big(seed=0):
+    return (np.arange(np.prod(BIG_SHAPE), dtype=np.float32) + seed).reshape(
+        BIG_SHAPE)
+
+
+def _segments_alive(names):
+    return [n for n in names if os.path.exists(os.path.join("/dev/shm", n))]
+
+
+@pytest.fixture()
+def server():
+    s = QueueServer(authkey=AUTH, mode="local", maxsize=8)
+    s.start()
+    yield s
+    s.stop()
+
+
+def test_negotiation_and_roundtrip_integrity(server):
+    c = QueueClient(server.addr, AUTH)
+    assert c.shm_active, "same-process client must negotiate shm"
+    big, small = _big(), np.arange(16, dtype=np.int32)
+    chunk = [big, small, {"label": 3, "x": big + 1}]
+    c.put("input", chunk)
+    got = server.queue_get("input", timeout=5)
+    np.testing.assert_array_equal(got[0], big)
+    np.testing.assert_array_equal(got[1], small)
+    assert got[2]["label"] == 3
+    np.testing.assert_array_equal(got[2]["x"], big + 1)
+    got[0][0, 0] = -1.0  # zero-copy views must stay writable
+    assert c._chan.stats["shm_msgs"] == 1
+    assert c._chan.stats["fallbacks"] == 0
+    c.close()
+
+
+def test_received_views_are_physically_shared(server):
+    """The receive side must get views of the producer's segment, not a
+    copy: a write through the received array is visible through a fresh
+    attach of the ring segment."""
+    from multiprocessing import shared_memory
+
+    c = QueueClient(server.addr, AUTH)
+    c.put("input", _big())
+    item = server.queue_get("input", timeout=5)
+    item[0, 0] = 1234.5
+    [name] = c._chan.ring_segment_names()
+    seg = shared_memory.SharedMemory(name=name, create=False)
+    try:
+        assert np.frombuffer(seg.buf, np.float32, count=1)[0] == 1234.5
+    finally:
+        del item
+        seg.close()
+    c.close()
+
+
+def test_slot_release_recycles_ring(server, monkeypatch):
+    """Dropping the consumer's views releases the slot back to the
+    producer (piggybacked on the next response): a 2-slot ring sustains
+    many more than 2 messages with zero fallbacks."""
+    monkeypatch.setenv(shm_mod.SLOTS_ENV, "2")
+    monkeypatch.setenv(shm_mod.SLOT_MB_ENV, "1")
+    c = QueueClient(server.addr, AUTH)
+    for i in range(10):
+        c.put("input", _big(i))
+        got = server.queue_get("input", timeout=5)
+        assert got[0, 0] == float(i)
+        del got
+        gc.collect()  # drop the lease promptly
+    assert c._chan.stats["shm_msgs"] == 10
+    assert c._chan.stats["fallbacks"] == 0
+    c.close()
+
+
+def test_pool_exhaustion_falls_back_then_recovers(server, monkeypatch):
+    """Ring exhausted (consumer still holds every lease) → the message
+    takes the socket path, correctly; once leases drop, shm resumes."""
+    monkeypatch.setenv(shm_mod.SLOTS_ENV, "1")
+    monkeypatch.setenv(shm_mod.SLOT_MB_ENV, "1")
+    c = QueueClient(server.addr, AUTH)
+    c.put("input", _big(1))
+    held = server.queue_get("input", timeout=5)  # lease the only slot
+    c.put("input", _big(2))                      # must fall back, not fail
+    got2 = server.queue_get("input", timeout=5)
+    assert got2[0, 0] == 2.0
+    assert c._chan.stats == {"shm_msgs": 1, "fallbacks": 1, "free_slots": 0}
+    del held, got2
+    gc.collect()
+    c.kv_get("state")  # any exchange carries the pending release back
+    assert c._chan.stats["free_slots"] == 1
+    c.put("input", _big(3))                      # shm path again
+    got3 = server.queue_get("input", timeout=5)
+    assert got3[0, 0] == 3.0
+    assert c._chan.stats["shm_msgs"] == 2
+    c.close()
+
+
+def test_oversized_payload_falls_back(server, monkeypatch):
+    monkeypatch.setenv(shm_mod.SLOT_MB_ENV, "1")
+    c = QueueClient(server.addr, AUTH)
+    big = np.random.rand(1 << 19).astype(np.float32)  # 2 MB > 1 MB slot
+    c.put("input", big)
+    np.testing.assert_array_equal(server.queue_get("input", timeout=5), big)
+    assert c._chan.stats["fallbacks"] == 1
+    c.close()
+
+
+def test_env_kill_switch_pins_socket_path(monkeypatch):
+    monkeypatch.setenv(shm_mod.DISABLE_ENV, "1")
+    s = QueueServer(authkey=AUTH, mode="local")
+    s.start()
+    try:
+        c = QueueClient(s.addr, AUTH)
+        assert not c.shm_active
+        c.put("input", _big())
+        np.testing.assert_array_equal(s.queue_get("input", timeout=5),
+                                      _big())
+        c.close()
+    finally:
+        s.stop()
+
+
+def test_server_param_disable_downgrades_client(server):
+    s = QueueServer(authkey=AUTH, mode="local", shm=False)
+    s.start()
+    try:
+        c = QueueClient(s.addr, AUTH)  # client offers, server refuses
+        assert not c.shm_active
+        c.put("input", _big())
+        np.testing.assert_array_equal(s.queue_get("input", timeout=5),
+                                      _big())
+        c.close()
+    finally:
+        s.stop()
+
+
+def test_client_param_disable(server):
+    c = QueueClient(server.addr, AUTH, shm=False)
+    assert not c.shm_active
+    c.put("input", [1, 2])
+    assert server.queue_get("input", timeout=5) == [1, 2]
+    c.close()
+
+
+def test_cross_host_probe_failure_downgrades(server, monkeypatch):
+    """A peer that cannot actually read the probe segment (the cross-host
+    case) must land on the socket protocol, transparently."""
+    monkeypatch.setattr(shm_mod, "verify_probe", lambda name, tok: False)
+    c = QueueClient(server.addr, AUTH)
+    assert not c.shm_active
+    c.put("input", _big())
+    np.testing.assert_array_equal(server.queue_get("input", timeout=5),
+                                  _big())
+    c.close()
+
+
+def test_no_leaked_segments_after_normal_shutdown():
+    s = QueueServer(authkey=AUTH, mode="local")
+    s.start()
+    c = QueueClient(s.addr, AUTH)
+    c.put("input", _big())
+    item = s.queue_get("input", timeout=5)
+    names = c._chan.ring_segment_names()
+    assert names, "expected a ring segment in flight"
+    del item  # consumer done
+    gc.collect()
+    c.close()
+    s.stop()
+    assert _segments_alive(names) == []
+
+
+def test_no_leaked_segments_with_leases_still_held():
+    """Closing while a consumer STILL holds views must unlink the names
+    (memory itself lives until the views die — that's the mmap contract)."""
+    s = QueueServer(authkey=AUTH, mode="local")
+    s.start()
+    c = QueueClient(s.addr, AUTH)
+    c.put("input", _big())
+    item = s.queue_get("input", timeout=5)
+    names = c._chan.ring_segment_names()
+    c.close()  # lease never released — close anyway
+    s.stop()
+    assert _segments_alive(names) == []
+    assert item[0, 0] == 0.0  # view stays valid until dropped
+    del item
+
+
+def test_consumer_crash_leaves_no_segments():
+    """Worker process dies mid-lease (hard os._exit, no cleanup): the
+    producer's close still unlinks every ring segment."""
+    import multiprocessing as mp
+
+    from tests.cluster_funcs import shm_crash_server
+
+    ctx = mp.get_context("spawn")
+    parent, child = ctx.Pipe()
+    p = ctx.Process(target=shm_crash_server, args=(child,), daemon=True)
+    p.start()
+    try:
+        addr = parent.recv()
+        c = QueueClient(tuple(addr), AUTH)
+        assert c.shm_active, "cross-process same-host must negotiate shm"
+        c.put("input", _big(7))
+        assert parent.recv() == 7  # payload crossed the process boundary
+        names = c._chan.ring_segment_names()
+        assert names
+        parent.send("die")
+        p.join(10)
+        assert p.exitcode == 1
+        c.close()
+        assert _segments_alive(names) == []
+    finally:
+        if p.is_alive():  # pragma: no cover - only on assertion failure
+            p.terminate()
+
+
+def test_datafeed_next_chunk_over_shm(server):
+    from tensorflowonspark_tpu.datafeed import DataFeed
+    from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition
+
+    c = QueueClient(server.addr, AUTH)
+    c.put("input", _big(1))
+    c.put("input", EndPartition())
+    c.put("input", _big(2))
+    c.put("input", EndOfFeed())
+    feed = DataFeed(server)
+    assert feed.next_chunk(timeout=5)[0, 0] == 1.0
+    assert feed.next_chunk(timeout=5)[0, 0] == 2.0  # marker skipped
+    assert feed.next_chunk(timeout=5) is None
+    assert feed.should_stop()
+    c.close()
+
+
+def test_concurrent_feeders_over_shm(server):
+    """Two shm connections (two rings) interleaving on one queue."""
+    def _feed(tag):
+        c = QueueClient(server.addr, AUTH)
+        for i in range(6):
+            c.put("input", [_big(i), tag], timeout=10)
+        c.close()
+
+    threads = [threading.Thread(target=_feed, args=(t,)) for t in (0, 1)]
+    for t in threads:
+        t.start()
+    seen = []
+    for _ in range(12):
+        arr, tag = server.queue_get("input", timeout=10)
+        seen.append((int(arr[0, 0]), tag))
+    for t in threads:
+        t.join(5)
+    assert sorted(seen) == sorted([(i, t) for t in (0, 1) for i in range(6)])
+
+
+def test_probe_rejects_foreign_names_and_malformed_tokens():
+    assert not shm_mod.verify_probe("not-ours", b"x" * 16)
+    assert not shm_mod.verify_probe(None, b"x" * 16)
+    assert not shm_mod.verify_probe(shm_mod.SEG_PREFIX + "nonexistent",
+                                    b"x" * 16)
+    # malformed hello fields must downgrade, never raise (the server's
+    # connection thread calls this on peer-controlled input)
+    probe = shm_mod.Probe()
+    try:
+        assert not shm_mod.verify_probe(probe.name, None)
+        assert not shm_mod.verify_probe(probe.name, b"")
+        assert not shm_mod.verify_probe(probe.name, "not-bytes")
+        assert shm_mod.verify_probe(probe.name, probe.token)
+    finally:
+        probe.close()

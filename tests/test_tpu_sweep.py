@@ -220,3 +220,22 @@ def test_mfu_attack_join(tmp_path, monkeypatch):
     assert out["flag_attack"][0]["speedup_vs_control"] == 1.05
     assert "vmem96" in out["verdict"] and "1.050x" in out["verdict"]
     assert "40.0%" in out["verdict"]
+
+
+def test_parse_compiler_options_coerces_types():
+    """--compiler-options values that look like ints/bools must reach
+    compile() typed — PJRT rejects stringly-typed values for typed
+    options with an opaque compile-time error (ADVICE r5 item 3)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("tpu_sweep_mod", SWEEP)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    parse = mod._parse_compiler_options
+
+    assert parse("xla_tpu_scoped_vmem_limit_kib=98304") == {
+        "xla_tpu_scoped_vmem_limit_kib": 98304}
+    assert parse("a=true,b=False,c=text,d=-3,e=0.5") == {
+        "a": True, "b": False, "c": "text", "d": -3, "e": 0.5}
+    with pytest.raises(ValueError, match="k=v"):
+        parse("novalue")

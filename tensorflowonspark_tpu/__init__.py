@@ -30,8 +30,11 @@ from tensorflowonspark_tpu.util import apply_jax_platforms_env as _apply_env
 # platform selection keeps working for every entry point that imports us.
 _apply_env()
 
-from tensorflowonspark_tpu.cluster import InputMode, TPUCluster  # noqa: F401,E402
+from tensorflowonspark_tpu.cluster import (InputMode, TPUCluster,  # noqa: F401,E402
+                                           run_with_recovery)
 from tensorflowonspark_tpu.datafeed import DataFeed  # noqa: F401
+from tensorflowonspark_tpu.health import (ClusterFailure, ClusterMonitor,  # noqa: F401
+                                          HeartbeatReporter)
 from tensorflowonspark_tpu.node import NodeContext  # noqa: F401
 from tensorflowonspark_tpu.checkpoint import (CheckpointManager, ExportedModel,  # noqa: F401
                                               export_model, restore_checkpoint,

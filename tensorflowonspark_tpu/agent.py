@@ -264,21 +264,52 @@ class HostAgent(MessageSocket):
 
 
 class _AgentConn(MessageSocket):
-    """One authenticated driver→agent connection (request-response)."""
+    """One authenticated driver→agent connection (request-response).
+
+    A transient socket failure (timeout, reset, half-closed peer) must not
+    poison the cached connection for the rest of the job — the driver's
+    ``alive()``/``join()`` polls and the steady-state health monitor reuse
+    this object for hours.  ``request`` therefore reconnects and retries
+    ONCE (short backoff) on ``OSError``/``socket.timeout``/``EOFError``
+    before propagating.  Note the retry re-sends the message: LAUNCH is
+    guarded agent-side ("already running"), the other verbs are idempotent.
+    """
+
+    RETRY_BACKOFF_SECS = 0.2
 
     def __init__(self, addr: tuple[str, int], authkey: bytes | None,
                  timeout: float = 30.0):
         self.addr = tuple(addr)
-        self._sock = socket.create_connection(self.addr, timeout=timeout)
-        self._sock.settimeout(timeout)
+        self.authkey = authkey
+        self.timeout = timeout
         self._lock = threading.Lock()
-        if authkey is not None:
-            self.auth_respond(self._sock, authkey)
+        self._sock: socket.socket | None = None
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(self.addr, timeout=self.timeout)
+        self._sock.settimeout(self.timeout)
+        if self.authkey is not None:
+            self.auth_respond(self._sock, self.authkey)
+
+    def _roundtrip(self, msg: dict):
+        self.send(self._sock, msg)
+        return self.receive(self._sock)
 
     def request(self, msg: dict):
         with self._lock:
-            self.send(self._sock, msg)
-            resp = self.receive(self._sock)
+            try:
+                resp = self._roundtrip(msg)
+            except (OSError, EOFError) as e:  # socket.timeout is an OSError
+                logger.warning("agent %s: %s during %r; reconnecting once",
+                               self.addr, type(e).__name__, msg.get("type"))
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                time.sleep(self.RETRY_BACKOFF_SECS)
+                self._connect()  # propagates if the agent is really gone
+                resp = self._roundtrip(msg)
         if isinstance(resp, tuple) and resp and resp[0] == "ERR":
             raise RuntimeError(f"agent {self.addr}: {resp[1]}")
         return resp
@@ -347,6 +378,13 @@ class AgentBackend:
         return [i for i in sorted(self._assignment)
                 if not st.get(i, {}).get("alive", False)
                 and st.get(i, {}).get("exitcode") not in (0, None)]
+
+    def exitcodes(self) -> dict[int, int | None]:
+        """Exit codes by executor id (None while alive / unknown) — feeds
+        the health monitor's crash-vs-preemption classification."""
+        st = self._statuses()
+        return {i: st.get(i, {}).get("exitcode")
+                for i in sorted(self._assignment)}
 
     def join(self, timeout: float | None = None) -> bool:
         deadline = None if timeout is None else time.monotonic() + timeout

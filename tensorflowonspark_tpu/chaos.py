@@ -1,0 +1,242 @@
+"""Deterministic, env-driven fault injection for kill/restore testing.
+
+VERDICT.md called the recovery story "tested-on-CPU" until a real
+kill/restore demonstration exists; this module is the injection half of
+that demonstration.  Worker processes *self-apply* faults from a plan in
+the ``TFOS_CHAOS`` environment variable, so tests and
+``scripts/bench_recovery.py`` can script byte-reproducible failure
+scenarios end-to-end through ``LocalProcessBackend`` (and, unchanged,
+through ``AgentBackend`` — the env rides ``worker_env``).
+
+Plan grammar (full reference: ``docs/robustness.md``)::
+
+    TFOS_CHAOS = action [';' action]...
+    action     = verb SP assignments          # 'kill node=1 at_step=3'
+    assignments= key'='value [[',' | SP] key'='value]...
+    verb       = 'kill' | 'term' | 'stall' | 'drop'
+
+Keys:
+
+- ``node=<int>`` (required) — executor id the action targets.
+- ``at_step=<int>`` — fire when ``ctx.report_step()`` reaches this step.
+- ``after_secs=<float>`` — fire this long after the worker's harness
+  starts (checked on the heartbeat tick) — for faults before step 1.
+- ``grace=<float>`` (``term`` only) — follow the SIGTERM with SIGKILL
+  after this many seconds, modelling a preemption grace window.
+- ``secs=<float>`` (``stall`` only) — how long to stall heartbeats
+  (default: forever).
+
+Verbs:
+
+- ``kill`` — SIGKILL self: the hard crash (no finally blocks, no crash
+  file) the driver must notice from process exit + silence alone.
+- ``term`` — SIGTERM self (optionally SIGKILL after ``grace``): the
+  preemption shape; with a :class:`~tensorflowonspark_tpu.preemption.
+  PreemptionGuard` installed the worker checkpoints and exits cleanly,
+  without one it dies and the monitor classifies ``preemption``.
+- ``stall`` — suppress heartbeat publishing while the process stays
+  alive: the wedged-on-a-collective shape the hang watchdog exists for.
+- ``drop`` — stop the node's queue server: feeders and the monitor's kv
+  polls lose their connection while training continues.
+
+Every action fires at most once **per job**, not per attempt: before
+firing, the worker writes a sentinel file ``chaos.<node>.<index>``
+(containing ``time.time()``, which doubles as the fired-at timestamp for
+detection-latency accounting) into ``TFOS_CHAOS_DIR`` — defaulting to the
+cluster's working dir — and an existing sentinel disarms the action.
+Restarted attempts therefore run clean, which is exactly what a
+kill-then-recover scenario needs from a static env var.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+import signal
+import tempfile
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+PLAN_ENV = "TFOS_CHAOS"
+STATE_DIR_ENV = "TFOS_CHAOS_DIR"
+
+VERBS = ("kill", "term", "stall", "drop")
+
+_INT_KEYS = ("node", "at_step")
+_FLOAT_KEYS = ("after_secs", "grace", "secs")
+
+
+class ChaosPlanError(ValueError):
+    """Malformed ``TFOS_CHAOS`` plan — raised at parse time, in the worker
+    harness, so a typo'd plan fails the job loudly instead of silently
+    injecting nothing."""
+
+
+@dataclasses.dataclass
+class ChaosAction:
+    """One parsed fault: what to do, on which node, triggered by what."""
+
+    verb: str
+    node: int
+    at_step: int | None = None
+    after_secs: float | None = None
+    grace: float | None = None
+    secs: float | None = None
+    index: int = 0  # position in the plan → sentinel-file identity
+
+    def describe(self) -> str:
+        trig = (f"at_step={self.at_step}" if self.at_step is not None
+                else f"after_secs={self.after_secs}")
+        return f"{self.verb} node={self.node} {trig}"
+
+
+def parse_plan(spec: str) -> list[ChaosAction]:
+    """Parse a ``TFOS_CHAOS`` plan string into actions (see module doc)."""
+    actions: list[ChaosAction] = []
+    for idx, raw in enumerate(s for s in spec.split(";") if s.strip()):
+        parts = [p for p in re.split(r"[,\s]+", raw.strip()) if p]
+        verb = parts[0].lower()
+        if verb not in VERBS:
+            raise ChaosPlanError(
+                f"unknown chaos verb {verb!r} in {raw!r} (want one of {VERBS})")
+        kwargs: dict = {}
+        for assign in parts[1:]:
+            if "=" not in assign:
+                raise ChaosPlanError(f"expected key=value, got {assign!r} in {raw!r}")
+            key, val = assign.split("=", 1)
+            key = key.strip().lower()
+            try:
+                if key in _INT_KEYS:
+                    kwargs[key] = int(val)
+                elif key in _FLOAT_KEYS:
+                    kwargs[key] = float(val)
+                else:
+                    raise ChaosPlanError(
+                        f"unknown chaos key {key!r} in {raw!r} "
+                        f"(want one of {_INT_KEYS + _FLOAT_KEYS})")
+            except ValueError as e:
+                if isinstance(e, ChaosPlanError):
+                    raise
+                raise ChaosPlanError(f"bad value for {key!r} in {raw!r}: {val!r}")
+        if "node" not in kwargs:
+            raise ChaosPlanError(f"chaos action {raw!r} needs node=<int>")
+        if kwargs.get("at_step") is None and kwargs.get("after_secs") is None:
+            raise ChaosPlanError(
+                f"chaos action {raw!r} needs a trigger: at_step= or after_secs=")
+        actions.append(ChaosAction(verb=verb, index=idx, **kwargs))
+    return actions
+
+
+class ChaosAgent:
+    """Self-applies the subset of a plan targeting this executor.
+
+    Mounted on the worker's :class:`~tensorflowonspark_tpu.health.
+    HeartbeatReporter`: ``on_step`` runs inside ``ctx.report_step()``
+    (deterministic step triggers), ``on_tick`` on the heartbeat thread
+    (time triggers).  Firing order within one trigger follows plan order.
+    """
+
+    def __init__(self, actions: list[ChaosAction], executor_id: int,
+                 state_dir: str | None = None, node_ctx=None):
+        self.executor_id = int(executor_id)
+        self.actions = [a for a in actions if a.node == self.executor_id]
+        # an explicit $TFOS_CHAOS_DIR wins over the harness default (the
+        # cluster working dir) — the operator writing the plan knows where
+        # the driver-side latency accounting will look for sentinels
+        self.state_dir = os.environ.get(STATE_DIR_ENV) or state_dir \
+            or tempfile.gettempdir()
+        self.node_ctx = node_ctx
+        self._reporter = None
+        self._armed_at = time.monotonic()
+        self._fired: set[int] = set()
+        for a in self.actions:
+            logger.warning("chaos armed on node %d: %s", executor_id,
+                           a.describe())
+
+    def attach(self, reporter) -> None:
+        self._reporter = reporter
+
+    # -- triggers --------------------------------------------------------
+    def on_step(self, step: int) -> None:
+        for a in self.actions:
+            if a.at_step is not None and step >= a.at_step:
+                self._fire(a)
+
+    def on_tick(self) -> None:
+        elapsed = time.monotonic() - self._armed_at
+        for a in self.actions:
+            if a.after_secs is not None and elapsed >= a.after_secs:
+                self._fire(a)
+
+    # -- firing ----------------------------------------------------------
+    def _sentinel(self, action: ChaosAction) -> str:
+        return os.path.join(self.state_dir,
+                            f"chaos.{action.node}.{action.index}")
+
+    def _fire(self, action: ChaosAction) -> None:
+        if action.index in self._fired:
+            return
+        self._fired.add(action.index)
+        sentinel = self._sentinel(action)
+        if os.path.exists(sentinel):  # already fired in a previous attempt
+            return
+        try:
+            with open(sentinel, "w") as f:
+                f.write(f"{time.time():.6f}")
+        except OSError:
+            logger.warning("chaos: cannot write sentinel %s; firing anyway",
+                           sentinel)
+        logger.warning("chaos FIRING on node %d: %s", self.executor_id,
+                       action.describe())
+        getattr(self, f"_fire_{action.verb}")(action)
+
+    def _fire_kill(self, action: ChaosAction) -> None:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def _fire_term(self, action: ChaosAction) -> None:
+        if action.grace:
+            pid = os.getpid()
+            t = threading.Timer(action.grace,
+                                lambda: os.kill(pid, signal.SIGKILL))
+            t.daemon = True
+            t.start()
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    def _fire_stall(self, action: ChaosAction) -> None:
+        if self._reporter is not None:
+            self._reporter.stall(action.secs)
+
+    def _fire_drop(self, action: ChaosAction) -> None:
+        ctx = self.node_ctx
+        if ctx is not None and getattr(ctx, "mgr", None) is not None:
+            try:
+                ctx.mgr.stop()
+            except Exception:
+                logger.exception("chaos: drop failed")
+
+
+def fired_at(state_dir: str, node: int, index: int = 0) -> float | None:
+    """Read the fired-at wall time a sentinel recorded (bench/test helper);
+    None if that action has not fired."""
+    path = os.path.join(state_dir, f"chaos.{node}.{index}")
+    try:
+        with open(path) as f:
+            return float(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def from_env(executor_id: int, state_dir: str | None = None,
+             node_ctx=None) -> ChaosAgent | None:
+    """Build this worker's agent from ``$TFOS_CHAOS``; None when unset or
+    when no action targets this executor (the common, zero-cost case)."""
+    spec = os.environ.get(PLAN_ENV)
+    if not spec:
+        return None
+    agent = ChaosAgent(parse_plan(spec), executor_id, state_dir=state_dir,
+                       node_ctx=node_ctx)
+    return agent if agent.actions else None

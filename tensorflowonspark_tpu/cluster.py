@@ -41,6 +41,7 @@ import tempfile
 import threading
 import time
 
+from tensorflowonspark_tpu import health as tpu_health
 from tensorflowonspark_tpu import node as tpu_node, util
 from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition
 from tensorflowonspark_tpu.queues import DEFAULT_QUEUES, QueueClient
@@ -115,6 +116,11 @@ class LocalProcessBackend:
         return [i for i, p in enumerate(self.procs)
                 if (not p.is_alive()) and p.exitcode not in (0, None)]
 
+    def exitcodes(self) -> dict[int, int | None]:
+        """Exit codes by executor id (None while alive) — the monitor's
+        crash-vs-preemption classifier reads the signal number from here."""
+        return {i: p.exitcode for i, p in enumerate(self.procs)}
+
     def join(self, timeout: float | None = None) -> bool:
         deadline = None if timeout is None else time.monotonic() + timeout
         for p in self.procs:
@@ -133,6 +139,10 @@ class LocalProcessBackend:
 class TPUCluster:
     """Handle for a running cluster.  Reference: ``TFCluster.py::TFCluster``."""
 
+    # how long shutdown waits for active feeder threads to notice the stop
+    # before closing their QueueClients out from under them
+    FEEDER_JOIN_SECS = 30.0
+
     def __init__(self, backend, server: Server, cluster_info: list[dict],
                  cluster_meta: dict, input_mode: int, working_dir: str,
                  queues=DEFAULT_QUEUES):
@@ -148,6 +158,13 @@ class TPUCluster:
         self._shutdown_done = False
         self._stop_feed = threading.Event()  # one-shot for the cluster's life
         self._active_feeders: set = set()
+        self._monitor: "tpu_health.ClusterMonitor | None" = None
+
+    @property
+    def monitor(self):
+        """The steady-state :class:`~tensorflowonspark_tpu.health.
+        ClusterMonitor`, or None when disabled (``monitor=False``)."""
+        return self._monitor
 
     # ------------------------------------------------------------------ run
     @classmethod
@@ -158,7 +175,9 @@ class TPUCluster:
             queues=DEFAULT_QUEUES, backend=None, worker_env: dict | None = None,
             working_dir: str | None = None, queue_depth: int = 64,
             default_fs: str = "", queue_shm: bool | None = None,
-            tensorboard_logdir: str | None = None) -> "TPUCluster":
+            tensorboard_logdir: str | None = None, monitor: bool = True,
+            hang_timeout: float = 120.0, step_timeout: float | None = None,
+            heartbeat_interval: float = 1.0) -> "TPUCluster":
         """Boot the cluster and block until every node has registered.
 
         Mirrors ``TFCluster.py::run``'s signature and behavior: build the
@@ -167,6 +186,17 @@ class TPUCluster:
         role label for parity, but on TPU those nodes join SPMD training as
         embedding-shard owners rather than running a gRPC parameter server
         (SURVEY.md §2c — PS is an anti-pattern on TPU).
+
+        Once every node has registered, a steady-state
+        :class:`~tensorflowonspark_tpu.health.ClusterMonitor` takes over
+        from the bootstrap crash watcher for the cluster's whole life
+        (``monitor=False`` disables it): mid-training crashes are detected
+        from process exit within a poll interval, and a worker whose
+        heartbeat goes stale for ``hang_timeout`` seconds — or, with
+        ``step_timeout`` set, whose reported step stops advancing — is
+        treated as hung and the cluster is fail-fast aborted instead of
+        wedging on collectives until the shutdown timeout
+        (``docs/robustness.md``).
         """
         assert num_workers > 0, "need at least one worker"
         if driver_ps_nodes:
@@ -211,15 +241,23 @@ class TPUCluster:
             "reservation_timeout": reservation_timeout,
             "tensorboard": tensorboard,
             "tensorboard_logdir": tensorboard_logdir,
+            "heartbeat_interval": heartbeat_interval,
         }
 
         backend = backend or LocalProcessBackend(worker_env=worker_env)
-        backend.start(num_workers, map_fun, tf_args, cluster_meta, queues)
+        try:
+            backend.start(num_workers, map_fun, tf_args, cluster_meta, queues)
+        except Exception:
+            # a backend that cannot even launch (agents still re-provisioning
+            # after a preemption) must not leak the reservation server —
+            # run_with_recovery retries this whole bootstrap
+            server.stop()
+            raise
 
         status: dict = {}
-        monitor = threading.Thread(
+        boot_watch = threading.Thread(
             target=_watch_for_crashes, args=(backend, server, status), daemon=True)
-        monitor.start()
+        boot_watch.start()
         try:
             cluster_info = server.await_reservations(
                 timeout=reservation_timeout, status=status)
@@ -230,8 +268,13 @@ class TPUCluster:
             _raise_worker_errors(working_dir, num_workers)
             raise
         logger.info("all %d nodes registered", num_workers)
-        return cls(backend, server, cluster_info, cluster_meta, input_mode,
-                   working_dir, queues)
+        cluster = cls(backend, server, cluster_info, cluster_meta, input_mode,
+                      working_dir, queues)
+        if monitor:
+            cluster._monitor = tpu_health.ClusterMonitor(
+                cluster, hang_timeout=hang_timeout, step_timeout=step_timeout)
+            cluster._monitor.start()
+        return cluster
 
     # ---------------------------------------------------------------- feed
     def _feedable_nodes(self) -> list[dict]:
@@ -414,8 +457,15 @@ class TPUCluster:
         for t in list(self._active_feeders):
             # wait for feeders to notice the stop before we close the
             # QueueClients they are using (~2 s put attempts, see _put_chunk)
-            if t is not threading.current_thread():
-                t.join(timeout=30)
+            if t is threading.current_thread():
+                continue
+            t.join(timeout=self.FEEDER_JOIN_SECS)
+            if t.is_alive():
+                logger.warning(
+                    "feeder thread %r still running after %.0fs; its "
+                    "QueueClient will be closed out from under it (expect a "
+                    "ConnectionError in that thread)",
+                    t.name, self.FEEDER_JOIN_SECS)
         if grace_secs:
             time.sleep(grace_secs)
         if self.input_mode == InputMode.SPARK:
@@ -427,6 +477,20 @@ class TPUCluster:
                         logger.warning("could not send EndOfFeed('%s') to node %d",
                                        qn, n["executor_id"])
         finished = self.backend.join(timeout)
+        monitor_failure = None
+        if self._monitor is not None:
+            # keep the monitor alive THROUGH the join above — a crash or
+            # hang mid-drain aborts the join instead of wedging it.  A
+            # death that unblocked the join *between* monitor polls still
+            # needs classifying: poll once more, synchronously, then stop.
+            # After a join TIMEOUT, don't poll — and stop BEFORE the
+            # terminate() below: those self-inflicted SIGTERM exits must
+            # not be read back as a 'preemption' (the TimeoutError at the
+            # end of this method is the truth).
+            if finished:
+                self._monitor.poll_now()
+            self._monitor.stop()
+            monitor_failure = self._monitor.failure
         if not finished:
             logger.warning("workers still alive after %.0fs; terminating", timeout)
             self.backend.terminate()
@@ -437,17 +501,19 @@ class TPUCluster:
             c.close()
         self.server.stop()
         _raise_worker_errors(self.working_dir, self.cluster_meta["num_workers"])
+        if monitor_failure is not None:
+            # no crash file (SIGKILL / hang / remote host) but the monitor
+            # classified the failure — surface that instead of the generic
+            # nonzero-exit error below, enriched with the implicated
+            # workers' captured log tails when the backend can serve them
+            # (AgentBackend's LOGS protocol; Spark executor-log parity)
+            raise _with_log_tails(monitor_failure, self.backend)
         # No crash file (remote host, no shared FS) but workers exited
         # nonzero: surface their captured logs through the agent protocol
         # instead of failing silently (Spark executor-log parity).
         failed = self.backend.failed() if finished else []
         if failed:
-            fetch = getattr(self.backend, "fetch_logs", None)
-            logs = fetch(failed) if fetch is not None else {}
-            detail = "\n".join(
-                f"--- executor {i} log tail ---\n"
-                f"{logs.get(i, '<no log available on driver>')}"
-                for i in failed)
+            detail = _log_tail_detail(self.backend, failed) or "<no logs>"
             raise RuntimeError(
                 f"worker(s) {failed} exited with nonzero status:\n{detail}")
         if not finished:
@@ -459,6 +525,8 @@ class TPUCluster:
         forever), kill orphaned TensorBoards (SIGTERMed workers skip their
         ``finally``), release sockets and the reservation server."""
         self._stop_feed.set()
+        if self._monitor is not None:
+            self._monitor.stop()  # no-op join when called from its thread
         with contextlib.suppress(Exception):
             self.backend.terminate()
         _kill_registered_tensorboards(self.cluster_info)
@@ -479,6 +547,9 @@ def run_with_recovery(map_fun, tf_args, num_workers: int, *,
                       max_restarts: int = 2, data=None, num_epochs: int = 1,
                       input_mode: int = InputMode.TENSORFLOW,
                       shutdown_timeout: float = 259200.0,
+                      backoff_base: float = 1.0, backoff_cap: float = 30.0,
+                      restart_budget: tuple[int, float] | None = None,
+                      retry_policy=None, on_restart=None,
                       **run_kwargs) -> None:
     """Run a cluster job to completion, relaunching after worker failures.
 
@@ -493,12 +564,37 @@ def run_with_recovery(map_fun, tf_args, num_workers: int, *,
     model is also the idiomatic one for TPU slices, where a preempted slice
     always comes back as a fresh SPMD job.
 
+    Failure *detection* comes from the per-cluster
+    :class:`~tensorflowonspark_tpu.health.ClusterMonitor` (on by default via
+    ``TPUCluster.run``): crashes and stale-heartbeat hangs abort the attempt
+    within seconds and arrive here as classified
+    :class:`~tensorflowonspark_tpu.health.ClusterFailure` s.  The restart
+    decision then follows ``health.classify_restart`` — deterministic user
+    errors (e.g. a ``ValueError`` out of the map_fun's first step) are NOT
+    retried, infra failures (crash/hang/preemption/socket/timeout) always
+    are — overridable via ``retry_policy(exc, kind) -> bool``.  Relaunches
+    wait ``health.backoff_delay`` (exponential from ``backoff_base`` capped
+    at ``backoff_cap``, with jitter), and ``restart_budget=(R, T)`` bounds
+    the restart *rate* to R per sliding T seconds on top of the per-job
+    ``max_restarts``.  ``on_restart(attempt, exc, kind)`` runs before each
+    relaunch (metrics, cache-warming, paging).
+
     ``data``/``num_epochs`` replay the InputMode.SPARK feed on every
     attempt (idempotence is the map_fun's contract, as it was with Spark
     task retries); TENSORFLOW mode needs neither.
 
-    Raises the final failure once ``max_restarts`` relaunches are exhausted.
+    Raises the final failure once retries are exhausted or a failure
+    classifies as no-retry.
     """
+    budget = None
+    if restart_budget is not None:
+        budget = tpu_health.RestartBudget(*restart_budget)
+    # one working dir for ALL attempts: chaos once-per-job sentinels, the
+    # health event log, and post-mortem crash files must survive relaunches
+    # (TPUCluster.run would otherwise mkdtemp a fresh dir per attempt; it
+    # already clears stale error files when reusing a dir)
+    if run_kwargs.get("working_dir") is None:
+        run_kwargs["working_dir"] = tempfile.mkdtemp(prefix="tfos_tpu_job_")
     attempt = 0
     while True:
         cluster = None
@@ -514,18 +610,64 @@ def run_with_recovery(map_fun, tf_args, num_workers: int, *,
         except Exception as e:
             if cluster is not None:
                 cluster._abort()
+            kind = tpu_health.classify_failure(e)
+            retry = (retry_policy(e, kind) if retry_policy is not None
+                     else tpu_health.classify_restart(kind))
+            if not retry:
+                logger.error(
+                    "cluster failed with a no-retry %s error (%s); a restart "
+                    "would fail identically — raising", kind, type(e).__name__)
+                raise
             attempt += 1
             if attempt > max_restarts:
                 logger.error("giving up after %d restart(s)", max_restarts)
                 raise
+            if budget is not None and not budget.allow():
+                logger.error(
+                    "restart budget exhausted (%d restarts within %.0fs); "
+                    "raising", restart_budget[0], restart_budget[1])
+                raise
+            delay = tpu_health.backoff_delay(attempt, backoff_base, backoff_cap)
             logger.warning(
-                "cluster attempt %d/%d failed (%s: %s); relaunching — "
-                "map_fun resumes from its latest checkpoint",
-                attempt, max_restarts, type(e).__name__,
-                str(e).splitlines()[0] if str(e) else "")
+                "cluster attempt %d/%d failed [%s] (%s: %s); relaunching in "
+                "%.1fs — map_fun resumes from its latest checkpoint",
+                attempt, max_restarts, kind, type(e).__name__,
+                str(e).splitlines()[0] if str(e) else "", delay)
+            if on_restart is not None:
+                on_restart(attempt, e, kind)
+            time.sleep(delay)
 
 
 # -- helpers ---------------------------------------------------------------
+
+def _log_tail_detail(backend, failed: list) -> str:
+    """The implicated workers' captured log tails, formatted for an error
+    message (''/empty when the backend cannot serve logs)."""
+    fetch = getattr(backend, "fetch_logs", None)
+    if not failed or fetch is None:
+        return ""
+    try:
+        logs = fetch(failed)
+    except Exception:
+        return ""
+    if not logs:
+        return ""
+    return "\n".join(
+        f"--- executor {i} log tail ---\n"
+        f"{logs.get(i, '<no log available on driver>')}" for i in failed)
+
+
+def _with_log_tails(failure: "tpu_health.ClusterFailure", backend):
+    """Append the implicated workers' captured log tails to a classified
+    failure, keeping its kind/workers/detected_at intact."""
+    detail = _log_tail_detail(backend, list(failure.failed_workers))
+    if not detail:
+        return failure
+    enriched = tpu_health.ClusterFailure(
+        failure.kind, f"{failure}\n{detail}", failure.failed_workers)
+    enriched.detected_at = failure.detected_at
+    return enriched
+
 
 def _kill_registered_tensorboards(cluster_info) -> None:
     """Kill TensorBoards via the reservation's ``tb_pid`` (reference parity:
@@ -659,14 +801,26 @@ def _watch_for_crashes(backend, server: Server, status: dict) -> None:
 
 
 def _raise_worker_errors(working_dir: str, num_workers: int) -> None:
-    """Re-raise the first worker traceback found in crash files.
+    """Re-raise worker tracebacks found in crash files — ALL of them.
 
     Reference: ``TFCluster.py::shutdown`` re-raising errors drained from the
-    per-node ``'error'`` queues.
+    per-node ``'error'`` queues.  Every crashed worker's traceback is
+    aggregated into the one ``RuntimeError``, so a multi-worker failure
+    (e.g. a bad batch shape crashing all SPMD peers at once) is diagnosed
+    in one read instead of one restart at a time.
     """
+    found: list[tuple[int, str]] = []
     for i in range(num_workers):
         crash = os.path.join(working_dir, f"error.{i}")
         if os.path.exists(crash):
             with open(crash) as f:
-                tb = f.read()
-            raise RuntimeError(f"worker {i} failed:\n{tb}")
+                found.append((i, f.read()))
+    if not found:
+        return
+    if len(found) == 1:
+        i, tb = found[0]
+        raise RuntimeError(f"worker {i} failed:\n{tb}")
+    detail = "\n".join(f"--- worker {i} failed ---\n{tb}" for i, tb in found)
+    raise RuntimeError(
+        f"{len(found)} workers failed "
+        f"({', '.join(str(i) for i, _ in found)}):\n{detail}")

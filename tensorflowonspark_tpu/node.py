@@ -30,8 +30,10 @@ import os
 import tempfile
 import traceback
 
-from tensorflowonspark_tpu import util
+from tensorflowonspark_tpu import chaos as chaos_mod
+from tensorflowonspark_tpu import preemption, util
 from tensorflowonspark_tpu.datafeed import DataFeed
+from tensorflowonspark_tpu.health import HeartbeatReporter
 from tensorflowonspark_tpu.queues import DEFAULT_QUEUES, QueueServer
 from tensorflowonspark_tpu.reservation import Client, get_ip_address
 
@@ -61,6 +63,7 @@ class NodeContext:
         self.num_workers = len(cluster_info)
         self.tensorboard_logdir = tensorboard_logdir or os.path.join(
             self.working_dir, "tensorboard")
+        self._heartbeat = None  # HeartbeatReporter, attached by node.run
 
     # -- cluster spec ------------------------------------------------------
     @property
@@ -152,6 +155,19 @@ class NodeContext:
     def export_dir(self, subdir: str = "export") -> str:
         return self.absolute_path(subdir)
 
+    def report_step(self, step: int, phase: str = "step") -> None:
+        """Report training progress to the driver's health monitor.
+
+        Publishes ``step`` into this node's heartbeat payload immediately
+        (``health.HeartbeatReporter.report_step``), arming the driver-side
+        hang watchdog (it stays unarmed until a node reports step ≥ 1, so a
+        long first compile is never mistaken for a wedge) and giving chaos
+        injection its deterministic ``at_step`` trigger.  Safe to call from
+        any map_fun's step loop; a no-op when no reporter is attached
+        (e.g. a NodeContext built outside the node harness)."""
+        if self._heartbeat is not None:
+            self._heartbeat.report_step(step, phase)
+
 
 def start_cluster_server(ctx: NodeContext, num_devices: int = 1, rdma: bool = False):
     """API-parity shim for the reference's TF1-era
@@ -183,6 +199,8 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
         mgr = None
         client = None
         tb_proc = None
+        reporter = None
+        on_preempt = None
         try:
             job_name, task_index = _role_for(cluster_meta["cluster_template"], executor_id)
             host = get_ip_address()
@@ -198,6 +216,14 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
                               maxsize=cluster_meta.get("queue_depth", 64),
                               shm=cluster_meta.get("queue_shm"))
             addr = mgr.start()
+
+            # 1b. liveness: publish heartbeat/step/phase into this node's kv
+            #     from the moment the queue server exists, so the driver's
+            #     ClusterMonitor can tell 'compiling' from 'wedged' for the
+            #     whole bootstrap, not just steady state (health.py).
+            reporter = HeartbeatReporter(
+                mgr, interval=float(cluster_meta.get("heartbeat_interval", 1.0)))
+            reporter.start()
 
             # 2. ports: one for the (unused-on-TPU) server slot, one that
             #    process 0 will use as the jax.distributed coordinator.
@@ -243,6 +269,7 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
                 "tb_port": tb_port,
             })
             cluster_info = client.await_reservations()
+            reporter.set_phase("init")
 
             # 4. context + user function
             ctx = NodeContext(executor_id, job_name, task_index, cluster_info,
@@ -250,6 +277,20 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
                               working_dir=cluster_meta.get("working_dir"),
                               mgr=mgr,
                               tensorboard_logdir=cluster_meta.get("tensorboard_logdir"))
+            ctx._heartbeat = reporter
+            # a latched SIGTERM surfaces as phase 'preempted' so the driver
+            # classifies this exit as a preemption, not a crash.
+            # note_preempted, not set_phase: the callback runs inside the
+            # signal handler and must not touch the kv lock (health.py)
+            on_preempt = reporter.note_preempted
+            preemption.on_preempted(on_preempt)
+            # chaos self-injection (TFOS_CHAOS): deterministic kill/stall/
+            # drop faults ride the heartbeat/report_step hooks (chaos.py)
+            chaos_agent = chaos_mod.from_env(
+                executor_id, state_dir=cluster_meta.get("working_dir"),
+                node_ctx=ctx)
+            if chaos_agent is not None:
+                reporter.attach_chaos(chaos_agent)
             env = ctx.distributed_env()
             os.environ["TFOS_COORDINATOR"] = env["coordinator_address"]
             os.environ["TFOS_NUM_PROCESSES"] = str(env["num_processes"])
@@ -278,8 +319,10 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
                 os.environ.get("TFOS_CACHE_MIN_COMPILE_SECS", "1.0"))
 
             logger.info("node %d starting map_fun as %s:%d", executor_id, job_name, task_index)
+            reporter.set_phase("run")
             fn(tf_args, ctx)
             mgr.kv_set("state", "finished")
+            reporter.set_phase("finished")
             logger.info("node %d map_fun finished", executor_id)
         except Exception:
             tb = traceback.format_exc()
@@ -296,8 +339,14 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
                     mgr.kv_set("state", "failed")
                 except Exception:
                     pass
+            if reporter is not None:
+                reporter.set_phase("failed")
             raise
         finally:
+            if on_preempt is not None:
+                preemption.remove_on_preempted(on_preempt)
+            if reporter is not None:
+                reporter.stop()
             if tb_proc is not None:
                 from tensorflowonspark_tpu import observability
 

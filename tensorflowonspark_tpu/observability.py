@@ -27,6 +27,7 @@ import logging
 import os
 import subprocess
 import sys
+import threading
 import time
 from collections import defaultdict
 
@@ -131,6 +132,51 @@ def annotate(name: str):
     import jax
 
     return jax.profiler.TraceAnnotation(name)
+
+
+# ------------------------------------------------------------ health events
+
+class EventLog:
+    """Append-only JSONL stream of cluster lifecycle/health events.
+
+    The reference surfaced executor failures through the Spark UI/event
+    log; this is the rebuild's equivalent record.  One JSON object per
+    line, each stamped with the writer's ``time.time()`` — the
+    :class:`~tensorflowonspark_tpu.health.ClusterMonitor` writes
+    ``monitor_started`` / ``crash`` / ``hang`` / ``preemption`` / ``abort``
+    events here (default path: ``<working_dir>/health_events.jsonl``), and
+    ``scripts/bench_recovery.py`` reads the timestamps back for
+    detection-latency accounting.  Line-buffered append, so a post-mortem
+    sees every event the driver managed to classify before dying.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+        self._lock = threading.Lock()
+
+    def emit(self, kind: str, **fields) -> dict:
+        rec = {"t": time.time(), "kind": kind, **fields}
+        with self._lock:
+            self._f.write(json.dumps(rec) + "\n")
+        logger.info("health event: %s %s", kind, fields or "")
+        return rec
+
+    def close(self) -> None:
+        with contextlib.suppress(OSError, ValueError):
+            self._f.close()
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        """Parse an event file back into records (bench/test helper)."""
+        out: list[dict] = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out
 
 
 # ----------------------------------------------------------------- goodput

@@ -17,6 +17,7 @@ and return cleanly.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import signal
 import threading
@@ -28,6 +29,16 @@ logger = logging.getLogger(__name__)
 # must still see it (the OS will follow up with SIGKILL).
 _PREEMPTED = threading.Event()
 
+# Observers notified (once, from the signal handler's thread) when the latch
+# first sets.  The node harness registers the heartbeat reporter here so the
+# driver's ClusterMonitor sees phase 'preempted' and classifies a
+# SIGTERM-shaped exit as a preemption rather than a crash (health.py).
+# Deliberately lockless: the notifier runs inside the signal handler, which
+# executes on the main thread and can interrupt that same thread mid-
+# register — holding any lock here would self-deadlock.  CPython list
+# append/snapshot are atomic under the GIL, which is all that's needed.
+_CALLBACKS: list = []
+
 
 def is_preempted() -> bool:
     """True once any PreemptionGuard in this process has seen its signal."""
@@ -37,6 +48,50 @@ def is_preempted() -> bool:
 def reset() -> None:
     """Clear the process-wide latch (tests / deliberate in-process restart)."""
     _PREEMPTED.clear()
+
+
+class _Once:
+    """Fire-at-most-once wrapper, closing the register-time race where the
+    signal lands between a callback's append and its latched-already check
+    (both paths would otherwise run it)."""
+
+    __slots__ = ("cb", "fired")
+
+    def __init__(self, cb):
+        self.cb = cb
+        self.fired = False
+
+    def run(self) -> None:
+        if self.fired:
+            return
+        self.fired = True
+        try:
+            self.cb()
+        except Exception:  # observer bugs must not break signal handling
+            logger.exception("preemption callback failed")
+
+
+def on_preempted(callback) -> None:
+    """Register ``callback()`` to run (at most once) when this process's
+    latch sets; runs immediately if it already has.  Callbacks must be
+    quick, must not raise, and must not acquire non-reentrant locks the
+    interrupted code could hold (they execute inside the signal handler)."""
+    entry = _Once(callback)
+    _CALLBACKS.append(entry)
+    if _PREEMPTED.is_set():
+        entry.run()
+
+
+def remove_on_preempted(callback) -> None:
+    for entry in list(_CALLBACKS):
+        if entry.cb is callback:
+            with contextlib.suppress(ValueError):
+                _CALLBACKS.remove(entry)
+
+
+def _notify() -> None:
+    for entry in list(_CALLBACKS):
+        entry.run()
 
 
 class PreemptionGuard:
@@ -85,7 +140,10 @@ class PreemptionGuard:
         logger.warning("PreemptionGuard: received signal %d; requesting "
                        "graceful stop", signum)
         self._event.set()
+        first = not _PREEMPTED.is_set()
         _PREEMPTED.set()
+        if first:
+            _notify()
 
     @property
     def preempted(self) -> bool:

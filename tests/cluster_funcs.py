@@ -56,6 +56,69 @@ def fn_crash(args, ctx):
     raise ValueError("deliberate failure for error-propagation test")
 
 
+def fn_crash_infra(args, ctx):
+    """Crash with an infra-shaped error (retried by run_with_recovery's
+    classifier, unlike fn_crash's deterministic ValueError)."""
+    raise ConnectionError("injected infra failure")
+
+
+def fn_report_steps(args, ctx):
+    """Step loop that reports progress to the health monitor — the chaos
+    tests' 'training': deterministic steps the TFOS_CHAOS plan can target."""
+    import time
+
+    total = int(args.get("total_steps", 100))
+    for s in range(1, total + 1):
+        ctx.report_step(s)
+        time.sleep(float(args.get("step_secs", 0.1)))
+    with open(os.path.join(ctx.working_dir, f"steps.{ctx.executor_id}"), "w") as f:
+        f.write(str(total))
+
+
+def fn_report_then_sleep(args, ctx):
+    """Report a couple of steps (arming the hang watchdog / giving a
+    chaos ``stall`` its trigger), then block — the wedged-worker shape."""
+    import time
+
+    ctx.report_step(1)
+    ctx.report_step(2)
+    time.sleep(float(args.get("sleep_secs", 120)))
+
+
+def fn_train_ckpt_report(args, ctx):
+    """Deterministic 'training' with per-step orbax checkpoints and
+    ``ctx.report_step`` progress — the kill/restore chaos workload.  Unlike
+    ``fn_train_checkpoint_crash_once`` it injects nothing itself: the
+    TFOS_CHAOS plan supplies the fault.  Appends ``<wall_time> <start>``
+    per attempt to ``resume.<id>`` so tests/bench assert resume points and
+    restart-to-first-step latency."""
+    import time
+
+    import numpy as np
+
+    from tensorflowonspark_tpu.checkpoint import CheckpointManager
+
+    total = int(args["total_steps"])
+    ckpt = CheckpointManager(args["model_dir"])
+    start, w = 0, np.zeros(())
+    if ckpt.latest_step() is not None:
+        state = ckpt.restore()
+        start, w = int(state["step"]), np.asarray(state["w"])
+    with open(os.path.join(ctx.working_dir, f"resume.{ctx.executor_id}"), "a") as f:
+        f.write(f"{time.time():.6f} {start}\n")
+
+    for s in range(start, total):
+        w = w + 1.0
+        step = s + 1
+        if ctx.is_chief:
+            ckpt.save(step, {"step": np.asarray(step), "w": w}, force=True)
+            ckpt.wait()  # durable BEFORE report_step can fire a chaos kill
+        ctx.report_step(step)
+        time.sleep(float(args.get("step_secs", 0.05)))
+    if ctx.is_chief:
+        ckpt.close()
+
+
 def fn_crash_before_register(args, ctx):  # pragma: no cover - not called
     raise RuntimeError("unused")
 

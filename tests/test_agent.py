@@ -178,3 +178,21 @@ def test_failed_worker_logs_reach_driver_via_agent(tmp_path):
         backend.close()
     finally:
         agent.stop()
+
+
+def test_agent_conn_reconnects_after_transient_reset():
+    """One transient socket failure must not poison the cached connection:
+    request() reconnects + retries once before propagating (satellite:
+    _AgentConn reconnect)."""
+    key = b"\x03" * 16
+    agent = HostAgent(port=0, authkey=key)
+    addr = agent.start()
+    try:
+        conn = _AgentConn(addr, authkey=key, timeout=10)
+        assert conn.request({"type": "PING"})["ok"]
+        conn._sock.close()  # simulate a reset/timeout poisoning the socket
+        pong = conn.request({"type": "PING"})  # must transparently reconnect
+        assert pong["ok"] and pong["workers"] == []
+        conn.close()
+    finally:
+        agent.stop()

@@ -211,3 +211,143 @@ def test_worker_compile_cache_env_contract(tmp_path, monkeypatch):
     for i in range(2):
         with open(os.path.join(str(tmp_path), f"cacheenv.{i}")) as f:
             assert f.read() == "/tmp/tfos_ct_cache:0.7"
+
+
+def test_raise_worker_errors_aggregates_all_crashes(tmp_path):
+    """A multi-worker failure must surface EVERY worker's traceback in one
+    error, not one per restart (satellite: _raise_worker_errors)."""
+    from tensorflowonspark_tpu.cluster import _raise_worker_errors
+
+    (tmp_path / "error.0").write_text("Traceback...\nValueError: boom zero\n")
+    (tmp_path / "error.2").write_text("Traceback...\nTypeError: boom two\n")
+    with pytest.raises(RuntimeError) as ei:
+        _raise_worker_errors(str(tmp_path), 3)
+    msg = str(ei.value)
+    assert "worker 0" in msg and "worker 2" in msg
+    assert "boom zero" in msg and "boom two" in msg
+
+    # single-crash format unchanged (the common case, matched by callers)
+    (tmp_path / "error.2").unlink()
+    with pytest.raises(RuntimeError, match="worker 0 failed"):
+        _raise_worker_errors(str(tmp_path), 3)
+
+
+class FlakyBackend:
+    """LocalProcessBackend whose first start() raises — the relaunch-during-
+    re-provisioning shape (an agent fleet not yet back after preemption)."""
+
+    def __init__(self, fail_times=1, worker_env=None):
+        from tensorflowonspark_tpu.cluster import LocalProcessBackend
+
+        self._inner = LocalProcessBackend(worker_env=worker_env)
+        self.fail_times = fail_times
+        self.start_calls = 0
+
+    def start(self, *a, **kw):
+        self.start_calls += 1
+        if self.start_calls <= self.fail_times:
+            raise ConnectionError("agents still re-provisioning")
+        self._inner.start(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_run_with_recovery_retries_bootstrap_failure(tmp_path):
+    """When TPUCluster.run ITSELF raises (backend cannot launch), the
+    recovery loop must classify it infra and relaunch — previously only
+    in-training failures were exercised."""
+    from tensorflowonspark_tpu.cluster import run_with_recovery
+
+    backend = FlakyBackend(fail_times=1, worker_env={"JAX_PLATFORMS": "cpu"})
+    run_with_recovery(
+        funcs.fn_noop, {}, num_workers=1, max_restarts=2, backoff_base=0.1,
+        backend=backend, working_dir=str(tmp_path),
+        reservation_timeout=60, shutdown_timeout=60)
+    assert backend.start_calls == 2  # failed once, relaunched, completed
+
+
+def test_run_with_recovery_user_error_not_retried(tmp_path):
+    """A deterministic map_fun ValueError classifies 'user': no relaunch,
+    no burned restart budget — the error surfaces on the first attempt."""
+    from tensorflowonspark_tpu.cluster import run_with_recovery
+
+    restarts = []
+    with pytest.raises(RuntimeError, match="deliberate failure"):
+        run_with_recovery(
+            funcs.fn_crash, {}, num_workers=1, max_restarts=3,
+            on_restart=lambda *a: restarts.append(a),
+            working_dir=str(tmp_path), worker_env={"JAX_PLATFORMS": "cpu"},
+            reservation_timeout=60, shutdown_timeout=60)
+    assert restarts == [], "user error must not be retried"
+
+
+def test_run_with_recovery_restart_budget_window(tmp_path):
+    """restart_budget=(R, T) bounds the restart RATE below max_restarts:
+    an infra crash loop stops after R windowed restarts."""
+    from tensorflowonspark_tpu.cluster import run_with_recovery
+
+    kinds = []
+    with pytest.raises(RuntimeError, match="injected infra failure"):
+        run_with_recovery(
+            funcs.fn_crash_infra, {}, num_workers=1, max_restarts=5,
+            restart_budget=(1, 3600.0), backoff_base=0.1,
+            on_restart=lambda attempt, exc, kind: kinds.append(kind),
+            working_dir=str(tmp_path), worker_env={"JAX_PLATFORMS": "cpu"},
+            reservation_timeout=60, shutdown_timeout=60)
+    assert kinds == ["infra"], kinds  # one restart allowed, then budget cut
+
+
+def test_shutdown_warns_on_stuck_feeder(tmp_path, caplog, monkeypatch):
+    """A feeder thread that outlives the join window must be named in a
+    warning before its QueueClient is closed out from under it."""
+    import logging as _logging
+    import threading
+
+    class StubBackend:
+        def join(self, timeout=None):
+            return True
+
+        def failed(self):
+            return []
+
+        def terminate(self):
+            pass
+
+    class StubServer:
+        def stop(self):
+            pass
+
+    monkeypatch.setattr(TPUCluster, "FEEDER_JOIN_SECS", 0.2)
+    cluster = TPUCluster(StubBackend(), StubServer(), [], {"num_workers": 0},
+                         InputMode.TENSORFLOW, working_dir=str(tmp_path))
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, name="stuck-feeder", daemon=True)
+    t.start()
+    cluster._active_feeders.add(t)
+    try:
+        with caplog.at_level(_logging.WARNING,
+                             logger="tensorflowonspark_tpu.cluster"):
+            cluster.shutdown(timeout=5)
+        assert any("stuck-feeder" in r.getMessage() for r in caplog.records)
+    finally:
+        release.set()
+
+
+def test_monitor_disabled_and_enabled(tmp_path):
+    """monitor=False must actually disable the watchdog (regression: the
+    run() parameter was once shadowed by a local), and the default must
+    expose a running monitor on the handle."""
+    cluster = _run(funcs.fn_noop, 1, tmp_path / "off", monitor=False)
+    try:
+        assert cluster.monitor is None
+    finally:
+        cluster.shutdown(timeout=60)
+    (tmp_path / "on").mkdir()
+    cluster = _run(funcs.fn_noop, 1, tmp_path / "on")
+    try:
+        assert cluster.monitor is not None
+        assert cluster.monitor.failure is None
+    finally:
+        cluster.shutdown(timeout=60)
+    assert (tmp_path / "on" / "health_events.jsonl").exists()

@@ -1,0 +1,270 @@
+"""Health subsystem unit tests: heartbeat publishing, monitor
+classification (crash/hang/preemption), watchdog arming, and the restart
+policy helpers behind ``run_with_recovery``.
+
+Process-level detection with real worker processes lives in
+``tests/test_chaos.py``; here the monitor runs against in-process fakes so
+each classification branch is exercised deterministically and fast.
+"""
+
+import signal
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import health
+from tensorflowonspark_tpu.health import (ClusterFailure, ClusterMonitor,
+                                          HeartbeatReporter, RestartBudget,
+                                          backoff_delay, classify_failure,
+                                          classify_restart)
+from tensorflowonspark_tpu.queues import QueueServer
+
+
+# --------------------------------------------------------------- reporter
+
+@pytest.fixture()
+def kv_server():
+    srv = QueueServer(authkey=b"k" * 16, qnames=("input",), mode="local",
+                      shm=False)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_heartbeat_reporter_publishes_and_reports_steps(kv_server):
+    rep = HeartbeatReporter(kv_server, interval=0.05)
+    rep.start()
+    try:
+        time.sleep(0.2)
+        hb = kv_server.kv_get(health.HEARTBEAT_KEY)
+        assert hb["seq"] >= 1 and hb["step"] is None and hb["phase"] == "boot"
+
+        rep.report_step(7, phase="step")  # publishes immediately, no beat wait
+        hb2 = kv_server.kv_get(health.HEARTBEAT_KEY)
+        assert hb2["step"] == 7 and hb2["seq"] > hb["seq"]
+
+        rep.set_phase("preempted")
+        assert kv_server.kv_get(health.HEARTBEAT_KEY)["phase"] == "preempted"
+    finally:
+        rep.stop()
+
+
+def test_heartbeat_reporter_stall_freezes_payload(kv_server):
+    rep = HeartbeatReporter(kv_server, interval=0.05)
+    rep.start()
+    try:
+        rep.report_step(1)
+        rep.stall()  # forever
+        frozen = kv_server.kv_get(health.HEARTBEAT_KEY)
+        time.sleep(0.25)
+        rep.report_step(2)  # suppressed too: a wedge reports nothing
+        assert kv_server.kv_get(health.HEARTBEAT_KEY)["seq"] == frozen["seq"]
+    finally:
+        rep.stop()
+
+
+# ---------------------------------------------------------------- monitor
+
+class FakeBackend:
+    def __init__(self, n):
+        self._alive = [True] * n
+        self._codes: dict[int, int | None] = {i: None for i in range(n)}
+
+    def die(self, i, code):
+        self._alive[i] = False
+        self._codes[i] = code
+
+    def alive(self):
+        return list(self._alive)
+
+    def failed(self):
+        return [i for i, a in enumerate(self._alive)
+                if not a and self._codes[i] not in (0, None)]
+
+    def exitcodes(self):
+        return dict(self._codes)
+
+    def terminate(self):
+        self._alive = [False] * len(self._alive)
+
+
+class FakeCluster:
+    def __init__(self, n):
+        self.backend = FakeBackend(n)
+        self.cluster_info = [{"executor_id": i, "addr": ("127.0.0.1", 1),
+                              "authkey": b"x"} for i in range(n)]
+        self.working_dir = None  # no event log file in unit tests
+        self.aborted = False
+
+    def _abort(self):
+        self.aborted = True
+        self.backend.terminate()
+
+
+class FakeKV:
+    """Stands in for the monitor's per-node QueueClient."""
+
+    def __init__(self, payloads):
+        self.payloads = payloads  # executor_id -> mutable payload dict|None
+
+    def client(self, info):
+        eid = info["executor_id"]
+        outer = self
+
+        class _C:
+            def kv_get(self, key):
+                p = outer.payloads.get(eid)
+                if isinstance(p, Exception):
+                    raise p
+                return p
+
+            def close(self):
+                pass
+
+        return _C()
+
+
+def _monitor(cluster, payloads, **kw):
+    kw.setdefault("poll_interval", 0.02)
+    return ClusterMonitor(cluster, client_factory=FakeKV(payloads).client, **kw)
+
+
+def test_monitor_classifies_crash_and_aborts():
+    cluster = FakeCluster(2)
+    mon = _monitor(cluster, {}, hang_timeout=60)
+    mon.start()
+    try:
+        cluster.backend.die(1, code=1)
+        failure = mon.wait(timeout=5)
+        assert failure is not None and failure.kind == health.CRASH
+        assert failure.failed_workers == (1,)
+        assert cluster.aborted
+    finally:
+        mon.stop()
+
+
+def test_monitor_classifies_sigterm_exit_as_preemption():
+    cluster = FakeCluster(1)
+    mon = _monitor(cluster, {}, hang_timeout=60)
+    mon.start()
+    try:
+        cluster.backend.die(0, code=-int(signal.SIGTERM))
+        failure = mon.wait(timeout=5)
+        assert failure is not None and failure.kind == health.PREEMPTION
+    finally:
+        mon.stop()
+
+
+def test_monitor_hang_requires_arming():
+    """A frozen payload with NO reported step (a long compile) must never
+    trip the watchdog; the same staleness after step >= 1 must."""
+    payloads = {0: {"seq": 1, "step": None, "phase": "init"}}
+    cluster = FakeCluster(1)
+    mon = _monitor(cluster, payloads, hang_timeout=0.2)
+    mon.start()
+    try:
+        time.sleep(0.7)  # stale for > 3x hang_timeout, but unarmed
+        assert mon.failure is None and not cluster.aborted
+
+        payloads[0] = {"seq": 2, "step": 3, "phase": "step"}  # arm...
+        time.sleep(0.1)          # ...let the monitor see the change
+        # payload now frozen (seq never advances) -> hang
+        failure = mon.wait(timeout=5)
+        assert failure is not None and failure.kind == health.HANG
+        assert "heartbeat stale" in str(failure)
+        assert cluster.aborted
+    finally:
+        mon.stop()
+
+
+def test_monitor_step_timeout_detects_stuck_step():
+    """Heartbeats keep flowing (background thread alive) but the reported
+    step stops advancing — the SPMD-collective wedge; only step_timeout
+    catches this shape."""
+    payloads = {0: {"seq": 1, "step": 2, "phase": "step"}}
+    cluster = FakeCluster(1)
+    mon = _monitor(cluster, payloads, hang_timeout=60, step_timeout=0.3)
+
+    def beat():  # advance seq, never step
+        while not mon._stop.is_set():
+            payloads[0] = dict(payloads[0], seq=payloads[0]["seq"] + 1)
+            time.sleep(0.02)
+
+    t = threading.Thread(target=beat, daemon=True)
+    mon.start()
+    t.start()
+    try:
+        failure = mon.wait(timeout=5)
+        assert failure is not None and failure.kind == health.HANG
+        assert "stuck at step" in str(failure)
+    finally:
+        mon.stop()
+
+
+def test_monitor_unreachable_kv_counts_as_stale():
+    payloads = {0: {"seq": 1, "step": 1, "phase": "step"}}
+    cluster = FakeCluster(1)
+    mon = _monitor(cluster, payloads, hang_timeout=0.3)
+    mon.start()
+    try:
+        time.sleep(0.1)
+        payloads[0] = ConnectionError("kv down")  # node stops answering
+        failure = mon.wait(timeout=5)
+        assert failure is not None and failure.kind == health.HANG
+    finally:
+        mon.stop()
+
+
+def test_monitor_ignores_clean_exit():
+    cluster = FakeCluster(1)
+    mon = _monitor(cluster, {}, hang_timeout=0.2)
+    mon.start()
+    try:
+        cluster.backend.die(0, code=0)  # finished, not failed
+        time.sleep(0.5)
+        assert mon.failure is None and not cluster.aborted
+    finally:
+        mon.stop()
+
+
+# ------------------------------------------------------- restart policy
+
+def test_classify_failure_user_vs_infra():
+    user_tb = ("worker 0 failed:\nTraceback (most recent call last):\n"
+               '  File "m.py", line 1, in fn\n'
+               "ValueError: deliberate failure")
+    infra_tb = ("worker 0 failed:\nTraceback (most recent call last):\n"
+                "ConnectionError: injected infra failure")
+    mixed_tb = ("2 workers failed (0, 1):\n--- worker 0 failed ---\n"
+                "ValueError: bad\n--- worker 1 failed ---\n"
+                "ConnectionResetError: peer gone")
+    assert classify_failure(RuntimeError(user_tb)) == health.USER
+    assert classify_failure(RuntimeError(infra_tb)) == health.INFRA
+    # any infra participant makes the aggregate retryable
+    assert classify_failure(RuntimeError(mixed_tb)) == health.INFRA
+    assert classify_failure(TimeoutError("reservation timed out")) == health.INFRA
+    assert classify_failure(ValueError("driver-side bad arg")) == health.USER
+    for kind in (health.CRASH, health.HANG, health.PREEMPTION):
+        assert classify_failure(ClusterFailure(kind, "x")) == kind
+
+
+def test_classify_restart_policy():
+    assert not classify_restart(health.USER)
+    for kind in (health.CRASH, health.HANG, health.PREEMPTION, health.INFRA):
+        assert classify_restart(kind)
+
+
+def test_backoff_delay_exponential_with_jitter():
+    for attempt, ceiling in [(1, 1.0), (2, 2.0), (3, 4.0), (10, 30.0)]:
+        for _ in range(20):
+            d = backoff_delay(attempt, base=1.0, cap=30.0)
+            assert 0.5 * ceiling <= d <= ceiling
+
+
+def test_restart_budget_sliding_window():
+    b = RestartBudget(2, window_secs=10.0)
+    assert b.allow(now=0.0)
+    assert b.allow(now=1.0)
+    assert not b.allow(now=2.0)      # 3 restarts inside 10s
+    assert b.allow(now=20.0)         # old restarts aged out of the window

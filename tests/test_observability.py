@@ -111,3 +111,23 @@ def test_cluster_tensorboard_url(tmp_path):
         assert sum(1 for p in ports if p) == 1  # exactly one chief spawn
     finally:
         cluster.shutdown(timeout=120)
+
+
+def test_event_log_jsonl_roundtrip(tmp_path):
+    """EventLog appends one timestamped JSON object per event (creating
+    parent dirs) and reads them back — the health monitor's audit trail."""
+    path = str(tmp_path / "events" / "health_events.jsonl")
+    log = observability.EventLog(path)
+    t0 = time.time()
+    log.emit("monitor_started", workers=2)
+    log.emit("crash", workers=[1], message="worker 1 exit=-9")
+    log.close()
+
+    log2 = observability.EventLog(path)  # append mode: reopen must not clobber
+    log2.emit("abort", reason="crash")
+    log2.close()
+
+    recs = observability.EventLog.read(path)
+    assert [r["kind"] for r in recs] == ["monitor_started", "crash", "abort"]
+    assert recs[1]["workers"] == [1]
+    assert all(r["t"] >= t0 - 1 for r in recs)

@@ -16,8 +16,10 @@ from tensorflowonspark_tpu.preemption import PreemptionGuard
 @pytest.fixture(autouse=True)
 def _clear_latch():
     preemption.reset()
+    preemption._CALLBACKS.clear()
     yield
     preemption.reset()
+    preemption._CALLBACKS.clear()
 
 
 def test_guard_latches_sigterm_and_restores_handler():
@@ -30,6 +32,49 @@ def test_guard_latches_sigterm_and_restores_handler():
     assert signal.getsignal(signal.SIGTERM) is prev
     # the process-wide latch survives the guard's exit
     assert preemption.is_preempted()
+
+
+def test_guard_off_main_thread_degrades_inert():
+    """Constructed off the main thread (e.g. inside a feeder thread) the
+    guard must degrade to an inert flag: no handler swap, no raise, and no
+    handler restoration on exit that could clobber the main thread's."""
+    import threading
+
+    prev = signal.getsignal(signal.SIGTERM)
+    result = {}
+
+    def run():
+        with PreemptionGuard() as guard:
+            result["guard"] = guard
+            result["handler_inside"] = signal.getsignal(signal.SIGTERM)
+        result["handler_after"] = signal.getsignal(signal.SIGTERM)
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(10)
+    assert not t.is_alive()
+    assert result["handler_inside"] is prev, "no handler must be installed"
+    assert result["handler_after"] is prev
+    assert not result["guard"].preempted  # inert flag, never set
+    # ...but the inert guard still SEES a latch set elsewhere in-process
+    preemption._PREEMPTED.set()
+    assert result["guard"].preempted
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_on_preempted_callbacks_fire_once_and_late_registration():
+    calls = []
+    preemption.on_preempted(lambda: calls.append("early"))
+    with PreemptionGuard() as guard:
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.wait(5)
+        os.kill(os.getpid(), signal.SIGTERM)  # second signal: no re-notify
+        assert guard.wait(5)
+    assert calls == ["early"]
+    # registering after the latch fires immediately (node.run may attach
+    # the heartbeat reporter after a very early SIGTERM)
+    preemption.on_preempted(lambda: calls.append("late"))
+    assert calls == ["early", "late"]
 
 
 def _make_estimator(model_dir):

@@ -1,0 +1,245 @@
+"""Online-serving benchmark: throughput + latency percentiles under a
+Poisson open-loop load, with an optional mid-run replica kill.
+
+Boots a real serving tier (``serving.ServingCluster`` over
+``LocalProcessBackend`` replicas, each hosting a compiled
+``ContinuousBatcher``) and drives it the way a load balancer sees
+traffic: requests arrive on a Poisson process at ``--rate`` req/s
+REGARDLESS of completion (open loop — a closed loop would hide queueing
+delay, the number an online service actually ships), each handled on its
+own thread through its own ``ServeClient`` connection.
+
+Per request the bench records TTFT (submit → first streamed delta) and
+end-to-end latency; the tier's own scheduler histograms
+(``observability.LatencyHistogram``) are captured too, so driver-side
+queueing is visible from both ends.  With ``--kill-step N`` a
+``TFOS_CHAOS`` plan SIGKILLs replica 1 mid-run: the run then also
+asserts the serving acceptance property — degraded throughput, ZERO
+accepted requests lost (failover re-queues the dead replica's in-flight
+work; greedy determinism keeps the replayed streams exact).
+
+Writes ``bench_artifacts/serving.json``::
+
+    {"benchmark": "serving",
+     "config": {...},                      # replicas/slots/rate/model...
+     "rows": [{"scenario": "steady" | "replica_kill",
+               "requests": {"offered", "accepted", "completed", "shed",
+                            "failed", "requeued"},
+               "tokens_total": int,
+               "throughput_tokens_per_s": float,   # completed tokens/wall
+               "throughput_requests_per_s": float,
+               "wall_secs": float,
+               "ttft": {count,mean_secs,p50_secs,p95_secs,p99_secs,max_secs},
+               "e2e":  {same shape},               # client-side clocks
+               "scheduler": <scheduler.metrics() snapshot>}]}
+
+Run: ``python scripts/bench_serving.py [--requests 60] [--rate 6]
+[--kill-step 8]`` (CPU by default; tiny GPT so the numbers measure the
+serving plane, not the model).
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+VOCAB, HIDDEN, LAYERS, HEADS, MAXLEN = 83, 32, 2, 4, 64
+
+
+def bench_model_builder(args):
+    """Replica-side model: deterministic seeded tiny GPT (top level so
+    multiprocessing spawn can pickle it by reference)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=HIDDEN, num_layers=LAYERS,
+                    num_heads=HEADS, intermediate_size=2 * HIDDEN,
+                    max_position_embeddings=MAXLEN, dtype=jnp.float32,
+                    pos_encoding="rope")
+    params = GPT(cfg).init(jax.random.key(int(args.get("seed", 0))),
+                           jnp.ones((1, 4), jnp.int32))["params"]
+    return cfg, params
+
+
+def _run_load(serving, reqs, rate, rng):
+    """Open-loop Poisson arrivals; returns per-request records."""
+    from tensorflowonspark_tpu.serving import ServingError
+
+    records = [None] * len(reqs)
+    threads = []
+
+    def one(i, prompt, budget):
+        t0 = time.monotonic()
+        rec = {"ok": False, "ttft": None, "e2e": None, "tokens": 0}
+        try:
+            with serving.client() as c:
+                toks = []
+                for delta in c.generate_stream(prompt, budget, timeout=600):
+                    if rec["ttft"] is None:
+                        rec["ttft"] = time.monotonic() - t0
+                    toks.extend(delta)
+                rec["e2e"] = time.monotonic() - t0
+                rec["tokens"] = len(toks)
+                rec["ok"] = True
+                rec["out"] = toks
+        except ServingError as e:
+            rec["error"] = f"{type(e).__name__}: {e}"
+        records[i] = rec
+
+    for i, (p, n) in enumerate(reqs):
+        t = threading.Thread(target=one, args=(i, p, n), daemon=True)
+        t.start()
+        threads.append(t)
+        time.sleep(rng.exponential(1.0 / rate))   # Poisson inter-arrivals
+    for t in threads:
+        t.join(600)
+    return records
+
+
+def _percentiles(samples):
+    from tensorflowonspark_tpu.observability import LatencyHistogram
+
+    h = LatencyHistogram()
+    for s in samples:
+        h.record(s)
+    return h.summary()
+
+
+def bench_scenario(scenario, n_requests, rate, replicas, slots, kill_step,
+                   seed=0):
+    import numpy as np
+
+    from tensorflowonspark_tpu.serving import ServingCluster
+
+    worker_env = {"JAX_PLATFORMS": "cpu"}
+    if scenario == "replica_kill":
+        worker_env["TFOS_CHAOS"] = f"kill node=1 at_step={kill_step}"
+
+    rng = np.random.default_rng(seed)
+    reqs = [(rng.integers(0, VOCAB, (int(rng.integers(3, 10)),))
+             .astype(np.int32), int(rng.integers(8, 17)))
+            for _ in range(n_requests)]
+
+    serving = ServingCluster.run(
+        bench_model_builder, replicas, max_batch=slots,
+        worker_env=worker_env, reservation_timeout=120)
+    try:
+        # warmup: one CONCURRENT request per replica, so least-outstanding
+        # routing lands one on each and every replica pays its XLA
+        # compiles outside the measured window (sequential warmups would
+        # all route to replica 0 — ties prefer the lowest id)
+        def _warm():
+            with serving.client() as c:
+                c.generate(reqs[0][0], 2, timeout=600)
+
+        warmers = [threading.Thread(target=_warm) for _ in range(replicas)]
+        for t in warmers:
+            t.start()
+        for t in warmers:
+            t.join(600)
+        sched0 = serving.metrics()      # baseline: exclude warmup counts
+        t0 = time.monotonic()
+        records = _run_load(serving, reqs, rate, rng)
+        wall = time.monotonic() - t0
+        sched = serving.metrics()
+        for k in ("accepted", "completed", "shed", "failed", "requeued"):
+            sched[k] -= sched0[k]
+    finally:
+        serving.shutdown(timeout=300)
+
+    ok = [r for r in records if r and r["ok"]]
+    lost = [i for i, r in enumerate(records)
+            if r is None or (not r["ok"] and "error" not in r)]
+    if lost:
+        raise RuntimeError(f"requests lost without a typed error: {lost}")
+    if scenario == "replica_kill":
+        # acceptance: the kill must not lose a single accepted request
+        failed = [r for r in records if r and not r["ok"]]
+        if failed:
+            raise RuntimeError(f"accepted requests failed after the "
+                               f"replica kill: {failed[:3]}")
+        # and the replayed streams must be exact: greedy determinism
+        # means byte-equal output for identical requests
+        import jax.numpy as jnp
+
+        from tensorflowonspark_tpu.models import greedy_generate
+
+        cfg, params = bench_model_builder({"seed": seed})
+        for (p, n), r in zip(reqs, records):
+            want = np.asarray(greedy_generate(
+                cfg, params, jnp.asarray(p)[None, :], n))[0, len(p):]
+            assert r["out"] == want.tolist(), "post-kill stream diverged"
+    tokens = sum(r["tokens"] for r in ok)
+    return {
+        "scenario": scenario,
+        "requests": {
+            "offered": n_requests, "accepted": sched["accepted"],
+            "completed": len(ok), "shed": sched["shed"],
+            "failed": sched["failed"], "requeued": sched["requeued"],
+        },
+        "tokens_total": tokens,
+        "wall_secs": round(wall, 3),
+        "throughput_tokens_per_s": round(tokens / wall, 2),
+        "throughput_requests_per_s": round(len(ok) / wall, 2),
+        "ttft": _percentiles([r["ttft"] for r in ok if r["ttft"] is not None]),
+        "e2e": _percentiles([r["e2e"] for r in ok]),
+        "scheduler": {k: sched[k] for k in ("ttft", "e2e", "replicas")},
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=60)
+    ap.add_argument("--rate", type=float, default=6.0,
+                    help="Poisson arrival rate, requests/s")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--slots", type=int, default=4,
+                    help="ContinuousBatcher max_batch per replica")
+    ap.add_argument("--kill-step", type=int, default=8,
+                    help="decode step at which the chaos plan kills "
+                         "replica 1 in the replica_kill scenario")
+    ap.add_argument("--skip-kill", action="store_true",
+                    help="run only the steady-state scenario")
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    rows = []
+    scenarios = ["steady"] + ([] if args.skip_kill else ["replica_kill"])
+    for scenario in scenarios:
+        row = bench_scenario(scenario, args.requests, args.rate,
+                             args.replicas, args.slots, args.kill_step)
+        print(json.dumps(row, indent=2))
+        rows.append(row)
+
+    out = {
+        "benchmark": "serving",
+        "config": {
+            "backend": "LocalProcessBackend", "platform": "cpu",
+            "replicas": args.replicas, "slots_per_replica": args.slots,
+            "poisson_rate_per_s": args.rate, "requests": args.requests,
+            "model": {"vocab": VOCAB, "hidden": HIDDEN, "layers": LAYERS,
+                      "heads": HEADS, "max_len": MAXLEN},
+            "prompt_tokens": "uniform 3..9",
+            "max_new_tokens": "uniform 8..16",
+            "kill_plan": None if args.skip_kill
+            else f"kill node=1 at_step={args.kill_step}",
+        },
+        "rows": rows,
+    }
+    path = os.path.join(REPO, "bench_artifacts", "serving.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

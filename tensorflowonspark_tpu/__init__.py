@@ -57,3 +57,8 @@ from tensorflowonspark_tpu.pipeline import (Namespace, Pipeline,  # noqa: F401
 # TFNode.DataFeed, TFManager.start/connect, gpu_info.get_gpus, compat.*).
 from tensorflowonspark_tpu import (TFCluster, TFManager, TFNode,  # noqa: F401,E402
                                    TFSparkNode, compat, gpu_info)
+
+# Online serving tier (docs/serving.md): ServingCluster / ServeClient over
+# ContinuousBatcher replicas.  Safe to import eagerly — the replica-side
+# jax/model imports happen inside the worker map_fun, not at import time.
+from tensorflowonspark_tpu import serving  # noqa: F401,E402

@@ -228,12 +228,24 @@ class ClusterMonitor:
     instead of wedging on collectives.  ``TPUCluster.shutdown`` re-raises
     the recorded failure; ``cluster.run_with_recovery`` classifies it for
     the restart decision.
+
+    **Serving-tier mode** (``abort_on_failure=False, keep_polling=True,
+    on_failure=...``): an online serving cluster must OUTLIVE a replica
+    death — the right response is re-routing, not teardown.  With
+    ``keep_polling`` the monitor does not stop at the first failure: each
+    classified failure is appended to :attr:`failures`, handed to the
+    ``on_failure(failure)`` callback (exceptions suppressed — detection
+    must outlive a buggy subscriber), and the implicated workers are
+    retired from both checks so one dead replica is reported exactly once
+    while the survivors stay under watch.  Training clusters keep the
+    default fail-fast single-shot behavior.
     """
 
     def __init__(self, cluster, hang_timeout: float = 120.0,
                  poll_interval: float = 0.5, step_timeout: float | None = None,
                  abort_on_failure: bool = True, event_log=None,
-                 client_factory=None):
+                 client_factory=None, on_failure=None,
+                 keep_polling: bool = False):
         self.cluster = cluster
         self.hang_timeout = float(hang_timeout)
         self.poll_interval = float(poll_interval)
@@ -248,6 +260,12 @@ class ClusterMonitor:
         self._client_factory = client_factory or (
             lambda info: QueueClient(info["addr"], info["authkey"],
                                      timeout=2.0, shm=False))
+        self.on_failure = on_failure
+        self.keep_polling = bool(keep_polling)
+        #: every classified failure, in detection order (one entry per
+        #: failure with ``keep_polling``; at most one without)
+        self.failures: list[ClusterFailure] = []
+        self._handled: set[int] = set()  # workers already reported
         self._clients: dict[int, QueueClient] = {}
         self._kv_retry_at: dict[int, float] = {}  # reconnect cooldowns
         self._hb: dict[int, dict] = {}
@@ -302,7 +320,7 @@ class ClusterMonitor:
         generic nonzero-exit error.
         """
         with self._poll_lock:
-            if self._failure is None:
+            if self._failure is None or self.keep_polling:
                 self._poll_once()
         return self._failure
 
@@ -311,9 +329,9 @@ class ClusterMonitor:
         while not self._stop.is_set():
             try:
                 with self._poll_lock:
-                    if self._failure is not None:
+                    if self._failure is not None and not self.keep_polling:
                         return
-                    if self._poll_once():
+                    if self._poll_once() and not self.keep_polling:
                         return
             except Exception:  # the watchdog must outlive its own bugs
                 logger.exception("cluster monitor poll failed")
@@ -358,6 +376,7 @@ class ClusterMonitor:
         return codes, alive, failed
 
     def _check_processes(self, codes: dict, failed: list) -> bool:
+        failed = [i for i in failed if i not in self._handled]
         if not failed:
             return False
         sigterm = -int(signal.SIGTERM)
@@ -375,6 +394,8 @@ class ClusterMonitor:
         now = time.monotonic()
         for node in self.cluster.cluster_info:
             eid = node["executor_id"]
+            if eid in self._handled:
+                continue  # already reported; keep_polling watches the rest
             if eid < len(alive) and not alive[eid]:
                 continue  # exited; crash/preemption handled by process check
             payload = self._poll_kv(node)
@@ -437,10 +458,18 @@ class ClusterMonitor:
 
     def _fail(self, failure: ClusterFailure) -> None:
         self._failure = failure
+        self.failures.append(failure)
         logger.error("cluster monitor: %s", failure)
         self._emit(failure.kind, message=str(failure),
                    workers=list(failure.failed_workers))
         self._failure_evt.set()
+        if self.keep_polling:
+            self._handled.update(failure.failed_workers)
+        if self.on_failure is not None:
+            try:
+                self.on_failure(failure)
+            except Exception:
+                logger.exception("on_failure subscriber raised")
         if self.abort_on_failure:
             self._emit("abort", reason=failure.kind)
             with contextlib.suppress(Exception):

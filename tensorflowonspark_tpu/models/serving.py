@@ -248,6 +248,9 @@ class ContinuousBatcher:
         self._reserved: set[int] = set()
         self._ids = itertools.count()
         self._results: dict[int, np.ndarray] = {}
+        #: per-request streaming callbacks (``submit(on_token=...)``);
+        #: dropped at finish alongside the request's other live state
+        self._on_token: dict[int, object] = {}
         #: prompt per live request (speculative drafting needs the full
         #: history); dropped at finish so memory tracks the in-flight set
         self._prompts: dict[int, np.ndarray] = {}
@@ -304,6 +307,25 @@ class ContinuousBatcher:
                 "and the cache are unrecoverable. Build a new batcher "
                 f"and resubmit. Original error: {self._poisoned}")
 
+    def _emit_token(self, rid: int, tok: int) -> None:
+        cb = self._on_token.get(rid)
+        if cb is not None:
+            cb(rid, tok)
+
+    def load(self) -> dict:
+        """Queue-depth snapshot for routers/schedulers: ``active`` slots
+        decoding, ``pending`` queued-but-unadmitted requests (counting the
+        at-most-one chunked admission in flight), ``reserved`` slots held
+        for that admission, and ``total`` = active + pending — every live
+        request counted exactly once.  ``has_free_slot()`` answers "may I
+        submit"; this answers "how deep is the queue", which is what
+        least-loaded routing across replicas needs."""
+        active = sum(s is not None for s in self.slots)
+        pending = len(self._pending) + (1 if self._inflight is not None
+                                        else 0)
+        return {"active": active, "pending": pending,
+                "reserved": len(self._reserved), "total": active + pending}
+
     # -- admission ---------------------------------------------------------
     def has_free_slot(self) -> bool:
         """True while another ``submit`` would find a slot: queued-but-
@@ -316,7 +338,7 @@ class ContinuousBatcher:
 
     def submit(self, prompt_ids, max_new_tokens: int, *,
                temperature: float = 0.0, top_p: float = 1.0,
-               seed: int = 0) -> int:
+               seed: int = 0, on_token=None) -> int:
         """Queue a request; it is admitted into a slot on the next
         ``step()`` with a free slot.  Returns the request id.
 
@@ -324,7 +346,17 @@ class ContinuousBatcher:
         a solo ``greedy_generate`` run.  ``temperature>0`` samples from
         the nucleus ``top_p`` at that temperature, keyed by ``seed``:
         the output is a pure function of (params, prompt, budget,
-        temperature, top_p, seed) — batch company never changes it."""
+        temperature, top_p, seed) — batch company never changes it.
+
+        ``on_token(request_id, token)`` streams every COMMITTED token in
+        emission order, from inside the ``step()`` that commits it — the
+        hook a serving loop uses to forward deltas before the request
+        finishes.  Tokens a block/speculative dispatch computes but
+        discards (past eos or budget) are never surfaced.  The callback
+        runs on the driving thread and must be cheap and must not raise:
+        an exception propagates out of ``step()`` and poisons the batcher
+        exactly like a device failure (the dispatch that produced the
+        token already consumed the donated cache)."""
         self._check_usable()
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
@@ -349,6 +381,8 @@ class ContinuousBatcher:
         rid = next(self._ids)
         self._pending.append((rid, prompt, int(max_new_tokens),
                               float(temperature), float(top_p), int(seed)))
+        if on_token is not None:
+            self._on_token[rid] = on_token
         if self.spec_k is not None:   # only drafting reads the history,
             # and only its trailing window of it
             self._prompts[rid] = prompt[-self.spec_window:]
@@ -404,6 +438,7 @@ class ContinuousBatcher:
         self._scatter_rows(row_cache, [slot])
         self._inflight = None
         tok = int(np.asarray(first)[0])
+        self._emit_token(rid, tok)
         s = _Slot(request_id=rid, remaining=budget - 1, tokens=[tok],
                   temperature=temp, top_p=top_p, seed=seed)
         if s.remaining <= 0 or tok == self.eos_id:
@@ -549,6 +584,7 @@ class ContinuousBatcher:
                     admitted.append((slots[j], (rid, budget, temp, top_p,
                                                 seed), int(firsts[j])))
             for slot, (rid, budget, temp, top_p, seed), tok in admitted:
+                self._emit_token(rid, tok)
                 s = _Slot(request_id=rid, remaining=budget - 1, tokens=[tok],
                           temperature=temp, top_p=top_p, seed=seed)
                 if s.remaining <= 0 or tok == self.eos_id:
@@ -561,6 +597,7 @@ class ContinuousBatcher:
     def _finish(self, i: int, s: _Slot) -> None:
         self._results[s.request_id] = np.asarray(s.tokens, np.int32)
         self._prompts.pop(s.request_id, None)
+        self._on_token.pop(s.request_id, None)
         self.slots[i] = None
 
     # -- decode ------------------------------------------------------------
@@ -690,6 +727,7 @@ class ContinuousBatcher:
             new = list(toks[i, 1:1 + a[i]]) + [int(bonus[i])]
             for tok in new:
                 s.tokens.append(int(tok))
+                self._emit_token(s.request_id, int(tok))
                 s.remaining -= 1
                 if s.remaining <= 0 or tok == self.eos_id:
                     done.append(s.request_id)
@@ -803,6 +841,7 @@ class ContinuousBatcher:
             for tok in seq[i]:
                 tok = int(tok)
                 s.tokens.append(tok)
+                self._emit_token(s.request_id, tok)
                 s.remaining -= 1
                 if s.remaining <= 0 or tok == self.eos_id:
                     done.append(s.request_id)
@@ -835,6 +874,7 @@ class ContinuousBatcher:
                 continue
             tok = int(nxt[i])
             s.tokens.append(tok)
+            self._emit_token(s.request_id, tok)
             s.remaining -= 1
             if s.remaining <= 0 or tok == self.eos_id:
                 done.append(s.request_id)

@@ -169,14 +169,75 @@ class EventLog:
 
     @staticmethod
     def read(path: str) -> list[dict]:
-        """Parse an event file back into records (bench/test helper)."""
+        """Parse an event file back into records (bench/test helper).
+
+        Tolerates malformed lines: a driver killed mid-``emit`` leaves a
+        truncated final line, and a post-mortem read that raised on it
+        would lose every GOOD record in the file.  Bad lines are skipped
+        with a warning instead."""
         out: list[dict] = []
         with open(path) as f:
-            for line in f:
+            for lineno, line in enumerate(f, 1):
                 line = line.strip()
-                if line:
+                if not line:
+                    continue
+                try:
                     out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    logger.warning(
+                        "skipping malformed event at %s:%d (truncated by a "
+                        "mid-write death?): %.80r", path, lineno, line)
         return out
+
+
+# ------------------------------------------------------ latency histogram
+
+class LatencyHistogram:
+    """Latency percentile accumulator (p50/p95/p99) with a lock-free
+    hot path.
+
+    ``record`` is a single ``list.append`` — atomic under the GIL — so
+    request threads never contend on a lock to record a sample (the
+    serving frontend records TTFT/e2e from many connection threads at
+    once).  Readers take a snapshot copy (also GIL-atomic via the slice)
+    and sort it; percentile reads are O(n log n) but off the hot path
+    (stats endpoints, bench roll-ups).  Percentiles use the nearest-rank
+    method, so every reported value is a latency that actually occurred.
+    """
+
+    def __init__(self):
+        self._samples: list[float] = []
+
+    def record(self, secs: float) -> None:
+        self._samples.append(float(secs))
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    @staticmethod
+    def _rank(snap: list, q: float):
+        """Nearest-rank pick from a sorted snapshot (``ceil(q/100*n)``-th
+        sample, 1-based, clamped)."""
+        n = len(snap)
+        return snap[min(n, int(max(1, -(-n * q // 100)))) - 1]
+
+    def percentile(self, q: float) -> float | None:
+        """Nearest-rank percentile ``q`` in [0, 100]; None when empty."""
+        snap = sorted(self._samples)
+        return self._rank(snap, q) if snap else None
+
+    def summary(self) -> dict:
+        """``{count, mean_secs, p50_secs, p95_secs, p99_secs, max_secs}``
+        (None-valued stats when no sample was recorded)."""
+        snap = sorted(self._samples)
+        n = len(snap)
+        if not n:
+            return {"count": 0, "mean_secs": None, "p50_secs": None,
+                    "p95_secs": None, "p99_secs": None, "max_secs": None}
+        return {"count": n, "mean_secs": sum(snap) / n,
+                "p50_secs": self._rank(snap, 50),
+                "p95_secs": self._rank(snap, 95),
+                "p99_secs": self._rank(snap, 99), "max_secs": snap[-1]}
 
 
 # ----------------------------------------------------------------- goodput

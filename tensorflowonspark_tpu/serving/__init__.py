@@ -1,0 +1,33 @@
+"""Distributed online serving over ContinuousBatcher replicas.
+
+The missing layer between the single-process continuous batcher
+(``models/serving.py``) and "serves heavy traffic" (ROADMAP north star):
+a driver-side frontend + scheduler that admits, sheds, routes and fails
+over generate requests across a cluster of replica workers, each running
+one compiled decode loop behind the node's queue/shm data plane.
+
+    from tensorflowonspark_tpu.serving import ServingCluster
+
+    serving = ServingCluster.run(my_model_builder, num_replicas=2,
+                                 max_batch=4, eos_id=50256)
+    with serving.client() as c:
+        tokens = c.generate(prompt_ids, max_new_tokens=64)
+    serving.shutdown()
+
+Layout: ``scheduler`` (admission/routing/failover + typed errors),
+``replica`` (the worker map_fun), ``frontend`` (TCP edge +
+``ServingCluster`` composition), ``client`` (``ServeClient``).
+Architecture, backpressure semantics and the failure model are in
+``docs/serving.md``.
+"""
+
+from tensorflowonspark_tpu.serving.client import ServeClient  # noqa: F401
+from tensorflowonspark_tpu.serving.frontend import (ServeFrontend,  # noqa: F401
+                                                    ServingCluster)
+from tensorflowonspark_tpu.serving.replica import serve_replica  # noqa: F401
+from tensorflowonspark_tpu.serving.scheduler import (DeadlineExceeded,  # noqa: F401
+                                                     ReplicaFailed,
+                                                     ReplicaScheduler,
+                                                     RequestRejected,
+                                                     ServeRequest,
+                                                     ServingError)

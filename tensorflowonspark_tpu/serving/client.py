@@ -1,0 +1,147 @@
+"""`ServeClient`: the user-facing handle on a serving frontend.
+
+One client = one authenticated TCP connection = one request at a time
+(frames of concurrent requests would interleave on the socket; run N
+concurrent streams with N clients — they are cheap).  Errors are typed:
+
+- :class:`~tensorflowonspark_tpu.serving.scheduler.RequestRejected` —
+  load shed at admission (``.reason`` says why: ``queue_full`` /
+  ``shutdown`` / ``no_replica``);
+- :class:`~tensorflowonspark_tpu.serving.scheduler.DeadlineExceeded` —
+  the per-request deadline passed;
+- :class:`~tensorflowonspark_tpu.serving.scheduler.ReplicaFailed` — the
+  request was lost to replica failure beyond the one re-queue;
+- ``ValueError`` — the request itself is invalid (e.g. prompt + budget
+  exceed the model's positions), reported by the replica's validator.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import socket
+import threading
+
+import numpy as np
+
+from tensorflowonspark_tpu.reservation import MessageSocket
+from tensorflowonspark_tpu.serving.scheduler import (DeadlineExceeded,
+                                                     ReplicaFailed,
+                                                     RequestRejected,
+                                                     ServingError)
+
+_REJECT_REASONS = ("queue_full", "shutdown", "no_replica")
+
+
+def _raise_typed(reason: str, message: str):
+    if reason in _REJECT_REASONS:
+        raise RequestRejected(reason, message)
+    if reason == "deadline":
+        raise DeadlineExceeded(message)
+    if reason == "replica_failed":
+        raise ReplicaFailed(message)
+    if reason == "bad_request":
+        raise ValueError(message)
+    raise ServingError(f"{reason}: {message}")
+
+
+class ServeClient(MessageSocket):
+    """Blocking client for :class:`~tensorflowonspark_tpu.serving.
+    frontend.ServeFrontend` (module docstring has the error contract)."""
+
+    def __init__(self, addr: tuple[str, int], authkey: bytes,
+                 timeout: float = 600.0):
+        self.addr = tuple(addr)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(timeout)
+        self._sock.connect(self.addr)
+        self._lock = threading.Lock()
+        try:
+            self.auth_respond(self._sock, bytes(authkey))
+        except (PermissionError, EOFError, OSError) as e:
+            self.close()   # don't leak the connected fd on a bad key
+            raise ConnectionError(
+                f"serving frontend rejected connection: {e!r}")
+
+    # -- requests ----------------------------------------------------------
+    def _gen_msg(self, prompt, max_new_tokens, temperature, top_p, seed,
+                 stream, timeout):
+        return {"op": "generate",
+                "prompt": np.asarray(prompt, np.int32).reshape(-1),
+                "max_new_tokens": int(max_new_tokens),
+                "temperature": float(temperature), "top_p": float(top_p),
+                "seed": int(seed), "stream": bool(stream),
+                "timeout": timeout}
+
+    def generate(self, prompt, max_new_tokens: int, *,
+                 temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
+                 timeout: float | None = None) -> np.ndarray:
+        """Generate to completion; returns the token array (prompt
+        excluded).  ``timeout`` is the end-to-end deadline (queue wait
+        included); greedy (default) output is exact vs a solo
+        ``greedy_generate`` run."""
+        with self._lock:
+            self.send(self._sock, self._gen_msg(
+                prompt, max_new_tokens, temperature, top_p, seed,
+                stream=False, timeout=timeout))
+            while True:
+                frame = self.receive(self._sock)
+                kind = frame[0]
+                if kind == "DONE":
+                    return np.asarray(frame[1], np.int32)
+                if kind == "ERR":
+                    _raise_typed(frame[1], frame[2])
+                # tolerate stray TOK frames (stream flag mismatch)
+
+    def generate_stream(self, prompt, max_new_tokens: int, *,
+                        temperature: float = 0.0, top_p: float = 1.0,
+                        seed: int = 0, timeout: float | None = None):
+        """Yield token deltas (lists of ints) as the replica commits them;
+        exact concatenation == :meth:`generate`'s output.  Consume the
+        iterator fully (or ``close()`` the client): abandoning it
+        mid-stream closes the connection to avoid frame desync."""
+        with self._lock:
+            self.send(self._sock, self._gen_msg(
+                prompt, max_new_tokens, temperature, top_p, seed,
+                stream=True, timeout=timeout))
+            try:
+                while True:
+                    frame = self.receive(self._sock)
+                    kind = frame[0]
+                    if kind == "TOK":
+                        yield list(frame[1])
+                    elif kind == "DONE":
+                        return
+                    else:
+                        _raise_typed(frame[1], frame[2])
+            except GeneratorExit:
+                # abandoned mid-stream: unread frames would desync the
+                # next request — retire the connection instead
+                self.close()
+                raise
+
+    # -- control -----------------------------------------------------------
+    def stats(self) -> dict:
+        """The scheduler's metrics snapshot (counters + ttft/e2e
+        percentile summaries + per-replica state)."""
+        with self._lock:
+            self.send(self._sock, {"op": "stats"})
+            frame = self.receive(self._sock)
+        if frame[0] != "OK":
+            _raise_typed(frame[1], frame[2])
+        return frame[1]
+
+    def ping(self) -> bool:
+        with self._lock:
+            self.send(self._sock, {"op": "ping"})
+            return self.receive(self._sock) == "OK"
+
+    def close(self) -> None:
+        with contextlib.suppress(OSError):
+            self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

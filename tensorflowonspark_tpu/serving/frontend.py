@@ -1,0 +1,327 @@
+"""Online serving frontend: the TCP edge + the cluster composition.
+
+:class:`ServeFrontend` is the process boundary of the serving tier: it
+listens on a TCP port, authenticates clients with the same mutual-HMAC
+authkey handshake the rest of the stack uses
+(:class:`~tensorflowonspark_tpu.reservation.MessageSocket`), and turns
+each ``generate`` op into a :meth:`ReplicaScheduler.submit` — typed
+load-shed rejections and deadline expiries travel back as ``("ERR",
+reason, message)`` frames, streamed tokens as ``("TOK", [deltas])``.
+
+:class:`ServingCluster` composes the whole tier::
+
+    serving = ServingCluster.run(model_builder, num_replicas=2,
+                                 max_batch=4, eos_id=50256)
+    client = serving.client()
+    tokens = client.generate(prompt, max_new_tokens=64)
+    for delta in client.generate_stream(prompt, 64):
+        ...
+    serving.shutdown()
+
+Wiring (docs/serving.md has the picture):
+
+- replicas are ordinary cluster workers running
+  :func:`~tensorflowonspark_tpu.serving.replica.serve_replica`
+  (``TPUCluster.run`` with ``InputMode.SPARK``), so bootstrap,
+  reservation, heartbeats, crash files and shutdown all reuse the
+  training-path machinery;
+- the cluster's fail-fast monitor is replaced by a serving-mode
+  :class:`~tensorflowonspark_tpu.health.ClusterMonitor`
+  (``abort_on_failure=False, keep_polling=True``) whose classified
+  failures feed :meth:`ReplicaScheduler.on_cluster_failure` — a replica
+  death triggers failover, not teardown;
+- ``shutdown`` drains the scheduler, stops the edge, then runs the
+  normal cluster shutdown; worker exits caused by replica deaths the
+  scheduler already failed over are tolerated (they were *handled*, and
+  every accepted request completed or got a typed error), anything else
+  re-raises.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import socket
+import threading
+import time
+
+import numpy as np
+
+from tensorflowonspark_tpu.cluster import InputMode, TPUCluster
+from tensorflowonspark_tpu.health import ClusterMonitor
+from tensorflowonspark_tpu.reservation import (FrameFormatError,
+                                               MessageSocket, _peer_name)
+from tensorflowonspark_tpu.serving.scheduler import (ReplicaScheduler,
+                                                     RequestRejected,
+                                                     ServingError)
+
+logger = logging.getLogger(__name__)
+
+
+class ServeFrontend(MessageSocket):
+    """TCP edge of the serving tier (one thread per client connection).
+
+    Client protocol (after the authkey handshake), all frames pickled
+    through the shared ``MessageSocket`` wire format:
+
+    - ``{"op": "generate", "prompt", "max_new_tokens", "temperature",
+      "top_p", "seed", "stream", "timeout"}`` → a sequence of
+      ``("TOK", [tokens])`` frames (``stream=True`` only) terminated by
+      ``("DONE", payload)`` — payload is the full generated token array
+      for ``stream=False``, the total token count for streams — or
+      ``("ERR", reason, message)``;
+    - ``{"op": "stats"}`` → ``("OK", metrics_dict)``;
+    - ``{"op": "ping"}`` → ``"OK"``.
+    """
+
+    def __init__(self, scheduler: ReplicaScheduler, authkey: bytes,
+                 mode: str = "local", default_timeout: float = 600.0):
+        self.scheduler = scheduler
+        self.authkey = bytes(authkey)
+        self.mode = mode
+        self.default_timeout = float(default_timeout)
+        self.done = threading.Event()
+        self._listener: socket.socket | None = None
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+        self.connections = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> tuple[str, int]:
+        host = "127.0.0.1" if self.mode == "local" else "0.0.0.0"
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, 0))
+        self._listener.listen(128)
+        self.port = self._listener.getsockname()[1]
+        threading.Thread(target=self._accept_loop, name="serve-frontend",
+                         daemon=True).start()
+        from tensorflowonspark_tpu.reservation import get_ip_address
+
+        self.addr = ("127.0.0.1" if self.mode == "local"
+                     else get_ip_address(), self.port)
+        logger.info("serving frontend listening at %s", self.addr)
+        return self.addr
+
+    def stop(self) -> None:
+        self.done.set()
+        if self._listener is not None:
+            with contextlib.suppress(OSError):
+                self._listener.close()
+        # close established connections too: their threads block in
+        # receive() and would otherwise linger past the tier's life
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for conn in conns:
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    # -- serving -----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self.done.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.add(conn)
+            self.connections += 1
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            nonce = self.auth_challenge(conn)
+            if not self.auth_verify(conn, self.authkey, nonce):
+                return
+            while not self.done.is_set():
+                msg = self.receive(conn)
+                op = msg.get("op") if isinstance(msg, dict) else None
+                if op == "generate":
+                    self._handle_generate(conn, msg)
+                elif op == "stats":
+                    self.send(conn, ("OK", self.scheduler.metrics()))
+                elif op == "ping":
+                    self.send(conn, "OK")
+                else:
+                    self.send(conn, ("ERR", "bad_request",
+                                     f"unknown op {op!r}"))
+        except FrameFormatError as e:
+            logger.error("dropping serve peer %s: %s", _peer_name(conn), e)
+        except (EOFError, OSError, ValueError):
+            pass
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def _handle_generate(self, conn: socket.socket, msg: dict) -> None:
+        stream = bool(msg.get("stream"))
+        # clients send an explicit "timeout": None for "no deadline asked";
+        # the tier's default_timeout must still apply then, or a saturated
+        # tier would hold this connection thread forever
+        timeout = msg.get("timeout")
+        if timeout is None:
+            timeout = self.default_timeout
+        try:
+            req = self.scheduler.submit(
+                msg["prompt"], int(msg["max_new_tokens"]),
+                temperature=float(msg.get("temperature", 0.0)),
+                top_p=float(msg.get("top_p", 1.0)),
+                seed=int(msg.get("seed", 0)), timeout=timeout)
+        except (RequestRejected, ServingError) as e:
+            self.send(conn, ("ERR", getattr(e, "reason", "rejected"), str(e)))
+            return
+        except (ValueError, TypeError, KeyError) as e:
+            self.send(conn, ("ERR", "bad_request", str(e)))
+            return
+        try:
+            while True:
+                remaining = (None if req.deadline is None
+                             else req.deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    self.scheduler.abandon(req)
+                    self.send(conn, ("ERR", "deadline",
+                                     "deadline exceeded mid-request"))
+                    return
+                try:
+                    ev = req.events.get(timeout=remaining)
+                except Exception:   # queue.Empty on deadline
+                    continue        # loop re-checks remaining (<= 0 now)
+                if ev[0] == "tok":
+                    if stream:
+                        self.send(conn, ("TOK", ev[1]))
+                elif ev[0] == "done":
+                    self.send(conn, ("DONE",
+                                     ev[1] if stream
+                                     else np.asarray(req.tokens, np.int32)))
+                    return
+                else:  # ("err", reason, message)
+                    self.send(conn, ("ERR", ev[1], ev[2]))
+                    return
+        except (BrokenPipeError, ConnectionError, OSError):
+            # client went away mid-request: stop tracking so replica
+            # output for it is dropped instead of queuing forever
+            self.scheduler.abandon(req, reason="disconnect")
+            raise
+
+
+class ServingCluster:
+    """A running online-serving tier: cluster + monitor + scheduler +
+    frontend, shut down as one unit (see module docstring)."""
+
+    def __init__(self, cluster: TPUCluster, scheduler: ReplicaScheduler,
+                 monitor: ClusterMonitor | None, frontend: ServeFrontend,
+                 address: tuple[str, int]):
+        self.cluster = cluster
+        self.scheduler = scheduler
+        self.monitor = monitor
+        self.frontend = frontend
+        self.address = address
+        self._shutdown_done = False
+
+    # ------------------------------------------------------------------ run
+    @classmethod
+    def run(cls, model_builder, num_replicas: int, *, max_batch: int = 4,
+            eos_id: int | None = None, batcher_kwargs: dict | None = None,
+            replica_args: dict | None = None, overcommit: int = 2,
+            max_queue_depth: int | None = None, requeue_limit: int = 1,
+            hang_timeout: float = 120.0, step_timeout: float | None = None,
+            monitor: bool = True, frontend_mode: str = "local",
+            client_timeout: float = 600.0, **cluster_kwargs) -> "ServingCluster":
+        """Boot ``num_replicas`` serving workers and the driver-side tier.
+
+        ``model_builder(args) -> (cfg, params)`` must be a picklable
+        top-level callable (it runs inside each worker process).
+        ``cluster_kwargs`` pass through to :meth:`TPUCluster.run`
+        (``backend=``, ``worker_env=``, ``working_dir=``, ``queue_shm=``,
+        ``queue_depth=``, ``reservation_timeout=``...).
+        """
+        from tensorflowonspark_tpu.serving.replica import serve_replica
+
+        args = dict(replica_args or {})
+        args.update({
+            "serve_model_builder": model_builder,
+            "serve_max_batch": int(max_batch),
+            "serve_eos_id": eos_id,
+            "serve_batcher_kwargs": dict(batcher_kwargs or {}),
+        })
+        # monitor=False: the training monitor's fail-fast abort is the
+        # wrong policy here — a serving-mode monitor is attached below
+        cluster = TPUCluster.run(serve_replica, args, num_replicas,
+                                 input_mode=InputMode.SPARK, monitor=False,
+                                 **cluster_kwargs)
+        try:
+            scheduler = ReplicaScheduler(
+                cluster, slots_per_replica=max_batch, overcommit=overcommit,
+                max_queue_depth=max_queue_depth, requeue_limit=requeue_limit)
+            mon = None
+            if monitor:
+                mon = ClusterMonitor(
+                    cluster, hang_timeout=hang_timeout,
+                    step_timeout=step_timeout, abort_on_failure=False,
+                    keep_polling=True,
+                    on_failure=scheduler.on_cluster_failure)
+                mon.start()
+            scheduler.start()
+            frontend = ServeFrontend(
+                scheduler, authkey=cluster.cluster_meta["authkey"],
+                mode=frontend_mode, default_timeout=client_timeout)
+            address = frontend.start()
+        except Exception:
+            cluster._abort()
+            raise
+        return cls(cluster, scheduler, mon, frontend, address)
+
+    # -------------------------------------------------------------- clients
+    @property
+    def authkey(self) -> bytes:
+        return self.cluster.cluster_meta["authkey"]
+
+    def client(self, **kwargs):
+        """A connected :class:`~tensorflowonspark_tpu.serving.client.
+        ServeClient` for this tier (one per concurrent request stream)."""
+        from tensorflowonspark_tpu.serving.client import ServeClient
+
+        return ServeClient(self.address, self.authkey, **kwargs)
+
+    def metrics(self) -> dict:
+        return self.scheduler.metrics()
+
+    # ------------------------------------------------------------- shutdown
+    def shutdown(self, timeout: float = 600.0,
+                 drain_timeout: float = 60.0) -> None:
+        """Drain in-flight requests, stop the tier, shut the cluster down.
+
+        Worker failures the scheduler already failed over (dead replicas
+        whose requests were re-queued or given typed errors) are
+        tolerated — a serving tier that survived a replica death must not
+        fail its own shutdown over the corpse.  Unhandled failures
+        re-raise as usual.
+        """
+        if self._shutdown_done:
+            return
+        self._shutdown_done = True
+        if not self.scheduler.drain(drain_timeout):
+            logger.warning("serving scheduler still busy after %.0fs drain; "
+                           "remaining requests get typed shutdown errors",
+                           drain_timeout)
+        handled = self.scheduler.dead_replicas()
+        self.frontend.stop()
+        self.scheduler.stop()
+        if self.monitor is not None:
+            self.monitor.stop()
+        try:
+            self.cluster.shutdown(timeout=timeout)
+        except Exception as e:
+            failed = set()
+            with contextlib.suppress(Exception):
+                failed = set(self.cluster.backend.failed())
+            if handled and failed and failed <= handled:
+                logger.warning(
+                    "tolerating worker exit(s) %s already failed over by "
+                    "the serving tier: %s", sorted(failed), e)
+            else:
+                raise

@@ -1,0 +1,147 @@
+"""Worker-side serving replica: ContinuousBatcher behind the node queues.
+
+``serve_replica`` is a ``map_fun`` launched through the ordinary cluster
+runtime (``TPUCluster.run`` / ``node.run``), so a serving replica gets the
+whole worker substrate for free: the node's
+:class:`~tensorflowonspark_tpu.queues.QueueServer` (with per-connection
+shm negotiation) as its request/response plane, crash files + the
+``error`` queue for failure propagation, and the
+:class:`~tensorflowonspark_tpu.health.HeartbeatReporter` the driver's
+:class:`~tensorflowonspark_tpu.health.ClusterMonitor` watches.
+
+The loop interleaves request intake with decode — the same shape as
+``examples/gpt/cluster_serving.py``'s worker, upgraded for the online
+tier:
+
+- intake is near-non-blocking while any slot is decoding (a blocking
+  wait would stall every in-flight request) and blocks briefly when idle;
+- every committed token streams back immediately through the batcher's
+  ``on_token`` hook, flushed as one ``{"event": "tok"}`` delta message
+  per request per step (so a K-token block/speculative commit costs one
+  message, not K);
+- each decode step reports ``ctx.report_step(steps, phase="serving")`` —
+  the driver's hang watchdog therefore covers the decode loop itself
+  (a wedged device dispatch stops the step counter and trips
+  ``step_timeout``/staleness exactly like a wedged training step), and
+  chaos plans get their deterministic ``at_step`` trigger;
+- response messages piggyback the batcher's
+  :meth:`~tensorflowonspark_tpu.models.serving.ContinuousBatcher.load`
+  total, giving the scheduler real queue depth for routing;
+- an :class:`~tensorflowonspark_tpu.marker.EndOfFeed` marker (sent by
+  ``cluster.shutdown`` exactly as for a training feed) stops intake; the
+  loop drains its in-flight requests and exits cleanly.
+
+``args`` contract (all keys prefixed ``serve_``):
+
+- ``serve_model_builder(args) -> (cfg, params)`` — a picklable callable
+  (top-level function) building the model in the worker process;
+- ``serve_max_batch`` (default 4), ``serve_eos_id`` (default None),
+  ``serve_batcher_kwargs`` (extra ``ContinuousBatcher`` kwargs, e.g.
+  ``decode_block_steps``/``speculative_k`` — note blocks trade intake
+  latency for dispatch amortization);
+- ``serve_idle_poll`` / ``serve_busy_poll`` — intake timeouts (secs).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as _queue
+
+from tensorflowonspark_tpu.marker import EndOfFeed, Marker
+from tensorflowonspark_tpu.serving.scheduler import (REQUEST_QUEUE,
+                                                     RESPONSE_QUEUE)
+
+logger = logging.getLogger(__name__)
+
+
+def serve_replica(args, ctx) -> None:
+    """The serving-tier ``map_fun``: serve generate requests until the
+    driver sends ``EndOfFeed``."""
+    # jax (and the model stack) import inside the worker process only —
+    # the harness contract is that no jax import happens before map_fun
+    from tensorflowonspark_tpu.models.serving import ContinuousBatcher
+
+    cfg, params = args["serve_model_builder"](args)
+    batcher = ContinuousBatcher(
+        cfg, params,
+        max_batch=int(args.get("serve_max_batch", 4)),
+        eos_id=args.get("serve_eos_id"),
+        **dict(args.get("serve_batcher_kwargs") or {}))
+    mgr = ctx.mgr
+    if mgr is None:
+        raise RuntimeError("serve_replica needs the node queue server "
+                           "(InputMode.SPARK)")
+    idle_poll = float(args.get("serve_idle_poll", 0.5))
+    busy_poll = float(args.get("serve_busy_poll", 0.005))
+
+    deltas: dict[int, list[int]] = {}   # batcher rid -> tokens this step
+
+    def on_token(brid: int, tok: int) -> None:
+        deltas.setdefault(brid, []).append(int(tok))
+
+    rid_map: dict[int, int] = {}        # batcher rid -> scheduler rid
+    stopping = False
+    steps = 0
+    served = 0
+
+    def busy() -> bool:
+        return batcher.load()["total"] > 0
+
+    logger.info("replica %d serving (max_batch=%d)", ctx.executor_id,
+                batcher.max_batch)
+    while True:
+        while not stopping and batcher.has_free_slot():
+            try:
+                item = mgr.queue_get(REQUEST_QUEUE,
+                                     timeout=busy_poll if busy()
+                                     else idle_poll)
+            except (_queue.Empty, TimeoutError):
+                break
+            if isinstance(item, EndOfFeed):
+                stopping = True
+                break
+            if isinstance(item, Marker):
+                continue
+            if not (isinstance(item, dict) and item.get("op") == "gen"):
+                logger.warning("replica %d: ignoring non-request item %r",
+                               ctx.executor_id, type(item))
+                continue
+            try:
+                brid = batcher.submit(
+                    item["prompt"], int(item["max_new_tokens"]),
+                    temperature=float(item.get("temperature", 0.0)),
+                    top_p=float(item.get("top_p", 1.0)),
+                    seed=int(item.get("seed", 0)), on_token=on_token)
+            except ValueError as e:
+                # a malformed request must not kill the replica; bounce
+                # the typed error back to the scheduler
+                mgr.queue_put(RESPONSE_QUEUE,
+                              {"rid": item.get("rid"), "event": "error",
+                               "error": str(e)})
+                continue
+            rid_map[brid] = item["rid"]
+        if not busy():
+            if stopping:
+                break
+            continue
+        done = batcher.step()
+        steps += 1
+        # serving-phase heartbeat: arms the hang watchdog on the decode
+        # loop and gives chaos its at_step trigger
+        ctx.report_step(steps, phase="serving")
+        load = batcher.load()["total"]
+        for brid, toks in deltas.items():
+            mgr.queue_put(RESPONSE_QUEUE,
+                          {"rid": rid_map[brid], "event": "tok",
+                           "tokens": toks, "load": load})
+        deltas.clear()
+        for brid in done:
+            batcher.result(brid, pop=True)  # tokens already streamed
+            mgr.queue_put(RESPONSE_QUEUE,
+                          {"rid": rid_map.pop(brid), "event": "done",
+                           "load": load})
+            served += 1
+    logger.info("replica %d drained: %d requests over %d steps "
+                "(%d prefill + %d decode dispatches)", ctx.executor_id,
+                served, steps, batcher.prefill_dispatches,
+                batcher.decode_dispatches)

@@ -1,0 +1,534 @@
+"""Driver-side request scheduler: admission, routing, re-queue on death.
+
+The scheduler is the piece between the :class:`~tensorflowonspark_tpu.
+serving.frontend.ServeFrontend` (which owns client connections) and the
+per-worker replica loops (:func:`~tensorflowonspark_tpu.serving.replica.
+serve_replica`).  It speaks to each replica through the node's existing
+queue data plane — a :class:`~tensorflowonspark_tpu.queues.QueueClient`
+pair per replica (one for request puts, one for streamed-response gets,
+so a blocked read never serializes behind a write on the shared
+connection lock), which transparently negotiates the zero-copy shm
+transport when driver and replica share a host (``shm.py``).
+
+Scheduling policy (docs/serving.md):
+
+- **Admission control** — a bounded global queue: when queued + in-flight
+  requests reach ``max_queue_depth``, ``submit`` raises a typed
+  :class:`RequestRejected` (``reason="queue_full"``) instead of letting an
+  overloaded service build an unbounded latency backlog.  Shedding at
+  admission is the serving-tier analogue of the data plane's bounded
+  queue backpressure.
+- **Routing** — least-outstanding-requests: a request is dispatched to
+  the alive replica with the fewest driver-tracked in-flight requests
+  (ties broken by the replica's last self-reported
+  :meth:`~tensorflowonspark_tpu.models.serving.ContinuousBatcher.load`),
+  bounded per replica by ``slots x overcommit`` so one replica's local
+  queue can never absorb the whole backlog.
+- **Deadlines** — a request's ``timeout`` covers its time in the
+  scheduler: expired while queued → typed :class:`DeadlineExceeded`
+  before any replica sees it; expired while streaming → the frontend
+  abandons it (tokens already computed are discarded, the replica runs
+  the slot to completion — a deliberately simple contract, the deadline
+  bounds what the *client* waits for).
+- **Failure handling** — replica deaths arrive from three independent
+  signals: the :class:`~tensorflowonspark_tpu.health.ClusterMonitor`'s
+  classified failures (``on_cluster_failure``), the supervisor's
+  ``backend.exitcodes()`` poll, and transport errors on the replica's
+  queue connections.  A dead replica's in-flight requests are re-queued
+  ONCE to the survivors at the FRONT of the queue; because decode output
+  is a pure function of the request (the ContinuousBatcher contract),
+  the replay regenerates the identical token sequence and the scheduler
+  suppresses the first ``len(delivered)`` tokens, so a client mid-stream
+  observes an uninterrupted exact stream across the failover.  A second
+  death fails the request with a typed :class:`ReplicaFailed`.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import itertools
+import logging
+import os
+import queue as _queue
+import threading
+import time
+
+import numpy as np
+
+from tensorflowonspark_tpu import observability
+from tensorflowonspark_tpu.queues import QueueClient
+
+logger = logging.getLogger(__name__)
+
+#: serving traffic rides the node's standard data-plane queues — the
+#: shm fast path, queue_depth bound and EndOfFeed shutdown all come for
+#: free (cluster.shutdown drains replicas exactly like a training feed)
+REQUEST_QUEUE = "input"
+RESPONSE_QUEUE = "output"
+
+
+class ServingError(RuntimeError):
+    """Base class for typed serving-tier failures."""
+
+
+class RequestRejected(ServingError):
+    """Load-shed at admission: the request never entered the queue.
+
+    ``reason`` is machine-readable: ``queue_full`` (bounded queue depth
+    reached), ``shutdown`` (scheduler stopping), ``no_replica`` (every
+    replica is dead)."""
+
+    def __init__(self, reason: str, message: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed before it completed."""
+
+
+class ReplicaFailed(ServingError):
+    """The request was lost to replica failure(s) after its one re-queue
+    (or no replica survives to run it)."""
+
+
+class ServeRequest:
+    """One in-flight generate request, owned by the scheduler.
+
+    ``events`` is the delivery channel to whoever is waiting (the
+    frontend's connection thread): ``("tok", [t...])`` deltas,
+    ``("done", n_tokens)``, or ``("err", reason, message)``.  ``tokens``
+    accumulates every delta already delivered — the replay-dedup source
+    and the non-streaming result.
+    """
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "temperature", "top_p",
+                 "seed", "deadline", "events", "tokens", "attempts",
+                 "replica", "skip", "created", "first_token_at", "finished")
+
+    def __init__(self, rid: int, prompt, max_new_tokens: int,
+                 temperature: float, top_p: float, seed: int,
+                 deadline: float | None):
+        self.rid = rid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+        self.deadline = deadline          # time.monotonic() deadline | None
+        self.events: _queue.Queue = _queue.Queue()
+        self.tokens: list[int] = []
+        self.attempts = 0
+        self.replica: int | None = None   # executor id currently serving
+        self.skip = 0                     # replay dedup: deltas to suppress
+        self.created = time.monotonic()
+        self.first_token_at: float | None = None
+        self.finished = False
+
+    def message(self) -> dict:
+        """The wire message the replica loop consumes."""
+        return {"op": "gen", "rid": self.rid, "prompt": self.prompt,
+                "max_new_tokens": self.max_new_tokens,
+                "temperature": self.temperature, "top_p": self.top_p,
+                "seed": self.seed}
+
+
+class _Replica:
+    """Driver-side view of one replica worker."""
+
+    def __init__(self, info: dict, max_inflight: int):
+        self.info = info
+        self.eid = int(info["executor_id"])
+        self.max_inflight = int(max_inflight)
+        self.outstanding: dict[int, ServeRequest] = {}
+        self.reported_load = 0   # last ContinuousBatcher.load()["total"]
+        self.alive = True
+        self.send_cli = None
+        self.recv_cli = None
+        self.served = 0
+
+
+class ReplicaScheduler:
+    """Routes generate requests over a cluster of ContinuousBatcher
+    replicas (see module docstring for policy)."""
+
+    def __init__(self, cluster, *, slots_per_replica: int,
+                 overcommit: int = 2, max_queue_depth: int | None = None,
+                 poll_interval: float = 0.25, requeue_limit: int = 1,
+                 client_factory=None, event_log=None):
+        self.cluster = cluster
+        feedable = sorted(
+            (n for n in cluster.cluster_info
+             if n.get("job_name", "worker") in ("worker", "chief", "master")),
+            key=lambda n: n["executor_id"])
+        if not feedable:
+            raise ValueError("serving cluster has no feedable replicas")
+        max_inflight = max(1, int(slots_per_replica) * int(overcommit))
+        self.replicas: dict[int, _Replica] = {
+            n["executor_id"]: _Replica(n, max_inflight) for n in feedable}
+        #: bounded admission queue: queued + in-flight across the tier
+        self.max_queue_depth = int(
+            max_queue_depth if max_queue_depth is not None
+            else 2 * max_inflight * len(self.replicas))
+        self.poll_interval = float(poll_interval)
+        self.requeue_limit = int(requeue_limit)
+        self._client_factory = client_factory or self._default_client
+        self._own_events = event_log is None and bool(
+            getattr(cluster, "working_dir", None))
+        if self._own_events:
+            event_log = observability.EventLog(
+                os.path.join(cluster.working_dir, "serving_events.jsonl"))
+        self.events = event_log
+        self._pending: collections.deque[ServeRequest] = collections.deque()
+        self._requests: dict[int, ServeRequest] = {}
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._ids = itertools.count()
+        self._threads: list[threading.Thread] = []
+        # -- metrics (observability.LatencyHistogram: lock-free record) --
+        self.ttft = observability.LatencyHistogram()
+        self.e2e = observability.LatencyHistogram()
+        self.accepted = 0
+        self.completed = 0
+        self.shed = 0
+        self.expired = 0
+        self.abandoned = 0      # client disconnects, not deadline expiries
+        self.failed = 0
+        self.requeued = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ReplicaScheduler":
+        self._emit("scheduler_started", replicas=sorted(self.replicas),
+                   max_queue_depth=self.max_queue_depth)
+        self._threads = [
+            threading.Thread(target=self._dispatch_loop, name="serve-dispatch",
+                             daemon=True),
+            threading.Thread(target=self._supervise_loop,
+                             name="serve-supervise", daemon=True),
+        ] + [
+            threading.Thread(target=self._recv_loop, args=(rep,),
+                             name=f"serve-recv-{rep.eid}", daemon=True)
+            for rep in self.replicas.values()
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop routing; reject queued/in-flight leftovers as ``shutdown``."""
+        with self._lock:
+            self._stop.set()
+            self._work.notify_all()
+            leftovers = list(self._pending) + [
+                r for rep in self.replicas.values()
+                for r in rep.outstanding.values()]
+            self._pending.clear()
+            for rep in self.replicas.values():
+                rep.outstanding.clear()
+            for req in leftovers:
+                if not req.finished:
+                    self._finish_err(req, "shutdown",
+                                     "scheduler stopped before completion")
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
+        for rep in self.replicas.values():
+            self._close_clients(rep)
+        if self._own_events and self.events is not None:
+            self.events.close()
+            self.events = None
+            self._own_events = False
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Wait for the queue and every replica's in-flight set to empty;
+        False if ``timeout`` elapses first."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = bool(self._pending) or any(
+                    rep.outstanding for rep in self.replicas.values())
+            if not busy:
+                return True
+            time.sleep(0.05)
+        return False
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, *, temperature: float = 0.0,
+               top_p: float = 1.0, seed: int = 0,
+               timeout: float | None = None) -> ServeRequest:
+        """Admit one request (typed rejections; see module docstring)."""
+        with self._lock:
+            if self._stop.is_set():
+                raise RequestRejected("shutdown", "serving tier is stopping")
+            if not any(rep.alive for rep in self.replicas.values()):
+                raise RequestRejected("no_replica", "no replica alive")
+            depth = len(self._pending) + sum(
+                len(rep.outstanding) for rep in self.replicas.values())
+            if depth >= self.max_queue_depth:
+                self.shed += 1
+                raise RequestRejected(
+                    "queue_full",
+                    f"serving queue full ({depth} >= "
+                    f"{self.max_queue_depth} queued+in-flight)")
+            rid = next(self._ids)
+            req = ServeRequest(
+                rid, prompt, max_new_tokens, temperature, top_p, seed,
+                deadline=None if timeout is None
+                else time.monotonic() + float(timeout))
+            self._requests[rid] = req
+            self._pending.append(req)
+            self.accepted += 1
+            self._work.notify()
+        return req
+
+    def abandon(self, req: ServeRequest, reason: str = "expired") -> None:
+        """Stop tracking ``req``: later replica output for it is discarded
+        on arrival.  ``reason`` keeps the metrics honest — ``expired``
+        (frontend-side deadline) vs ``disconnect`` (client went away)."""
+        with self._lock:
+            if req.finished:
+                return
+            req.finished = True
+            self._requests.pop(req.rid, None)
+            with contextlib.suppress(ValueError):
+                self._pending.remove(req)
+            if req.replica is not None:
+                rep = self.replicas.get(req.replica)
+                if rep is not None:
+                    rep.outstanding.pop(req.rid, None)
+                    self._work.notify_all()
+            if reason == "expired":
+                self.expired += 1
+            else:
+                self.abandoned += 1
+
+    # -- failure intake ----------------------------------------------------
+    def on_cluster_failure(self, failure) -> None:
+        """`ClusterMonitor` subscriber: classified crash/hang/preemption."""
+        with self._lock:
+            for eid in getattr(failure, "failed_workers", ()):  # noqa: B007
+                self._mark_dead(int(eid),
+                                f"{getattr(failure, 'kind', 'failure')}: "
+                                f"{failure}")
+
+    def dead_replicas(self) -> set[int]:
+        with self._lock:
+            return {eid for eid, rep in self.replicas.items()
+                    if not rep.alive}
+
+    # -- metrics -----------------------------------------------------------
+    def metrics(self) -> dict:
+        with self._lock:
+            return {
+                "accepted": self.accepted, "completed": self.completed,
+                "shed": self.shed, "expired": self.expired,
+                "abandoned": self.abandoned,
+                "failed": self.failed, "requeued": self.requeued,
+                "queued": len(self._pending),
+                "ttft": self.ttft.summary(), "e2e": self.e2e.summary(),
+                "replicas": {
+                    eid: {"alive": rep.alive,
+                          "outstanding": len(rep.outstanding),
+                          "reported_load": rep.reported_load,
+                          "served": rep.served}
+                    for eid, rep in self.replicas.items()},
+            }
+
+    # -- internals ---------------------------------------------------------
+    def _default_client(self, info: dict):
+        return QueueClient(info["addr"], info["authkey"], timeout=30.0,
+                           shm=self.cluster.cluster_meta.get("queue_shm"))
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self.events is not None:
+            with contextlib.suppress(Exception):
+                self.events.emit(kind, **fields)
+
+    def _close_clients(self, rep: _Replica) -> None:
+        for cli in (rep.send_cli, rep.recv_cli):
+            if cli is not None:
+                with contextlib.suppress(Exception):
+                    cli.close()
+        rep.send_cli = rep.recv_cli = None
+
+    def _pick_replica(self) -> _Replica | None:
+        """Least-outstanding alive replica with spare in-flight capacity
+        (ties by last self-reported batcher load); None when saturated."""
+        best = None
+        for rep in self.replicas.values():
+            if not rep.alive or len(rep.outstanding) >= rep.max_inflight:
+                continue
+            key = (len(rep.outstanding), rep.reported_load)
+            if best is None or key < (len(best.outstanding),
+                                      best.reported_load):
+                best = rep
+        return best
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._work:
+                while not self._pending and not self._stop.is_set():
+                    self._work.wait(0.2)
+                if self._stop.is_set():
+                    return
+                req = self._pending.popleft()
+                if req.finished:
+                    continue
+                if req.deadline is not None \
+                        and time.monotonic() > req.deadline:
+                    self._expire(req)
+                    continue
+                if not any(rep.alive for rep in self.replicas.values()):
+                    self._finish_err(req, "no_replica", "no replica alive")
+                    continue
+                rep = self._pick_replica()
+                if rep is None:            # all replicas saturated: wait
+                    self._pending.appendleft(req)
+                    self._work.wait(0.05)
+                    continue
+                req.replica = rep.eid
+                req.attempts += 1
+                rep.outstanding[req.rid] = req
+            # the put may block on the socket — never under the lock
+            try:
+                if rep.send_cli is None:
+                    rep.send_cli = self._client_factory(rep.info)
+                rep.send_cli.put(REQUEST_QUEUE, req.message(), timeout=30)
+            except Exception as e:
+                # a dead/wedged replica: everything it holds (including
+                # this request) is re-queued or failed by _mark_dead
+                with self._lock:
+                    self._mark_dead(rep.eid, f"request put failed: {e!r}")
+
+    def _expire(self, req: ServeRequest) -> None:
+        self.expired += 1
+        req.finished = True
+        self._requests.pop(req.rid, None)
+        req.events.put(("err", "deadline",
+                        f"deadline exceeded after "
+                        f"{time.monotonic() - req.created:.2f}s in queue"))
+
+    def _finish_err(self, req: ServeRequest, reason: str, msg: str) -> None:
+        self.failed += 1
+        req.finished = True
+        self._requests.pop(req.rid, None)
+        req.events.put(("err", reason, msg))
+
+    # -- replica responses -------------------------------------------------
+    def _recv_loop(self, rep: _Replica) -> None:
+        while not self._stop.is_set() and rep.alive:
+            try:
+                if rep.recv_cli is None:
+                    rep.recv_cli = self._client_factory(rep.info)
+                msg = rep.recv_cli.get(RESPONSE_QUEUE, timeout=0.5)
+            except TimeoutError:
+                continue
+            except Exception as e:
+                if self._stop.is_set():
+                    return
+                with self._lock:
+                    self._mark_dead(rep.eid, f"response channel lost: {e!r}")
+                return
+            if not isinstance(msg, dict):
+                continue
+            self._handle_response(rep, msg)
+
+    def _handle_response(self, rep: _Replica, msg: dict) -> None:
+        rid = msg.get("rid")
+        event = msg.get("event")
+        with self._lock:
+            if "load" in msg:
+                rep.reported_load = int(msg["load"])
+            req = rep.outstanding.get(rid)
+            if req is None or req.finished:
+                return          # abandoned, or replayed on another replica
+            if event == "tok":
+                toks = [int(t) for t in msg.get("tokens", ())]
+                if req.skip:    # replay after failover: dedup the prefix
+                    cut = min(req.skip, len(toks))
+                    req.skip -= cut
+                    toks = toks[cut:]
+                if not toks:
+                    return
+                if req.first_token_at is None:
+                    req.first_token_at = time.monotonic()
+                    self.ttft.record(req.first_token_at - req.created)
+                req.tokens.extend(toks)
+                req.events.put(("tok", toks))
+            elif event == "done":
+                rep.outstanding.pop(rid, None)
+                rep.served += 1
+                req.finished = True
+                self._requests.pop(rid, None)
+                self.completed += 1
+                self.e2e.record(time.monotonic() - req.created)
+                req.events.put(("done", len(req.tokens)))
+                self._work.notify_all()
+            elif event == "error":
+                rep.outstanding.pop(rid, None)
+                self._finish_err(req, "bad_request",
+                                 str(msg.get("error", "replica error")))
+                self._work.notify_all()
+
+    # -- supervision -------------------------------------------------------
+    def _supervise_loop(self) -> None:
+        backend = getattr(self.cluster, "backend", None)
+        exitcodes = getattr(backend, "exitcodes", None)
+        while not self._stop.wait(self.poll_interval):
+            if exitcodes is None:
+                continue
+            try:
+                codes = dict(exitcodes())
+            except Exception:
+                continue
+            with self._lock:
+                for eid, rep in self.replicas.items():
+                    if rep.alive and codes.get(eid) not in (0, None):
+                        self._mark_dead(
+                            eid, f"process exited (code {codes[eid]})")
+
+    def _mark_dead(self, eid: int, reason: str) -> None:
+        """Retire a replica and fail over its in-flight requests (lock
+        held by caller).  Idempotent — death is observed from several
+        independent signals."""
+        rep = self.replicas.get(eid)
+        if rep is None or not rep.alive:
+            return
+        rep.alive = False
+        logger.warning("serving replica %d marked dead: %s", eid, reason)
+        self._emit("replica_dead", replica=eid, reason=reason,
+                   inflight=len(rep.outstanding))
+        stranded = list(rep.outstanding.values())
+        rep.outstanding.clear()
+        self._close_clients(rep)
+        survivors = any(r.alive for r in self.replicas.values())
+        for req in stranded:
+            if req.finished:
+                continue
+            if not survivors:
+                self._finish_err(req, "no_replica",
+                                 f"replica {eid} died and no replica "
+                                 "survives to replay the request")
+            elif req.attempts > self.requeue_limit:
+                self._finish_err(
+                    req, "replica_failed",
+                    f"request lost to replica {eid} after "
+                    f"{req.attempts} attempts (re-queue limit "
+                    f"{self.requeue_limit})")
+            else:
+                # replay from scratch on a survivor; decode determinism
+                # + the skip counter make the client's stream exact
+                self.requeued += 1
+                req.replica = None
+                req.skip = len(req.tokens)
+                self._pending.appendleft(req)
+                self._emit("request_requeued", rid=req.rid,
+                           from_replica=eid, delivered=len(req.tokens))
+        if not survivors:
+            for req in list(self._pending):
+                self._finish_err(req, "no_replica", "no replica alive")
+            self._pending.clear()
+        self._work.notify_all()

@@ -553,6 +553,24 @@ def fn_write_cache_env(args, ctx):
                                  "MISSING"))
 
 
+def serving_tiny_gpt_builder(args):
+    """Model builder for serving-tier tests (``serving.ServingCluster``):
+    a deterministic seeded tiny GPT, rebuilt identically in every replica
+    process AND by the driver-side oracle, so cluster outputs can be
+    asserted greedy-exact against solo ``greedy_generate`` runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab_size=83, hidden_size=32, num_layers=2, num_heads=4,
+                    intermediate_size=64, max_position_embeddings=64,
+                    dtype=jnp.float32, pos_encoding="rope")
+    params = GPT(cfg).init(jax.random.key(int(args.get("seed", 0))),
+                           jnp.ones((1, 4), jnp.int32))["params"]
+    return cfg, params
+
+
 def shm_crash_server(pipe):
     """test_shm consumer-crash fixture: serve a queue (shm negotiation on),
     acknowledge the feed, then die HARD — no finally blocks, no atexit —

@@ -228,6 +228,84 @@ def test_monitor_ignores_clean_exit():
         mon.stop()
 
 
+def test_monitor_keep_polling_reports_each_failure_once_and_survives():
+    """Serving mode (abort_on_failure=False, keep_polling=True): each
+    replica death is classified once, handed to on_failure, and the
+    monitor keeps watching the survivors instead of stopping — a second
+    death is detected too, and the cluster is never aborted."""
+    cluster = FakeCluster(3)
+    seen: list = []
+    mon = _monitor(cluster, {}, hang_timeout=60, abort_on_failure=False,
+                   keep_polling=True, on_failure=seen.append)
+    mon.start()
+    try:
+        cluster.backend.die(1, code=1)
+        failure = mon.wait(timeout=5)
+        assert failure is not None and failure.failed_workers == (1,)
+        assert not cluster.aborted
+
+        cluster.backend.die(2, code=-int(signal.SIGTERM))
+        deadline = time.time() + 5
+        while len(seen) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(mon.failures) == 2, "second death not detected"
+        assert mon.failures[0].kind == health.CRASH
+        assert mon.failures[1].kind == health.PREEMPTION
+        assert [f.failed_workers for f in seen] == [(1,), (2,)]
+        # one report per death: give the poller time to re-trip, if buggy
+        time.sleep(0.2)
+        assert len(mon.failures) == 2
+        assert not cluster.aborted
+    finally:
+        mon.stop()
+
+
+def test_monitor_keep_polling_retires_hung_node_from_watch():
+    """A hang-classified node must be reported once and then retired from
+    the heartbeat check (its payload stays frozen forever)."""
+    payloads = {0: {"seq": 2, "step": 3, "phase": "step"},
+                1: {"seq": 1, "step": None, "phase": "init"}}
+    cluster = FakeCluster(2)
+    seen: list = []
+    mon = _monitor(cluster, payloads, hang_timeout=0.2,
+                   abort_on_failure=False, keep_polling=True,
+                   on_failure=seen.append)
+    mon.start()
+    try:
+        deadline = time.time() + 5
+        while not mon.failures and time.time() < deadline:
+            time.sleep(0.02)
+        assert mon.failures and mon.failures[0].kind == health.HANG
+        assert mon.failures[0].failed_workers == (0,)
+        time.sleep(0.5)      # stale forever; must not re-report
+        assert len(mon.failures) == 1
+        assert not cluster.aborted
+    finally:
+        mon.stop()
+
+
+def test_monitor_on_failure_subscriber_exception_is_contained():
+    """A buggy on_failure subscriber must not kill detection (or the
+    abort that follows it)."""
+    cluster = FakeCluster(1)
+
+    def boom(failure):
+        raise RuntimeError("subscriber bug")
+
+    mon = _monitor(cluster, {}, hang_timeout=60, on_failure=boom)
+    mon.start()
+    try:
+        cluster.backend.die(0, code=1)
+        failure = mon.wait(timeout=5)
+        assert failure is not None and failure.kind == health.CRASH
+        deadline = time.time() + 5  # abort runs just after the wait() event
+        while not cluster.aborted and time.time() < deadline:
+            time.sleep(0.02)
+        assert cluster.aborted      # abort still ran after the bad callback
+    finally:
+        mon.stop()
+
+
 # ------------------------------------------------------- restart policy
 
 def test_classify_failure_user_vs_infra():

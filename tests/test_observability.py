@@ -131,3 +131,72 @@ def test_event_log_jsonl_roundtrip(tmp_path):
     assert [r["kind"] for r in recs] == ["monitor_started", "crash", "abort"]
     assert recs[1]["workers"] == [1]
     assert all(r["t"] >= t0 - 1 for r in recs)
+
+
+def test_event_log_read_skips_truncated_final_line(tmp_path, caplog):
+    """A driver killed mid-emit leaves a partial JSON line; a post-mortem
+    read must keep every good record and skip the fragment with a
+    warning, not raise and lose the whole file."""
+    path = str(tmp_path / "events.jsonl")
+    log = observability.EventLog(path)
+    log.emit("monitor_started", workers=2)
+    log.emit("crash", workers=[0])
+    log.close()
+    with open(path, "a") as f:
+        f.write('{"t": 123.4, "kind": "abo')   # killed mid-write
+
+    import logging
+
+    with caplog.at_level(logging.WARNING,
+                         logger="tensorflowonspark_tpu.observability"):
+        recs = observability.EventLog.read(path)
+    assert [r["kind"] for r in recs] == ["monitor_started", "crash"]
+    assert any("malformed" in r.message for r in caplog.records)
+
+    # mid-file corruption (torn page) must not hide the records after it
+    with open(path, "a") as f:
+        f.write('\n{"t": 125.0, "kind": "late"}\n')
+    recs = observability.EventLog.read(path)
+    assert [r["kind"] for r in recs] == ["monitor_started", "crash", "late"]
+
+
+# -- latency histogram -----------------------------------------------------
+
+def test_latency_histogram_percentiles():
+    h = observability.LatencyHistogram()
+    assert len(h) == 0 and h.percentile(99) is None
+    assert h.summary()["count"] == 0 and h.summary()["p50_secs"] is None
+    for ms in range(1, 101):           # 1..100 ms
+        h.record(ms / 1000.0)
+    s = h.summary()
+    assert s["count"] == 100
+    # nearest-rank: every reported value is an actual sample
+    assert s["p50_secs"] == pytest.approx(0.050)
+    assert s["p95_secs"] == pytest.approx(0.095)
+    assert s["p99_secs"] == pytest.approx(0.099)
+    assert s["max_secs"] == pytest.approx(0.100)
+    assert s["mean_secs"] == pytest.approx(0.0505)
+    assert h.percentile(100) == pytest.approx(0.100)
+
+
+def test_latency_histogram_single_sample_and_concurrent_records():
+    h = observability.LatencyHistogram()
+    h.record(0.25)
+    s = h.summary()
+    assert s["p50_secs"] == s["p99_secs"] == s["max_secs"] == 0.25
+
+    # hot-path contract: record from many threads without a lock
+    import threading
+
+    h2 = observability.LatencyHistogram()
+
+    def worker():
+        for _ in range(500):
+            h2.record(0.001)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(h2) == 8 * 500          # list.append is GIL-atomic

@@ -749,6 +749,92 @@ def test_block_decode_admission_latency_policy():
     assert b2.decode_dispatches < 12           # ... in far fewer dispatches
 
 
+# -- streaming callback + load snapshot -----------------------------------
+
+@pytest.mark.parametrize("kw", [{}, {"decode_block_steps": 8},
+                                {"speculative_k": 3},
+                                {"prefill_chunk": 4}])
+def test_on_token_streams_exactly_the_oracle(kw):
+    """The ``submit(on_token=...)`` stream equals the solo greedy oracle
+    token-for-token, in order, under every decode regime (per-step,
+    scanned blocks, speculative verify, chunked prefill) — discarded
+    block/draft tokens never surface."""
+    cfg, params = _make()
+    rng = np.random.default_rng(30)
+    streamed: dict[int, list] = {}
+
+    def on_token(rid, tok):
+        streamed.setdefault(rid, []).append(tok)
+
+    b = ContinuousBatcher(cfg, params, max_batch=2, **kw)
+    # repetitive prompt so speculation drafts; a long one so chunking
+    # chunks; mixed budgets so slots churn
+    reqs = [(np.tile(np.asarray([7, 11, 23], np.int32), 5), 10),
+            (rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32), 7),
+            (rng.integers(0, cfg.vocab_size, (3,)).astype(np.int32), 1)]
+    rids = [b.submit(p, n, on_token=on_token) for p, n in reqs]
+    results = b.run()
+    for rid, (p, n) in zip(rids, reqs):
+        oracle = _oracle(cfg, params, p, n).tolist()
+        assert streamed[rid] == oracle, f"stream diverged ({kw})"
+        assert results[rid].tolist() == oracle
+    assert not b._on_token, "finished requests must drop their callbacks"
+
+
+def test_on_token_fires_before_finish_and_with_eos():
+    """Tokens stream as they commit (mid-flight, not at the end): after
+    the first step the stream holds exactly the first oracle token while
+    the request is still running; an eos stop truncates the stream
+    exactly like the result."""
+    cfg, params = _make()
+    rng = np.random.default_rng(32)
+    p = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+    oracle = _oracle(cfg, params, p, 6)
+    streamed: list = []
+    b = ContinuousBatcher(cfg, params, max_batch=1)
+    rid = b.submit(p, 6, on_token=lambda r, t: streamed.append((r, t)))
+    b.step()   # admits (prefill commits token 1) + one decode step
+    early = [t for r, t in streamed if r == rid]
+    assert early == oracle[: len(early)].tolist() and 0 < len(early) < 6
+    assert b.result(rid) is None, "tokens must stream BEFORE finish"
+    results = b.run()
+    assert [t for _, t in streamed] == results[rid].tolist() \
+        == oracle.tolist()
+
+    # eos truncation: the stream ends where the result ends (first eos),
+    # not at the budget
+    eos = int(oracle[0])
+    streamed2: list = []
+    b2 = ContinuousBatcher(cfg, params, max_batch=1, eos_id=eos)
+    rid2 = b2.submit(p, 10, on_token=lambda r, t: streamed2.append(t))
+    res2 = b2.run()[rid2]
+    first = list(_oracle(cfg, params, p, 10)).index(eos)
+    assert streamed2 == res2.tolist() \
+        == _oracle(cfg, params, p, 10)[: first + 1].tolist()
+
+
+def test_load_counts_every_live_request_once():
+    cfg, params = _make()
+    b = ContinuousBatcher(cfg, params, max_batch=2, prefill_chunk=4)
+    assert b.load() == {"active": 0, "pending": 0, "reserved": 0,
+                        "total": 0}
+    rng = np.random.default_rng(31)
+    b.submit(rng.integers(0, cfg.vocab_size, (3,)).astype(np.int32), 6)
+    b.submit(rng.integers(0, cfg.vocab_size, (18,)).astype(np.int32), 5)
+    b.submit(rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32), 6)
+    assert b.load() == {"active": 0, "pending": 3, "reserved": 0,
+                        "total": 3}
+    b.step()
+    # short prompt active; the long one is the in-flight chunked
+    # admission (pending, with its slot reserved); the third queued
+    load = b.load()
+    assert load["total"] == 3, load
+    assert load["active"] >= 1 and load["reserved"] == 1, load
+    b.run()
+    assert b.load() == {"active": 0, "pending": 0, "reserved": 0,
+                        "total": 0}
+
+
 def test_block_decode_validation():
     cfg, params = _make()
     with pytest.raises(ValueError, match="decode_block_steps"):

@@ -1,0 +1,492 @@
+"""Distributed online serving tier (``tensorflowonspark_tpu/serving``).
+
+Two layers, mirroring the health tests' split:
+
+- **unit** — ``ReplicaScheduler`` + ``ServeFrontend``/``ServeClient``
+  against deterministic in-process fake replicas, so every policy branch
+  (shed, deadline, least-outstanding routing, requeue-once failover,
+  typed errors, stream dedup across failover) is exercised fast.
+- **integration** — real 2-replica clusters (``LocalProcessBackend``,
+  spawned worker processes hosting ``ContinuousBatcher``), locked
+  greedy-exact against solo ``greedy_generate`` oracles, including a
+  chaos SIGKILL of a replica mid-stream (fast variant tier-1; the soak
+  is ``-m slow``).
+"""
+
+import queue as _queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.serving import (DeadlineExceeded, ReplicaFailed,
+                                           ReplicaScheduler, RequestRejected,
+                                           ServeClient, ServeFrontend)
+
+# --------------------------------------------------------------- fakes
+
+
+class _FakeBackend:
+    def __init__(self, n):
+        self.codes = {i: None for i in range(n)}
+
+    def exitcodes(self):
+        return dict(self.codes)
+
+    def failed(self):
+        return [i for i, c in self.codes.items() if c not in (0, None)]
+
+
+def _fake_tokens(prompt, n):
+    """The fake replica's deterministic 'decode': a pure function of the
+    request, like the real batcher's contract — so a failover replay
+    regenerates the identical sequence."""
+    base = int(np.sum(np.asarray(prompt, np.int64)))
+    return [(base + 7 * k) % 101 for k in range(n)]
+
+
+class _FakeWorld:
+    """N serial fake replicas speaking the serve queue protocol over
+    in-process queues; ``kill(i)`` emulates a SIGKILL (exit code -9,
+    connections start raising)."""
+
+    def __init__(self, n, token_delay=0.0):
+        self.backend = _FakeBackend(n)
+        self.cluster_info = [
+            {"executor_id": i, "job_name": "worker",
+             "addr": ("127.0.0.1", 0), "authkey": b"x"} for i in range(n)]
+        self.cluster_meta = {"queue_shm": False}
+        self.working_dir = None
+        self.token_delay = token_delay
+        self.inq = {i: _queue.Queue() for i in range(n)}
+        self.outq = {i: _queue.Queue() for i in range(n)}
+        self._dead: set[int] = set()
+        self.threads = [threading.Thread(target=self._run, args=(i,),
+                                         daemon=True) for i in range(n)]
+        for t in self.threads:
+            t.start()
+
+    def _run(self, i):
+        while i not in self._dead:
+            try:
+                item = self.inq[i].get(timeout=0.02)
+            except _queue.Empty:
+                continue
+            rid, p = item["rid"], item["prompt"]
+            for k, tok in enumerate(_fake_tokens(p, item["max_new_tokens"])):
+                if i in self._dead:
+                    return               # died mid-stream
+                if self.token_delay:
+                    time.sleep(self.token_delay)
+                self.outq[i].put({"rid": rid, "event": "tok",
+                                  "tokens": [tok], "load": 1})
+            self.outq[i].put({"rid": rid, "event": "done", "load": 0})
+
+    def kill(self, i):
+        self._dead.add(i)
+        self.backend.codes[i] = -9
+
+    def client(self, info):
+        eid, world = info["executor_id"], self
+
+        class _C:
+            def put(self, qname, item, timeout=None):
+                if eid in world._dead:
+                    raise ConnectionError("replica dead")
+                world.inq[eid].put(item)
+
+            def get(self, qname, timeout=0.5):
+                if eid in world._dead:
+                    raise ConnectionError("replica dead")
+                try:
+                    return world.outq[eid].get(timeout=timeout)
+                except _queue.Empty:
+                    raise TimeoutError
+
+            def close(self):
+                pass
+
+        return _C()
+
+
+def _scheduler(world, **kw):
+    kw.setdefault("slots_per_replica", 2)
+    kw.setdefault("poll_interval", 0.05)
+    return ReplicaScheduler(world, client_factory=world.client, **kw)
+
+
+def _collect(req, timeout=10.0):
+    """Drain one request's event stream; returns (tokens, error_or_None)."""
+    toks, deadline = [], time.monotonic() + timeout
+    while True:
+        ev = req.events.get(timeout=max(0.01, deadline - time.monotonic()))
+        if ev[0] == "tok":
+            toks.extend(ev[1])
+        elif ev[0] == "done":
+            return toks, None
+        else:
+            return toks, ev
+
+
+# ------------------------------------------------------- scheduler units
+
+def test_scheduler_routes_and_completes():
+    world = _FakeWorld(2)
+    s = _scheduler(world).start()
+    try:
+        prompts = [np.arange(1, 4 + i, dtype=np.int32) for i in range(6)]
+        reqs = [s.submit(p, 5) for p in prompts]
+        for req, p in zip(reqs, prompts):
+            toks, err = _collect(req)
+            assert err is None and toks == _fake_tokens(p, 5)
+        m = s.metrics()
+        assert m["accepted"] == m["completed"] == 6
+        assert m["shed"] == m["failed"] == m["requeued"] == 0
+        assert m["ttft"]["count"] == 6 and m["e2e"]["p99_secs"] is not None
+        # least-outstanding routing spread work over both replicas
+        assert all(r["served"] > 0 for r in m["replicas"].values())
+    finally:
+        s.stop()
+
+
+def test_scheduler_sheds_at_bounded_depth():
+    world = _FakeWorld(1, token_delay=0.2)   # slow: backlog builds
+    s = _scheduler(world, slots_per_replica=1, overcommit=1,
+                   max_queue_depth=2).start()
+    try:
+        a = s.submit(np.asarray([1], np.int32), 3)
+        b = s.submit(np.asarray([2], np.int32), 3)
+        with pytest.raises(RequestRejected) as ei:
+            s.submit(np.asarray([3], np.int32), 3)
+        assert ei.value.reason == "queue_full"
+        assert s.metrics()["shed"] == 1
+        for req in (a, b):                   # accepted work still completes
+            _, err = _collect(req)
+            assert err is None
+    finally:
+        s.stop()
+
+
+def test_scheduler_expires_queued_request_past_deadline():
+    world = _FakeWorld(1, token_delay=0.2)
+    s = _scheduler(world, slots_per_replica=1, overcommit=1).start()
+    try:
+        blocker = s.submit(np.asarray([1], np.int32), 4)  # owns the slot
+        late = s.submit(np.asarray([2], np.int32), 4, timeout=0.05)
+        toks, err = _collect(late)
+        assert err is not None and err[1] == "deadline" and toks == []
+        assert s.metrics()["expired"] == 1
+        _, err = _collect(blocker)
+        assert err is None
+    finally:
+        s.stop()
+
+
+def test_replica_death_requeues_once_with_exact_stream():
+    """Kill the replica serving a request mid-stream: the request replays
+    on the survivor and the client-visible stream is the exact oracle
+    sequence with no duplicates or gaps (skip-dedup across failover)."""
+    world = _FakeWorld(2, token_delay=0.05)
+    s = _scheduler(world, slots_per_replica=1, overcommit=1).start()
+    try:
+        p = np.asarray([3, 5], np.int32)
+        req = s.submit(p, 8)
+        # wait until some tokens flowed, then kill the serving replica
+        while not req.tokens:
+            time.sleep(0.01)
+        victim = req.replica
+        world.kill(victim)
+        toks, err = _collect(req, timeout=15)
+        assert err is None
+        assert toks == _fake_tokens(p, 8), "failover stream not exact"
+        m = s.metrics()
+        assert m["requeued"] == 1 and m["completed"] == 1
+        assert not m["replicas"][victim]["alive"]
+        assert s.dead_replicas() == {victim}
+    finally:
+        s.stop()
+
+
+def test_replica_death_beyond_requeue_limit_fails_typed():
+    world = _FakeWorld(2, token_delay=0.05)
+    s = _scheduler(world, slots_per_replica=1, overcommit=1,
+                   requeue_limit=0).start()
+    try:
+        req = s.submit(np.asarray([4], np.int32), 8)
+        while not req.tokens:
+            time.sleep(0.01)
+        world.kill(req.replica)
+        _, err = _collect(req, timeout=15)
+        assert err is not None and err[1] == "replica_failed"
+        assert s.metrics()["failed"] == 1
+    finally:
+        s.stop()
+
+
+def test_last_replica_death_fails_no_replica_and_rejects_submits():
+    world = _FakeWorld(1, token_delay=0.05)
+    s = _scheduler(world, slots_per_replica=1, overcommit=1).start()
+    try:
+        req = s.submit(np.asarray([5], np.int32), 8)
+        while not req.tokens:
+            time.sleep(0.01)
+        world.kill(0)
+        _, err = _collect(req, timeout=15)
+        assert err is not None and err[1] == "no_replica"
+        with pytest.raises(RequestRejected) as ei:
+            s.submit(np.asarray([6], np.int32), 2)
+        assert ei.value.reason == "no_replica"
+    finally:
+        s.stop()
+
+
+def test_monitor_failure_subscription_marks_dead():
+    """on_cluster_failure (the ClusterMonitor hook) retires the implicated
+    replica even when its process looks alive (the hang shape)."""
+    from tensorflowonspark_tpu.health import HANG, ClusterFailure
+
+    world = _FakeWorld(2)
+    s = _scheduler(world).start()
+    try:
+        s.on_cluster_failure(ClusterFailure(HANG, "wedged", (1,)))
+        assert s.dead_replicas() == {1}
+        # traffic keeps flowing on the survivor
+        req = s.submit(np.asarray([9], np.int32), 3)
+        toks, err = _collect(req)
+        assert err is None and toks == _fake_tokens([9], 3)
+    finally:
+        s.stop()
+
+
+def test_scheduler_stop_rejects_and_errors_leftovers():
+    world = _FakeWorld(1, token_delay=0.3)
+    s = _scheduler(world).start()
+    req = s.submit(np.asarray([1, 2], np.int32), 5)
+    s.stop()
+    _, err = _collect(req)
+    assert err is not None and err[1] == "shutdown"
+    with pytest.raises(RequestRejected) as ei:
+        s.submit(np.asarray([1], np.int32), 1)
+    assert ei.value.reason == "shutdown"
+
+
+# ------------------------------------------------- frontend/client units
+
+def test_frontend_client_roundtrip_and_typed_shed():
+    """The TCP edge over fake replicas: generate, generate_stream (delta
+    concat == generate), stats, and a typed queue_full rejection."""
+    world = _FakeWorld(2)
+    s = _scheduler(world, max_queue_depth=64).start()
+    fe = ServeFrontend(s, authkey=b"s" * 16)
+    addr = fe.start()
+    try:
+        with ServeClient(addr, b"s" * 16) as c:
+            assert c.ping()
+            p = np.asarray([2, 3, 4], np.int32)
+            got = c.generate(p, 6)
+            assert got.tolist() == _fake_tokens(p, 6)
+            deltas = list(c.generate_stream(p, 6))
+            assert [t for d in deltas for t in d] == _fake_tokens(p, 6)
+            stats = c.stats()
+            assert stats["completed"] == 2
+            assert stats["ttft"]["count"] == 2
+        with pytest.raises(ConnectionError):
+            ServeClient(addr, b"wrong-key-------")
+        # shed: shrink the bound under the scheduler lock-free counters
+        s.max_queue_depth = 0
+        with ServeClient(addr, b"s" * 16) as c, \
+                pytest.raises(RequestRejected) as ei:
+            c.generate(p, 2)
+        assert ei.value.reason == "queue_full"
+    finally:
+        fe.stop()
+        s.stop()
+
+
+def test_frontend_deadline_mid_request_is_typed():
+    world = _FakeWorld(1, token_delay=0.15)
+    s = _scheduler(world, slots_per_replica=1, overcommit=1).start()
+    fe = ServeFrontend(s, authkey=b"s" * 16)
+    addr = fe.start()
+    try:
+        with ServeClient(addr, b"s" * 16) as c, \
+                pytest.raises(DeadlineExceeded):
+            c.generate(np.asarray([1], np.int32), 50, timeout=0.3)
+    finally:
+        fe.stop()
+        s.stop()
+
+
+# ------------------------------------------------------ integration
+
+def _oracle(prompt, n, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import greedy_generate
+    from tests.cluster_funcs import serving_tiny_gpt_builder
+
+    cfg, params = serving_tiny_gpt_builder({"seed": seed})
+    out = greedy_generate(cfg, params,
+                          jnp.asarray(prompt, jnp.int32)[None, :], n)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _requests(rng, n, vocab=83, tmin=3, tmax=9, bmin=4, bmax=12):
+    return [(rng.integers(0, vocab, (int(rng.integers(tmin, tmax)),))
+             .astype(np.int32), int(rng.integers(bmin, bmax)))
+            for _ in range(n)]
+
+
+def _run_serving(tmp_path, worker_env, num_replicas=2, **kw):
+    from tests.cluster_funcs import serving_tiny_gpt_builder
+
+    from tensorflowonspark_tpu.serving import ServingCluster
+
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("reservation_timeout", 120)
+    return ServingCluster.run(
+        serving_tiny_gpt_builder, num_replicas,
+        worker_env=worker_env, working_dir=str(tmp_path), **kw)
+
+
+@pytest.mark.integration
+def test_serving_cluster_end_to_end(tmp_path, worker_env):
+    """Acceptance: N concurrent clients against 2 replicas under
+    staggered admission — every request greedy-exact vs its solo oracle,
+    both replicas served traffic, streaming deltas concat exactly."""
+    serving = _run_serving(tmp_path, worker_env)
+    try:
+        rng = np.random.default_rng(0)
+        reqs = _requests(rng, 12)
+        results: dict[int, list] = {}
+        errors: list = []
+
+        def run_client(cid):
+            try:
+                with serving.client() as c:
+                    for i in range(cid, len(reqs), 4):   # 4-way stagger
+                        p, n = reqs[i]
+                        results[i] = c.generate(p, n).tolist()
+                        time.sleep(0.01 * cid)
+            except Exception as e:                        # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=run_client, args=(cid,))
+                   for cid in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors, errors
+        assert len(results) == len(reqs)
+        for i, (p, n) in enumerate(reqs):
+            assert results[i] == _oracle(p, n), f"request {i} diverged"
+
+        # streaming: delta concat equals the oracle too
+        with serving.client() as c:
+            p, n = reqs[0]
+            deltas = list(c.generate_stream(p, n))
+            assert [t for d in deltas for t in d] == _oracle(p, n)
+            assert len(deltas) > 1, "no incremental streaming happened"
+            stats = c.stats()
+        assert stats["completed"] == len(reqs) + 1
+        assert stats["shed"] == stats["failed"] == 0
+        assert all(r["served"] > 0 for r in stats["replicas"].values()), \
+            f"routing starved a replica: {stats['replicas']}"
+        assert stats["e2e"]["p99_secs"] is not None
+    finally:
+        serving.shutdown(timeout=120)
+
+
+@pytest.mark.integration
+def test_serving_replica_kill_requeues_and_stays_exact(tmp_path, worker_env):
+    """Chaos: SIGKILL replica 1 mid-decode (TFOS_CHAOS at_step trigger on
+    the serving loop's report_step).  Every accepted request must still
+    complete with oracle-exact tokens — in-flight work on the dead
+    replica is re-queued to the survivor — and the death must be
+    recorded (requeued>0 or the dead replica visible in metrics) with
+    zero failed requests."""
+    env = dict(worker_env, TFOS_CHAOS="kill node=1 at_step=4")
+    serving = _run_serving(tmp_path, env)
+    try:
+        rng = np.random.default_rng(1)
+        reqs = _requests(rng, 8, bmin=10, bmax=16)   # long enough to span
+        results: dict[int, list] = {}
+        errors: list = []
+
+        def run_client(cid):
+            try:
+                with serving.client() as c:
+                    for i in range(cid, len(reqs), 2):
+                        p, n = reqs[i]
+                        results[i] = c.generate(p, n, timeout=120).tolist()
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=run_client, args=(cid,))
+                   for cid in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        assert not errors, errors
+        for i, (p, n) in enumerate(reqs):
+            assert results[i] == _oracle(p, n), f"request {i} diverged"
+        m = serving.metrics()
+        assert m["completed"] == len(reqs) and m["failed"] == 0, m
+        assert serving.scheduler.dead_replicas() == {1}, \
+            "chaos kill was not detected"
+    finally:
+        serving.shutdown(timeout=120)
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_serving_kill_soak_under_sustained_load(tmp_path, worker_env):
+    """Soak: sustained staggered traffic while a replica dies mid-run;
+    every accepted request completes exactly, none lost."""
+    env = dict(worker_env, TFOS_CHAOS="kill node=0 at_step=12")
+    serving = _run_serving(tmp_path, env, max_batch=2)
+    try:
+        rng = np.random.default_rng(2)
+        reqs = _requests(rng, 24, bmin=6, bmax=14)
+        results: dict[int, list] = {}
+        errors: list = []
+
+        def run_client(cid):
+            try:
+                with serving.client() as c:
+                    for i in range(cid, len(reqs), 3):
+                        p, n = reqs[i]
+                        results[i] = c.generate(p, n, timeout=180).tolist()
+                        time.sleep(0.05)
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=run_client, args=(cid,))
+                   for cid in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        assert not errors, errors
+        for i, (p, n) in enumerate(reqs):
+            assert results[i] == _oracle(p, n), f"request {i} diverged"
+        m = serving.metrics()
+        assert m["completed"] == len(reqs) and m["failed"] == 0, m
+        assert serving.scheduler.dead_replicas() == {0}
+        events = [e["kind"] for e in _serving_events(tmp_path)]
+        assert "replica_dead" in events
+    finally:
+        serving.shutdown(timeout=180)
+
+
+def _serving_events(tmp_path):
+    import os
+
+    from tensorflowonspark_tpu.observability import EventLog
+
+    path = os.path.join(str(tmp_path), "serving_events.jsonl")
+    return EventLog.read(path) if os.path.exists(path) else []

@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""``tfos-check`` from a fresh checkout — no install step needed.
+
+    python scripts/tfos_check.py [--json] [--baseline analysis_baseline.json] paths...
+
+Thin shim over ``python -m tensorflowonspark_tpu.analysis`` (same flags,
+same exit codes; see docs/analysis.md).  With no arguments it runs the
+repo-wide gate exactly as tier-1 does: whole package + exports-drift check
+against the committed baseline.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tensorflowonspark_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if not argv:  # the gate, as CI runs it
+        argv = ["--exports",
+                "--baseline", os.path.join(REPO_ROOT,
+                                           "analysis_baseline.json"),
+                "--root", REPO_ROOT,
+                os.path.join(REPO_ROOT, "tensorflowonspark_tpu")]
+    sys.exit(main(argv))

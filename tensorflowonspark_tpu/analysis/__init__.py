@@ -1,0 +1,49 @@
+"""``tfos-check`` — project-native static analysis for distributed/JAX
+invariants.
+
+Usage (``docs/analysis.md`` has the full rule catalog):
+
+    python -m tensorflowonspark_tpu.analysis [--json] \
+        [--baseline analysis_baseline.json] paths...
+
+Five rules encode this codebase's invariants — ``closure-capture``,
+``jit-purity``, ``lock-discipline``, ``resource-lifecycle``,
+``broad-except`` — plus the ``exports-drift`` docs/API consistency check.
+The closure-capture invariant is also enforced at runtime by
+:func:`~tensorflowonspark_tpu.analysis.preflight.check_payload`, which
+``TPUCluster.run`` calls before spawning any worker process.
+
+This package must stay import-light (no jax, no heavyweight deps): it runs
+in CI gates, at submit time inside ``TPUCluster.run``, and from the
+``scripts/tfos_check.py`` shim on fresh checkouts.
+"""
+
+from tensorflowonspark_tpu.analysis.broad_except import BroadExceptRule
+from tensorflowonspark_tpu.analysis.closure_capture import ClosureCaptureRule
+from tensorflowonspark_tpu.analysis.engine import (Finding, Rule,  # noqa: F401
+                                                   analyze_paths,
+                                                   analyze_source,
+                                                   load_baseline,
+                                                   new_findings,
+                                                   write_baseline)
+from tensorflowonspark_tpu.analysis.jit_purity import JitPurityRule
+from tensorflowonspark_tpu.analysis.lock_discipline import LockDisciplineRule
+from tensorflowonspark_tpu.analysis.resource_lifecycle import \
+    ResourceLifecycleRule
+
+ALL_RULES = [
+    ClosureCaptureRule,
+    JitPurityRule,
+    LockDisciplineRule,
+    ResourceLifecycleRule,
+    BroadExceptRule,
+]
+
+RULE_IDS = tuple(r.id for r in ALL_RULES)
+
+__all__ = [
+    "ALL_RULES", "RULE_IDS", "Finding", "Rule", "analyze_paths",
+    "analyze_source", "load_baseline", "new_findings", "write_baseline",
+    "BroadExceptRule", "ClosureCaptureRule", "JitPurityRule",
+    "LockDisciplineRule", "ResourceLifecycleRule",
+]

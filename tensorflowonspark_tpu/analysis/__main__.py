@@ -1,0 +1,109 @@
+"""CLI for ``tfos-check``.
+
+    python -m tensorflowonspark_tpu.analysis [--json] \
+        [--baseline analysis_baseline.json] [--write-baseline] \
+        [--rules closure-capture,broad-except] [--exports] paths...
+
+Exit codes: 0 clean (or all findings grandfathered by the baseline),
+1 new findings, 2 usage error.  Default paths: the installed
+``tensorflowonspark_tpu`` package.  ``--write-baseline`` records the
+current findings as the new baseline instead of gating (the explicit
+ratchet-reset step — see docs/analysis.md for when that is legitimate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tensorflowonspark_tpu.analysis import (ALL_RULES, RULE_IDS,
+                                            analyze_paths, load_baseline,
+                                            new_findings, write_baseline)
+from tensorflowonspark_tpu.analysis.exports import check_exports
+
+
+def _package_root() -> str:
+    """Repo/checkout root: the directory holding the package directory."""
+    import tensorflowonspark_tpu
+
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(tensorflowonspark_tpu.__file__)))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tensorflowonspark_tpu.analysis",
+        description="Project-native static analysis for distributed/JAX "
+                    "invariants (docs/analysis.md).")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to analyze (default: the "
+                             "tensorflowonspark_tpu package)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as a JSON array")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file; findings recorded there are "
+                             "grandfathered (ratchet)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write the current findings to --baseline "
+                             "(default analysis_baseline.json) and exit 0")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run "
+                             f"(default: all of {', '.join(RULE_IDS)})")
+    parser.add_argument("--exports", action="store_true",
+                        help="also run the exports-drift docs/API check")
+    parser.add_argument("--root", default=None,
+                        help="path-relativization root (default: the "
+                             "checkout root when paths are defaulted — so "
+                             "baseline keys match from any cwd — else cwd)")
+    args = parser.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - set(RULE_IDS)
+        if unknown:
+            parser.error(f"unknown rule id(s) {sorted(unknown)}; "
+                         f"known: {', '.join(RULE_IDS)}")
+        rules = [cls() for cls in ALL_RULES if cls.id in wanted]
+
+    root = os.path.abspath(
+        args.root or (os.getcwd() if args.paths else _package_root()))
+    paths = args.paths or [os.path.join(_package_root(),
+                                        "tensorflowonspark_tpu")]
+    findings = analyze_paths(paths, rules=rules, root=root)
+    if args.exports:
+        findings = sorted(findings + check_exports(_package_root()),
+                          key=lambda f: (f.path, f.line, f.rule))
+
+    if args.write_baseline:
+        out = args.baseline or "analysis_baseline.json"
+        write_baseline(findings, out)
+        print(f"wrote {len(findings)} finding(s) to {out}")
+        return 0
+
+    baseline = None
+    if args.baseline:
+        if not os.path.exists(args.baseline):
+            print(f"baseline {args.baseline} does not exist "
+                  "(use --write-baseline to create it)", file=sys.stderr)
+            return 2
+        baseline = load_baseline(args.baseline)
+
+    new = new_findings(findings, baseline) if baseline is not None else findings
+    if args.as_json:
+        print(json.dumps([f.to_dict() for f in new], indent=1))
+    else:
+        for f in new:
+            print(f.format())
+        grandfathered = len(findings) - len(new)
+        summary = f"{len(new)} new finding(s)"
+        if baseline is not None:
+            summary += f" ({grandfathered} grandfathered by baseline)"
+        print(summary)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

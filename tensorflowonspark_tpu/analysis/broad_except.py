@@ -1,0 +1,93 @@
+"""Rule ``broad-except``: handlers that swallow ``Exception`` silently.
+
+A ``try/except Exception: pass`` in the data plane or the health monitor
+turns a real failure (dead socket, corrupt shm segment, poisoned queue) into
+a silent no-op that later surfaces as a flaky hang three layers away.  The
+codebase's deliberate swallows (signal handlers, interpreter-shutdown races)
+must say so: either narrow the type, log with context, re-raise, or carry a
+``# tfos: ignore[broad-except]`` comment explaining why.
+
+A handler counts as *handling* the error when its body re-raises, calls a
+logging-ish function (``logger.*`` / ``logging.*`` / ``warnings.warn`` /
+``traceback.*``), or uses the bound exception name (``except Exception as
+e: errors.append(e)`` propagates the error, it doesn't swallow it).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tensorflowonspark_tpu.analysis.engine import FileContext, Finding, Rule
+
+_BROAD = {"Exception", "BaseException"}
+_LOGGERISH_BASES = {"logger", "logging", "log", "warnings", "traceback",
+                    "_logging"}
+_LOGGERISH_METHODS = {"exception", "warning", "error", "critical", "info",
+                      "debug", "warn", "log", "print_exc", "format_exc"}
+
+
+def _broad_name(type_node: ast.expr | None) -> str | None:
+    """The broad exception name this handler catches, or None if narrow."""
+    if type_node is None:
+        return "bare except"
+    names = []
+    stack = [type_node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Tuple):
+            stack.extend(n.elts)
+        elif isinstance(n, ast.Name):
+            names.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            names.append(n.attr)
+    for name in names:
+        if name in _BROAD:
+            return name
+    return None
+
+
+def _is_loggerish(call: ast.Call) -> bool:
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        base = func.value
+        if isinstance(base, ast.Name) and base.id in _LOGGERISH_BASES:
+            return True
+        if isinstance(base, ast.Attribute) and base.attr in _LOGGERISH_BASES:
+            return True  # self.logger.warning(...)
+        if func.attr in _LOGGERISH_METHODS and isinstance(base, ast.Name) \
+                and base.id.endswith(("logger", "log")):
+            return True
+    return False
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call) and _is_loggerish(node):
+            return True
+        if bound and isinstance(node, ast.Name) and node.id == bound:
+            return True
+    return False
+
+
+class BroadExceptRule(Rule):
+    id = "broad-except"
+    description = ("broad 'except Exception' that neither logs, re-raises, "
+                   "nor uses the exception")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = _broad_name(node.type)
+            if broad is None or _handles(node):
+                continue
+            findings.append(ctx.finding(
+                self.id, node,
+                f"'{'except ' + broad if broad != 'bare except' else broad}' "
+                "swallows the error silently — narrow the type, log with "
+                "context, or re-raise"))
+        return findings

@@ -1,0 +1,186 @@
+"""Rule ``closure-capture``: map_fun payloads that capture unpicklable or
+heavyweight objects.
+
+``TPUCluster.run(map_fun, ...)`` pickles ``map_fun`` into every spawned
+worker (``multiprocessing`` 'spawn').  A nested function that closes over a
+``threading.Lock``, an open socket/file, a live ``QueueClient``, or a jax
+array crashes *inside the child* with a pickle traceback that names none of
+the offending variables.  This rule finds the problem at the submission call
+site: for every nested function passed as a payload to ``TPUCluster.run`` /
+``ServingCluster.run`` / ``run_with_recovery`` / ``TFEstimator``, its free
+variables (exact, via ``symtable``) are matched against enclosing-scope
+assignments from known-bad constructors, and the finding names the variable.
+
+The same invariant is enforced at runtime — against the *actual* objects, so
+it also covers payloads built outside this file — by
+:mod:`tensorflowonspark_tpu.analysis.preflight`, which ``TPUCluster.run``
+invokes before any worker process is spawned.
+"""
+
+from __future__ import annotations
+
+import ast
+import symtable
+
+from tensorflowonspark_tpu.analysis.engine import (
+    FileContext, Finding, Rule, terminal_name as _terminal_name)
+from tensorflowonspark_tpu.analysis.preflight import TFOS_LIVE_CLASSES
+
+# constructor terminal name -> why capturing its result breaks a spawn pickle
+SUSPECT_CONSTRUCTORS = {
+    "Lock": "threading locks are unpicklable",
+    "RLock": "threading locks are unpicklable",
+    "Condition": "condition variables hold a lock and are unpicklable",
+    "Semaphore": "semaphores hold a lock and are unpicklable",
+    "BoundedSemaphore": "semaphores hold a lock and are unpicklable",
+    "Event": "events hold a lock and are unpicklable",
+    "Thread": "thread objects are unpicklable",
+    "Timer": "timer threads are unpicklable",
+    "socket": "open sockets are unpicklable",
+    "create_connection": "open sockets are unpicklable",
+    "open": "open file handles are unpicklable",
+    "SharedMemory": "shm segments must be attached by name in the worker, "
+                    "not pickled",
+    # package-internal live-resource classes come from the preflight's
+    # TFOS_LIVE_CLASSES so the static rule and the submit-time check
+    # cannot drift apart
+    **TFOS_LIVE_CLASSES,
+}
+# jax/jnp factories: the arrays pickle (as host copies) but device buffers
+# don't survive, and shipping weights through the closure is the slow path
+_JAX_BASES = {"jnp", "jax"}
+_PAYLOAD_ENTRY_POINTS = {"TPUCluster", "ServingCluster", "TFCluster"}
+
+
+def _base_name(node: ast.expr) -> str | None:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _suspect_value(value: ast.expr) -> str | None:
+    """Why assigning this expression produces a capture-hostile object."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _terminal_name(value.func)
+    if name in SUSPECT_CONSTRUCTORS:
+        return SUSPECT_CONSTRUCTORS[name]
+    if _base_name(value.func) in _JAX_BASES:
+        return ("jax arrays in a closure are re-pickled to every worker; "
+                "build them inside map_fun (device buffers don't survive "
+                "the spawn)")
+    return None
+
+
+class _Scope:
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.assignments: dict[str, tuple[str, int]] = {}  # name -> (why, line)
+
+
+class ClosureCaptureRule(Rule):
+    id = "closure-capture"
+    description = ("map_fun closures capturing locks/sockets/files/clients/"
+                   "jax arrays that cannot be pickled into spawned workers")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        self._walk(tree, [], ctx, findings)
+        return findings
+
+    # -- scope-tracking walk ----------------------------------------------
+    def _walk(self, node: ast.AST, scopes: list[_Scope], ctx: FileContext,
+              findings: list[Finding]) -> None:
+        if isinstance(node, ast.Assign) and scopes:
+            why = _suspect_value(node.value)
+            if why:
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        scopes[-1].assignments[target.id] = (why, node.lineno)
+        if isinstance(node, ast.Call):
+            self._check_submission(node, scopes, ctx, findings)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            scopes = scopes + [_Scope(node)]
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, scopes, ctx, findings)
+
+    # -- submission sites --------------------------------------------------
+    @staticmethod
+    def _payload_index(call: ast.Call) -> int | None:
+        """Positional index of the map_fun payload, or None if ``call`` is
+        not a submission site.  The reference-compat facade is the one odd
+        signature: ``TFCluster.run(sc, map_fun, ...)`` takes the
+        SparkContext first."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return 0 if func.id in ("run_with_recovery", "TFEstimator") \
+                else None
+        if isinstance(func, ast.Attribute) and func.attr == "run":
+            base = _terminal_name(func.value)
+            if base not in _PAYLOAD_ENTRY_POINTS:
+                return None
+            return 1 if base == "TFCluster" else 0
+        return None
+
+    def _check_submission(self, call: ast.Call, scopes: list[_Scope],
+                          ctx: FileContext, findings: list[Finding]) -> None:
+        idx = self._payload_index(call)
+        if idx is None:
+            return
+        if len(call.args) > idx:
+            payload = call.args[idx]
+        else:  # keyword-style call sites: every entry point names it map_fun
+            payload = next((kw.value for kw in call.keywords
+                            if kw.arg == "map_fun"), None)
+            if payload is None:
+                return
+        fn_node = None
+        if isinstance(payload, ast.Lambda):
+            fn_node = payload
+        elif isinstance(payload, ast.Name):
+            fn_node = self._resolve_local_def(payload.id, scopes)
+        if fn_node is None:
+            return
+        for name in self._free_vars(fn_node, ctx):
+            for scope in reversed(scopes):
+                if name in scope.assignments:
+                    why, _line = scope.assignments[name]
+                    label = getattr(fn_node, "name", "<lambda>")
+                    # no line number in the MESSAGE: it is part of the
+                    # baseline key, which must survive unrelated edits
+                    findings.append(ctx.finding(
+                        self.id, call,
+                        f"map_fun '{label}' captures '{name}': {why} — "
+                        "pass data through tf_args or create the object "
+                        "inside map_fun"))
+                    break
+
+    @staticmethod
+    def _resolve_local_def(name: str, scopes: list[_Scope]) -> ast.AST | None:
+        """The nested FunctionDef bound to ``name`` in an enclosing function
+        scope, if any.  Module-level payload functions are pickled by
+        reference and need no capture check here."""
+        for scope in reversed(scopes):
+            for child in ast.walk(scope.fn):
+                if isinstance(child, ast.FunctionDef) and child.name == name:
+                    return child
+        return None
+
+    @staticmethod
+    def _free_vars(fn_node: ast.AST, ctx: FileContext) -> set[str]:
+        """Exact free variables of the nested function via ``symtable``
+        (matched by name + line)."""
+        table = ctx.symtable()
+        if table is None:
+            return set()
+        want_line = fn_node.lineno
+        want_name = getattr(fn_node, "name", "lambda")
+        stack = [table]
+        while stack:
+            t = stack.pop()
+            if t.get_type() == "function" and t.get_lineno() == want_line \
+                    and t.get_name() in (want_name, "lambda"):
+                return {s.get_name() for s in t.get_symbols() if s.is_free()}
+            stack.extend(t.get_children())
+        return set()

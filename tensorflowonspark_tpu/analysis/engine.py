@@ -1,0 +1,294 @@
+"""AST rule engine for ``tfos-check`` — the project-native static analyzer.
+
+The runtime spans four concurrency-heavy planes (cluster orchestration, the
+shm/socket data plane, the health monitor, the serving scheduler) whose worst
+failure modes are invisible until a worker dies at runtime: an unpicklable
+``map_fun`` closure crashes inside a spawned worker with a useless traceback,
+an impure function under ``jax.jit`` silently freezes a timestamp at trace
+time, a missed lock only surfaces as a flaky hang.  This engine encodes those
+invariants as AST rules — the same role the reference's ``TFCluster.run``
+argument validation played, generalized into a rule engine that gates both CI
+(``tests/test_analysis.py``) and job submission
+(``analysis.preflight`` inside ``TPUCluster.run``).
+
+Architecture (``docs/analysis.md`` has the user-facing catalog):
+
+- each rule is a class with a stable ``id``, a per-file
+  ``check(tree, ctx) -> [Finding]`` and an optional cross-file
+  ``finalize() -> [Finding]`` (used by lock-order cycle detection);
+- findings are suppressed inline with ``# tfos: ignore[rule-id]`` on the
+  offending line or on a comment line directly above it;
+- a committed baseline (``analysis_baseline.json``) makes the CI gate a
+  ratchet, not a flag day: pre-existing findings are grandfathered by
+  (path, rule, message) identity — line numbers deliberately excluded so
+  unrelated edits don't invalidate the baseline — and any NEW finding fails.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from collections import Counter
+
+__all__ = [
+    "Finding", "FileContext", "Rule", "analyze_paths", "analyze_source",
+    "load_baseline", "write_baseline", "new_findings", "iter_py_files",
+    "terminal_name",
+]
+
+
+def terminal_name(node: ast.expr) -> str | None:
+    """'x' for both ``x`` and ``a.b.x`` — the terminal identifier rules
+    match constructors/entry points/call targets by."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+_SUPPRESS_RE = re.compile(r"#\s*tfos:\s*ignore\[([^\]]*)\]")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer hit: rule id, repo-relative path, 1-based line, message."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def key(self) -> str:
+        """Baseline identity — line-independent so edits elsewhere in the
+        file don't churn the committed baseline."""
+        return f"{self.path}::{self.rule}::{self.message}"
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FileContext:
+    """Per-file state shared by every rule: source, parsed tree, path."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self._symtable = None
+
+    def finding(self, rule_id: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule_id, self.path, getattr(node, "lineno", 0), message)
+
+    def symtable(self):
+        """Lazily-built ``symtable`` for exact free-variable queries
+        (closure-capture rule); None if the stdlib compiler rejects the
+        source that ``ast`` accepted (never observed, but cheap to guard)."""
+        if self._symtable is None:
+            import symtable
+
+            try:
+                self._symtable = symtable.symtable(self.source, self.path,
+                                                  "exec")
+            except SyntaxError:
+                return None
+        return self._symtable
+
+
+class Rule:
+    """Base rule: subclass, set ``id``/``description``, implement ``check``.
+
+    A rule instance lives for one ``analyze_paths`` run, so instance
+    attributes are the place for cross-file state consumed by ``finalize``.
+    """
+
+    id: str = ""
+    description: str = ""
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        """Clear cross-file state.  Called at the start of every
+        ``analyze_source``/``analyze_paths`` run so a reused rule instance
+        does not leak one run's finalize() findings into the next."""
+
+    def finalize(self) -> list[Finding]:
+        """Cross-file findings, emitted after every file was checked."""
+        return []
+
+
+def parse_suppressions(source: str) -> dict[int, set[str]]:
+    """Map line number -> suppressed rule ids for ``# tfos: ignore[...]``.
+
+    A suppression on a comment-only line applies to the next code line, so
+    long offending lines can carry the reason above them.
+    """
+    out: dict[int, set[str]] = {}
+    pending: set[str] = set()
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        stripped = line.strip()
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if stripped.startswith("#"):
+                pending |= rules
+            else:
+                # a code line consumes BOTH its inline suppression and any
+                # pending above-line one — otherwise the pending set leaks
+                # onto the next statement
+                out.setdefault(lineno, set()).update(rules | pending)
+                pending = set()
+        elif stripped and not stripped.startswith("#"):
+            if pending:
+                out.setdefault(lineno, set()).update(pending)
+                pending = set()
+    return out
+
+
+def _suppressed(finding: Finding, supp: dict[str, dict[int, set[str]]]) -> bool:
+    rules = supp.get(finding.path, {}).get(finding.line, set())
+    return finding.rule in rules or "all" in rules
+
+
+def iter_py_files(paths) -> list[str]:
+    """Expand files/directories into a sorted list of ``.py`` files,
+    skipping caches and hidden directories.  Deduplicated by realpath:
+    overlapping arguments (``pkg pkg/file.py``) must not analyze a file
+    twice, or the count-aware baseline ratchet reports its grandfathered
+    findings as new."""
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames) if f.endswith(".py"))
+        elif p.endswith(".py") and os.path.isfile(p):
+            files.append(p)
+    out: list[str] = []
+    seen: set[str] = set()
+    for f in files:
+        key = os.path.realpath(f)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def _default_rules() -> list[Rule]:
+    from tensorflowonspark_tpu.analysis import ALL_RULES
+
+    return [cls() for cls in ALL_RULES]
+
+
+def analyze_source(source: str, path: str,
+                   rules: list[Rule] | None = None) -> list[Finding]:
+    """Analyze one in-memory source (unit-fixture entry point).  Runs
+    per-file checks AND finalizers, so single-file lock-order cycles
+    surface too."""
+    rules = rules if rules is not None else _default_rules()
+    for rule in rules:
+        rule.reset()
+    findings, supp = _check_one(source, path, rules)
+    for rule in rules:
+        findings.extend(rule.finalize())
+    findings = [f for f in findings if not _suppressed(f, {path: supp})]
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def analyze_paths(paths, rules: list[Rule] | None = None,
+                  root: str | None = None) -> list[Finding]:
+    """Analyze files/directories; paths in findings are relative to
+    ``root`` (default: cwd) with posix separators, so the baseline is
+    stable across checkouts."""
+    rules = rules if rules is not None else _default_rules()
+    for rule in rules:
+        rule.reset()
+    root = os.path.abspath(root or os.getcwd())
+    findings: list[Finding] = []
+    supp: dict[str, dict[int, set[str]]] = {}
+    for p in paths:
+        # a typo'd/renamed path must fail loudly, not make the gate pass
+        # vacuously with nothing analyzed
+        if not os.path.isdir(p) and not (p.endswith(".py")
+                                         and os.path.isfile(p)):
+            rel = os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
+            findings.append(Finding(
+                "read-error", rel, 0,
+                "path does not exist (or is not a .py file or directory) — "
+                "nothing was analyzed for it"))
+    for fpath in iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(fpath), root).replace(os.sep, "/")
+        try:
+            with open(fpath, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding("read-error", rel, 0, str(e)))
+            continue
+        file_findings, file_supp = _check_one(source, rel, rules)
+        findings.extend(file_findings)
+        supp[rel] = file_supp
+    for rule in rules:
+        findings.extend(rule.finalize())
+    findings = [f for f in findings if not _suppressed(f, supp)]
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def _check_one(source: str, rel: str,
+               rules: list[Rule]) -> tuple[list[Finding], dict[int, set[str]]]:
+    findings: list[Finding] = []
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as e:
+        return ([Finding("syntax-error", rel, e.lineno or 0, e.msg or str(e))],
+                {})
+    ctx = FileContext(rel, source, tree)
+    for rule in rules:
+        findings.extend(rule.check(tree, ctx))
+    return findings, parse_suppressions(source)
+
+
+# -- baseline ratchet ------------------------------------------------------
+
+def load_baseline(path: str) -> Counter:
+    """Load the committed baseline as a multiset of finding keys."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return Counter(
+        Finding(e["rule"], e["path"], 0, e["message"]).key()
+        for e in data.get("findings", []))
+
+
+def write_baseline(findings: list[Finding], path: str) -> None:
+    """Write the current findings as the new baseline (the explicit
+    ratchet-reset step; see docs/analysis.md for when that is legitimate)."""
+    entries = sorted(
+        ({"rule": f.rule, "path": f.path, "message": f.message}
+         for f in findings),
+        key=lambda e: (e["path"], e["rule"], e["message"]))
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": entries}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+
+
+def new_findings(findings: list[Finding], baseline: Counter) -> list[Finding]:
+    """Findings not covered by the baseline.  Count-aware: a baseline with
+    two identical (path, rule, message) entries grandfathers exactly two
+    occurrences — a third is new."""
+    budget = Counter(baseline)
+    out = []
+    for f in findings:
+        if budget[f.key()] > 0:
+            budget[f.key()] -= 1
+        else:
+            out.append(f)
+    return out

@@ -1,0 +1,94 @@
+"""Check ``exports-drift``: the package's public surface vs ``docs/api.md``.
+
+Every public name the package root exports (``tensorflowonspark_tpu/
+__init__.py`` top-level imports/assignments not starting with ``_``) must
+appear in the package-root section of ``docs/api.md``, and vice versa — an
+undocumented export is invisible to users, a documented non-export is a doc
+lie that breaks the first copy-pasted snippet.  Runs as part of the tier-1
+analysis gate and via ``python -m tensorflowonspark_tpu.analysis --exports``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tensorflowonspark_tpu.analysis.engine import Finding
+
+API_SECTION_HEADER = "## `tensorflowonspark_tpu` (package root)"
+_IDENT_RE = re.compile(r"`([A-Za-z_][A-Za-z0-9_]*)`")
+
+
+def public_exports(init_path: str) -> dict[str, int]:
+    """Public name -> line for the package root's exports (imports and
+    plain-name assignments; underscore-prefixed names are private)."""
+    with open(init_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=init_path)
+    out: dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                if not name.startswith("_"):
+                    out.setdefault(name, node.lineno)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and not t.id.startswith("_"):
+                    out.setdefault(t.id, node.lineno)
+    return out
+
+
+def documented_names(api_path: str) -> tuple[set[str], int]:
+    """Backticked identifiers in the package-root section of api.md, plus
+    the section's starting line (for finding locations)."""
+    with open(api_path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    names: set[str] = set()
+    start = 0
+    in_section = False
+    for lineno, line in enumerate(lines, start=1):
+        if line.strip() == API_SECTION_HEADER:
+            in_section = True
+            start = lineno
+            continue
+        if in_section and line.startswith("## "):
+            break
+        if in_section:
+            names.update(_IDENT_RE.findall(line))
+    return names, start
+
+
+def check_exports(root: str) -> list[Finding]:
+    """Findings for both drift directions; empty when init and api.md agree."""
+    init_path = os.path.join(root, "tensorflowonspark_tpu", "__init__.py")
+    api_path = os.path.join(root, "docs", "api.md")
+    # a missing input must fail loudly — a vacuous pass would silently turn
+    # the tier-1 exports gate into a no-op (same rule as analyze_paths)
+    missing = [p for p in (init_path, api_path) if not os.path.exists(p)]
+    if missing:
+        return [Finding("read-error",
+                        os.path.relpath(p, root).replace(os.sep, "/"), 0,
+                        "exports-drift input does not exist — nothing was "
+                        "compared")
+                for p in missing]
+    exported = public_exports(init_path)
+    documented, section_line = documented_names(api_path)
+    if not documented:
+        return [Finding("exports-drift", "docs/api.md", 1,
+                        f"package-root section {API_SECTION_HEADER!r} not "
+                        "found — the exports check has nothing to compare "
+                        "against")]
+    findings: list[Finding] = []
+    for name in sorted(set(exported) - documented):
+        findings.append(Finding(
+            "exports-drift", "tensorflowonspark_tpu/__init__.py",
+            exported[name],
+            f"public export '{name}' is missing from docs/api.md's "
+            "package-root section"))
+    for name in sorted(documented - set(exported)):
+        findings.append(Finding(
+            "exports-drift", "docs/api.md", section_line,
+            f"docs/api.md documents '{name}' in the package-root section "
+            "but the package does not export it"))
+    return findings

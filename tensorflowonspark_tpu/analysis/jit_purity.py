@@ -1,0 +1,225 @@
+"""Rule ``jit-purity``: impure Python inside ``jax.jit``-compiled functions.
+
+``jax.jit`` traces the Python body ONCE and caches the XLA program: a
+``time.time()`` or ``np.random.*`` call inside the traced function freezes
+its value at trace time (every subsequent step reuses the first timestamp /
+random draw), ``print`` fires only during tracing, ``.item()`` / ``float()``
+force a device sync per call, and a Python ``if`` on a traced value either
+fails at trace time or silently specializes the program to the first branch
+taken.  These bugs produce no exception in steady state — only wrong
+numbers — which is why they are worth a static gate.
+
+Detected jit wrappers: ``@jax.jit`` / ``@jit`` / ``@pjit`` /
+``@shard_map(...)`` / ``@partial(jax.jit, ...)`` decorators, and the
+assignment form ``g = jax.jit(f)`` (marks ``f`` by name, same file).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tensorflowonspark_tpu.analysis.engine import (
+    FileContext, Finding, Rule, terminal_name as _terminal_name)
+
+_JIT_NAMES = {"jit", "pjit", "shard_map"}
+# attribute access on a traced array that yields a STATIC (trace-time) value,
+# so branching on it is fine
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_STATIC_CALLS = {"isinstance", "len", "hasattr", "getattr", "callable",
+                 "issubclass"}
+_IMPURE_CALL_BASES = {
+    "time": "time.* reads the host clock at trace time; the value is frozen "
+            "into the compiled program",
+    "random": "Python random.* draws once at trace time; use jax.random with "
+              "an explicit key",
+    "datetime": "datetime.* reads the host clock at trace time",
+}
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """True for an expression that *is* a jit-like transform: ``jax.jit``,
+    ``jit``, ``pjit``, ``shard_map``, or a call on one of those
+    (``jax.jit(...)``, ``partial(jax.jit, static_argnums=0)``)."""
+    if _terminal_name(node) in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        if _terminal_name(node.func) in _JIT_NAMES:
+            return True
+        if _terminal_name(node.func) == "partial" and node.args \
+                and _is_jit_expr(node.args[0]):
+            return True
+    return False
+
+
+def _np_random_call(func: ast.expr) -> bool:
+    """Matches ``np.random.x(...)`` / ``numpy.random.x(...)`` and direct
+    ``np.random(...)``-style bases."""
+    node = func
+    while isinstance(node, ast.Attribute):
+        if node.attr == "random":
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in ("np", "numpy"):
+                return True
+        node = node.value
+    return False
+
+
+class _Parented(ast.NodeVisitor):
+    """Annotates each node with ``._tfos_parent`` for upward walks."""
+
+    def generic_visit(self, node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            child._tfos_parent = node  # type: ignore[attr-defined]
+        super().generic_visit(node)
+
+
+class JitPurityRule(Rule):
+    id = "jit-purity"
+    description = ("host-side effects / traced-value branching inside "
+                   "jit-compiled functions")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
+        _Parented().visit(tree)
+        jit_names = self._assigned_jit_names(tree)
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and (
+                    any(_is_jit_expr(d) for d in node.decorator_list)
+                    or node.name in jit_names):
+                findings.extend(self._check_jit_fn(node, ctx))
+        return findings
+
+    @staticmethod
+    def _assigned_jit_names(tree: ast.Module) -> set[str]:
+        """Functions jit-wrapped by assignment: ``g = jax.jit(f)``."""
+        names: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _terminal_name(node.func) in \
+                    _JIT_NAMES and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                names.add(node.args[0].id)
+        return names
+
+    def _check_jit_fn(self, fn: ast.FunctionDef,
+                      ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        params = self._tainted_names(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                msg = self._impure_call(node, params)
+                if msg:
+                    findings.append(ctx.finding(
+                        self.id, node, f"inside jit function "
+                        f"'{fn.name}': {msg}"))
+            elif isinstance(node, ast.If):
+                traced = self._traced_test_names(node.test, params)
+                if traced:
+                    findings.append(ctx.finding(
+                        self.id, node, f"inside jit function '{fn.name}': "
+                        f"Python 'if' branches on traced value(s) "
+                        f"{', '.join(sorted(traced))} — the trace "
+                        "specializes to one branch; use lax.cond/jnp.where "
+                        "or mark the argument static"))
+        return findings
+
+    @staticmethod
+    def _static_params(fn: ast.FunctionDef) -> set[str]:
+        """Parameter names declared static via ``static_argnums`` /
+        ``static_argnames`` in a jit decorator — jit re-traces on their
+        value, so Python branching on them is valid and must not be
+        flagged."""
+        positional = fn.args.posonlyargs + fn.args.args
+        static: set[str] = set()
+        for dec in fn.decorator_list:
+            if not (isinstance(dec, ast.Call) and _is_jit_expr(dec)):
+                continue
+            for kw in dec.keywords:
+                if kw.arg == "static_argnums":
+                    for v in ast.walk(kw.value):
+                        if isinstance(v, ast.Constant) \
+                                and isinstance(v.value, int) \
+                                and 0 <= v.value < len(positional):
+                            static.add(positional[v.value].arg)
+                elif kw.arg == "static_argnames":
+                    for v in ast.walk(kw.value):
+                        if isinstance(v, ast.Constant) \
+                                and isinstance(v.value, str):
+                            static.add(v.value)
+        return static
+
+    def _tainted_names(self, fn: ast.FunctionDef) -> set[str]:
+        """Parameters plus locals derived from them (fixpoint over
+        assignments): ``loss = jnp.mean(batch)`` makes ``loss`` traced,
+        while ``n = batch.shape[0]`` stays static (the same static-read
+        exclusions as the branch check apply).  Parameters declared via
+        ``static_argnums``/``static_argnames`` are never tainted."""
+        tainted = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                   + fn.args.kwonlyargs)}
+        tainted -= self._static_params(fn)
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                    continue
+                if not self._traced_test_names(node.value, tainted):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and n.id not in tainted:
+                            tainted.add(n.id)
+                            changed = True
+        return tainted
+
+    def _impure_call(self, call: ast.Call,
+                     traced: set[str]) -> str | None:
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id == "print":
+                return ("print() fires only at trace time; use "
+                        "jax.debug.print")
+            # float(batch.shape[0]) is a static read and stays clean —
+            # flag only when the argument actually reads a traced value
+            if func.id in ("float", "int", "bool") and call.args and \
+                    self._traced_test_names(call.args[0], traced):
+                return (f"{func.id}() on a traced value forces "
+                        "concretization (trace error or per-call sync)")
+            return None
+        if isinstance(func, ast.Attribute):
+            if func.attr == "item" and not call.args:
+                return (".item() forces a device sync per call; keep values "
+                        "on device or return them")
+            # bare module calls only (time.time(), random.random()):
+            # jax.random.* / np.random.* must not match here
+            if isinstance(func.value, ast.Name) and \
+                    func.value.id in _IMPURE_CALL_BASES:
+                return _IMPURE_CALL_BASES[func.value.id]
+            if _np_random_call(func):
+                return ("np.random draws once at trace time; use jax.random "
+                        "with an explicit key")
+        return None
+
+    @staticmethod
+    def _traced_test_names(test: ast.expr, params: set[str]) -> set[str]:
+        """Parameter names the test reads as (potentially traced) VALUES —
+        excluding static reads: ``x.shape``-style attributes, ``is None``
+        comparisons, and calls like ``isinstance``/``len``."""
+        traced: set[str] = set()
+        for node in ast.walk(test):
+            if not (isinstance(node, ast.Name) and node.id in params):
+                continue
+            parent = getattr(node, "_tfos_parent", None)
+            if isinstance(parent, ast.Attribute) and \
+                    parent.attr in _STATIC_ATTRS:
+                continue
+            if isinstance(parent, ast.Call) and \
+                    _terminal_name(parent.func) in _STATIC_CALLS:
+                continue
+            if isinstance(parent, ast.Compare) and any(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in parent.ops):
+                continue
+            traced.add(node.id)
+        return traced

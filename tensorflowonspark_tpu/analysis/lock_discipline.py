@@ -1,0 +1,353 @@
+"""Rule ``lock-discipline``: unsynchronized cross-thread mutation and lock
+ordering.
+
+Two checks, both scoped to classes because that is where this codebase keeps
+its thread state (``health.py``'s monitor/reporter, ``serving/scheduler.py``,
+``shm.py``'s segment ring, ``queues.py``'s server):
+
+1. **Unlocked shared mutation.**  A method becomes a *thread entry point*
+   when any method of the class passes it as ``threading.Thread(target=
+   self.m)`` / ``Timer(..., self.m)``; entry-ness propagates through
+   ``self.helper()`` calls.  An instance attribute mutated both from
+   thread-entry code and from main-thread methods must hold the owning lock
+   (a ``with self.<lock>:`` ancestor) at EVERY mutation site; the first
+   unlocked site is flagged.  ``__init__`` is exempt (no thread exists yet).
+
+2. **Lock-acquisition order.**  Every ``with self.<lockA>:`` that lexically
+   encloses an acquisition of ``self.<lockB>`` contributes the edge
+   ``path::Class.lockA -> path::Class.lockB`` to a graph accumulated across
+   all files of the run; cycles (AB-BA deadlock potential) are reported from
+   ``finalize()`` with the full chain.  Nodes are qualified by file + class
+   so two unrelated classes sharing a name never merge into a phantom
+   cycle — which also means only conflicts among one class's own locks
+   (``self.<attr>`` acquisitions) are detectable, the shape this codebase's
+   threaded subsystems actually have.
+
+Lock attributes are recognized by assignment (``self.x = threading.Lock()``
+/ ``RLock`` / ``Condition``) or by name (an underscore-separated segment
+equal to ``lock``/``rlock``/``cond``/``condition``/``mutex`` — exact
+segments, so ``clock`` or ``poll_seconds`` never count as locks).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tensorflowonspark_tpu.analysis.engine import (
+    FileContext, Finding, Rule, terminal_name as _terminal_name)
+
+_LOCK_CONSTRUCTORS = {"Lock", "RLock", "Condition"}
+_MUTATING_METHODS = {"append", "add", "pop", "popleft", "update", "remove",
+                     "discard", "clear", "extend", "insert", "setdefault"}
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    """'x' for a ``self.x`` expression, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lockish_name(attr: str) -> bool:
+    """Exact underscore-segment match only: ``_lock``, ``state_lock``,
+    ``_cond`` — NOT ``clock``/``poll_seconds``/``blocked_count``, whose
+    substrings would otherwise exempt real shared state from the
+    mutation check (or invent phantom locks)."""
+    segments = attr.lower().split("_")
+    return any(s in ("lock", "rlock", "cond", "condition", "mutex")
+               for s in segments)
+
+
+class _MutationSite:
+    def __init__(self, method: str, node: ast.AST, locked: bool):
+        self.method = method
+        self.node = node
+        self.locked = locked
+
+
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    description = ("cross-thread attribute mutation without the owning lock; "
+                   "lock-acquisition-order cycles")
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        # path::Class.attr -> path::Class.attr edges with one witness site
+        # per edge, accumulated across every file of ONE run (finalize
+        # detects cycles; reset keeps reused instances from leaking runs)
+        self._order_edges: dict[tuple[str, str], Finding] = {}
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(node, ctx))
+        return findings
+
+    # -- per-class analysis ------------------------------------------------
+    def _check_class(self, cls: ast.ClassDef,
+                     ctx: FileContext) -> list[Finding]:
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, ast.FunctionDef)}
+        lock_attrs = self._lock_attrs(cls)
+
+        # thread entry points + transitive closure over self.helper() calls
+        entries = self._thread_entries(methods)
+        calls = {name: self._self_calls(m) for name, m in methods.items()}
+        frontier = list(entries)
+        while frontier:
+            m = frontier.pop()
+            for callee in calls.get(m, ()):
+                if callee in methods and callee not in entries:
+                    entries.add(callee)
+                    frontier.append(callee)
+
+        # mutation sites per attribute, with lock-held state
+        sites: dict[str, list[_MutationSite]] = {}
+        for name, m in methods.items():
+            if name == "__init__":
+                continue
+            for attr, node, locked in self._mutations(m, lock_attrs):
+                sites.setdefault(attr, []).append(
+                    _MutationSite(name, node, locked))
+            self._collect_order_edges(cls.name, m, lock_attrs, ctx)
+
+        findings: list[Finding] = []
+        if not entries:
+            return findings
+        for attr, attr_sites in sorted(sites.items()):
+            if attr in lock_attrs or _lockish_name(attr):
+                continue
+            from_thread = [s for s in attr_sites if s.method in entries]
+            from_main = [s for s in attr_sites if s.method not in entries]
+            if not from_thread or not from_main:
+                continue
+            unlocked = [s for s in attr_sites if not s.locked]
+            if not unlocked:
+                continue
+            s = unlocked[0]
+            findings.append(ctx.finding(
+                self.id, s.node,
+                f"{cls.name}.{attr} is mutated from thread target(s) "
+                f"{sorted({x.method for x in from_thread})} and main-thread "
+                f"method(s) {sorted({x.method for x in from_main})}, but "
+                f"'{s.method}' mutates it without holding a lock"))
+        return findings
+
+    @staticmethod
+    def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+        attrs: set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                if _terminal_name(node.value.func) in _LOCK_CONSTRUCTORS:
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            attrs.add(attr)
+        return attrs
+
+    @staticmethod
+    def _thread_entries(methods: dict[str, ast.FunctionDef]) -> set[str]:
+        entries: set[str] = set()
+        for m in methods.values():
+            for node in ast.walk(m):
+                if not (isinstance(node, ast.Call)
+                        and _terminal_name(node.func) in ("Thread", "Timer")):
+                    continue
+                cands = [kw.value for kw in node.keywords
+                         if kw.arg == "target"]
+                cands.extend(node.args)
+                for cand in cands:
+                    attr = _self_attr(cand)
+                    if attr:
+                        entries.add(attr)
+        return entries
+
+    @staticmethod
+    def _self_calls(m: ast.FunctionDef) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(m):
+            if isinstance(node, ast.Call):
+                attr = _self_attr(node.func)
+                if attr:
+                    out.add(attr)
+        return out
+
+    # lock-held walk: recursive descent carrying the set of held locks
+    def _mutations(self, m: ast.FunctionDef, lock_attrs: set[str]
+                   ) -> list[tuple[str, ast.AST, bool]]:
+        out: list[tuple[str, ast.AST, bool]] = []
+        # project convention: a helper whose docstring declares "lock held"
+        # (i.e. the caller acquires the lock) counts as locked throughout —
+        # the lexical walk cannot see the caller's `with self._lock:`
+        doc = " ".join((ast.get_docstring(m) or "").lower().split())
+        caller_locked = "lock held" in doc
+
+        def walk(node: ast.AST, held: bool) -> None:
+            if isinstance(node, ast.With):
+                acquires = any(
+                    self._acquired_lock(item.context_expr, lock_attrs)
+                    for item in node.items)
+                for child in node.body:
+                    walk(child, held or acquires)
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        out.append((attr, node, held))
+                    # self.x[k] = v mutates self.x
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr:
+                            out.append((attr, node, held))
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATING_METHODS:
+                attr = _self_attr(node.func.value)
+                if attr:
+                    out.append((attr, node, held))
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                walk(child, held)
+
+        for stmt in m.body:
+            walk(stmt, caller_locked)
+        # explicit acquire()/release() bracketing (the try/finally idiom)
+        # is invisible to the ast.With walk above — upgrade any mutation
+        # whose line falls inside a held range
+        ranges = self._acquire_release_ranges(m, lock_attrs)
+        if ranges:
+            out = [(attr, node,
+                    held or any(a < getattr(node, "lineno", 0) <= b
+                                for a, b in ranges))
+                   for attr, node, held in out]
+        return out
+
+    @staticmethod
+    def _acquire_release_ranges(m: ast.FunctionDef, lock_attrs: set[str]
+                                ) -> list[tuple[int, int]]:
+        """Line ranges where an explicit ``self.<lock>.acquire()`` ...
+        ``release()`` pair holds a lock.  An unmatched acquire holds to the
+        end of the method."""
+        events: list[tuple[int, str, str]] = []
+        for node in ast.walk(m):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("acquire", "release"):
+                attr = _self_attr(node.func.value)
+                if attr and (attr in lock_attrs or _lockish_name(attr)):
+                    events.append((node.lineno, node.func.attr, attr))
+        ranges: list[tuple[int, int]] = []
+        open_at: dict[str, int] = {}
+        for line, kind, attr in sorted(events):
+            if kind == "acquire":
+                open_at.setdefault(attr, line)
+            elif attr in open_at:
+                ranges.append((open_at.pop(attr), line))
+        end = getattr(m, "end_lineno", None) or 0
+        ranges.extend((line, max(line, end)) for line in open_at.values())
+        return ranges
+
+    @staticmethod
+    def _acquired_lock(expr: ast.expr, lock_attrs: set[str]) -> str | None:
+        """'x' when ``expr`` acquires ``self.x``: ``with self.x:`` or
+        ``self.x.acquire()``."""
+        attr = _self_attr(expr)
+        if attr and (attr in lock_attrs or _lockish_name(attr)):
+            return attr
+        if isinstance(expr, ast.Call) and \
+                isinstance(expr.func, ast.Attribute) and \
+                expr.func.attr == "acquire":
+            attr = _self_attr(expr.func.value)
+            if attr and (attr in lock_attrs or _lockish_name(attr)):
+                return attr
+        return None
+
+    # -- lock-order graph --------------------------------------------------
+    def _collect_order_edges(self, cls_name: str, m: ast.FunctionDef,
+                             lock_attrs: set[str], ctx: FileContext) -> None:
+        # nodes are keyed by file + class: two unrelated classes that happen
+        # to share a name (and lock names) must not have their edges merged
+        # into a phantom cycle
+        qual = f"{ctx.path}::{cls_name}"
+
+        def walk(node: ast.AST, held: list[str]) -> None:
+            acquired: list[str] = []
+
+            def add(lock: str) -> None:
+                # a multi-item `with self._b, self._a:` acquires
+                # SEQUENTIALLY — earlier items count as held for later
+                # ones, or the classic one-line AB-BA pair goes unseen
+                inner = f"{qual}.{lock}"
+                for outer in held + acquired:
+                    if outer != inner:
+                        self._order_edges.setdefault(
+                            (outer, inner),
+                            ctx.finding(self.id, node,
+                                        f"acquires {inner} while holding "
+                                        f"{outer}"))
+                acquired.append(inner)
+
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lock = self._acquired_lock(item.context_expr, lock_attrs)
+                    if lock:
+                        add(lock)
+            elif isinstance(node, ast.Call):
+                lock = self._acquired_lock(node, lock_attrs)
+                if lock:
+                    add(lock)
+            body = (node.body if isinstance(node, ast.With) else
+                    ast.iter_child_nodes(node))
+            for child in body:
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                walk(child, held + acquired)
+
+        for stmt in m.body:
+            walk(stmt, [])
+
+    def finalize(self) -> list[Finding]:
+        """Cycle detection over the accumulated acquisition-order graph."""
+        graph: dict[str, set[str]] = {}
+        for (a, b) in self._order_edges:
+            graph.setdefault(a, set()).add(b)
+
+        findings: list[Finding] = []
+        seen_cycles: set[frozenset] = set()
+        state: dict[str, int] = {}  # 0 unvisited / 1 on-stack / 2 done
+
+        def dfs(n: str, path: list[str]) -> None:
+            state[n] = 1
+            path.append(n)
+            for nxt in sorted(graph.get(n, ())):
+                if state.get(nxt, 0) == 1:
+                    cycle = path[path.index(nxt):] + [nxt]
+                    key = frozenset(cycle)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        witness = self._order_edges[(n, nxt)]
+                        findings.append(Finding(
+                            self.id, witness.path, witness.line,
+                            "lock-acquisition-order cycle "
+                            f"{' -> '.join(cycle)}: two threads taking "
+                            "these locks in opposite orders can deadlock"))
+                elif state.get(nxt, 0) == 0:
+                    dfs(nxt, path)
+            path.pop()
+            state[n] = 2
+
+        for n in sorted(graph):
+            if state.get(n, 0) == 0:
+                dfs(n, [])
+        return findings

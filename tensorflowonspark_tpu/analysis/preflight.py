@@ -1,0 +1,328 @@
+"""Submit-time preflight: reject capture-hostile payloads BEFORE spawn.
+
+``TPUCluster.run`` pickles ``map_fun`` and ``tf_args`` into every worker
+process (``multiprocessing`` 'spawn').  When the payload drags along a
+``threading.Lock``, an open socket/file, or a live ``QueueClient``, the
+failure historically happened *inside the spawned child* — a pickle
+traceback with no mention of which variable was at fault, after the
+reservation server and N processes were already up.
+
+:func:`check_payload` walks the payload's reachable object graph — closure
+cells (by free-variable name), defaults, ``functools.partial`` pieces, bound
+``__self__`` state, instance ``__dict__``s, and containers — and raises
+:class:`PreflightError` naming each offending path it finds, before any
+worker process exists.  The walk is bounded (depth ``_MAX_DEPTH``,
+``_MAX_ITEMS`` per container; a pruned branch is logged at debug), so an
+offender nested pathologically deep can still slip through to the child's
+pickle.  Heavyweight-but-picklable captures (jax arrays: the child rebuilds
+a host copy) are logged as warnings, never rejected.  This is the runtime
+twin of the static ``closure-capture`` rule (same invariant, checked
+against actual objects).
+
+Escape hatch: ``TFOS_NO_PREFLIGHT=1`` skips the check (e.g. for a custom
+in-process backend that never pickles).  Import-light by design: jax and
+package internals are detected by type/module NAME so the analyzer and the
+driver never pay (or require) those imports here.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import io
+import logging
+import socket as socket_mod
+import threading
+
+__all__ = ["PreflightError", "check_payload", "check_payloads",
+           "describe_suspect", "advisory_reason", "TFOS_LIVE_CLASSES"]
+
+logger = logging.getLogger(__name__)
+
+_MAX_DEPTH = 4
+_MAX_ITEMS = 256  # per-container scan bound: preflight must stay O(ms)
+
+DISABLE_ENV = "TFOS_NO_PREFLIGHT"
+
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+# class name -> why capturing a live instance breaks the spawn pickle.
+# Single source of truth shared with the static ``closure-capture`` rule
+# (its SUSPECT_CONSTRUCTORS merges this in) so the CI gate and the
+# submit-time preflight cannot drift apart.
+TFOS_LIVE_CLASSES = {
+    "QueueClient": "live queue clients hold an open socket",
+    "QueueServer": "queue servers hold listening sockets and threads",
+    "ServeClient": "live serving clients hold an open socket",
+    "ShmChannel": "shm channels hold sockets and mapped segments",
+    "SegmentRing": "shm segment rings hold mapped shm segments",
+    "SegmentMap": "shm segment maps hold mapped shm segments",
+}
+
+
+def _fd_backed(obj) -> bool:
+    """True when a file-like object wraps a real OS fd."""
+    try:
+        return isinstance(obj.fileno(), int)
+    except Exception:  # tfos: ignore[broad-except] — UnsupportedOperation,
+        return False   # ValueError on closed files, anything exotic: not fd
+
+
+class PreflightError(TypeError):
+    """A submit payload captures objects that cannot survive the spawn
+    pickle; ``.offenders`` lists ``(path, reason)`` pairs."""
+
+    def __init__(self, name: str, offenders: list[tuple[str, str]]):
+        self.offenders = offenders
+        lines = "\n".join(f"  - {path}: {reason}" for path, reason in offenders)
+        super().__init__(
+            f"{name} cannot be shipped to spawned workers — it captures "
+            f"object(s) that do not survive pickling:\n{lines}\n"
+            "Create these objects inside map_fun (they are per-process by "
+            "nature), or pass plain data through tf_args.  Set "
+            f"{DISABLE_ENV}=1 to skip this preflight for backends that "
+            "never pickle the payload.")
+
+
+def describe_suspect(obj) -> str | None:
+    """Why ``obj`` is capture-hostile, or None if it looks shippable."""
+    if isinstance(obj, _LOCK_TYPES):
+        return "threading lock (unpicklable; locks are per-process)"
+    if isinstance(obj, threading.Thread):
+        return "thread object (unpicklable)"
+    if isinstance(obj, (threading.Condition, threading.Semaphore,
+                        threading.Event)):
+        return f"threading.{type(obj).__name__} (holds a lock; unpicklable)"
+    if isinstance(obj, socket_mod.socket):
+        return "open socket (fds do not cross the spawn boundary)"
+    if isinstance(obj, io.IOBase) and _fd_backed(obj):
+        # fd-backed only: io.BytesIO/StringIO pickle fine and must pass
+        return "open file handle (fds do not cross the spawn boundary)"
+    if inspect.isgenerator(obj):
+        # live generators only — module-level generator FUNCTIONS pickle
+        # by reference like any function
+        return "generator (unpicklable; ship the factory arguments instead)"
+    cls = type(obj)
+    module = getattr(cls, "__module__", "") or ""
+    if module.startswith("multiprocessing.shared_memory") \
+            or cls.__name__ == "SharedMemory":
+        return ("SharedMemory segment (attach by name inside the worker "
+                "instead of pickling the handle)")
+    if module.startswith("tensorflowonspark_tpu") \
+            and cls.__name__ in TFOS_LIVE_CLASSES:
+        return (f"live {cls.__name__} ({TFOS_LIVE_CLASSES[cls.__name__]}; "
+                "workers must open their own)")
+    return None
+
+
+def advisory_reason(obj) -> str | None:
+    """Why ``obj`` is heavyweight-but-shippable — logged as a warning, never
+    fatal: modern jax arrays DO pickle (the child gets a host copy), so
+    rejecting them would fail previously-working submissions."""
+    cls = type(obj)
+    module = getattr(cls, "__module__", "") or ""
+    # detect by module/class NAME so a jax-free driver never imports jax
+    # here: ArrayImpl lives in jaxlib.xla_extension (older: jax.*)
+    if module.split(".", 1)[0] in ("jax", "jaxlib") and "Array" in cls.__name__:
+        return ("jax array in the payload — it pickles (host copy rebuilt "
+                "in each child) but is re-shipped to every worker; prefer "
+                "building arrays inside map_fun")
+    return None
+
+
+def _walk_instance_dict(obj, path: str, depth: int,
+                        seen: dict[int, tuple[object, int]],
+                        offenders: list[tuple[str, str]]) -> None:
+    state = getattr(obj, "__dict__", None)
+    if isinstance(state, dict):
+        for k, v in list(state.items())[:_MAX_ITEMS]:
+            _walk(v, f"{path}.{k}", depth + 1, seen, offenders)
+
+
+def _walk(obj, path: str, depth: int,
+          seen: dict[int, tuple[object, int]],
+          offenders: list[tuple[str, str]]) -> None:
+    if depth > _MAX_DEPTH:
+        # the cutoff is a deliberate cost bound, but it must not be silent:
+        # an offender below this level reaches the worker-side pickle crash
+        # this preflight exists to prevent
+        logger.debug("preflight: depth cutoff at %s — contents below this "
+                     "level were not checked", path)
+        return
+    # map id -> (object, depth-first-seen).  Keeping the object alive stops
+    # a temporary (e.g. a __getstate__() dict) being freed mid-walk and its
+    # address reused by a sibling's state; keeping the depth lets a
+    # revisit at a SHALLOWER depth re-walk contents that were pruned by
+    # the depth cutoff the first time
+    prev = seen.get(id(obj))
+    if prev is not None and prev[1] <= depth:
+        return
+    seen[id(obj)] = (obj, depth)
+
+    reason = describe_suspect(obj)
+    if reason:
+        offenders.append((path, reason))
+        return
+    note = advisory_reason(obj)
+    if note:
+        logger.warning("preflight advisory: %s: %s", path, note)
+        return
+
+    if isinstance(obj, functools.partial):
+        _walk(obj.func, f"{path}.func", depth + 1, seen, offenders)
+        for i, a in enumerate(obj.args[:_MAX_ITEMS]):
+            _walk(a, f"{path}.args[{i}]", depth + 1, seen, offenders)
+        for k, v in list(obj.keywords.items())[:_MAX_ITEMS]:
+            _walk(v, f"{path}.keywords[{k!r}]", depth + 1, seen, offenders)
+        return
+
+    if inspect.ismethod(obj):
+        _walk(obj.__self__, f"{path}.__self__", depth + 1, seen, offenders)
+        return
+
+    if inspect.isfunction(obj):
+        # functions pickle BY REFERENCE (module + qualname lookup): the
+        # worker re-imports the module, so a module-level function's
+        # closure/defaults are NEVER shipped — only a function defined
+        # inside another function, or a lambda, is a problem (it cannot
+        # be found by the worker no matter how clean its captures are —
+        # the single most common spawn-pickle failure)
+        if "<locals>" not in getattr(obj, "__qualname__", "") \
+                and obj.__name__ != "<lambda>":
+            return
+        offenders.append((
+            path,
+            "function defined inside another function (or a lambda) — "
+            "pickled by reference, so the spawned worker cannot import "
+            "it; define it at module level"))
+        # keep walking its captures: the fix is usually "move the def to
+        # module level AND stop capturing that lock" — name both now
+        closure = obj.__closure__ or ()
+        freevars = obj.__code__.co_freevars
+        for name, cell in zip(freevars, closure):
+            try:
+                value = cell.cell_contents
+            except ValueError:  # empty cell (e.g. recursive def)
+                continue
+            _walk(value, f"{path} closure '{name}'", depth + 1, seen,
+                  offenders)
+        for i, d in enumerate(obj.__defaults__ or ()):
+            _walk(d, f"{path} default #{i}", depth + 1, seen, offenders)
+        for k, v in (obj.__kwdefaults__ or {}).items():
+            _walk(v, f"{path} default '{k}'", depth + 1, seen, offenders)
+        return
+
+    if isinstance(obj, dict):
+        for k, v in list(obj.items())[:_MAX_ITEMS]:
+            # keys too: sockets/threads/frozen holders are all hashable
+            _walk(k, f"{path} key {k!r}", depth + 1, seen, offenders)
+            _walk(v, f"{path}[{k!r}]", depth + 1, seen, offenders)
+        # dict SUBCLASSES ship more than their items: defaultdict pickles
+        # its default_factory (a lambda factory dies in the child), and a
+        # subclass instance's __dict__ rides along as reduce state
+        if type(obj) is not dict:
+            factory = getattr(obj, "default_factory", None)
+            if factory is not None:
+                _walk(factory, f"{path}.default_factory", depth + 1, seen,
+                      offenders)
+            _walk_instance_dict(obj, path, depth, seen, offenders)
+        return
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        for i, v in enumerate(list(obj)[:_MAX_ITEMS]):
+            _walk(v, f"{path}[{i}]", depth + 1, seen, offenders)
+        if type(obj) not in (list, tuple, set, frozenset):
+            _walk_instance_dict(obj, path, depth, seen, offenders)
+        return
+
+    # classes pickle by reference like functions: one defined inside a
+    # function cannot be re-imported by the worker — and neither can an
+    # INSTANCE of it (pickle must look the class up to reconstruct it),
+    # __getstate__ or not.  Custom __reduce__ is the one way around that
+    # (a module-level factory), so it is checked first below.
+    if inspect.isclass(obj):
+        if "<locals>" in getattr(obj, "__qualname__", ""):
+            offenders.append((
+                path,
+                "class defined inside a function — pickled by reference, "
+                "so the spawned worker cannot import it; define it at "
+                "module level"))
+        return
+
+    # honor custom pickling before inspecting raw __dict__: an object that
+    # defines __getstate__ (or overrides __reduce__/__reduce_ex__) controls
+    # what pickle actually ships — a holder that drops its Lock in
+    # __getstate__ pickles fine and must pass preflight
+    cls = type(obj)
+    if not inspect.ismodule(obj):
+        if getattr(cls, "__reduce__", None) is not object.__reduce__ \
+                or getattr(cls, "__reduce_ex__", None) \
+                is not object.__reduce_ex__:
+            return  # custom reduce: pickle uses it, not __dict__ — trust it
+        if "<locals>" in getattr(cls, "__qualname__", ""):
+            offenders.append((
+                path,
+                f"instance of function-local class "
+                f"'{cls.__qualname__}' — pickle cannot re-import the "
+                "class in the spawned worker; define it at module level"))
+            return
+        if getattr(cls, "__getstate__", None) is not None \
+                and getattr(cls, "__getstate__", None) \
+                is not getattr(object, "__getstate__", None):
+            try:
+                state = obj.__getstate__()
+            except Exception:  # tfos: ignore[broad-except] — a raising
+                return         # __getstate__ fails in pickle too, loudly
+            _walk(state, f"{path}.__getstate__()", depth + 1, seen,
+                  offenders)
+            return
+
+    # user instances (args Namespaces, callable objects): walk their state
+    if inspect.ismodule(obj) or inspect.isclass(obj):
+        return
+    state = getattr(obj, "__dict__", None)
+    if isinstance(state, dict):
+        for k, v in list(state.items())[:_MAX_ITEMS]:
+            _walk(v, f"{path}.{k}", depth + 1, seen, offenders)
+    # __slots__ instances have no __dict__ (or a partial one): walk the
+    # slot attributes across the MRO too
+    for klass in type(obj).__mro__:
+        slots = getattr(klass, "__slots__", ())
+        for slot in ((slots,) if isinstance(slots, str) else slots):
+            if slot in ("__dict__", "__weakref__"):
+                continue
+            try:
+                _walk(getattr(obj, slot), f"{path}.{slot}", depth + 1,
+                      seen, offenders)
+            except AttributeError:  # unset slot
+                continue
+    if (isinstance(state, dict) or hasattr(type(obj), "__slots__")) \
+            and callable(obj):
+        call = getattr(type(obj), "__call__", None)
+        if inspect.isfunction(call):
+            _walk(call, f"{path}.__call__", depth + 1, seen, offenders)
+
+
+def check_payload(payload, name: str = "map_fun") -> None:
+    """Raise :class:`PreflightError` naming every capture-hostile object
+    reachable from ``payload``; a clean payload returns None.  Bounded walk
+    (depth ``_MAX_DEPTH``, ``_MAX_ITEMS`` per container), so large-but-clean
+    args stay cheap."""
+    offenders: list[tuple[str, str]] = []
+    _walk(payload, name, 0, {}, offenders)
+    if offenders:
+        raise PreflightError(name, offenders)
+
+
+def check_payloads(*payloads: tuple[object, str]) -> None:
+    """Check several ``(payload, name)`` pairs and raise ONE
+    :class:`PreflightError` naming every offender across all of them — a
+    submission with a bad map_fun AND a bad tf_args reports both in a
+    single round trip."""
+    offenders: list[tuple[str, str]] = []
+    for payload, name in payloads:
+        # fresh seen per pair: an offender reachable from BOTH payloads
+        # must be reported under both paths, or fixing one still costs a
+        # second submit round trip
+        _walk(payload, name, 0, {}, offenders)
+    if offenders:
+        raise PreflightError("/".join(name for _, name in payloads),
+                             offenders)

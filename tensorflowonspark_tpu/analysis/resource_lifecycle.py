@@ -1,0 +1,198 @@
+"""Rule ``resource-lifecycle``: leak-prone resource creation.
+
+Sockets, ``SharedMemory`` segments, threads, and file handles created in a
+function and cleaned up only on the happy path (or never) are this
+codebase's signature flake generator: an exception between ``create`` and
+``close`` leaks an fd / a /dev/shm segment / a non-daemon thread, and the
+leak only surfaces runs later as address-in-use, shm exhaustion, or a hang
+at interpreter exit.
+
+The rule flags a local ``name = <constructor>()`` when, within the same
+function, the name is neither
+
+- used as a context manager (``with sock:`` / ``with closing(sock):``), nor
+- cleaned up (``close``/``join``/``unlink``/``stop``/``terminate``/
+  ``shutdown``/``release``) inside a ``finally`` block,
+
+unless ownership escapes the function (returned/yielded, stored on an
+attribute or into a container, or passed to another call — the receiver owns
+the lifecycle then, which a per-function rule cannot judge).  Daemon threads
+are exempt: they need no ``join`` by design.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tensorflowonspark_tpu.analysis.engine import (
+    FileContext, Finding, Rule, terminal_name as _terminal_name)
+
+_CONSTRUCTORS = {
+    "socket": "socket",
+    "create_connection": "socket",
+    "SharedMemory": "shared-memory segment",
+    "Thread": "thread",
+    "Timer": "timer thread",
+    "open": "file handle",
+}
+_CLEANUP_METHODS = {"close", "join", "unlink", "stop", "terminate",
+                    "shutdown", "release", "kill"}
+
+
+def _is_daemon_thread(call: ast.Call) -> bool:
+    return any(kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+               and kw.value.value for kw in call.keywords)
+
+
+def _own_nodes(fn: ast.AST):
+    """All descendant nodes of ``fn`` EXCLUDING nested function/lambda
+    bodies (``ast.walk`` cannot prune; mixing scopes lets a nested def's
+    ``return sock`` mask the enclosing function's leak, and double-reports
+    nested leaks)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _daemonized_names(fn: ast.AST) -> set[str]:
+    """Locals made daemon after construction: ``t.daemon = True``."""
+    names: set[str] = set()
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                    and isinstance(t.value, ast.Name) \
+                    and isinstance(node.value, ast.Constant) and node.value.value:
+                names.add(t.value.id)
+    return names
+
+
+class ResourceLifecycleRule(Rule):
+    id = "resource-lifecycle"
+    description = ("sockets/shm/threads/files with no close/join/unlink in "
+                   "a finally or context manager")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_fn(node, ctx))
+        return findings
+
+    def _check_fn(self, fn: ast.AST, ctx: FileContext) -> list[Finding]:
+        creations: dict[str, tuple[ast.Assign, str]] = {}
+        daemonized = _daemonized_names(fn)
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1 \
+                    or not isinstance(node.targets[0], ast.Name) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            kind = _CONSTRUCTORS.get(_terminal_name(node.value.func))
+            if kind is None:
+                continue
+            if kind in ("thread", "timer thread") and (
+                    _is_daemon_thread(node.value)
+                    or node.targets[0].id in daemonized):
+                continue
+            creations[node.targets[0].id] = (node, kind)
+        if not creations:
+            return []
+
+        managed = self._context_managed_names(fn)
+        finalized = self._finally_cleaned_names(fn)
+        escaped = self._escaped_names(fn, set(creations))
+        return [
+            ctx.finding(self.id, assign,
+                        f"{kind} '{name}' has no close/join/unlink in a "
+                        "finally block or context manager — an exception "
+                        "before cleanup leaks it")
+            for name, (assign, kind) in creations.items()
+            if name not in managed and name not in finalized
+            and name not in escaped
+        ]
+
+    @staticmethod
+    def _context_managed_names(fn: ast.AST) -> set[str]:
+        names: set[str] = set()
+        for node in _own_nodes(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Name):
+                            names.add(sub.id)
+        return names
+
+    @staticmethod
+    def _finally_cleaned_names(fn: ast.AST) -> set[str]:
+        names: set[str] = set()
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Try) or not node.finalbody:
+                continue
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr in _CLEANUP_METHODS:
+                        base = sub.func.value
+                        if isinstance(base, ast.Name):
+                            names.add(base.id)
+                    # `del x` / `x = None` in a finally counts as an
+                    # explicit ownership statement too (NOT any mention:
+                    # logging a resource in finally is not cleanup)
+                    elif isinstance(sub, ast.Delete):
+                        names.update(t.id for t in sub.targets
+                                     if isinstance(t, ast.Name))
+                    elif isinstance(sub, ast.Assign) \
+                            and isinstance(sub.value, ast.Constant) \
+                            and sub.value.value is None:
+                        names.update(t.id for t in sub.targets
+                                     if isinstance(t, ast.Name))
+        return names
+
+    @classmethod
+    def _escaped_names(cls, fn: ast.AST, candidates: set[str]) -> set[str]:
+        escaped: set[str] = set()
+        for node in _own_nodes(fn):
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and node.value is not None:
+                escaped |= cls._direct_names(node.value) & candidates
+            elif isinstance(node, ast.Assign):
+                # aliased into another name/structure (`pair = (sock, x)`,
+                # `self._sock = sock`): ownership moved with the alias
+                escaped |= cls._direct_names(node.value) & candidates
+            elif isinstance(node, ast.Call):
+                # passed as a bare argument to another call: the receiver
+                # may take ownership (a mere `x.recv(...)` does not escape)
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    escaped |= cls._direct_names(arg) & candidates
+        # captured free by a nested function: the closure may own cleanup
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                bound = {t.id for a in ast.walk(node)
+                         if isinstance(a, ast.Assign)
+                         for t in a.targets if isinstance(t, ast.Name)}
+                used = {n.id for n in ast.walk(node)
+                        if isinstance(n, ast.Name)
+                        and isinstance(n.ctx, ast.Load)}
+                escaped |= (used - bound) & candidates
+        return escaped
+
+    @classmethod
+    def _direct_names(cls, expr: ast.expr) -> set[str]:
+        """Names referenced as VALUES in ``expr`` — excluding attribute
+        receivers, so ``sock`` escapes via ``return sock`` but not via
+        ``return sock.recv(16)``."""
+        out: set[str] = set()
+        if isinstance(expr, ast.Name):
+            return {expr.id}
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(expr, ast.Attribute) and isinstance(child, ast.Name):
+                continue  # receiver position: x.attr
+            if isinstance(child, ast.expr):
+                out |= cls._direct_names(child)
+        return out

@@ -77,6 +77,9 @@ def _worker_entry(executor_id: int, env: dict, fn, tf_args, cluster_meta: dict,
         import sys
 
         os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+        # tfos: ignore[resource-lifecycle] — deliberately left open for the
+        # process's whole life: fds 1/2 are dup2'd onto it, closing it would
+        # sever the worker's stdout/stderr capture
         f = open(log_path, "ab", buffering=0)
         os.dup2(f.fileno(), 1)
         os.dup2(f.fileno(), 2)
@@ -212,6 +215,20 @@ class TPUCluster:
                 "ps processes cannot be hosted on the driver.  Drop the "
                 "flag, or see parallel.embedding.ShardedEmbedding for the "
                 "PS-workload migration path.")
+        # Submit-time preflight (docs/analysis.md): the payload is pickled
+        # into every spawned worker — reject closures over locks/sockets/
+        # files/live clients HERE, with the variable named, instead of a
+        # pickle traceback inside a half-booted child.  Runs before the
+        # reservation server exists, so a bad payload costs nothing.  A
+        # custom backend that never pickles (in-process test double) can
+        # declare ``pickles_payload = False`` to opt out per-backend
+        # instead of the process-global env var.
+        if os.environ.get("TFOS_NO_PREFLIGHT") != "1" \
+                and getattr(backend, "pickles_payload", True):
+            from tensorflowonspark_tpu.analysis import preflight
+
+            preflight.check_payloads((map_fun, "map_fun"),
+                                     (tf_args, "tf_args"))
         cluster_template = _build_cluster_template(
             num_workers, num_ps, master_node, eval_node)
         logger.info("cluster template: %s", cluster_template)
@@ -649,6 +666,8 @@ def _log_tail_detail(backend, failed: list) -> str:
     try:
         logs = fetch(failed)
     except Exception:
+        logger.debug("could not fetch worker log tails from backend",
+                     exc_info=True)
         return ""
     if not logs:
         return ""

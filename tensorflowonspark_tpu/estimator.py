@@ -256,7 +256,9 @@ class Estimator:
                 if base.endswith(".json") and base[:-5].isdigit() \
                         and int(base[:-5]) not in keep:
                     fsutil.remove(fsutil.join(side_dir, base))
-        except Exception:  # best-effort: fsspec backends raise non-OSErrors
+        # tfos: ignore[broad-except] — best-effort sidecar pruning; fsspec
+        # backends raise non-OSErrors and a failed prune must not fail a save
+        except Exception:
             pass
 
     def _load_input_state(self, step: int):
@@ -565,7 +567,9 @@ def train_and_evaluate(estimator: Estimator, train_spec: TrainSpec,
                 saved = json.loads(f.read().decode())
             if not isinstance(saved, dict) or saved.get("config") != es_cfg:
                 saved = None  # different metric/direction: start fresh
-        except Exception:  # best-effort: fsspec raises non-OSErrors too
+        # tfos: ignore[broad-except] — best-effort resume state: fsspec
+        # raises non-OSErrors too; a corrupt sidecar just restarts the count
+        except Exception:
             saved = None
         if saved:
             best, stale = saved.get("best"), int(saved.get("stale", 0))
@@ -584,7 +588,9 @@ def train_and_evaluate(estimator: Estimator, train_spec: TrainSpec,
                     {"best": best, "stale": stale, "stopped": stopped,
                      "step": estimator.global_step,
                      "config": es_cfg}).encode())
-        except Exception:  # best-effort, never kills a training run
+        # tfos: ignore[broad-except] — best-effort persistence of the
+        # early-stop latch; losing it never kills a training run
+        except Exception:
             pass
     with guard if guard is not None else contextlib.nullcontext():
         while estimator.global_step < train_spec.max_steps:

@@ -71,6 +71,9 @@ _NO_RETRY_ERRORS = frozenset({
     "ValueError", "TypeError", "KeyError", "IndexError", "AttributeError",
     "AssertionError", "ZeroDivisionError", "NotImplementedError",
     "ImportError", "ModuleNotFoundError", "NameError",
+    # submit-time payload rejection (analysis.preflight): deterministic —
+    # the same payload fails identically on every attempt
+    "PreflightError",
 })
 
 _TB_ERROR_RE = re.compile(r"^([A-Za-z_][\w.]*(?:Error|Exception|Interrupt))\b",
@@ -192,7 +195,9 @@ class HeartbeatReporter:
                        "pid": os.getpid()}
         try:
             self.mgr.kv_set(HEARTBEAT_KEY, payload)
-        except Exception:  # liveness reporting must never kill training
+        # tfos: ignore[broad-except] — liveness reporting must never kill
+        # training; a dropped heartbeat IS the signal the driver detects
+        except Exception:
             pass
 
     def _run(self) -> None:
@@ -295,6 +300,9 @@ class ClusterMonitor:
         for c in self._clients.values():
             with contextlib.suppress(Exception):
                 c.close()
+        # tfos: ignore[lock-discipline] — the monitor thread is joined above;
+        # a >5s join straggler only swaps per-eid entries (GIL-atomic) and
+        # its next _poll_kv sees _stop set
         self._clients.clear()
         if self._own_events and self.events is not None:
             self.events.close()
@@ -363,15 +371,18 @@ class ClusterMonitor:
                           if c not in (0, None)]
                 return codes, alive, failed
             except Exception:
-                pass
+                logger.debug("backend.exitcodes() failed; falling back to "
+                             "alive()/failed()", exc_info=True)
         codes = {}
         try:
             alive = list(backend.alive())
         except Exception:
+            logger.debug("backend.alive() failed mid-poll", exc_info=True)
             alive = []
         try:
             failed = list(backend.failed())
         except Exception:
+            logger.debug("backend.failed() failed mid-poll", exc_info=True)
             failed = []
         return codes, alive, failed
 
@@ -443,6 +454,9 @@ class ClusterMonitor:
             payload = cli.kv_get(HEARTBEAT_KEY)
             self._kv_retry_at.pop(eid, None)
             return payload
+        # tfos: ignore[broad-except] — deliberate: an unreachable kv is an
+        # EXPECTED state the watchdog is built to absorb, and the handler
+        # acts on it (drops the client, arms the reconnect backoff)
         except Exception:
             # unreachable kv: drop the client and back off reconnecting —
             # a netsplit node's connect can otherwise block a whole poll

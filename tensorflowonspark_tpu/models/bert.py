@@ -44,6 +44,8 @@ def _context_mesh():
             from jax.interpreters import pxla
 
             m = pxla.thread_resources.env.physical_mesh
+    # tfos: ignore[broad-except] — probing a deprecated jax internal for an
+    # ambient mesh; any failure just means "no mesh", the supported default
     except Exception:
         return None
     return None if m.empty else m

@@ -337,6 +337,9 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
                 try:
                     mgr.queue_put("error", tb, timeout=1)
                     mgr.kv_set("state", "failed")
+                # tfos: ignore[broad-except] — best-effort crash reporting:
+                # the traceback is already logged above and lands in the
+                # crash file; a dead queue server must not mask it
                 except Exception:
                     pass
             if reporter is not None:

@@ -33,6 +33,7 @@ from jax.tree_util import register_pytree_with_keys
 
 try:  # flax is an optional import at this layer
     from flax.linen import meta as _nn_meta
+# tfos: ignore[broad-except] — optional flax dependency probe
 except Exception:  # pragma: no cover
     _nn_meta = None
 

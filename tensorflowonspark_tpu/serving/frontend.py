@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import queue
 import socket
 import threading
 import time
@@ -188,7 +189,7 @@ class ServeFrontend(MessageSocket):
                     return
                 try:
                     ev = req.events.get(timeout=remaining)
-                except Exception:   # queue.Empty on deadline
+                except queue.Empty:
                     continue        # loop re-checks remaining (<= 0 now)
                 if ev[0] == "tok":
                     if stream:

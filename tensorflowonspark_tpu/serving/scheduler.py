@@ -404,6 +404,7 @@ class ReplicaScheduler:
                     self._mark_dead(rep.eid, f"request put failed: {e!r}")
 
     def _expire(self, req: ServeRequest) -> None:
+        """Fail ``req`` with a deadline error (lock held by caller)."""
         self.expired += 1
         req.finished = True
         self._requests.pop(req.rid, None)
@@ -412,6 +413,7 @@ class ReplicaScheduler:
                         f"{time.monotonic() - req.created:.2f}s in queue"))
 
     def _finish_err(self, req: ServeRequest, reason: str, msg: str) -> None:
+        """Fail ``req`` with a typed error (lock held by caller)."""
         self.failed += 1
         req.finished = True
         self._requests.pop(req.rid, None)
@@ -483,6 +485,8 @@ class ReplicaScheduler:
             try:
                 codes = dict(exitcodes())
             except Exception:
+                logger.debug("replica supervise: exitcodes() failed "
+                             "(transient during teardown)", exc_info=True)
                 continue
             with self._lock:
                 for eid, rep in self.replicas.items():

@@ -298,7 +298,9 @@ class _Lease:
         if done:
             try:
                 self.on_release(self.name)
-            except Exception:  # pragma: no cover - interpreter shutdown
+            # tfos: ignore[broad-except] — GC-lease callback can fire during
+            # interpreter shutdown when modules are already torn down
+            except Exception:  # pragma: no cover
                 pass
 
 

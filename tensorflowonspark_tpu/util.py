@@ -88,7 +88,9 @@ def apply_jax_platforms_env() -> None:
         import jax
 
         jax.config.update("jax_platforms", platforms)
-    except Exception:  # pragma: no cover - config frozen past backend init
+    # tfos: ignore[broad-except] — once the backend initialized the config
+    # is frozen; re-applying the platform late is a benign no-op
+    except Exception:  # pragma: no cover
         pass
 
 
@@ -135,12 +137,10 @@ def find_in_path(path: str, file_name: str) -> str | bool:
 def get_free_port(host: str = "") -> int:
     """Reserve an ephemeral port (bind + close), as the reference's node
     runtime does when pre-binding the TF server port (``TFSparkNode.py::run``)."""
-    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    s.bind((host, 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
 
 
 def hdfs_path(ctx, path: str) -> str:

@@ -1,0 +1,42 @@
+"""Negative fixture: broad handlers that actually handle."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def logs(fn):
+    try:
+        return fn()
+    except Exception:
+        logger.warning("fn failed; using default")
+        return None
+
+
+def reraises(fn):
+    try:
+        return fn()
+    except Exception:
+        raise RuntimeError("fn failed")
+
+
+def propagates(fn, errors):
+    try:
+        return fn()
+    except Exception as e:
+        errors.append(e)        # error kept, not swallowed
+
+
+def narrow(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def suppressed_with_reason(fn):
+    try:
+        return fn()
+    # tfos: ignore[broad-except] — fixture: documented deliberate swallow
+    except Exception:
+        pass

@@ -1,0 +1,20 @@
+"""Positive fixture: nested map_fun captures a lock, a socket, a client."""
+import socket
+import threading
+
+from tensorflowonspark_tpu import TPUCluster
+from tensorflowonspark_tpu.queues import QueueClient
+
+
+def driver(args):
+    lock = threading.Lock()
+    sock = socket.socket()
+    client = QueueClient(("127.0.0.1", 0), b"k")
+
+    def map_fun(a, ctx):
+        with lock:
+            sock.send(b"x")
+            client.put("input", a)
+
+    cluster = TPUCluster.run(map_fun, args, 2)
+    return cluster

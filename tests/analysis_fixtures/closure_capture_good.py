@@ -1,0 +1,24 @@
+"""Negative fixture: payloads that are fine to pickle into spawned workers.
+
+Module-level functions pickle by reference; closures over plain data are
+harmless; objects created INSIDE map_fun are per-process by construction.
+"""
+import threading
+
+from tensorflowonspark_tpu import TPUCluster
+
+
+def map_fun_module_level(args, ctx):
+    lock = threading.Lock()  # created inside the worker: fine
+    with lock:
+        return args
+
+
+def driver(args):
+    scale = 2.0  # plain data in the closure: pickles fine
+
+    def map_fun(a, ctx):
+        return a.batch_size * scale
+
+    TPUCluster.run(map_fun, args, 2)
+    TPUCluster.run(map_fun_module_level, args, 2)

@@ -1,0 +1,24 @@
+"""Positive fixture: host effects and traced branching inside jit."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def impure_step(params, batch):
+    t0 = time.time()                # frozen at trace time
+    noise = np.random.normal()      # drawn once at trace time
+    print("step", t0)               # fires only while tracing
+    loss = jnp.mean(batch) + noise
+    if loss > 0:                    # Python branch on a traced value
+        loss = loss * 2
+    return float(loss)              # forced concretization
+
+
+def host_loss(x):
+    return x.item()                 # device sync per call
+
+
+wrapped = jax.jit(host_loss)

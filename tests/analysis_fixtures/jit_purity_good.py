@@ -1,0 +1,28 @@
+"""Negative fixture: pure jit functions using the supported idioms."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pure_step(params, batch, rng):
+    noise = jax.random.normal(rng, batch.shape)   # keyed randomness: fine
+    loss = jnp.mean(batch + noise)
+    loss = jnp.where(loss > 0, loss * 2, loss)    # traced select: fine
+    jax.debug.print("loss {l}", l=loss)           # runtime print: fine
+    return jax.lax.cond(loss > 1, lambda l: l, lambda l: -l, loss)
+
+
+@jax.jit
+def static_branches(x, flag=None):
+    if flag is None:          # `is None` is a static test: fine
+        return x
+    if x.ndim > 2:            # shape/ndim/dtype are static: fine
+        return x.sum(axis=0)
+    return x
+
+
+def host_side(x):
+    # not jit-compiled: host calls are legitimate here
+    import time
+
+    return time.time(), float(x)

@@ -1,0 +1,37 @@
+"""Positive fixture: unlocked cross-thread mutation + an AB-BA lock cycle."""
+import threading
+
+
+class UnlockedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            with self._lock:
+                self.count += 1
+
+    def reset(self):
+        self.count = 0          # main thread, no lock: flagged
+
+
+class OrderCycle:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def forward(self):
+        with self._alock:
+            with self._block:
+                pass
+
+    def backward(self):
+        with self._block:
+            with self._alock:   # opposite order: deadlock potential
+                pass

@@ -1,0 +1,47 @@
+"""Negative fixture: disciplined locking.
+
+Every cross-thread mutation holds the lock; helpers called with the lock
+held say so in their docstring (the project convention the rule honors);
+nested acquisition follows one global order.
+"""
+import threading
+
+
+class LockedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            with self._lock:
+                self._bump()
+
+    def _bump(self):
+        """Increment (lock held by caller)."""
+        self.count += 1
+
+    def reset(self):
+        with self._lock:
+            self.count = 0
+
+
+class OrderedLocks:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def forward(self):
+        with self._alock:
+            with self._block:
+                pass
+
+    def also_forward(self):
+        with self._alock:
+            with self._block:
+                pass

@@ -1,0 +1,30 @@
+"""Positive fixture: leak-prone creations with no finally / context mgr."""
+import socket
+import threading
+from multiprocessing.shared_memory import SharedMemory
+
+
+def leaky_probe(host, port):
+    s = socket.socket()                 # flagged
+    s.connect((host, port))
+    data = s.recv(16)
+    s.close()                           # happy-path only: an exception above leaks the fd
+    return data
+
+
+def leaky_segment(nbytes):
+    seg = SharedMemory(create=True, size=nbytes)    # flagged
+    seg.buf[0] = 1
+    value = bytes(seg.buf[:4])
+    seg.close()
+    return value
+
+
+def leaky_worker():
+    t = threading.Thread(target=print)  # non-daemon, never joined: flagged
+    t.start()
+
+
+def leaky_read(path):
+    f = open(path)                      # flagged
+    return f.read()

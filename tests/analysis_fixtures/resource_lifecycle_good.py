@@ -1,0 +1,35 @@
+"""Negative fixture: the lifecycles the rule wants to see."""
+import socket
+import threading
+from multiprocessing.shared_memory import SharedMemory
+
+
+def ctx_probe(host, port):
+    with socket.socket() as s:
+        s.connect((host, port))
+        return s.recv(16)
+
+
+def finally_segment(nbytes):
+    seg = SharedMemory(create=True, size=nbytes)
+    try:
+        seg.buf[0] = 1
+        return bytes(seg.buf[:4])
+    finally:
+        seg.close()
+        seg.unlink()
+
+
+def daemon_worker():
+    t = threading.Thread(target=print, daemon=True)  # daemon: no join needed
+    t.start()
+
+
+def handed_off():
+    sock = socket.socket()
+    return sock          # ownership escapes to the caller
+
+
+def ctx_read(path):
+    with open(path) as f:
+        return f.read()

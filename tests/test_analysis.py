@@ -1,0 +1,894 @@
+"""tfos-check: engine mechanics, the five rules, the repo-wide tier-1 gate,
+and the submit-time preflight (docs/analysis.md).
+
+The gate test is the ratchet: it analyzes the WHOLE package against the
+committed ``analysis_baseline.json`` and fails on any finding not
+grandfathered there — new code must come in clean (or explicitly
+``# tfos: ignore[rule-id]``'d with a reason, or deliberately baselined).
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+import tensorflowonspark_tpu
+from tensorflowonspark_tpu.analysis import (ALL_RULES, RULE_IDS, Finding,
+                                            analyze_paths, analyze_source,
+                                            load_baseline, new_findings,
+                                            write_baseline)
+from tensorflowonspark_tpu.analysis.__main__ import main as cli_main
+from tensorflowonspark_tpu.analysis.engine import parse_suppressions
+from tensorflowonspark_tpu.analysis.exports import (check_exports,
+                                                    documented_names,
+                                                    public_exports)
+from tensorflowonspark_tpu.analysis.preflight import (PreflightError,
+                                                      check_payload)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.abspath(tensorflowonspark_tpu.__file__)))
+PKG_DIR = os.path.join(REPO_ROOT, "tensorflowonspark_tpu")
+BASELINE = os.path.join(REPO_ROOT, "analysis_baseline.json")
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+
+
+def fixture_findings(name: str) -> list:
+    return analyze_paths([os.path.join(FIXTURES, name)], root=FIXTURES)
+
+
+def rules_of(findings) -> set:
+    return {f.rule for f in findings}
+
+
+# ------------------------------------------------------------------ gate
+
+class TestRepoGate:
+    def test_package_clean_against_baseline(self):
+        """THE tier-1 gate: repo-wide analyzer pass vs the committed
+        baseline.  A failure here names the exact new finding — fix it,
+        suppress it with a reasoned `# tfos: ignore[rule]`, or (last
+        resort) re-baseline via
+        `python -m tensorflowonspark_tpu.analysis --exports
+        --write-baseline --baseline analysis_baseline.json`."""
+        findings = analyze_paths([PKG_DIR], root=REPO_ROOT)
+        findings += check_exports(REPO_ROOT)
+        assert os.path.exists(BASELINE), "analysis_baseline.json missing"
+        new = new_findings(findings, load_baseline(BASELINE))
+        assert not new, "NEW analyzer findings:\n" + "\n".join(
+            f.format() for f in new)
+
+    def test_every_rule_registered(self):
+        assert set(RULE_IDS) == {"closure-capture", "jit-purity",
+                                 "lock-discipline", "resource-lifecycle",
+                                 "broad-except"}
+
+
+# ------------------------------------------------------------- rule units
+
+class TestRuleFixtures:
+    """Each rule: at least one positive (flagging) and one negative
+    (clean) fixture."""
+
+    @pytest.mark.parametrize("rule_id,stem", [
+        ("closure-capture", "closure_capture"),
+        ("jit-purity", "jit_purity"),
+        ("lock-discipline", "lock_discipline"),
+        ("resource-lifecycle", "resource_lifecycle"),
+        ("broad-except", "broad_except"),
+    ])
+    def test_positive_and_negative(self, rule_id, stem):
+        bad = fixture_findings(f"{stem}_bad.py")
+        assert rule_id in rules_of(bad), \
+            f"{stem}_bad.py produced no {rule_id} finding"
+        good = fixture_findings(f"{stem}_good.py")
+        assert rule_id not in rules_of(good), \
+            f"{stem}_good.py false positives: " + "\n".join(
+                f.format() for f in good if f.rule == rule_id)
+
+    def test_closure_capture_names_the_variable(self):
+        msgs = [f.message for f in fixture_findings("closure_capture_bad.py")
+                if f.rule == "closure-capture"]
+        assert any("'lock'" in m for m in msgs)
+        assert any("'sock'" in m for m in msgs)
+        assert any("'client'" in m for m in msgs)
+
+    def test_jit_purity_catalog(self):
+        msgs = " | ".join(
+            f.message for f in fixture_findings("jit_purity_bad.py"))
+        for marker in ("time.*", "np.random", "print()", "branches on "
+                       "traced value", "float()", ".item()"):
+            assert marker in msgs, f"jit-purity missed {marker}"
+
+    def test_lock_discipline_reports_cycle(self):
+        msgs = [f.message for f in fixture_findings("lock_discipline_bad.py")]
+        assert any("cycle" in m and "_alock" in m and "_block" in m
+                   for m in msgs)
+
+    def test_lock_discipline_lock_held_convention(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.n = 0\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._run).start()\n"
+            "    def _run(self):\n"
+            "        with self._lock:\n"
+            "            self._bump()\n"
+            "    def _bump(self):\n"
+            '        """Bump (lock held by caller)."""\n'
+            "        self.n += 1\n"
+            "    def reset(self):\n"
+            "        with self._lock:\n"
+            "            self.n = 0\n")
+        assert analyze_source(src, "conv.py") == []
+
+    def test_lock_discipline_substringy_names_are_not_locks(self):
+        """'poll_seconds' (contains 'cond') and 'clock' (contains 'lock')
+        are ordinary shared state — a substring heuristic used to exempt
+        them from the mutation check entirely."""
+        src_tmpl = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.{attr} = 0\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._run).start()\n"
+            "    def _run(self):\n"
+            "        self.{attr} += 1\n"
+            "    def tune(self):\n"
+            "        self.{attr} = 5\n")
+        for attr in ("poll_seconds", "clock", "blocked_count"):
+            found = analyze_source(src_tmpl.format(attr=attr), "sub.py")
+            assert any(f.rule == "lock-discipline" and attr in f.message
+                       for f in found), f"{attr} exempted as a lock"
+
+    def test_resource_lifecycle_counts_all_four_kinds(self):
+        kinds = {f.message.split(" '")[0]
+                 for f in fixture_findings("resource_lifecycle_bad.py")}
+        assert kinds == {"socket", "shared-memory segment", "thread",
+                         "file handle"}
+
+
+# ---------------------------------------------------- suppressions/baseline
+
+class TestEngineMechanics:
+    SRC_BAD = "try:\n    pass\nexcept Exception:\n    pass\n"
+
+    def test_finding_without_suppression(self):
+        assert rules_of(analyze_source(self.SRC_BAD, "x.py")) == \
+            {"broad-except"}
+
+    def test_same_line_suppression(self):
+        src = ("try:\n    pass\n"
+               "except Exception:  # tfos: ignore[broad-except]\n"
+               "    pass\n")
+        assert analyze_source(src, "x.py") == []
+
+    def test_comment_line_above_suppression(self):
+        src = ("try:\n    pass\n"
+               "# tfos: ignore[broad-except] — reason goes here\n"
+               "except Exception:\n    pass\n")
+        assert analyze_source(src, "x.py") == []
+
+    def test_suppression_is_rule_scoped(self):
+        src = ("try:\n    pass\n"
+               "except Exception:  # tfos: ignore[jit-purity]\n"
+               "    pass\n")
+        assert rules_of(analyze_source(src, "x.py")) == {"broad-except"}
+
+    def test_parse_suppressions_multi_rule(self):
+        supp = parse_suppressions(
+            "x = 1  # tfos: ignore[rule-a, rule-b]\n")
+        assert supp == {1: {"rule-a", "rule-b"}}
+
+    def test_parse_suppressions_pending_consumed_by_inline_line(self):
+        """An above-line suppression lands on the next code line even when
+        that line carries its own inline suppression — and must NOT leak
+        onto the statement after it."""
+        supp = parse_suppressions(
+            "# tfos: ignore[broad-except]\n"
+            "x = foo()  # tfos: ignore[jit-purity]\n"
+            "y = bar()\n")
+        assert supp == {2: {"broad-except", "jit-purity"}}
+
+    def test_overlapping_paths_analyze_each_file_once(self):
+        """`pkg pkg/file.py` on the CLI must not double-count findings —
+        with the count-aware ratchet a duplicate pass would report fully
+        baselined findings as new."""
+        explicit = os.path.join(FIXTURES, "broad_except_bad.py")
+        once = analyze_paths([explicit], root=FIXTURES)
+        twice = analyze_paths([FIXTURES, explicit], root=FIXTURES)
+        bad_rel = "broad_except_bad.py"
+        assert [f for f in twice if f.path == bad_rel] == \
+            [f for f in once if f.path == bad_rel]
+
+    def test_baseline_ratchet(self, tmp_path):
+        old = Finding("broad-except", "m.py", 3,
+                      "'except Exception' swallows the error silently — "
+                      "narrow the type, log with context, or re-raise")
+        path = str(tmp_path / "base.json")
+        write_baseline([old], path)
+        baseline = load_baseline(path)
+        # same finding at a DIFFERENT line is still grandfathered
+        moved = Finding(old.rule, old.path, 17, old.message)
+        assert new_findings([moved], baseline) == []
+        # a second occurrence beyond the baselined count is new
+        assert new_findings([moved, moved], baseline) == [moved]
+        # a different file is new
+        other = Finding(old.rule, "other.py", 3, old.message)
+        assert new_findings([other], baseline) == [other]
+
+    def test_syntax_error_is_a_finding(self):
+        assert rules_of(analyze_source("def broken(:\n", "x.py")) == \
+            {"syntax-error"}
+
+    def test_nonexistent_path_is_a_finding_not_a_vacuous_pass(self, tmp_path):
+        findings = analyze_paths([str(tmp_path / "typo_dir")],
+                                 root=str(tmp_path))
+        assert rules_of(findings) == {"read-error"}
+
+    def test_nonexistent_py_file_is_exactly_one_finding(self, tmp_path):
+        findings = analyze_paths([str(tmp_path / "missing.py")],
+                                 root=str(tmp_path))
+        assert len(findings) == 1 and findings[0].rule == "read-error"
+
+    def test_closure_capture_message_is_line_stable(self):
+        """The message is the baseline key — it must not embed line
+        numbers, or grandfathered findings churn on unrelated edits."""
+        msgs = [f.message for f in fixture_findings("closure_capture_bad.py")
+                if f.rule == "closure-capture"]
+        assert msgs and all("line" not in m for m in msgs)
+
+    def test_resource_lifecycle_scopes_are_separate(self):
+        """A nested def's `return` must not mask the enclosing function's
+        leak, and a nested leak is reported exactly once."""
+        src = ("import socket\n"
+               "def outer():\n"
+               "    sock = socket.socket()\n"      # leaked: flagged
+               "    def make():\n"
+               "        sock = socket.socket()\n"  # own scope: returned
+               "        return sock\n"
+               "    return make\n")
+        findings = [f for f in analyze_source(src, "x.py")
+                    if f.rule == "resource-lifecycle"]
+        assert [f.line for f in findings] == [3]
+
+    def test_resource_lifecycle_closure_capture_is_escape(self):
+        src = ("import socket\n"
+               "def outer(register):\n"
+               "    sock = socket.socket()\n"
+               "    def cleanup():\n"
+               "        sock.close()\n"
+               "    register(cleanup)\n")
+        assert analyze_source(src, "x.py") == []
+
+    def test_closure_capture_tfcluster_facade_skips_spark_context(self):
+        """The reference-compat facade is ``TFCluster.run(sc, map_fun,
+        ...)`` — the payload is the SECOND positional arg, and a Lock
+        capture in it must still be flagged (not the SparkContext)."""
+        src = ("import threading\n"
+               "def main(sc):\n"
+               "    lock = threading.Lock()\n"
+               "    def map_fun(args, ctx):\n"
+               "        with lock:\n"
+               "            pass\n"
+               "    TFCluster.run(sc, map_fun, None, 4)\n")
+        findings = [f for f in analyze_source(src, "x.py")
+                    if f.rule == "closure-capture"]
+        assert findings and "'lock'" in findings[0].message
+
+    def test_lock_discipline_acquire_release_bracketing_counts_as_held(self):
+        """Explicit acquire()/release() (the try/finally idiom) must count
+        as holding the lock, same as `with self._lock:`."""
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self.n = 0\n"
+               "    def start(self):\n"
+               "        threading.Thread(target=self._run).start()\n"
+               "    def _run(self):\n"
+               "        self._lock.acquire()\n"
+               "        try:\n"
+               "            self.n += 1\n"
+               "        finally:\n"
+               "            self._lock.release()\n"
+               "    def bump(self):\n"
+               "        with self._lock:\n"
+               "            self.n += 1\n")
+        assert analyze_source(src, "x.py") == []
+
+    def test_reused_rule_instances_do_not_leak_finalize_state(self):
+        """A rule instance reused across runs must not re-report the
+        previous run's cross-file findings."""
+        rules = [cls() for cls in ALL_RULES]
+        cycle_src = ("import threading\n"
+                     "class C:\n"
+                     "    def __init__(self):\n"
+                     "        self._alock = threading.Lock()\n"
+                     "        self._block = threading.Lock()\n"
+                     "    def ab(self):\n"
+                     "        with self._alock:\n"
+                     "            with self._block:\n"
+                     "                pass\n"
+                     "    def ba(self):\n"
+                     "        with self._block:\n"
+                     "            with self._alock:\n"
+                     "                pass\n")
+        first = analyze_source(cycle_src, "a.py", rules=rules)
+        assert any("cycle" in f.message for f in first)
+        assert analyze_source("x = 1\n", "b.py", rules=rules) == []
+
+    def test_lock_order_multi_item_with_is_sequential(self):
+        """`with self._b, self._a:` acquires b THEN a — paired with a
+        nested `with self._a: with self._b:` elsewhere it is the classic
+        AB-BA deadlock and must produce a cycle finding."""
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._alock = threading.Lock()\n"
+               "        self._block = threading.Lock()\n"
+               "    def ab(self):\n"
+               "        with self._alock:\n"
+               "            with self._block:\n"
+               "                pass\n"
+               "    def ba(self):\n"
+               "        with self._block, self._alock:\n"
+               "            pass\n")
+        findings = analyze_source(src, "x.py")
+        assert any("cycle" in f.message for f in findings)
+
+    def test_closure_capture_keyword_payload_also_checked(self):
+        """`TPUCluster.run(map_fun=train, ...)` must be inspected like the
+        positional form."""
+        src = ("import threading\n"
+               "def main():\n"
+               "    lock = threading.Lock()\n"
+               "    def train(args, ctx):\n"
+               "        with lock:\n"
+               "            pass\n"
+               "    TPUCluster.run(map_fun=train, tf_args=None,\n"
+               "                   num_workers=2)\n")
+        findings = [f for f in analyze_source(src, "x.py")
+                    if f.rule == "closure-capture"]
+        assert findings and "'lock'" in findings[0].message
+
+    def test_lock_order_same_class_name_across_files_not_merged(self, tmp_path):
+        """Two unrelated classes that happen to share a name (and lock
+        names) in different files must not have their acquisition edges
+        merged into a phantom AB-BA cycle."""
+        (tmp_path / "a.py").write_text(
+            "import threading\n"
+            "class Server:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cond_lock = threading.Lock()\n"
+            "    def step(self):\n"
+            "        with self._lock:\n"
+            "            with self._cond_lock:\n"
+            "                pass\n")
+        (tmp_path / "b.py").write_text(
+            "import threading\n"
+            "class Server:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cond_lock = threading.Lock()\n"
+            "    def step(self):\n"
+            "        with self._cond_lock:\n"
+            "            with self._lock:\n"
+            "                pass\n")
+        findings = analyze_paths([str(tmp_path)], root=str(tmp_path))
+        assert not any("cycle" in f.message for f in findings)
+
+    def test_jit_purity_static_argnums_branch_not_flagged(self):
+        """Branching on a static_argnums/static_argnames-declared argument
+        is valid JAX (jit re-traces per value) and must stay clean."""
+        src = ("import jax\n"
+               "from functools import partial\n"
+               "@partial(jax.jit, static_argnums=(2,))\n"
+               "def step(x, y, training):\n"
+               "    if training:\n"
+               "        return x + y\n"
+               "    return x\n"
+               "@partial(jax.jit, static_argnames=('mode',))\n"
+               "def run(x, mode):\n"
+               "    if mode:\n"
+               "        return x * 2\n"
+               "    return x\n")
+        assert analyze_source(src, "x.py") == []
+
+    def test_jit_purity_non_static_branch_still_flagged(self):
+        src = ("import jax\n"
+               "from functools import partial\n"
+               "@partial(jax.jit, static_argnums=(2,))\n"
+               "def step(x, y, training):\n"
+               "    if y:\n"
+               "        return x\n"
+               "    return x + 1\n")
+        assert rules_of(analyze_source(src, "x.py")) == {"jit-purity"}
+
+    def test_jit_purity_static_shape_int_not_flagged(self):
+        src = ("import jax\n"
+               "@jax.jit\n"
+               "def f(batch):\n"
+               "    n = int(batch.shape[0])\n"
+               "    return batch * n\n")
+        assert analyze_source(src, "x.py") == []
+
+    def test_finally_non_cleanup_method_is_not_cleanup(self):
+        """A finally that merely TOUCHES the resource (no close/join/
+        unlink, no del/None) must not silence the leak finding."""
+        src = ("import socket\n"
+               "def probe(log):\n"
+               "    s = socket.socket()\n"
+               "    try:\n"
+               "        s.connect(('h', 1))\n"
+               "    finally:\n"
+               "        s.setblocking(True)\n")
+        assert rules_of(analyze_source(src, "x.py")) == {"resource-lifecycle"}
+
+    def test_finally_del_or_none_is_cleanup(self):
+        src = ("import socket\n"
+               "def probe():\n"
+               "    s = socket.socket()\n"
+               "    try:\n"
+               "        s.connect(('h', 1))\n"
+               "    finally:\n"
+               "        del s\n")
+        assert analyze_source(src, "x.py") == []
+
+
+# ------------------------------------------------------------------- CLI
+
+class TestCLI:
+    def test_clean_path_exits_zero(self, capsys):
+        rc = cli_main([os.path.join(FIXTURES, "broad_except_good.py")])
+        assert rc == 0
+        assert "0 new finding(s)" in capsys.readouterr().out
+
+    def test_findings_exit_one_and_json(self, capsys):
+        rc = cli_main(["--json",
+                       os.path.join(FIXTURES, "broad_except_bad.py")])
+        assert rc == 1
+        data = json.loads(capsys.readouterr().out)
+        assert len(data) == 3
+        assert {d["rule"] for d in data} == {"broad-except"}
+
+    def test_rule_filter(self, capsys):
+        rc = cli_main(["--rules", "jit-purity",
+                       os.path.join(FIXTURES, "broad_except_bad.py")])
+        assert rc == 0
+        capsys.readouterr()
+
+    def test_unknown_rule_id_rejected(self, capsys):
+        with pytest.raises(SystemExit):
+            cli_main(["--rules", "no-such-rule", FIXTURES])
+        capsys.readouterr()
+
+    def test_missing_baseline_is_usage_error(self, tmp_path, capsys):
+        rc = cli_main(["--baseline", str(tmp_path / "nope.json"), FIXTURES])
+        assert rc == 2
+        capsys.readouterr()
+
+    def test_default_paths_use_checkout_root_from_any_cwd(self, tmp_path,
+                                                          monkeypatch,
+                                                          capsys):
+        """The docs-advertised gate invocation must produce baseline-
+        matching keys regardless of cwd (root defaults to the checkout
+        root when paths are defaulted)."""
+        monkeypatch.chdir(tmp_path)
+        rc = cli_main(["--exports", "--baseline", BASELINE])
+        assert rc == 0
+        capsys.readouterr()
+
+    def test_write_then_gate_roundtrip(self, tmp_path, capsys):
+        bad = os.path.join(FIXTURES, "broad_except_bad.py")
+        base = str(tmp_path / "b.json")
+        assert cli_main(["--write-baseline", "--baseline", base, bad]) == 0
+        # same findings now grandfathered
+        assert cli_main(["--baseline", base, bad]) == 0
+        capsys.readouterr()
+
+    def test_scripts_shim(self):
+        """`python scripts/tfos_check.py` works from a fresh checkout."""
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                          "tfos_check.py"),
+             os.path.join(FIXTURES, "broad_except_bad.py")],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1, proc.stderr
+        assert "broad-except" in proc.stdout
+
+
+# ---------------------------------------------------------- exports drift
+
+class TestExportsDrift:
+    def test_current_repo_is_reconciled(self):
+        assert check_exports(REPO_ROOT) == []
+
+    def test_detects_both_directions(self, tmp_path):
+        pkg = tmp_path / "tensorflowonspark_tpu"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text(
+            "from tensorflowonspark_tpu.cluster import TPUCluster\n"
+            "from tensorflowonspark_tpu.node import NodeContext\n")
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "api.md").write_text(
+            "## `tensorflowonspark_tpu` (package root)\n\n"
+            "`TPUCluster`, `Ghost`.\n\n## `cluster`\n")
+        msgs = [f.message for f in check_exports(str(tmp_path))]
+        assert any("'NodeContext' is missing from docs" in m for m in msgs)
+        assert any("'Ghost'" in m and "does not export" in m for m in msgs)
+
+    def test_missing_inputs_fail_loudly_not_vacuously(self, tmp_path):
+        """A missing __init__.py or docs/api.md must produce read-error
+        findings, not a silent pass of the exports gate."""
+        findings = check_exports(str(tmp_path))
+        assert findings and all(f.rule == "read-error" for f in findings)
+        assert {f.path for f in findings} == \
+            {"tensorflowonspark_tpu/__init__.py", "docs/api.md"}
+
+    def test_export_parsers(self):
+        exported = public_exports(os.path.join(PKG_DIR, "__init__.py"))
+        documented, _ = documented_names(
+            os.path.join(REPO_ROOT, "docs", "api.md"))
+        for name in ("TPUCluster", "run_with_recovery", "serving",
+                     "PreemptionGuard"):
+            assert name in exported
+            assert name in documented
+
+
+# -------------------------------------------------------------- preflight
+
+def _gen_fn():
+    yield 1
+
+
+def _module_map_fun(args, ctx):
+    return 0
+
+
+_module_lock = threading.Lock()
+
+
+def _fn_with_lock_default(args, ctx, guard=_module_lock):
+    return 0
+
+
+# preflight test doubles live at module level: instances of function-local
+# classes are themselves (correctly) rejected as unpicklable-by-reference,
+# which would mask the specific behavior each test exercises
+
+class _GetstateHolder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.data = [1, 2, 3]
+
+    def __getstate__(self):
+        return {"data": self.data}
+
+
+class _ItemHolder:
+    def __init__(self, item):
+        self.item = item
+
+    def __getstate__(self):
+        return {"item": self.item}
+
+
+class _LockAttrHolder:
+    def __init__(self):
+        self.lock = threading.Lock()
+
+
+class _SlotHolder:
+    __slots__ = ("lock",)
+
+    def __init__(self):
+        self.lock = threading.Lock()
+
+
+class _GetstateLiar:
+    def __init__(self):
+        self.data = 1
+
+    def __getstate__(self):
+        return {"oops": threading.Lock()}
+
+
+class _CallablePayload:
+    def __init__(self):
+        self.guard = threading.RLock()
+
+    def __call__(self, args, ctx):
+        pass
+
+class TestPreflight:
+    def test_getstate_dropping_the_lock_passes(self):
+        """An object that excludes its Lock via __getstate__ pickles fine
+        and must pass preflight — walk what pickle ships, not raw
+        __dict__."""
+        check_payload({"args": _GetstateHolder()}, name="tf_args")
+
+    def test_getstate_sibling_id_reuse_does_not_mask_offender(self):
+        """Temporary __getstate__() dicts must be kept alive during the
+        walk: a freed dict's address can be reused by a sibling's state,
+        which would make the offender look already-seen and skip it."""
+        with pytest.raises(PreflightError) as ei:
+            check_payload([_ItemHolder("clean"),
+                           _ItemHolder(threading.Lock())], name="tf_args")
+        assert "lock" in str(ei.value)
+
+    def test_deep_first_visit_does_not_mask_shallow_revisit(self):
+        """An object first reached AT the depth cutoff is pruned; a later
+        shallower path must re-walk it, not trust the pruned visit."""
+        h = _LockAttrHolder()
+        deep = {"a": {"b": {"c": {"d": h}}}, "h": h}
+        with pytest.raises(PreflightError):
+            check_payload(deep, name="tf_args")
+
+    def test_slots_instance_state_is_walked(self):
+        with pytest.raises(PreflightError) as ei:
+            check_payload({"h": _SlotHolder()}, name="tf_args")
+        assert ".lock" in str(ei.value)
+
+    def test_function_local_class_instance_rejected(self):
+        """Instances of a function-local class are the class-level twin of
+        the nested-function case: pickle must re-import the class by
+        reference and cannot."""
+        class Cfg:
+            pass
+
+        with pytest.raises(PreflightError) as ei:
+            check_payload({"cfg": Cfg()}, name="tf_args")
+        assert "module level" in str(ei.value)
+
+    def test_dict_keys_are_walked(self):
+        import socket
+
+        s = socket.socket()
+        try:
+            with pytest.raises(PreflightError) as ei:
+                check_payload({s: "peer"}, name="tf_args")
+            assert "socket" in str(ei.value)
+        finally:
+            s.close()
+
+    def test_getstate_returning_a_lock_still_fails(self):
+        with pytest.raises(PreflightError) as ei:
+            check_payload(_GetstateLiar(), name="tf_args")
+        assert "__getstate__" in str(ei.value)
+
+    def test_lock_in_closure_named(self):
+        lock = threading.Lock()
+
+        def map_fun(args, ctx):
+            with lock:
+                pass
+
+        with pytest.raises(PreflightError) as ei:
+            check_payload(map_fun)
+        assert "'lock'" in str(ei.value)
+        assert "unpicklable" in str(ei.value)
+
+    def test_clean_payloads_pass(self):
+        check_payload(_module_map_fun)
+        check_payload({"lr": 0.1, "layers": [1, 2, 3]}, name="tf_args")
+
+    def test_jax_array_is_advisory_not_fatal(self, caplog):
+        """Modern jax arrays pickle (the child rebuilds a host copy) —
+        rejecting them would fail previously-working submissions.  The
+        preflight warns instead."""
+        jnp = pytest.importorskip("jax.numpy")
+        with caplog.at_level(logging.WARNING,
+                             logger="tensorflowonspark_tpu.analysis"
+                                    ".preflight"):
+            check_payload({"w": jnp.ones(3)}, name="tf_args")  # no raise
+        assert any("jax array" in r.getMessage() for r in caplog.records)
+
+    def test_depth_cutoff_is_logged_not_silent(self, caplog):
+        """An offender below _MAX_DEPTH slips through (deliberate cost
+        bound) — but the pruned branch must leave a debug trace, not
+        silently imply the payload was fully vetted."""
+        deep = {"a": {"b": {"c": {"d": {"e": threading.Lock()}}}}}
+        with caplog.at_level(logging.DEBUG,
+                             logger="tensorflowonspark_tpu.analysis"
+                                    ".preflight"):
+            check_payload(deep, name="tf_args")
+        assert any("depth cutoff" in r.getMessage() for r in caplog.records)
+
+    def test_nested_function_and_lambda_rejected_even_when_clean(self):
+        """Functions pickle by reference: a <locals> function or lambda
+        cannot be imported by the spawned worker no matter how clean its
+        captures are — the most common spawn-pickle failure."""
+        def nested(args, ctx):
+            return 0
+
+        with pytest.raises(PreflightError) as ei:
+            check_payload(nested)
+        assert "module level" in str(ei.value)
+        with pytest.raises(PreflightError):
+            check_payload(lambda a, c: None)
+
+    def test_socket_in_args_container(self):
+        import socket
+
+        s = socket.socket()
+        try:
+            with pytest.raises(PreflightError) as ei:
+                check_payload({"cfg": {"conn": s}}, name="tf_args")
+            assert "tf_args['cfg']['conn']" in str(ei.value)
+        finally:
+            s.close()
+
+    def test_open_file_default_arg(self):
+        f = open(os.devnull)
+        try:
+            def map_fun(args, ctx, sink=f):
+                pass
+
+            with pytest.raises(PreflightError):
+                check_payload(map_fun)
+        finally:
+            f.close()
+
+    def test_callable_object_state_walked(self):
+        with pytest.raises(PreflightError) as ei:
+            check_payload(_CallablePayload())
+        assert ".guard" in str(ei.value)
+
+    def test_partial_pieces_walked(self):
+        import functools
+
+        ev = threading.Event()
+
+        def fn(event, args, ctx):
+            pass
+
+        with pytest.raises(PreflightError) as ei:
+            check_payload(functools.partial(fn, ev))
+        assert "args[0]" in str(ei.value)
+
+    def test_numpy_and_plain_data_not_flagged(self):
+        import numpy as np
+
+        check_payload({"weights": np.ones((8, 8)), "name": "ok"},
+                      name="tf_args")
+
+    def test_in_memory_buffers_pass(self):
+        """io.BytesIO/StringIO pickle fine; only fd-backed files are
+        rejected."""
+        import io
+        import pickle
+
+        payload = {"blob": io.BytesIO(b"weights"), "txt": io.StringIO("x")}
+        pickle.dumps(payload)  # the ground truth the preflight must match
+        check_payload(payload, name="tf_args")
+
+    def test_module_level_generator_function_passes(self):
+        check_payload({"make_data": _gen_fn}, name="tf_args")
+
+    def test_shared_offender_reported_under_both_payload_paths(self):
+        """check_payloads must name an offender reachable from BOTH
+        map_fun and tf_args under both paths — one resubmit fixes all."""
+        from tensorflowonspark_tpu.analysis.preflight import check_payloads
+
+        lock = threading.Lock()
+        with pytest.raises(PreflightError) as ei:
+            check_payloads(({"l": lock}, "map_fun"), ([lock], "tf_args"))
+        msg = str(ei.value)
+        assert "map_fun['l']" in msg and "tf_args[0]" in msg
+
+    def test_module_level_function_defaults_never_ship(self):
+        """A module-level function pickles by reference — the worker
+        re-imports it, so an unpicklable DEFAULT is irrelevant and must
+        not be rejected."""
+        import pickle
+
+        pickle.dumps(_fn_with_lock_default)  # ground truth
+        check_payload(_fn_with_lock_default)
+        check_payload({"fn": _fn_with_lock_default}, name="tf_args")
+
+    def test_live_generator_rejected(self):
+        with pytest.raises(PreflightError) as ei:
+            check_payload({"data": _gen_fn()}, name="tf_args")
+        assert "generator" in str(ei.value)
+
+
+class _RecordingBackend:
+    """Backend double: booting it at all is the failure condition."""
+
+    def __init__(self):
+        self.start_calls = 0
+
+    def start(self, *a, **kw):
+        self.start_calls += 1
+        raise AssertionError("backend.start reached — preflight must "
+                             "reject the payload before any spawn")
+
+    def alive(self):
+        return []
+
+    def failed(self):
+        return []
+
+    def join(self, timeout=None):
+        return True
+
+    def terminate(self):
+        pass
+
+
+class TestRunPreflightIntegration:
+    def test_run_rejects_lock_closure_before_spawn(self, tmp_path):
+        """Acceptance: TPUCluster.run fails a Lock-capturing map_fun at
+        submit time, naming the variable, with zero workers spawned."""
+        from tensorflowonspark_tpu import TPUCluster
+
+        progress_lock = threading.Lock()
+
+        def map_fun(args, ctx):
+            with progress_lock:
+                pass
+
+        backend = _RecordingBackend()
+        with pytest.raises(PreflightError) as ei:
+            TPUCluster.run(map_fun, {"steps": 1}, 1, backend=backend,
+                           working_dir=str(tmp_path))
+        assert "'progress_lock'" in str(ei.value)
+        assert backend.start_calls == 0
+
+    def test_run_checks_tf_args_too(self, tmp_path):
+        from tensorflowonspark_tpu import TPUCluster
+
+        def map_fun(args, ctx):
+            pass
+
+        backend = _RecordingBackend()
+        with pytest.raises(PreflightError) as ei:
+            TPUCluster.run(map_fun, {"bad": threading.Lock()}, 1,
+                           backend=backend, working_dir=str(tmp_path))
+        assert "tf_args['bad']" in str(ei.value)
+        assert backend.start_calls == 0
+
+    def test_escape_hatch_backend_flag(self, tmp_path):
+        """A backend that never pickles can opt out per-submission with
+        ``pickles_payload = False`` — no process-global env var needed."""
+        from tensorflowonspark_tpu import TPUCluster
+
+        lock = threading.Lock()
+
+        def map_fun(args, ctx):
+            with lock:
+                pass
+
+        backend = _RecordingBackend()
+        backend.pickles_payload = False
+        with pytest.raises(AssertionError, match="backend.start reached"):
+            TPUCluster.run(map_fun, {}, 1, backend=backend,
+                           working_dir=str(tmp_path))
+        assert backend.start_calls == 1
+
+    def test_escape_hatch_env(self, tmp_path, monkeypatch):
+        from tensorflowonspark_tpu import TPUCluster
+
+        monkeypatch.setenv("TFOS_NO_PREFLIGHT", "1")
+        lock = threading.Lock()
+
+        def map_fun(args, ctx):
+            with lock:
+                pass
+
+        backend = _RecordingBackend()
+        # preflight skipped: the run proceeds all the way to backend.start
+        with pytest.raises(AssertionError, match="backend.start reached"):
+            TPUCluster.run(map_fun, {}, 1, backend=backend,
+                           working_dir=str(tmp_path))
+        assert backend.start_calls == 1

@@ -50,6 +50,44 @@ def test_parse_plan_rejects_malformed(bad):
         parse_plan(bad)
 
 
+@pytest.mark.parametrize("bad,tokens", [
+    # bad action verb: the verb and the accepted set are both named
+    ("explode node=0 at_step=1", ["'explode'", "kill"]),
+    # missing node=: the whole offending action is quoted
+    ("kill at_step=3", ["'kill at_step=3'", "node=<int>"]),
+    # non-numeric at_step: key and offending value are both named
+    ("kill node=0 at_step=soon", ["'at_step'", "'soon'"]),
+    # non-numeric node
+    ("kill node=zero at_step=3", ["'node'", "'zero'"]),
+    # unknown key: key is named, known keys listed
+    ("kill node=0 at_step=3 volume=11", ["'volume'", "at_step"]),
+    # bare token with no '=': the token is quoted
+    ("kill node=0 at_step", ["'at_step'", "key=value"]),
+    # missing trigger: both trigger spellings offered
+    ("kill node=0", ["at_step=", "after_secs="]),
+])
+def test_parse_plan_errors_are_single_line_and_name_the_token(bad, tokens):
+    """A typo'd $TFOS_CHAOS plan must fail with a single-line error that
+    names the offending token — it surfaces through a worker crash file,
+    where a multi-line or vague message costs a round of debugging."""
+    with pytest.raises(ChaosPlanError) as ei:
+        parse_plan(bad)
+    msg = str(ei.value)
+    assert "\n" not in msg, f"multi-line chaos error: {msg!r}"
+    for token in tokens:
+        assert token in msg, f"error {msg!r} does not name {token!r}"
+
+
+def test_parse_plan_error_names_offending_action_in_multiaction_plan():
+    """Only the bad action is quoted, not the whole plan."""
+    with pytest.raises(ChaosPlanError) as ei:
+        parse_plan("kill node=0 at_step=1; stall node=1 at_step=nope")
+    msg = str(ei.value)
+    assert "\n" not in msg
+    assert "'nope'" in msg
+    assert "stall" in msg and "kill node=0" not in msg
+
+
 def test_from_env_filters_to_this_executor(monkeypatch, tmp_path):
     monkeypatch.setenv(chaos.PLAN_ENV, "kill node=1 at_step=3")
     assert chaos.from_env(0, state_dir=str(tmp_path)) is None  # not targeted

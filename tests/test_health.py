@@ -327,6 +327,17 @@ def test_classify_failure_user_vs_infra():
         assert classify_failure(ClusterFailure(kind, "x")) == kind
 
 
+def test_classify_failure_preflight_rejection_is_no_retry():
+    """A submit-time payload rejection is deterministic — retrying it with
+    backoff just delays the user's error by the whole restart budget."""
+    from tensorflowonspark_tpu.analysis.preflight import PreflightError
+
+    exc = PreflightError("map_fun", [("map_fun closure 'lock'",
+                                      "threading lock (unpicklable)")])
+    assert classify_failure(exc) == health.USER
+    assert not health.classify_restart(classify_failure(exc))
+
+
 def test_classify_restart_policy():
     assert not classify_restart(health.USER)
     for kind in (health.CRASH, health.HANG, health.PREEMPTION, health.INFRA):

@@ -1,0 +1,373 @@
+"""Telemetry-plane overhead benchmark + acceptance probes.
+
+A/Bs the PR-4 serving bench topology (2 `ContinuousBatcher` replicas
+over `LocalProcessBackend`, Poisson open-loop load) with the telemetry
+plane ON (metrics registry + heartbeat-carried snapshots + request
+tracing + live `/metrics` endpoint) vs OFF (`TFOS_NO_TELEMETRY=1` in
+driver and workers, no exposition server), and measures the per-request
+cost as the tok/s delta.  Each arm runs in its own subprocess so the
+kill switch is set before the package's default registry is created.
+
+The ON arm also exercises the acceptance criteria end to end:
+
+- scrapes the live `/metrics` page mid-run and asserts the Prometheus
+  text carries scheduler queue depth, per-replica outstanding, the TTFT
+  histogram, and the shed/requeue counters (a direct `submit` burst past
+  `max_queue_depth` tickles the shed counter deterministically);
+- re-runs with a `TFOS_CHAOS` replica kill, finds the failed-over
+  request's trace id, stitches its admission → route → first-token →
+  requeue → re-route → done timeline with `tracing.stitch_trace`, and
+  proves the `scripts/tfos_trace.py` CLI renders the same trace.
+
+Writes ``bench_artifacts/telemetry.json``::
+
+    {"benchmark": "telemetry",
+     "config": {...},
+     "arms": {"telemetry_on": {...}, "telemetry_off": {...}},
+     "overhead": {"tok_s_on", "tok_s_off", "regression_pct",
+                  "bar_pct": 5.0, "pass": bool,
+                  "pr4_serving_steady_tok_s": float | None},
+     "exposition": {"series": {name: bool}, "sample_lines": [...]},
+     "trace": {"trace_id", "kinds", "requeued_hop", "cli_exit",
+               "timeline": "..."}}
+
+Run: ``python scripts/bench_telemetry.py [--requests 60] [--rate 6]``.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+RESULT_MARK = "RESULT_JSON: "
+
+#: exposition series the /metrics page must carry during the run, as
+#: (line prefix, label fragment) — the merged page stamps a leading
+#: ``node=...`` label on every sample, so exact label sets can't be used
+REQUIRED_SERIES = {
+    "queue_depth": ("tfos_serving_queue_depth_count", ""),
+    "replica_outstanding": ("tfos_serving_replica_outstanding_count{", ""),
+    "ttft_histogram": ("tfos_serving_ttft_seconds_bucket{", ""),
+    "shed_counter": ("tfos_serving_requests_total{", 'outcome="shed"'),
+    "requeue_counter": ("tfos_serving_requests_total{",
+                        'outcome="requeued"'),
+    "accepted_counter": ("tfos_serving_requests_total{",
+                         'outcome="accepted"'),
+    "replica_side_tokens": ("tfos_replica_tokens_total{", ""),
+}
+
+
+def _series_present(page: str, spec: tuple) -> bool:
+    prefix, fragment = spec
+    return any(ln.startswith(prefix) and fragment in ln
+               for ln in page.splitlines())
+
+
+# --------------------------------------------------------------- child arms
+
+def _drive(serving, reqs, rate, rng, traces=None, on_half_issued=None):
+    """Open-loop Poisson load (the serving bench's shape), optionally
+    stamping client-supplied trace ids and firing a mid-run callback."""
+    from tensorflowonspark_tpu.serving import ServingError
+
+    records = [None] * len(reqs)
+    threads = []
+
+    def one(i, prompt, budget):
+        t0 = time.monotonic()
+        rec = {"ok": False, "ttft": None, "e2e": None, "tokens": 0}
+        try:
+            with serving.client() as c:
+                toks = []
+                for delta in c.generate_stream(
+                        prompt, budget, timeout=600,
+                        trace=traces[i] if traces else None):
+                    if rec["ttft"] is None:
+                        rec["ttft"] = time.monotonic() - t0
+                    toks.extend(delta)
+                rec["e2e"] = time.monotonic() - t0
+                rec["tokens"] = len(toks)
+                rec["ok"] = True
+        except ServingError as e:
+            rec["error"] = f"{type(e).__name__}: {e}"
+        records[i] = rec
+
+    for i, (p, n) in enumerate(reqs):
+        t = threading.Thread(target=one, args=(i, p, n), daemon=True)
+        t.start()
+        threads.append(t)
+        if on_half_issued is not None and i == len(reqs) // 2:
+            on_half_issued()
+        time.sleep(rng.exponential(1.0 / rate))
+    for t in threads:
+        t.join(600)
+    return records
+
+
+def _scrape(address):
+    host, port = address
+    return urllib.request.urlopen(
+        f"http://{host}:{port}/metrics", timeout=10).read().decode()
+
+
+def _shed_probe(serving):
+    """Deterministically tick the shed counter: direct submits past
+    max_queue_depth (then abandon the probes — they never decode)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.serving import RequestRejected
+
+    probes = []
+    try:
+        for _ in range(serving.scheduler.max_queue_depth + 1):
+            probes.append(serving.scheduler.submit(
+                np.asarray([1, 2, 3], np.int32), 4))
+    except RequestRejected:
+        pass
+    else:
+        raise RuntimeError("shed probe never hit the queue bound")
+    for req in probes:
+        serving.scheduler.abandon(req, reason="abandoned")
+
+
+def _run_scenario(bench_serving, *, requests, rate, replicas, slots,
+                  telemetry, kill_step=None, seed=0):
+    """One serving run; returns (tok/s row, scrape texts, working_dir,
+    trace ids in request order)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.serving import ServingCluster
+
+    wd = tempfile.mkdtemp(prefix="tfos_bench_telemetry_")
+    worker_env = {"JAX_PLATFORMS": "cpu"}
+    if not telemetry:
+        worker_env["TFOS_NO_TELEMETRY"] = "1"
+    if kill_step is not None:
+        worker_env["TFOS_CHAOS"] = f"kill node=1 at_step={kill_step}"
+
+    rng = np.random.default_rng(seed)
+    reqs = [(rng.integers(0, bench_serving.VOCAB,
+                          (int(rng.integers(3, 10)),)).astype(np.int32),
+             int(rng.integers(8, 17)))
+            for _ in range(requests)]
+    traces = None
+    if telemetry:
+        from tensorflowonspark_tpu import tracing
+
+        traces = [tracing.new_trace_id() for _ in reqs]
+
+    serving = ServingCluster.run(
+        bench_serving.bench_model_builder, replicas, max_batch=slots,
+        worker_env=worker_env, reservation_timeout=120, working_dir=wd,
+        metrics_port=0 if telemetry else None)
+    scrapes = []
+    try:
+        def _warm():
+            with serving.client() as c:
+                c.generate(reqs[0][0], 2, timeout=600)
+
+        warmers = [threading.Thread(target=_warm) for _ in range(replicas)]
+        for t in warmers:
+            t.start()
+        for t in warmers:
+            t.join(600)
+
+        on_half = None
+        if telemetry:
+            def on_half():
+                scrapes.append(_scrape(serving.metrics_address))
+
+        t0 = time.monotonic()
+        records = _drive(serving, reqs, rate, rng, traces=traces,
+                         on_half_issued=on_half)
+        wall = time.monotonic() - t0
+        if telemetry:
+            if kill_step is None:
+                _shed_probe(serving)
+            scrapes.append(_scrape(serving.metrics_address))
+    finally:
+        serving.shutdown(timeout=300)
+
+    ok = [r for r in records if r and r["ok"]]
+    bad = [r for r in records if not (r and r["ok"])]
+    if bad:
+        raise RuntimeError(f"requests failed: {bad[:3]}")
+    tokens = sum(r["tokens"] for r in ok)
+    row = {"requests": len(ok), "tokens_total": tokens,
+           "wall_secs": round(wall, 3),
+           "throughput_tokens_per_s": round(tokens / wall, 2),
+           "ttft_p50_secs": round(sorted(
+               r["ttft"] for r in ok)[len(ok) // 2], 4)}
+    return row, scrapes, wd, traces
+
+
+def _stitch_requeued_trace(wd):
+    """The failed-over request's stitched timeline + the CLI's view."""
+    from tensorflowonspark_tpu import tracing
+
+    requeued = [t for t, info in tracing.list_traces(wd).items()
+                if "request_requeued" in info["kinds"]]
+    if not requeued:
+        raise RuntimeError("chaos kill produced no requeued trace")
+    trace = requeued[0]
+    timeline = tracing.stitch_trace(wd, trace)
+    kinds = [r["kind"] for r in timeline if not r.get("_context")]
+    for a, b in [("request_admitted", "request_routed"),
+                 ("request_routed", "request_requeued"),
+                 ("request_requeued", "request_done")]:
+        assert kinds.index(a) < kinds.index(b), (a, b, kinds)
+    assert "request_first_token" in kinds, kinds
+    routed = [r for r in timeline if r["kind"] == "request_routed"]
+    assert len(routed) == 2 and routed[0]["replica"] != routed[1]["replica"]
+    cli = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tfos_trace.py"),
+         "--dir", wd, trace], capture_output=True, text=True, timeout=120)
+    assert trace_ok(cli), cli.stderr
+    return {"trace_id": trace, "kinds": kinds,
+            "requeued_hop": {"from": routed[0]["replica"],
+                             "to": routed[1]["replica"]},
+            "cli_exit": cli.returncode,
+            "timeline": tracing.format_timeline(timeline)}
+
+
+def trace_ok(cli) -> bool:
+    return cli.returncode == 0 and "request_requeued" in cli.stdout
+
+
+def run_arm(args) -> dict:
+    # a plain import (scripts/ on sys.path, which spawn propagates to the
+    # replica processes) so bench_model_builder pickles by reference
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import bench_serving
+
+    telemetry = args.arm == "on"
+    from tensorflowonspark_tpu import metrics
+
+    assert metrics.telemetry_enabled() == telemetry, \
+        "TFOS_NO_TELEMETRY must be set before the process imports the package"
+
+    out = {"telemetry": telemetry}
+    row, scrapes, _, _ = _run_scenario(
+        bench_serving, requests=args.requests, rate=args.rate,
+        replicas=args.replicas, slots=args.slots, telemetry=telemetry)
+    out["steady"] = row
+
+    if telemetry:
+        # series presence across the mid-run + post-probe scrapes
+        # (requeue asserted on the kill run's page below)
+        page = "\n".join(scrapes)
+        series = {k: _series_present(page, spec)
+                  for k, spec in REQUIRED_SERIES.items()
+                  if k != "requeue_counter"}
+        kill_row, kill_scrapes, kill_wd, _ = _run_scenario(
+            bench_serving, requests=args.requests, rate=args.rate,
+            replicas=args.replicas, slots=args.slots, telemetry=True,
+            kill_step=args.kill_step)
+        series["requeue_counter"] = _series_present(
+            "\n".join(kill_scrapes), REQUIRED_SERIES["requeue_counter"])
+        missing = [k for k, hit in series.items() if not hit]
+        if missing:
+            raise RuntimeError(f"/metrics page missing series: {missing}")
+        out["replica_kill"] = kill_row
+        out["exposition"] = {
+            "series": series,
+            "sample_lines": sorted(
+                ln for ln in set("\n".join(scrapes).splitlines())
+                if ln.startswith(("tfos_serving_queue_depth",
+                                  "tfos_serving_replica_outstanding",
+                                  "tfos_serving_requests_total"))),
+        }
+        out["trace"] = _stitch_requeued_trace(kill_wd)
+    return out
+
+
+# ------------------------------------------------------------------- parent
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=60)
+    ap.add_argument("--rate", type=float, default=6.0)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--kill-step", type=int, default=8)
+    ap.add_argument("--arm", choices=["on", "off"],
+                    help="internal: run one A/B arm in this process")
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.arm:
+        print(RESULT_MARK + json.dumps(run_arm(args)))
+        return
+
+    arms = {}
+    for arm in ("off", "on"):        # off first: a clean-room baseline
+        env = dict(os.environ)
+        env.pop("TFOS_NO_TELEMETRY", None)
+        if arm == "off":
+            env["TFOS_NO_TELEMETRY"] = "1"
+        cmd = [sys.executable, os.path.abspath(__file__), "--arm", arm,
+               "--requests", str(args.requests), "--rate", str(args.rate),
+               "--replicas", str(args.replicas), "--slots", str(args.slots),
+               "--kill-step", str(args.kill_step)]
+        print(f"== arm telemetry_{arm}: {' '.join(cmd)}", flush=True)
+        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                              timeout=3600)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stdout[-4000:] + proc.stderr[-4000:])
+            raise SystemExit(f"arm {arm} failed rc={proc.returncode}")
+        (line,) = [ln for ln in proc.stdout.splitlines()
+                   if ln.startswith(RESULT_MARK)]
+        arms[f"telemetry_{arm}"] = json.loads(line[len(RESULT_MARK):])
+
+    tok_on = arms["telemetry_on"]["steady"]["throughput_tokens_per_s"]
+    tok_off = arms["telemetry_off"]["steady"]["throughput_tokens_per_s"]
+    regression = 100.0 * (tok_off - tok_on) / tok_off
+    pr4 = None
+    try:
+        with open(os.path.join(REPO, "bench_artifacts", "serving.json")) as f:
+            pr4 = [r for r in json.load(f)["rows"]
+                   if r["scenario"] == "steady"][0]["throughput_tokens_per_s"]
+    except (OSError, KeyError, IndexError, ValueError):
+        pass
+
+    out = {
+        "benchmark": "telemetry",
+        "config": {
+            "backend": "LocalProcessBackend", "platform": "cpu",
+            "replicas": args.replicas, "slots_per_replica": args.slots,
+            "poisson_rate_per_s": args.rate, "requests": args.requests,
+            "kill_plan": f"kill node=1 at_step={args.kill_step}",
+            "ab_switch": "TFOS_NO_TELEMETRY=1 (driver + workers), "
+                         "metrics_port=None in the off arm",
+        },
+        "arms": arms,
+        "overhead": {
+            "tok_s_on": tok_on, "tok_s_off": tok_off,
+            "regression_pct": round(regression, 2),
+            "bar_pct": 5.0, "pass": regression < 5.0,
+            "pr4_serving_steady_tok_s": pr4,
+        },
+        "exposition": arms["telemetry_on"].get("exposition"),
+        "trace": arms["telemetry_on"].get("trace"),
+    }
+    path = os.path.join(REPO, "bench_artifacts", "telemetry.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out["overhead"], indent=2))
+    print(f"wrote {path}")
+    if not out["overhead"]["pass"]:
+        raise SystemExit("telemetry overhead exceeds the 5% bar")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""tfos-trace: stitch one request's end-to-end timeline from the JSONL
+telemetry streams of a cluster working dir (docs/observability.md).
+
+    python scripts/tfos_trace.py --dir /tmp/tfos_tpu_xxxx --list
+    python scripts/tfos_trace.py --dir /tmp/tfos_tpu_xxxx <trace_id>
+
+The timeline merges ``serving_events.jsonl`` (admission, routing, first
+token, requeue hops, completion), ``trace_events.jsonl`` (replica-side
+intake/decode spans) and ``health_events.jsonl``; cluster failures inside
+the request's window (e.g. the chaos replica kill that caused a requeue)
+appear as ``[context]`` rows.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def main(argv=None) -> int:
+    from tensorflowonspark_tpu import tracing
+
+    ap = argparse.ArgumentParser(
+        prog="tfos_trace",
+        description="Reconstruct one request's admission→route→first-token"
+                    "→done timeline from a cluster's JSONL streams.")
+    ap.add_argument("trace_id", nargs="?",
+                    help="trace id to stitch (omit with --list)")
+    ap.add_argument("--dir", default=".", dest="working_dir",
+                    help="cluster working dir holding the *_events.jsonl "
+                         "streams (default: cwd)")
+    ap.add_argument("--list", action="store_true",
+                    help="list trace ids seen in the streams and exit")
+    ap.add_argument("--context-slack", type=float, default=1.0,
+                    help="seconds around the trace window in which "
+                         "untraced failure events are folded in")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        traces = tracing.list_traces(args.working_dir)
+        if not traces:
+            print("no traced events found under", args.working_dir)
+            return 1
+        for trace, info in traces.items():
+            print(f"{trace}  spans={info['spans']:<3d} "
+                  f"kinds={','.join(info['kinds'])}")
+        return 0
+    if not args.trace_id:
+        ap.error("trace_id required (or use --list)")
+    timeline = tracing.stitch_trace(args.working_dir, args.trace_id,
+                                    context_slack=args.context_slack)
+    if not timeline:
+        print(f"trace {args.trace_id} not found under {args.working_dir} "
+              "(try --list)", file=sys.stderr)
+        return 1
+    print(tracing.format_timeline(timeline))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

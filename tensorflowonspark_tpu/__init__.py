@@ -62,3 +62,8 @@ from tensorflowonspark_tpu import (TFCluster, TFManager, TFNode,  # noqa: F401,E
 # ContinuousBatcher replicas.  Safe to import eagerly — the replica-side
 # jax/model imports happen inside the worker map_fun, not at import time.
 from tensorflowonspark_tpu import serving  # noqa: F401,E402
+
+# Telemetry plane (docs/observability.md): process-local metrics registry
+# with heartbeat-carried aggregation + Prometheus exposition, and
+# end-to-end request tracing with the tfos_trace timeline stitcher.
+from tensorflowonspark_tpu import metrics, tracing  # noqa: F401,E402

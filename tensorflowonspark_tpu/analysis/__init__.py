@@ -6,9 +6,10 @@ Usage (``docs/analysis.md`` has the full rule catalog):
     python -m tensorflowonspark_tpu.analysis [--json] \
         [--baseline analysis_baseline.json] paths...
 
-Five rules encode this codebase's invariants — ``closure-capture``,
+Six rules encode this codebase's invariants — ``closure-capture``,
 ``jit-purity``, ``lock-discipline``, ``resource-lifecycle``,
-``broad-except`` — plus the ``exports-drift`` docs/API consistency check.
+``broad-except``, ``metric-naming`` — plus the ``exports-drift``
+docs/API consistency check.
 The closure-capture invariant is also enforced at runtime by
 :func:`~tensorflowonspark_tpu.analysis.preflight.check_payload`, which
 ``TPUCluster.run`` calls before spawning any worker process.
@@ -28,6 +29,7 @@ from tensorflowonspark_tpu.analysis.engine import (Finding, Rule,  # noqa: F401
                                                    write_baseline)
 from tensorflowonspark_tpu.analysis.jit_purity import JitPurityRule
 from tensorflowonspark_tpu.analysis.lock_discipline import LockDisciplineRule
+from tensorflowonspark_tpu.analysis.metric_naming import MetricNamingRule
 from tensorflowonspark_tpu.analysis.resource_lifecycle import \
     ResourceLifecycleRule
 
@@ -37,6 +39,7 @@ ALL_RULES = [
     LockDisciplineRule,
     ResourceLifecycleRule,
     BroadExceptRule,
+    MetricNamingRule,
 ]
 
 RULE_IDS = tuple(r.id for r in ALL_RULES)
@@ -45,5 +48,5 @@ __all__ = [
     "ALL_RULES", "RULE_IDS", "Finding", "Rule", "analyze_paths",
     "analyze_source", "load_baseline", "new_findings", "write_baseline",
     "BroadExceptRule", "ClosureCaptureRule", "JitPurityRule",
-    "LockDisciplineRule", "ResourceLifecycleRule",
+    "LockDisciplineRule", "MetricNamingRule", "ResourceLifecycleRule",
 ]

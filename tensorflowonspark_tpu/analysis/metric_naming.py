@@ -1,0 +1,137 @@
+"""Rule ``metric-naming``: registered metric names must follow the catalog
+convention.
+
+The telemetry plane's whole value is a *consistent* catalog
+(docs/observability.md): one naming scheme, greppable prefixes, explicit
+units.  A metric registered as ``requests`` next to one registered as
+``tfos_serving_requests_total`` makes dashboards and the heartbeat-merged
+exposition page lie by omission.  This rule pins every statically visible
+registration — ``registry.counter("...")`` / ``.gauge("...")`` /
+``.histogram("...")`` calls and direct ``Counter``/``Gauge``/``Histogram``
+constructions imported from :mod:`tensorflowonspark_tpu.metrics` — to:
+
+- ``^[a-z][a-z0-9_]*$`` (Prometheus-safe, lowercase snake case);
+- a ``tfos_`` prefix (the project namespace);
+- a unit suffix: counters end ``_total``, gauges/histograms end in one of
+  ``_seconds`` / ``_bytes`` / ``_count`` / ``_ratio`` / ``_info``.
+
+The convention itself lives in :mod:`tensorflowonspark_tpu.metrics`
+(:func:`~tensorflowonspark_tpu.metrics.validate_name`, which enforces it
+at runtime); this rule calls that same validator at review time, before
+a worker ever registers the bad name — one source of truth, two
+enforcement points.  Only string-literal first arguments are checked — a
+dynamically built name is invisible here and fails at registration
+instead.  Method calls are checked only on *registry receivers* — a name
+assigned from ``get_registry()`` / ``MetricsRegistry(...)`` or a call
+chained directly off one — so a third-party client's ``statsd.gauge("x")``
+never false-positives.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tensorflowonspark_tpu.analysis.engine import FileContext, Finding, Rule
+from tensorflowonspark_tpu.metrics import validate_name
+
+_METHODS = {"counter": "counter", "gauge": "gauge", "histogram": "histogram"}
+_CONSTRUCTORS = {"Counter": "counter", "Gauge": "gauge",
+                 "Histogram": "histogram"}
+_METRICS_MODULE = "tensorflowonspark_tpu.metrics"
+
+
+def _metrics_constructor_imports(tree: ast.Module) -> set[str]:
+    """Names bound in this file to Counter/Gauge/Histogram imported from
+    the metrics module — only those constructors are metric
+    registrations (``collections.Counter`` must not false-positive)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == _METRICS_MODULE:
+            for alias in node.names:
+                if alias.name in _CONSTRUCTORS:
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+_REGISTRY_FACTORIES = ("get_registry", "MetricsRegistry")
+
+
+def _is_registry_call(node: ast.AST, factory_imports: set[str]) -> bool:
+    """True for ``get_registry(...)`` / ``MetricsRegistry(...)`` calls —
+    by local name imported from the metrics module, or as an attribute
+    (``metrics.get_registry()``, ``_metrics.MetricsRegistry(...)``)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in factory_imports
+    return isinstance(f, ast.Attribute) and f.attr in _REGISTRY_FACTORIES
+
+
+def _registry_bindings(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(names bound to a registry instance, local names of the registry
+    factories imported from the metrics module)."""
+    factories: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == _METRICS_MODULE:
+            for alias in node.names:
+                if alias.name in _REGISTRY_FACTORIES:
+                    factories.add(alias.asname or alias.name)
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) \
+                and _is_registry_call(node.value, factories):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+    return names, factories
+
+
+def _check_name(name: str, kind: str) -> str | None:
+    """The violation message for ``name`` registered as ``kind``, or
+    None when conformant (delegates to ``metrics.validate_name`` — the
+    runtime and static checks can never drift apart)."""
+    try:
+        validate_name(name, kind)
+    except ValueError as e:
+        return str(e)
+    return None
+
+
+class MetricNamingRule(Rule):
+    id = "metric-naming"
+    description = ("registered metric names must be tfos_-prefixed "
+                   "snake_case with a unit suffix")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
+        constructors = _metrics_constructor_imports(tree)
+        reg_names, factories = _registry_bindings(tree)
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                continue
+            func = node.func
+            kind = None
+            if isinstance(func, ast.Attribute) and func.attr in _METHODS:
+                # only registry receivers: `reg.counter(...)` where reg
+                # came from get_registry()/MetricsRegistry(...), or the
+                # chained `get_registry().counter(...)` — a third-party
+                # client's .gauge()/.counter() is not ours to police
+                recv = func.value
+                if isinstance(recv, ast.Name) and recv.id in reg_names \
+                        or _is_registry_call(recv, factories):
+                    kind = _METHODS[func.attr]
+            elif isinstance(func, ast.Name) and func.id in constructors:
+                kind = _CONSTRUCTORS[func.id]
+            if kind is None:
+                continue
+            msg = _check_name(first.value, kind)
+            if msg is not None:
+                findings.append(ctx.finding(self.id, node, msg))
+        return findings

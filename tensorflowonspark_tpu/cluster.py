@@ -42,6 +42,7 @@ import threading
 import time
 
 from tensorflowonspark_tpu import health as tpu_health
+from tensorflowonspark_tpu import metrics as tpu_metrics
 from tensorflowonspark_tpu import node as tpu_node, util
 from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition
 from tensorflowonspark_tpu.queues import DEFAULT_QUEUES, QueueClient
@@ -162,12 +163,51 @@ class TPUCluster:
         self._stop_feed = threading.Event()  # one-shot for the cluster's life
         self._active_feeders: set = set()
         self._monitor: "tpu_health.ClusterMonitor | None" = None
+        self._metrics_http = None
 
     @property
     def monitor(self):
         """The steady-state :class:`~tensorflowonspark_tpu.health.
         ClusterMonitor`, or None when disabled (``monitor=False``)."""
         return self._monitor
+
+    # ------------------------------------------------------------- metrics
+    def metrics(self) -> dict:
+        """Aggregated cluster telemetry (docs/observability.md).
+
+        ``{"driver": <this process's metrics-registry snapshot>,
+        "nodes": {eid: {"metrics", "goodput", "step", "phase",
+        "age_secs"}}}`` — the per-node view is whatever each worker's
+        :class:`~tensorflowonspark_tpu.health.HeartbeatReporter` last
+        carried in its heartbeat payload, read from the running
+        monitor's cache (empty with ``monitor=False``)."""
+        nodes = (self._monitor.node_metrics()
+                 if self._monitor is not None else {})
+        return {"driver": tpu_metrics.get_registry().snapshot(),
+                "nodes": nodes}
+
+    def metrics_text(self) -> str:
+        """The merged cluster view in Prometheus text exposition format
+        (driver samples labeled ``node="driver"``, worker samples by
+        executor id)."""
+        m = self.metrics()
+        return tpu_metrics.render_cluster_text(m["driver"], m["nodes"])
+
+    def serve_metrics(self, host: str = "127.0.0.1",
+                      port: int = 0) -> tuple[str, int]:
+        """Start (or return) this cluster's ``/metrics`` + ``/statusz``
+        HTTP endpoint — the standalone exposition server for
+        training-only jobs (the serving tier hangs its own off the
+        frontend).  Returns the bound ``(host, port)``."""
+        if self._metrics_http is None:
+            server = tpu_metrics.MetricsHTTPServer(
+                self.metrics_text, statusz=self.metrics,
+                host=host, port=port)
+            server.start()
+            # cache only a server that actually bound — a failed start
+            # (port taken) must stay retryable
+            self._metrics_http = server
+        return self._metrics_http.address
 
     # ------------------------------------------------------------------ run
     @classmethod
@@ -514,6 +554,10 @@ class TPUCluster:
             # SIGTERMed workers never run their finally block, and their
             # TensorBoard child lives in its own session — kill it from here
             _kill_registered_tensorboards(self.cluster_info)
+        if self._metrics_http is not None:
+            with contextlib.suppress(Exception):
+                self._metrics_http.stop()
+            self._metrics_http = None
         for c in self._clients.values():
             c.close()
         self.server.stop()
@@ -544,6 +588,10 @@ class TPUCluster:
         self._stop_feed.set()
         if self._monitor is not None:
             self._monitor.stop()  # no-op join when called from its thread
+        if self._metrics_http is not None:
+            with contextlib.suppress(Exception):
+                self._metrics_http.stop()
+            self._metrics_http = None
         with contextlib.suppress(Exception):
             self.backend.terminate()
         _kill_registered_tensorboards(self.cluster_info)
@@ -612,6 +660,10 @@ def run_with_recovery(map_fun, tf_args, num_workers: int, *,
     # already clears stale error files when reusing a dir)
     if run_kwargs.get("working_dir") is None:
         run_kwargs["working_dir"] = tempfile.mkdtemp(prefix="tfos_tpu_job_")
+    restarts_total = tpu_metrics.get_registry().counter(
+        "tfos_restarts_total",
+        "Cluster relaunches performed by run_with_recovery, by failure "
+        "kind.", labelnames=("kind",))
     attempt = 0
     while True:
         cluster = None
@@ -644,6 +696,7 @@ def run_with_recovery(map_fun, tf_args, num_workers: int, *,
                     "restart budget exhausted (%d restarts within %.0fs); "
                     "raising", restart_budget[0], restart_budget[1])
                 raise
+            restarts_total.inc(kind=kind)
             delay = tpu_health.backoff_delay(attempt, backoff_base, backoff_cap)
             logger.warning(
                 "cluster attempt %d/%d failed [%s] (%s: %s); relaunching in "

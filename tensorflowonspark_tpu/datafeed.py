@@ -29,6 +29,7 @@ import time
 
 import numpy as np
 
+from tensorflowonspark_tpu import metrics as _metrics
 from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition, Marker
 
 logger = logging.getLogger(__name__)
@@ -55,6 +56,16 @@ class DataFeed:
         )
         self.done_feeding = False
         self._buffer: list = []          # samples carried over between batches
+        # feed telemetry: wait time blocked on the queue, chunk/sample
+        # throughput — carried to the driver in the heartbeat payload
+        reg = _metrics.get_registry()
+        self._m_wait = reg.histogram(
+            "tfos_feed_wait_seconds",
+            "Time blocked on the input queue per fetched chunk.")
+        self._m_chunks = reg.counter(
+            "tfos_feed_chunks_total", "Chunks consumed from the feed.")
+        self._m_items = reg.counter(
+            "tfos_feed_items_total", "Samples consumed from the feed.")
 
     # -- input -------------------------------------------------------------
     def next_batch(self, batch_size: int, timeout: float = 600.0):
@@ -74,6 +85,7 @@ class DataFeed:
                 batch.extend(self._buffer[:take])
                 self._buffer = self._buffer[take:]
                 continue
+            wait_start = time.monotonic()
             try:
                 item = self.mgr.queue_get(self.qname_in,
                                           timeout=max(0.1, deadline - time.monotonic()))
@@ -81,6 +93,7 @@ class DataFeed:
                 if batch:
                     break
                 raise TimeoutError(f"no data on '{self.qname_in}' after {timeout}s")
+            self._m_wait.record(time.monotonic() - wait_start)
             if isinstance(item, EndOfFeed):
                 self.done_feeding = True
                 break
@@ -91,6 +104,8 @@ class DataFeed:
             if isinstance(item, Marker):  # unknown marker: skip
                 continue
             samples = item if isinstance(item, (list, tuple)) else [item]
+            self._m_chunks.inc()
+            self._m_items.inc(len(samples))
             if self.input_tensors is not None:
                 samples = [
                     [s[col] for col in self.input_tensors] if isinstance(s, dict) else s
@@ -120,6 +135,7 @@ class DataFeed:
             return None
         deadline = time.monotonic() + timeout
         while True:
+            wait_start = time.monotonic()
             try:
                 item = self.mgr.queue_get(
                     self.qname_in,
@@ -127,11 +143,13 @@ class DataFeed:
             except (_queue.Empty, TimeoutError):
                 raise TimeoutError(
                     f"no data on '{self.qname_in}' after {timeout}s")
+            self._m_wait.record(time.monotonic() - wait_start)
             if isinstance(item, EndOfFeed):
                 self.done_feeding = True
                 return None
             if isinstance(item, Marker):
                 continue
+            self._m_chunks.inc()   # opaque pre-batched chunk: no item count
             return item
 
     def next_batch_arrays(self, batch_size: int, timeout: float = 600.0):

@@ -49,6 +49,7 @@ import threading
 import time
 from collections import deque
 
+from tensorflowonspark_tpu import metrics as tpu_metrics
 from tensorflowonspark_tpu import observability
 from tensorflowonspark_tpu.queues import QueueClient
 
@@ -121,6 +122,8 @@ class HeartbeatReporter:
         self._seq = 0
         self._step: int | None = None
         self._phase = "boot"
+        self._goodput = None            # observability.GoodputRecorder
+        self._metrics_extras: dict = {}  # last snapshot, reused per publish
         # RLock: set_phase("preempted") runs inside the SIGTERM handler,
         # which executes on the MAIN thread and may interrupt report_step
         # while it holds this lock — a plain Lock would self-deadlock
@@ -132,7 +135,7 @@ class HeartbeatReporter:
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "HeartbeatReporter":
-        self._publish()
+        self._publish(include_metrics=True)
         self._thread = threading.Thread(target=self._run, name="heartbeat",
                                         daemon=True)
         self._thread.start()
@@ -162,7 +165,15 @@ class HeartbeatReporter:
         surfaced to the driver's classifier."""
         with self._lock:
             self._phase = phase
-        self._publish()
+        self._publish(include_metrics=True)
+
+    def attach_goodput(self, recorder) -> None:
+        """Carry ``recorder.summary()`` in the heartbeat payload so the
+        driver's aggregated ``metrics()`` view shows per-node goodput
+        live, not only as an end-of-job JSON file (``ctx.goodput()`` is
+        the map_fun-side entry point)."""
+        self._goodput = recorder
+        self._publish(include_metrics=True)
 
     def note_preempted(self) -> None:
         """Signal-handler-safe phase flip to ``preempted``: one attribute
@@ -185,14 +196,35 @@ class HeartbeatReporter:
         agent.attach(self)
 
     # -- internals -------------------------------------------------------
-    def _publish(self) -> None:
+    def _publish(self, include_metrics: bool = False) -> None:
+        """Publish the heartbeat payload.  ``include_metrics`` refreshes
+        this process's metrics-registry snapshot (and goodput summary) —
+        the zero-new-sockets telemetry transport.  Only the periodic
+        beat (and phase changes) pay the snapshot cost; ``report_step``'s
+        per-step publishes reuse the cached extras so a fast decode/
+        train loop never folds histograms on its hot path, yet EVERY
+        payload the driver samples carries telemetry (at most one
+        ``interval`` stale)."""
         if time.monotonic() < self._stall_until:
             return
+        if include_metrics:
+            # snapshot outside the reporter lock: it takes registry locks
+            # of its own and runs collect hooks
+            try:
+                extras = {"metrics": tpu_metrics.get_registry().snapshot()}
+                if self._goodput is not None:
+                    extras["goodput"] = self._goodput.summary()
+                self._metrics_extras = extras
+            # tfos: ignore[broad-except] — telemetry enrichment must never
+            # block liveness reporting; the bare heartbeat still goes out
+            except Exception:
+                logger.debug("heartbeat metrics snapshot failed",
+                             exc_info=True)
         with self._lock:
             self._seq += 1
             payload = {"seq": self._seq, "time": time.time(),
                        "step": self._step, "phase": self._phase,
-                       "pid": os.getpid()}
+                       "pid": os.getpid(), **self._metrics_extras}
         try:
             self.mgr.kv_set(HEARTBEAT_KEY, payload)
         # tfos: ignore[broad-except] — liveness reporting must never kill
@@ -204,7 +236,7 @@ class HeartbeatReporter:
         while not self._stop.wait(self.interval):
             if self._chaos is not None:
                 self._chaos.on_tick()
-            self._publish()
+            self._publish(include_metrics=True)
 
 
 # ------------------------------------------------------------- driver side
@@ -274,6 +306,10 @@ class ClusterMonitor:
         self._clients: dict[int, QueueClient] = {}
         self._kv_retry_at: dict[int, float] = {}  # reconnect cooldowns
         self._hb: dict[int, dict] = {}
+        self._failures_total = tpu_metrics.get_registry().counter(
+            "tfos_health_failures_total",
+            "Classified cluster failures detected by the monitor.",
+            labelnames=("kind",))
         self._failure: ClusterFailure | None = None
         self._failure_evt = threading.Event()
         self._stop = threading.Event()
@@ -317,6 +353,25 @@ class ClusterMonitor:
         """Block until a failure is detected (or ``timeout``); returns it."""
         self._failure_evt.wait(timeout)
         return self._failure
+
+    def node_metrics(self) -> dict[int, dict]:
+        """Last heartbeat-carried telemetry per node: ``{eid: {"metrics":
+        <registry snapshot>, "goodput": <summary|None>, "step", "phase",
+        "age_secs"}}`` — the driver-side aggregation point behind
+        ``TPUCluster.metrics()`` / ``ServingCluster.metrics()``.  Purely
+        a read of what the monitor already polls; no extra kv round."""
+        now = time.monotonic()
+        out: dict[int, dict] = {}
+        for eid, rec in list(self._hb.items()):
+            if eid in self._handled:
+                # dead/retired workers must drop off the merged page,
+                # not freeze at their last-reported values
+                continue
+            out[eid] = {"metrics": rec.get("metrics") or {},
+                        "goodput": rec.get("goodput"),
+                        "step": rec.get("step"), "phase": rec.get("phase"),
+                        "age_secs": now - rec.get("seen", now)}
+        return out
 
     def poll_now(self) -> ClusterFailure | None:
         """One synchronous check, returning any (new or prior) failure.
@@ -417,6 +472,12 @@ class ClusterMonitor:
                 rec["seq"] = payload.get("seq")
                 rec["seen"] = now
                 rec["phase"] = payload.get("phase")
+                # heartbeat-carried telemetry (metrics.py): keep the last
+                # snapshot/goodput per node for the aggregated cluster view
+                if "metrics" in payload:
+                    rec["metrics"] = payload.get("metrics")
+                if "goodput" in payload:
+                    rec["goodput"] = payload.get("goodput")
                 if payload.get("step") != rec["step"]:
                     rec["step"] = payload.get("step")
                     rec["step_seen"] = now
@@ -473,6 +534,7 @@ class ClusterMonitor:
     def _fail(self, failure: ClusterFailure) -> None:
         self._failure = failure
         self.failures.append(failure)
+        self._failures_total.inc(kind=failure.kind)
         logger.error("cluster monitor: %s", failure)
         self._emit(failure.kind, message=str(failure),
                    workers=list(failure.failed_workers))

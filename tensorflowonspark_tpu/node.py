@@ -64,6 +64,7 @@ class NodeContext:
         self.tensorboard_logdir = tensorboard_logdir or os.path.join(
             self.working_dir, "tensorboard")
         self._heartbeat = None  # HeartbeatReporter, attached by node.run
+        self._goodput = None    # GoodputRecorder, created by ctx.goodput()
 
     # -- cluster spec ------------------------------------------------------
     @property
@@ -167,6 +168,27 @@ class NodeContext:
         (e.g. a NodeContext built outside the node harness)."""
         if self._heartbeat is not None:
             self._heartbeat.report_step(step, phase)
+
+    def goodput(self):
+        """This node's :class:`~tensorflowonspark_tpu.observability.
+        GoodputRecorder`, wired into the heartbeat payload.
+
+        Created on first call (idempotent).  Once attached, every beat
+        carries ``recorder.summary()`` so per-node goodput shows up in
+        the driver's aggregated ``TPUCluster.metrics()`` view live,
+        instead of only as an end-of-job JSON file::
+
+            rec = ctx.goodput()
+            with rec.time("data"):  batch = feed.next_batch(...)
+            with rec.time("step"):  state, _ = train_step(state, batch)
+        """
+        if self._goodput is None:
+            from tensorflowonspark_tpu.observability import GoodputRecorder
+
+            self._goodput = GoodputRecorder()
+            if self._heartbeat is not None:
+                self._heartbeat.attach_goodput(self._goodput)
+        return self._goodput
 
 
 def start_cluster_server(ctx: NodeContext, num_devices: int = 1, rdma: bool = False):

@@ -22,6 +22,7 @@ surface and adds the TPU-era equivalents:
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 import logging
 import os
@@ -150,21 +151,44 @@ class EventLog:
     sees every event the driver managed to classify before dying.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, echo: bool = True):
+        """``echo=False`` silences the per-event INFO log line — required
+        for per-request/per-span streams (serving audit, tracing) whose
+        emit rate would flood the process log."""
         self.path = path
+        self._echo = echo
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._f = open(path, "a", buffering=1)
         self._lock = threading.Lock()
+        self._write_failed = False
 
     def emit(self, kind: str, **fields) -> dict:
+        """Append one event.  Safe after :meth:`close` (and after the fd
+        is otherwise gone): a late monitor-thread emit into a closed
+        line-buffered file degrades to a one-time logged warning instead
+        of a ``ValueError`` out of the writer thread.  Later emits still
+        attempt the write (a transient failure — brief ENOSPC — may
+        clear), but only the first failure warns."""
         rec = {"t": time.time(), "kind": kind, **fields}
+        line = json.dumps(rec) + "\n"
         with self._lock:
-            self._f.write(json.dumps(rec) + "\n")
-        logger.info("health event: %s %s", kind, fields or "")
+            try:
+                self._f.write(line)
+            except (ValueError, OSError, AttributeError) as e:
+                # ValueError: write-after-close; OSError: fd gone
+                if not self._write_failed:
+                    self._write_failed = True
+                    logger.warning(
+                        "event log %s is unwritable (%s); dropped %r — "
+                        "later writes are retried silently", self.path, e,
+                        kind)
+                return rec
+        if self._echo:
+            logger.info("health event: %s %s", kind, fields or "")
         return rec
 
     def close(self) -> None:
-        with contextlib.suppress(OSError, ValueError):
+        with self._lock, contextlib.suppress(OSError, ValueError):
             self._f.close()
 
     @staticmethod
@@ -194,25 +218,50 @@ class EventLog:
 
 class LatencyHistogram:
     """Latency percentile accumulator (p50/p95/p99) with a lock-free
-    hot path.
+    hot path and a **bounded** sample reservoir.
 
-    ``record`` is a single ``list.append`` — atomic under the GIL — so
-    request threads never contend on a lock to record a sample (the
-    serving frontend records TTFT/e2e from many connection threads at
-    once).  Readers take a snapshot copy (also GIL-atomic via the slice)
-    and sort it; percentile reads are O(n log n) but off the hot path
-    (stats endpoints, bench roll-ups).  Percentiles use the nearest-rank
-    method, so every reported value is a latency that actually occurred.
+    ``record`` costs one ``itertools.count`` tick plus one list
+    append/assign — all GIL-atomic, no lock — so request threads never
+    contend to record a sample (the serving frontend records TTFT/e2e
+    from many connection threads at once).  The reservoir is a ring of
+    the most recent ``cap`` samples (default 4096): a long-lived serving
+    frontend at millions-of-users scale must not grow a sample list
+    forever, and recency is the window an operator actually wants
+    percentiles over.  Readers take a snapshot copy (GIL-atomic slice)
+    and sort it; percentile reads are O(cap log cap) off the hot path.
+    Percentiles use the nearest-rank method on the retained window, so
+    every reported value is a latency that actually occurred;
+    ``summary()['count']`` stays the TOTAL recorded count.
     """
 
-    def __init__(self):
+    DEFAULT_CAP = 4096
+
+    def __init__(self, cap: int = DEFAULT_CAP):
+        self._cap = max(1, int(cap))
         self._samples: list[float] = []
+        self._ids = itertools.count()   # thread-safe total-count source
+        self._count = 0
 
     def record(self, secs: float) -> None:
-        self._samples.append(float(secs))
+        i = next(self._ids)
+        if i >= self._count:            # monotonic, benign-race update
+            self._count = i + 1
+        v = float(secs)
+        s = self._samples
+        n = len(s)
+        if n >= self._cap:
+            # the list never shrinks, so i % n is always in range even
+            # if a fill-phase straggler appends concurrently; indexing
+            # by the ACTUAL length keeps every slot reachable
+            s[i % n] = v
+        else:
+            # fill phase: racing threads may overshoot cap by at most
+            # one slot each (bounded, and still part of the ring above)
+            s.append(v)
 
     def __len__(self) -> int:
-        return len(self._samples)
+        """Total samples recorded (retained window is ``min(len, cap)``)."""
+        return max(len(self._samples), self._count)
 
     @staticmethod
     def _rank(snap: list, q: float):
@@ -228,13 +277,15 @@ class LatencyHistogram:
 
     def summary(self) -> dict:
         """``{count, mean_secs, p50_secs, p95_secs, p99_secs, max_secs}``
-        (None-valued stats when no sample was recorded)."""
+        (None-valued stats when no sample was recorded).  ``count`` is
+        the total ever recorded; the other stats cover the retained
+        window (the most recent ``cap`` samples)."""
         snap = sorted(self._samples)
         n = len(snap)
         if not n:
             return {"count": 0, "mean_secs": None, "p50_secs": None,
                     "p95_secs": None, "p99_secs": None, "max_secs": None}
-        return {"count": n, "mean_secs": sum(snap) / n,
+        return {"count": len(self), "mean_secs": sum(snap) / n,
                 "p50_secs": self._rank(snap, 50),
                 "p95_secs": self._rank(snap, 95),
                 "p99_secs": self._rank(snap, 99), "max_secs": snap[-1]}

@@ -65,25 +65,28 @@ class ServeClient(MessageSocket):
 
     # -- requests ----------------------------------------------------------
     def _gen_msg(self, prompt, max_new_tokens, temperature, top_p, seed,
-                 stream, timeout):
+                 stream, timeout, trace):
         return {"op": "generate",
                 "prompt": np.asarray(prompt, np.int32).reshape(-1),
                 "max_new_tokens": int(max_new_tokens),
                 "temperature": float(temperature), "top_p": float(top_p),
                 "seed": int(seed), "stream": bool(stream),
-                "timeout": timeout}
+                "timeout": timeout, "trace": trace}
 
     def generate(self, prompt, max_new_tokens: int, *,
                  temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
-                 timeout: float | None = None) -> np.ndarray:
+                 timeout: float | None = None,
+                 trace: str | None = None) -> np.ndarray:
         """Generate to completion; returns the token array (prompt
         excluded).  ``timeout`` is the end-to-end deadline (queue wait
         included); greedy (default) output is exact vs a solo
-        ``greedy_generate`` run."""
+        ``greedy_generate`` run.  ``trace`` propagates a caller-chosen
+        trace id through the tier's telemetry (``tracing.new_trace_id()``;
+        the frontend mints one otherwise)."""
         with self._lock:
             self.send(self._sock, self._gen_msg(
                 prompt, max_new_tokens, temperature, top_p, seed,
-                stream=False, timeout=timeout))
+                stream=False, timeout=timeout, trace=trace))
             while True:
                 frame = self.receive(self._sock)
                 kind = frame[0]
@@ -95,7 +98,8 @@ class ServeClient(MessageSocket):
 
     def generate_stream(self, prompt, max_new_tokens: int, *,
                         temperature: float = 0.0, top_p: float = 1.0,
-                        seed: int = 0, timeout: float | None = None):
+                        seed: int = 0, timeout: float | None = None,
+                        trace: str | None = None):
         """Yield token deltas (lists of ints) as the replica commits them;
         exact concatenation == :meth:`generate`'s output.  Consume the
         iterator fully (or ``close()`` the client): abandoning it
@@ -103,7 +107,7 @@ class ServeClient(MessageSocket):
         with self._lock:
             self.send(self._sock, self._gen_msg(
                 prompt, max_new_tokens, temperature, top_p, seed,
-                stream=True, timeout=timeout))
+                stream=True, timeout=timeout, trace=trace))
             try:
                 while True:
                     frame = self.receive(self._sock)

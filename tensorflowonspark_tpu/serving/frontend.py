@@ -48,6 +48,7 @@ import time
 
 import numpy as np
 
+from tensorflowonspark_tpu import metrics as tpu_metrics
 from tensorflowonspark_tpu.cluster import InputMode, TPUCluster
 from tensorflowonspark_tpu.health import ClusterMonitor
 from tensorflowonspark_tpu.reservation import (FrameFormatError,
@@ -86,6 +87,9 @@ class ServeFrontend(MessageSocket):
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
         self.connections = 0
+        self._m_ops = tpu_metrics.get_registry().counter(
+            "tfos_frontend_requests_total",
+            "Frontend operations received, by op.", labelnames=("op",))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> tuple[str, int]:
@@ -139,6 +143,10 @@ class ServeFrontend(MessageSocket):
             while not self.done.is_set():
                 msg = self.receive(conn)
                 op = msg.get("op") if isinstance(msg, dict) else None
+                # label only the known op set — a client-controlled label
+                # value must not mint unbounded counter series
+                self._m_ops.inc(op=op if op in ("generate", "stats", "ping")
+                                else "other")
                 if op == "generate":
                     self._handle_generate(conn, msg)
                 elif op == "stats":
@@ -167,11 +175,14 @@ class ServeFrontend(MessageSocket):
         if timeout is None:
             timeout = self.default_timeout
         try:
+            # the edge stamps the trace id (honoring a client-supplied
+            # one): every downstream event for this request carries it
             req = self.scheduler.submit(
                 msg["prompt"], int(msg["max_new_tokens"]),
                 temperature=float(msg.get("temperature", 0.0)),
                 top_p=float(msg.get("top_p", 1.0)),
-                seed=int(msg.get("seed", 0)), timeout=timeout)
+                seed=int(msg.get("seed", 0)), timeout=timeout,
+                trace=msg.get("trace"))
         except (RequestRejected, ServingError) as e:
             self.send(conn, ("ERR", getattr(e, "reason", "rejected"), str(e)))
             return
@@ -221,6 +232,9 @@ class ServingCluster:
         self.monitor = monitor
         self.frontend = frontend
         self.address = address
+        self.metrics_http = None
+        #: ``(host, port)`` of the /metrics + /statusz endpoint, or None
+        self.metrics_address: tuple[str, int] | None = None
         self._shutdown_done = False
 
     # ------------------------------------------------------------------ run
@@ -231,7 +245,8 @@ class ServingCluster:
             max_queue_depth: int | None = None, requeue_limit: int = 1,
             hang_timeout: float = 120.0, step_timeout: float | None = None,
             monitor: bool = True, frontend_mode: str = "local",
-            client_timeout: float = 600.0, **cluster_kwargs) -> "ServingCluster":
+            client_timeout: float = 600.0,
+            metrics_port: int | None = 0, **cluster_kwargs) -> "ServingCluster":
         """Boot ``num_replicas`` serving workers and the driver-side tier.
 
         ``model_builder(args) -> (cfg, params)`` must be a picklable
@@ -239,6 +254,11 @@ class ServingCluster:
         ``cluster_kwargs`` pass through to :meth:`TPUCluster.run`
         (``backend=``, ``worker_env=``, ``working_dir=``, ``queue_shm=``,
         ``queue_depth=``, ``reservation_timeout=``...).
+
+        ``metrics_port`` binds the Prometheus ``/metrics`` + JSON
+        ``/statusz`` endpoint next to the frontend (0 = an ephemeral
+        port, surfaced as ``serving.metrics_address``; ``None``
+        disables it).
         """
         from tensorflowonspark_tpu.serving.replica import serve_replica
 
@@ -254,11 +274,11 @@ class ServingCluster:
         cluster = TPUCluster.run(serve_replica, args, num_replicas,
                                  input_mode=InputMode.SPARK, monitor=False,
                                  **cluster_kwargs)
+        scheduler = mon = frontend = None
         try:
             scheduler = ReplicaScheduler(
                 cluster, slots_per_replica=max_batch, overcommit=overcommit,
                 max_queue_depth=max_queue_depth, requeue_limit=requeue_limit)
-            mon = None
             if monitor:
                 mon = ClusterMonitor(
                     cluster, hang_timeout=hang_timeout,
@@ -271,10 +291,30 @@ class ServingCluster:
                 scheduler, authkey=cluster.cluster_meta["authkey"],
                 mode=frontend_mode, default_timeout=client_timeout)
             address = frontend.start()
+            tier = cls(cluster, scheduler, mon, frontend, address)
+            if metrics_port is not None:
+                tier.metrics_http = tpu_metrics.MetricsHTTPServer(
+                    tier.metrics_text, statusz=tier.metrics,
+                    host="127.0.0.1" if frontend_mode == "local"
+                    else "0.0.0.0", port=metrics_port)
+                bound = tier.metrics_http.start()
+                # surface a connectable address, not the wildcard bind:
+                # remote mode advertises the same host the frontend does
+                tier.metrics_address = (
+                    (address[0], bound[1]) if bound[0] == "0.0.0.0"
+                    else bound)
         except Exception:
+            # a late failure (e.g. the metrics port is taken) must tear
+            # down everything already live: the frontend's accept thread
+            # and bound port, the scheduler's threads AND its registry
+            # collect hook (scheduler.stop unhooks it), the monitor
+            for part in (frontend, scheduler, mon):
+                if part is not None:
+                    with contextlib.suppress(Exception):
+                        part.stop()
             cluster._abort()
             raise
-        return cls(cluster, scheduler, mon, frontend, address)
+        return tier
 
     # -------------------------------------------------------------- clients
     @property
@@ -289,7 +329,23 @@ class ServingCluster:
         return ServeClient(self.address, self.authkey, **kwargs)
 
     def metrics(self) -> dict:
-        return self.scheduler.metrics()
+        """The scheduler's counters/latency view, plus ``"nodes"``: the
+        heartbeat-carried per-replica registry snapshots and goodput
+        aggregated by the serving-mode monitor (docs/observability.md)."""
+        m = self.scheduler.metrics()
+        m["nodes"] = (self.monitor.node_metrics()
+                      if self.monitor is not None else {})
+        return m
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the whole tier: the driver
+        registry (scheduler queue depth, per-replica outstanding, TTFT/
+        e2e histograms, shed/requeue counters, frontend ops) merged with
+        every replica's heartbeat-carried snapshot, samples labeled by
+        ``node``."""
+        return tpu_metrics.render_cluster_text(
+            tpu_metrics.get_registry().snapshot(),
+            self.monitor.node_metrics() if self.monitor is not None else {})
 
     # ------------------------------------------------------------- shutdown
     def shutdown(self, timeout: float = 600.0,
@@ -310,6 +366,10 @@ class ServingCluster:
                            "remaining requests get typed shutdown errors",
                            drain_timeout)
         handled = self.scheduler.dead_replicas()
+        if self.metrics_http is not None:
+            with contextlib.suppress(Exception):
+                self.metrics_http.stop()
+            self.metrics_http = None
         self.frontend.stop()
         self.scheduler.stop()
         if self.monitor is not None:

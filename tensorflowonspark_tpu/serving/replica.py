@@ -47,6 +47,8 @@ from __future__ import annotations
 import logging
 import queue as _queue
 
+from tensorflowonspark_tpu import metrics as _metrics
+from tensorflowonspark_tpu import tracing
 from tensorflowonspark_tpu.marker import EndOfFeed, Marker
 from tensorflowonspark_tpu.serving.scheduler import (REQUEST_QUEUE,
                                                      RESPONSE_QUEUE)
@@ -79,10 +81,26 @@ def serve_replica(args, ctx) -> None:
     def on_token(brid: int, tok: int) -> None:
         deltas.setdefault(brid, []).append(int(tok))
 
-    rid_map: dict[int, int] = {}        # batcher rid -> scheduler rid
+    # batcher rid -> (scheduler rid, trace id)
+    rid_map: dict[int, tuple[int, str | None]] = {}
+    first_sent: set[int] = set()        # batcher rids past first delta
     stopping = False
     steps = 0
     served = 0
+
+    # telemetry: this worker process's registry rides the heartbeat
+    # payload back to the driver (health.HeartbeatReporter); spans land
+    # in <working_dir>/trace_events.jsonl (tracing.py)
+    reg = _metrics.get_registry()
+    m_steps = reg.counter("tfos_replica_steps_total",
+                          "Decode steps executed by this replica.")
+    m_tokens = reg.counter("tfos_replica_tokens_total",
+                           "Tokens streamed by this replica.")
+    m_served = reg.counter("tfos_replica_requests_total",
+                           "Requests served to completion by this replica.")
+    g_load = reg.gauge("tfos_replica_load_count",
+                       "Batcher queue depth (active+pending+reserved).")
+    tracer = tracing.tracer_for(ctx.working_dir)
 
     def busy() -> bool:
         return batcher.load()["total"] > 0
@@ -119,7 +137,10 @@ def serve_replica(args, ctx) -> None:
                               {"rid": item.get("rid"), "event": "error",
                                "error": str(e)})
                 continue
-            rid_map[brid] = item["rid"]
+            rid_map[brid] = (item["rid"], item.get("trace"))
+            tracer.event("replica_intake", item.get("trace"),
+                         rid=item["rid"], replica=ctx.executor_id,
+                         prompt_tokens=len(item["prompt"]))
         if not busy():
             if stopping:
                 break
@@ -130,16 +151,28 @@ def serve_replica(args, ctx) -> None:
         # loop and gives chaos its at_step trigger
         ctx.report_step(steps, phase="serving")
         load = batcher.load()["total"]
+        m_steps.inc()
+        g_load.set(load)
         for brid, toks in deltas.items():
+            rid, trace = rid_map[brid]
+            if brid not in first_sent:
+                first_sent.add(brid)
+                tracer.event("replica_first_token", trace, rid=rid,
+                             replica=ctx.executor_id)
+            m_tokens.inc(len(toks))
             mgr.queue_put(RESPONSE_QUEUE,
-                          {"rid": rid_map[brid], "event": "tok",
+                          {"rid": rid, "event": "tok",
                            "tokens": toks, "load": load})
         deltas.clear()
         for brid in done:
             batcher.result(brid, pop=True)  # tokens already streamed
+            rid, trace = rid_map.pop(brid)
+            first_sent.discard(brid)
+            tracer.event("replica_done", trace, rid=rid,
+                         replica=ctx.executor_id)
+            m_served.inc()
             mgr.queue_put(RESPONSE_QUEUE,
-                          {"rid": rid_map.pop(brid), "event": "done",
-                           "load": load})
+                          {"rid": rid, "event": "done", "load": load})
             served += 1
     logger.info("replica %d drained: %d requests over %d steps "
                 "(%d prefill + %d decode dispatches)", ctx.executor_id,

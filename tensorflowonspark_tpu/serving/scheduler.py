@@ -56,7 +56,8 @@ import time
 
 import numpy as np
 
-from tensorflowonspark_tpu import observability
+from tensorflowonspark_tpu import metrics as _metrics
+from tensorflowonspark_tpu import observability, tracing
 from tensorflowonspark_tpu.queues import QueueClient
 
 logger = logging.getLogger(__name__)
@@ -105,12 +106,14 @@ class ServeRequest:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature", "top_p",
                  "seed", "deadline", "events", "tokens", "attempts",
-                 "replica", "skip", "created", "first_token_at", "finished")
+                 "replica", "skip", "created", "first_token_at", "finished",
+                 "trace")
 
     def __init__(self, rid: int, prompt, max_new_tokens: int,
                  temperature: float, top_p: float, seed: int,
-                 deadline: float | None):
+                 deadline: float | None, trace: str | None = None):
         self.rid = rid
+        self.trace = trace or tracing.new_trace_id()
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -127,11 +130,12 @@ class ServeRequest:
         self.finished = False
 
     def message(self) -> dict:
-        """The wire message the replica loop consumes."""
+        """The wire message the replica loop consumes (``trace`` rides
+        along so replica-side spans correlate with the driver's)."""
         return {"op": "gen", "rid": self.rid, "prompt": self.prompt,
                 "max_new_tokens": self.max_new_tokens,
                 "temperature": self.temperature, "top_p": self.top_p,
-                "seed": self.seed}
+                "seed": self.seed, "trace": self.trace}
 
 
 class _Replica:
@@ -177,8 +181,11 @@ class ReplicaScheduler:
         self._own_events = event_log is None and bool(
             getattr(cluster, "working_dir", None))
         if self._own_events:
+            # echo=False: admitted/routed/first-token/done fire per
+            # request — lifecycle problems still log via logger.warning
             event_log = observability.EventLog(
-                os.path.join(cluster.working_dir, "serving_events.jsonl"))
+                os.path.join(cluster.working_dir, "serving_events.jsonl"),
+                echo=False)
         self.events = event_log
         self._pending: collections.deque[ServeRequest] = collections.deque()
         self._requests: dict[int, ServeRequest] = {}
@@ -197,6 +204,38 @@ class ReplicaScheduler:
         self.abandoned = 0      # client disconnects, not deadline expiries
         self.failed = 0
         self.requeued = 0
+        # -- registry instruments (metrics.py): counters/histograms inc
+        # on the paths that already hold the scheduler lock; gauges that
+        # mirror live state are set by the collect hook at snapshot time
+        # so the hot path never touches them
+        reg = _metrics.get_registry()
+        self._m_requests = reg.counter(
+            "tfos_serving_requests_total",
+            "Serving requests by outcome (accepted/completed/shed/"
+            "expired/abandoned/failed/requeued).", labelnames=("outcome",))
+        self._m_ttft = reg.histogram(
+            "tfos_serving_ttft_seconds", "Admission to first token.")
+        self._m_e2e = reg.histogram(
+            "tfos_serving_e2e_seconds", "Admission to completion.")
+        self._g_depth = reg.gauge(
+            "tfos_serving_queue_depth_count",
+            "Requests queued in the scheduler, not yet dispatched.")
+        self._g_outstanding = reg.gauge(
+            "tfos_serving_replica_outstanding_count",
+            "Driver-tracked in-flight requests per replica.",
+            labelnames=("replica",))
+        self._g_load = reg.gauge(
+            "tfos_serving_replica_load_count",
+            "Replica's last self-reported batcher load.",
+            labelnames=("replica",))
+        self._g_alive = reg.gauge(
+            "tfos_serving_replicas_alive_count", "Alive serving replicas.")
+        reg.add_collect_hook(self._collect_gauges)
+        # audit events are enqueued (GIL-atomic append) and written by a
+        # dedicated thread: a stalled disk must never block the request
+        # path, which emits under the global scheduler lock
+        self._event_q: collections.deque = collections.deque()
+        self._event_wake = threading.Event()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ReplicaScheduler":
@@ -211,6 +250,9 @@ class ReplicaScheduler:
             threading.Thread(target=self._recv_loop, args=(rep,),
                              name=f"serve-recv-{rep.eid}", daemon=True)
             for rep in self.replicas.values()
+        ] + [
+            threading.Thread(target=self._event_loop, name="serve-events",
+                             daemon=True),
         ]
         for t in self._threads:
             t.start()
@@ -234,8 +276,19 @@ class ReplicaScheduler:
         for t in self._threads:
             if t is not threading.current_thread():
                 t.join(timeout=5.0)
+        # the collect hook holds a reference to this scheduler; unhook so
+        # a later snapshot doesn't read gauges off a stopped instance —
+        # and drop this tier's gauge series so a still-running /metrics
+        # page doesn't freeze them at their last values
+        _metrics.get_registry().remove_collect_hook(self._collect_gauges)
+        for eid in self.replicas:
+            self._g_outstanding.remove(replica=str(eid))
+            self._g_load.remove(replica=str(eid))
+        self._g_depth.remove()
+        self._g_alive.remove()
         for rep in self.replicas.values():
             self._close_clients(rep)
+        self._drain_events()     # anything emitted after the writer exited
         if self._own_events and self.events is not None:
             self.events.close()
             self.events = None
@@ -257,8 +310,11 @@ class ReplicaScheduler:
     # -- admission ---------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, *, temperature: float = 0.0,
                top_p: float = 1.0, seed: int = 0,
-               timeout: float | None = None) -> ServeRequest:
-        """Admit one request (typed rejections; see module docstring)."""
+               timeout: float | None = None,
+               trace: str | None = None) -> ServeRequest:
+        """Admit one request (typed rejections; see module docstring).
+        ``trace`` propagates a caller-supplied trace id; one is minted
+        otherwise — every event for this request carries it."""
         with self._lock:
             if self._stop.is_set():
                 raise RequestRejected("shutdown", "serving tier is stopping")
@@ -268,6 +324,7 @@ class ReplicaScheduler:
                 len(rep.outstanding) for rep in self.replicas.values())
             if depth >= self.max_queue_depth:
                 self.shed += 1
+                self._m_requests.inc(outcome="shed")
                 raise RequestRejected(
                     "queue_full",
                     f"serving queue full ({depth} >= "
@@ -276,10 +333,13 @@ class ReplicaScheduler:
             req = ServeRequest(
                 rid, prompt, max_new_tokens, temperature, top_p, seed,
                 deadline=None if timeout is None
-                else time.monotonic() + float(timeout))
+                else time.monotonic() + float(timeout), trace=trace)
             self._requests[rid] = req
             self._pending.append(req)
             self.accepted += 1
+            self._m_requests.inc(outcome="accepted")
+            self._emit("request_admitted", rid=rid, trace=req.trace,
+                       depth=depth)
             self._work.notify()
         return req
 
@@ -301,8 +361,12 @@ class ReplicaScheduler:
                     self._work.notify_all()
             if reason == "expired":
                 self.expired += 1
+                self._m_requests.inc(outcome="expired")
             else:
                 self.abandoned += 1
+                self._m_requests.inc(outcome="abandoned")
+            self._emit("request_failed", rid=req.rid, trace=req.trace,
+                       reason=reason)
 
     # -- failure intake ----------------------------------------------------
     def on_cluster_failure(self, failure) -> None:
@@ -319,6 +383,25 @@ class ReplicaScheduler:
                     if not rep.alive}
 
     # -- metrics -----------------------------------------------------------
+    def _collect_gauges(self) -> None:
+        """Registry collect hook: mirror live scheduler state into the
+        queue-depth / per-replica gauges at snapshot (scrape) time."""
+        with self._lock:
+            self._g_depth.set(len(self._pending))
+            alive = 0
+            for eid, rep in self.replicas.items():
+                if rep.alive:
+                    self._g_outstanding.set(len(rep.outstanding),
+                                            replica=str(eid))
+                    self._g_load.set(rep.reported_load, replica=str(eid))
+                    alive += 1
+                else:
+                    # a retired replica must stop being reported, not
+                    # freeze at its last values
+                    self._g_outstanding.remove(replica=str(eid))
+                    self._g_load.remove(replica=str(eid))
+            self._g_alive.set(alive)
+
     def metrics(self) -> dict:
         with self._lock:
             return {
@@ -342,9 +425,31 @@ class ReplicaScheduler:
                            shm=self.cluster.cluster_meta.get("queue_shm"))
 
     def _emit(self, kind: str, **fields) -> None:
+        """Queue an audit event (callers hold the scheduler lock — the
+        actual file write happens on the serve-events thread).  The
+        timestamp is captured here so a backlogged writer can't skew the
+        stitched trace timelines."""
         if self.events is not None:
-            with contextlib.suppress(Exception):
-                self.events.emit(kind, **fields)
+            self._event_q.append((time.time(), kind, fields))
+            self._event_wake.set()
+
+    def _event_loop(self) -> None:
+        while True:
+            self._event_wake.wait(0.2)
+            self._event_wake.clear()
+            self._drain_events()
+            if self._stop.is_set() and not self._event_q:
+                return
+
+    def _drain_events(self) -> None:
+        while True:
+            try:
+                t, kind, fields = self._event_q.popleft()
+            except IndexError:
+                return
+            if self.events is not None:
+                with contextlib.suppress(Exception):
+                    self.events.emit(kind, t=t, **fields)
 
     def _close_clients(self, rep: _Replica) -> None:
         for cli in (rep.send_cli, rep.recv_cli):
@@ -392,6 +497,8 @@ class ReplicaScheduler:
                 req.replica = rep.eid
                 req.attempts += 1
                 rep.outstanding[req.rid] = req
+                self._emit("request_routed", rid=req.rid, trace=req.trace,
+                           replica=rep.eid, attempt=req.attempts)
             # the put may block on the socket — never under the lock
             try:
                 if rep.send_cli is None:
@@ -406,8 +513,11 @@ class ReplicaScheduler:
     def _expire(self, req: ServeRequest) -> None:
         """Fail ``req`` with a deadline error (lock held by caller)."""
         self.expired += 1
+        self._m_requests.inc(outcome="expired")
         req.finished = True
         self._requests.pop(req.rid, None)
+        self._emit("request_failed", rid=req.rid, trace=req.trace,
+                   reason="deadline")
         req.events.put(("err", "deadline",
                         f"deadline exceeded after "
                         f"{time.monotonic() - req.created:.2f}s in queue"))
@@ -415,8 +525,11 @@ class ReplicaScheduler:
     def _finish_err(self, req: ServeRequest, reason: str, msg: str) -> None:
         """Fail ``req`` with a typed error (lock held by caller)."""
         self.failed += 1
+        self._m_requests.inc(outcome="failed")
         req.finished = True
         self._requests.pop(req.rid, None)
+        self._emit("request_failed", rid=req.rid, trace=req.trace,
+                   reason=reason)
         req.events.put(("err", reason, msg))
 
     # -- replica responses -------------------------------------------------
@@ -457,7 +570,12 @@ class ReplicaScheduler:
                     return
                 if req.first_token_at is None:
                     req.first_token_at = time.monotonic()
-                    self.ttft.record(req.first_token_at - req.created)
+                    ttft = req.first_token_at - req.created
+                    self.ttft.record(ttft)
+                    self._m_ttft.record(ttft)
+                    self._emit("request_first_token", rid=rid,
+                               trace=req.trace, replica=rep.eid,
+                               ttft_secs=round(ttft, 6))
                 req.tokens.extend(toks)
                 req.events.put(("tok", toks))
             elif event == "done":
@@ -466,7 +584,13 @@ class ReplicaScheduler:
                 req.finished = True
                 self._requests.pop(rid, None)
                 self.completed += 1
-                self.e2e.record(time.monotonic() - req.created)
+                self._m_requests.inc(outcome="completed")
+                e2e = time.monotonic() - req.created
+                self.e2e.record(e2e)
+                self._m_e2e.record(e2e)
+                self._emit("request_done", rid=rid, trace=req.trace,
+                           replica=rep.eid, tokens=len(req.tokens),
+                           e2e_secs=round(e2e, 6))
                 req.events.put(("done", len(req.tokens)))
                 self._work.notify_all()
             elif event == "error":
@@ -526,10 +650,11 @@ class ReplicaScheduler:
                 # replay from scratch on a survivor; decode determinism
                 # + the skip counter make the client's stream exact
                 self.requeued += 1
+                self._m_requests.inc(outcome="requeued")
                 req.replica = None
                 req.skip = len(req.tokens)
                 self._pending.appendleft(req)
-                self._emit("request_requeued", rid=req.rid,
+                self._emit("request_requeued", rid=req.rid, trace=req.trace,
                            from_replica=eid, delivered=len(req.tokens))
         if not survivors:
             for req in list(self._pending):

@@ -379,6 +379,20 @@ class ShmChannel:
         self._map = SegmentMap()
         self._pending_rel: list[str] = []
         self._rel_lock = threading.Lock()
+        # telemetry (metrics.py): shm-vs-fallback message counts and bytes
+        # across every channel in this process (per-channel stats stay on
+        # the ring for bench/tests)
+        from tensorflowonspark_tpu import metrics as _metrics
+
+        reg = _metrics.get_registry()
+        self._m_msgs = reg.counter(
+            "tfos_shm_messages_total",
+            "Data-plane messages with out-of-band buffers, by transport "
+            "path.", labelnames=("path",))
+        self._m_bytes = reg.counter(
+            "tfos_shm_payload_bytes_total",
+            "Out-of-band payload bytes moved, by transport path.",
+            labelnames=("path",))
 
     # -- release plumbing --------------------------------------------------
     def _queue_release(self, name: str) -> None:
@@ -405,12 +419,16 @@ class ShmChannel:
                 for off, v in zip(offs, bufs):
                     sv[off:off + v.nbytes] = v.cast("B")  # the ONE copy
                 self._ring.shm_msgs += 1
+                self._m_msgs.inc(path="shm")
+                self._m_bytes.inc(sum(v.nbytes for v in bufs), path="shm")
                 self._ms.send(self._sock, {
                     "rel": rel,
                     "shm": {"seg": seg.name, "offs": offs,
                             "lens": [v.nbytes for v in bufs], "p": data}})
                 return
             self._ring.fallbacks += 1
+            self._m_msgs.inc(path="fallback")
+            self._m_bytes.inc(sum(v.nbytes for v in bufs), path="fallback")
         # socket path: ship the ALREADY-pickled stream + buffers wrapped
         # as uint8 arrays — MessageSocket's out-of-band framing moves the
         # buffers (and a large stream) with no re-pickle and no copies

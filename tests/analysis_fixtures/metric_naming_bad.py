@@ -1,0 +1,26 @@
+"""Positive fixture: metric registrations violating the catalog naming."""
+from tensorflowonspark_tpu.metrics import Counter, Histogram, get_registry
+
+reg = get_registry()
+
+# missing tfos_ prefix
+requests = reg.counter("serving_requests_total", "no prefix")
+
+# counter without the _total suffix
+steps = reg.counter("tfos_replica_steps", "no unit suffix")
+
+# gauge without any unit suffix
+depth = reg.gauge("tfos_queue_depth", "no unit suffix")
+
+# not snake case (uppercase)
+latency = reg.histogram("tfos_TTFT_seconds", "not lowercase")
+
+# direct constructors imported from the metrics module are checked too
+bad_direct = Counter("plainname_total")
+bad_hist = Histogram("tfos_latency_millis")
+
+# chained off the factory (no intermediate name) is still a registration
+chained = get_registry().counter("tfos_chained_registrations")
+
+# gauges must NOT borrow the counter suffix — *_total reads as monotonic
+fake_counter = reg.gauge("tfos_live_conns_total", "not a counter")

@@ -1,0 +1,37 @@
+"""Negative fixture: conformant names and lookalikes that must not flag."""
+import collections
+
+from tensorflowonspark_tpu.metrics import Counter, get_registry
+
+reg = get_registry()
+
+ok_counter = reg.counter("tfos_serving_requests_total", "by outcome",
+                         labelnames=("outcome",))
+ok_gauge = reg.gauge("tfos_serving_queue_depth_count", "queued requests")
+ok_hist = reg.histogram("tfos_serving_ttft_seconds", "first-token latency")
+ok_bytes = reg.counter("tfos_shm_payload_bytes_total", "payload bytes")
+ok_direct = Counter("tfos_restarts_total", "recovery relaunches")
+
+# collections.Counter is NOT a metric registration — no finding even
+# though the name would violate every metric rule
+word_counts = collections.Counter("abcabc")
+
+
+# a third-party client's .gauge/.counter/.histogram is not ours to
+# police — only registry receivers are checked
+class _StatsdLike:
+    def gauge(self, name, value):
+        pass
+
+    def counter(self, name):
+        pass
+
+
+statsd = _StatsdLike()
+statsd.gauge("response_time_ms", 12)
+statsd.counter("hits")
+
+# dynamically built names are out of scope for the static rule (the
+# runtime validate_name still rejects bad ones)
+name = "tfos_" + "dynamic" + "_total"
+dynamic = reg.counter(name, "built at runtime")

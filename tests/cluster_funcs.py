@@ -75,6 +75,31 @@ def fn_report_steps(args, ctx):
         f.write(str(total))
 
 
+def fn_goodput_metrics_steps(args, ctx):
+    """Telemetry-plane workload: a step loop recording goodput via
+    ``ctx.goodput()`` and a registry counter — both must become visible
+    from the DRIVER through the heartbeat-carried snapshots.  Loops until
+    the driver sets kv ``stop_goodput`` (or ``max_secs`` elapses)."""
+    import time
+
+    from tensorflowonspark_tpu import metrics as tpu_metrics
+
+    rec = ctx.goodput()
+    demo = tpu_metrics.get_registry().counter(
+        "tfos_test_worker_steps_total", "steps run by the test map_fun")
+    deadline = time.monotonic() + float(args.get("max_secs", 30))
+    step = 0
+    while time.monotonic() < deadline:
+        if ctx.mgr is not None and ctx.mgr.kv_get("stop_goodput"):
+            break
+        step += 1
+        with rec.time("step"):
+            time.sleep(0.02)
+        demo.inc()
+        ctx.report_step(step)
+        time.sleep(0.02)
+
+
 def fn_report_then_sleep(args, ctx):
     """Report a couple of steps (arming the hang watchdog / giving a
     chaos ``stall`` its trigger), then block — the wedged-worker shape."""

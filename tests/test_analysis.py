@@ -64,7 +64,7 @@ class TestRepoGate:
     def test_every_rule_registered(self):
         assert set(RULE_IDS) == {"closure-capture", "jit-purity",
                                  "lock-discipline", "resource-lifecycle",
-                                 "broad-except"}
+                                 "broad-except", "metric-naming"}
 
 
 # ------------------------------------------------------------- rule units
@@ -79,6 +79,7 @@ class TestRuleFixtures:
         ("lock-discipline", "lock_discipline"),
         ("resource-lifecycle", "resource_lifecycle"),
         ("broad-except", "broad_except"),
+        ("metric-naming", "metric_naming"),
     ])
     def test_positive_and_negative(self, rule_id, stem):
         bad = fixture_findings(f"{stem}_bad.py")

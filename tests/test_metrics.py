@@ -1,0 +1,333 @@
+"""Telemetry plane: metrics registry, Prometheus exposition, aggregation,
+HTTP endpoints, and request tracing (docs/observability.md).
+
+The exposition tests parse the rendered text with a minimal
+text-format-0.0.4 parser written here — escaping and histogram
+cumulativity are pinned against what a real scraper would read, not
+against our own renderer's internals.
+"""
+
+import json
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from tensorflowonspark_tpu import metrics, tracing
+from tensorflowonspark_tpu.observability import EventLog
+
+# ------------------------------------------------- minimal text parser
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+
+
+def _parse_labels(body: str) -> dict:
+    """Parse `k="v",k2="v2"` honoring \\\\, \\" and \\n escapes."""
+    labels: dict[str, str] = {}
+    i = 0
+    while i < len(body):
+        eq = body.index("=", i)
+        key = body[i:eq]
+        assert body[eq + 1] == '"', body
+        j = eq + 2
+        val: list[str] = []
+        while body[j] != '"':
+            if body[j] == "\\":
+                nxt = body[j + 1]
+                val.append({"n": "\n", "\\": "\\", '"': '"'}[nxt])
+                j += 2
+            else:
+                val.append(body[j])
+                j += 1
+        labels[key] = "".join(val)
+        i = j + 1
+        if i < len(body):
+            assert body[i] == ","
+            i += 1
+    return labels
+
+
+def parse_prometheus(text: str) -> dict:
+    """name -> {"type", "help", "samples": [(sample_name, labels, value)]}."""
+    out: dict[str, dict] = {}
+
+    def family(name: str) -> dict:
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        key = base if base in out else name
+        return out.setdefault(key, {"samples": []})
+
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, name, help_text = line.split(" ", 3)
+            out.setdefault(name, {"samples": []})["help"] = help_text
+        elif line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            out.setdefault(name, {"samples": []})["type"] = kind
+        elif line.startswith("#"):
+            continue
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            name, labels, value = m.group(1), m.group(2), m.group(3)
+            family(name)["samples"].append(
+                (name, _parse_labels(labels) if labels else {},
+                 float(value)))
+    return out
+
+
+# ------------------------------------------------------- registry units
+
+def test_counter_gauge_histogram_basics():
+    reg = metrics.MetricsRegistry()
+    c = reg.counter("tfos_t_requests_total", "reqs", labelnames=("outcome",))
+    c.inc(outcome="ok")
+    c.inc(2, outcome="shed")
+    c.labels(outcome="ok").inc(3)
+    assert c.value(outcome="ok") == 4 and c.value(outcome="shed") == 2
+    assert c.value(outcome="never") == 0
+
+    g = reg.gauge("tfos_t_depth_count", "depth")
+    g.set(7)
+    assert g.value() == 7
+    g.set(3)
+    assert g.value() == 3
+
+    h = reg.histogram("tfos_t_wait_seconds", "wait", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.record(v)
+    snap = reg.snapshot()
+    ((labels, series),) = snap["tfos_t_wait_seconds"]["samples"]
+    assert labels == {}
+    assert series["counts"] == [1, 2, 1]      # per-bucket, overflow last
+    assert series["count"] == 4
+    assert series["sum"] == pytest.approx(6.05)
+
+
+def test_registry_get_or_create_and_conflicts():
+    reg = metrics.MetricsRegistry()
+    a = reg.counter("tfos_t_shared_total", "x")
+    b = reg.counter("tfos_t_shared_total", "x")
+    assert a is b
+    with pytest.raises(ValueError):            # kind conflict
+        reg.gauge("tfos_t_shared_total")
+    with pytest.raises(ValueError):            # label-schema conflict
+        reg.counter("tfos_t_shared_total", labelnames=("x",))
+    h = reg.histogram("tfos_t_shared_seconds")
+    assert reg.histogram("tfos_t_shared_seconds") is h
+    with pytest.raises(ValueError):            # bucket-layout conflict
+        reg.histogram("tfos_t_shared_seconds", buckets=(60.0, 300.0))
+
+
+def test_metric_naming_enforced_at_registration():
+    reg = metrics.MetricsRegistry()
+    with pytest.raises(ValueError):            # no tfos_ prefix
+        reg.counter("serving_requests_total")
+    with pytest.raises(ValueError):            # counter needs _total
+        reg.counter("tfos_steps_count")
+    with pytest.raises(ValueError):            # gauge needs a unit suffix
+        reg.gauge("tfos_queue_depth")
+    with pytest.raises(ValueError):            # not snake case
+        reg.histogram("tfos_TTFT_seconds")
+    with pytest.raises(ValueError):            # wrong label set at use
+        reg.counter("tfos_t_lbl_total", labelnames=("a",)).inc(b="x")
+
+
+def test_disabled_registry_is_noop():
+    reg = metrics.MetricsRegistry(enabled=False)
+    c = reg.counter("anything goes — never registered", "x")
+    c.inc()
+    c.labels(outcome="x").inc()
+    reg.histogram("also unchecked").record(1.0)
+    assert reg.snapshot() == {}
+
+
+def test_collect_hook_sets_gauges_at_snapshot_time():
+    reg = metrics.MetricsRegistry()
+    g = reg.gauge("tfos_t_live_count", "live")
+    state = {"n": 3}
+    reg.add_collect_hook(lambda: g.set(state["n"]))
+    assert reg.snapshot()["tfos_t_live_count"]["samples"] == [[{}, 3.0]]
+    state["n"] = 9
+    assert reg.snapshot()["tfos_t_live_count"]["samples"] == [[{}, 9.0]]
+    # a raising hook must not break the snapshot
+    reg.add_collect_hook(lambda: 1 / 0)
+    assert "tfos_t_live_count" in reg.snapshot()
+
+
+def test_histogram_record_is_thread_safe_lock_free():
+    reg = metrics.MetricsRegistry()
+    h = reg.histogram("tfos_t_conc_seconds", buckets=(0.5,))
+    child = h.labels()
+
+    def worker():
+        for _ in range(500):
+            child.record(0.1)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ((_, series),) = reg.snapshot()["tfos_t_conc_seconds"]["samples"]
+    assert series["count"] == 8 * 500 and series["counts"][0] == 8 * 500
+
+
+# --------------------------------------------------------- exposition
+
+def test_exposition_parses_with_types_helps_and_escaping():
+    reg = metrics.MetricsRegistry()
+    c = reg.counter("tfos_t_esc_total", 'weird "help"\nwith newline',
+                    labelnames=("path",))
+    c.inc(path='with"quote')
+    c.inc(path="with\\backslash")
+    c.inc(path="with\nnewline")
+    parsed = parse_prometheus(reg.render())
+    fam = parsed["tfos_t_esc_total"]
+    assert fam["type"] == "counter"
+    assert "newline" in fam["help"]
+    values = {s[1]["path"]: s[2] for s in fam["samples"]}
+    # the escape round-trip: parser recovers the original label values
+    assert values == {'with"quote': 1.0, "with\\backslash": 1.0,
+                      "with\nnewline": 1.0}
+
+
+def test_exposition_histogram_buckets_are_cumulative():
+    reg = metrics.MetricsRegistry()
+    h = reg.histogram("tfos_t_cum_seconds", "h", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 2.0, 100.0):
+        h.record(v)
+    parsed = parse_prometheus(reg.render())
+    fam = parsed["tfos_t_cum_seconds"]
+    assert fam["type"] == "histogram"
+    buckets = [(s[1]["le"], s[2]) for s in fam["samples"]
+               if s[0].endswith("_bucket")]
+    les = [b[0] for b in buckets]
+    counts = [b[1] for b in buckets]
+    assert les == ["0.1", "1", "10", "+Inf"]
+    assert counts == [1.0, 3.0, 4.0, 5.0]          # cumulative
+    assert counts == sorted(counts)                # non-decreasing
+    total = [s[2] for s in fam["samples"] if s[0].endswith("_count")]
+    assert total == [5.0] and counts[-1] == total[0]
+    (sum_v,) = [s[2] for s in fam["samples"] if s[0].endswith("_sum")]
+    assert sum_v == pytest.approx(103.05)
+
+
+def test_merge_snapshots_stamps_node_label():
+    reg_a, reg_b = metrics.MetricsRegistry(), metrics.MetricsRegistry()
+    reg_a.counter("tfos_t_m_total").inc(2)
+    reg_b.counter("tfos_t_m_total").inc(5)
+    merged = metrics.merge_snapshots({"0": reg_a.snapshot(),
+                                      "driver": reg_b.snapshot()})
+    parsed = parse_prometheus(metrics.render_prometheus(merged))
+    values = {s[1]["node"]: s[2]
+              for s in parsed["tfos_t_m_total"]["samples"]}
+    assert values == {"0": 2.0, "driver": 5.0}
+
+
+def test_http_endpoint_serves_metrics_and_statusz():
+    reg = metrics.MetricsRegistry()
+    reg.counter("tfos_t_http_total").inc()
+    srv = metrics.MetricsHTTPServer(reg.render,
+                                    statusz=lambda: {"state": "ok"})
+    host, port = srv.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5)
+        assert body.status == 200
+        assert "version=0.0.4" in body.headers["Content-Type"]
+        text = body.read().decode()
+        assert parse_prometheus(text)["tfos_t_http_total"]["samples"]
+        sz = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/statusz", timeout=5).read())
+        assert sz == {"state": "ok"}
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://{host}:{port}/nope", timeout=5)
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------ tracing
+
+def test_tracer_emits_and_stitch_reconstructs_timeline(tmp_path):
+    wd = str(tmp_path)
+    tracer = tracing.Tracer(str(tmp_path / tracing.TRACE_FILENAME))
+    trace = tracing.new_trace_id()
+    other = tracing.new_trace_id()
+    sched_log = EventLog(str(tmp_path / "serving_events.jsonl"))
+    sched_log.emit("request_admitted", rid=0, trace=trace, depth=0)
+    sched_log.emit("request_routed", rid=0, trace=trace, replica=1,
+                   attempt=1)
+    tracer.event("replica_intake", trace, rid=0, replica=1)
+    sched_log.emit("request_admitted", rid=1, trace=other, depth=1)
+    tracer.event("replica_first_token", trace, rid=0, replica=1)
+    sched_log.emit("request_done", rid=0, trace=trace, tokens=8,
+                   e2e_secs=0.5)
+    sched_log.close()
+    tracer.close()
+
+    timeline = tracing.stitch_trace(wd, trace)
+    kinds = [r["kind"] for r in timeline]
+    assert kinds == ["request_admitted", "request_routed", "replica_intake",
+                     "replica_first_token", "request_done"]
+    assert all(r["trace"] == trace for r in timeline)
+    assert [r["t"] for r in timeline] == sorted(r["t"] for r in timeline)
+
+    text = tracing.format_timeline(timeline)
+    assert "request_admitted" in text and "replica=1" in text
+
+    traces = tracing.list_traces(wd)
+    assert set(traces) == {trace, other}
+    assert traces[trace]["spans"] == 5
+
+
+def test_stitch_folds_in_untraced_failures_as_context(tmp_path):
+    trace = tracing.new_trace_id()
+    sched_log = EventLog(str(tmp_path / "serving_events.jsonl"))
+    sched_log.emit("request_admitted", rid=0, trace=trace, depth=0)
+    sched_log.emit("replica_dead", replica=1, reason="kill")   # no trace
+    sched_log.emit("request_requeued", rid=0, trace=trace, from_replica=1,
+                   delivered=3)
+    sched_log.emit("request_done", rid=0, trace=trace, tokens=8)
+    sched_log.close()
+    health_log = EventLog(str(tmp_path / "health_events.jsonl"))
+    health_log.emit("crash", workers=[1])                      # no trace
+    health_log.close()
+
+    timeline = tracing.stitch_trace(str(tmp_path), trace)
+    kinds = [(r["kind"], bool(r.get("_context"))) for r in timeline]
+    assert ("replica_dead", True) in kinds
+    assert ("crash", True) in kinds
+    assert ("request_requeued", False) in kinds
+    assert "[context]" in tracing.format_timeline(timeline)
+
+
+def test_stitch_unknown_trace_returns_empty(tmp_path):
+    assert tracing.stitch_trace(str(tmp_path), "deadbeef") == []
+
+
+def test_tfos_trace_cli(tmp_path, capsys):
+    import importlib.util
+    import os
+
+    trace = tracing.new_trace_id()
+    log = EventLog(str(tmp_path / "serving_events.jsonl"))
+    log.emit("request_admitted", rid=0, trace=trace, depth=0)
+    log.emit("request_done", rid=0, trace=trace, tokens=4)
+    log.close()
+
+    spec = importlib.util.spec_from_file_location(
+        "tfos_trace", os.path.join(os.path.dirname(__file__), "..",
+                                   "scripts", "tfos_trace.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    assert mod.main(["--dir", str(tmp_path), "--list"]) == 0
+    assert trace in capsys.readouterr().out
+    assert mod.main(["--dir", str(tmp_path), trace]) == 0
+    out = capsys.readouterr().out
+    assert "request_admitted" in out and "request_done" in out
+    assert mod.main(["--dir", str(tmp_path), "not-a-trace"]) == 1

@@ -113,6 +113,53 @@ def test_cluster_tensorboard_url(tmp_path):
         cluster.shutdown(timeout=120)
 
 
+@pytest.mark.integration
+def test_goodput_and_worker_metrics_visible_from_driver(tmp_path):
+    """The heartbeat-carried telemetry transport end to end: a map_fun
+    using ``ctx.goodput()`` + a registry counter becomes visible in the
+    driver's aggregated ``cluster.metrics()`` view (and the Prometheus
+    page) while the job runs — not only as an end-of-job file."""
+    from tensorflowonspark_tpu import TPUCluster
+    from tests import cluster_funcs as funcs
+
+    cluster = TPUCluster.run(
+        funcs.fn_goodput_metrics_steps, {"max_secs": 60}, 1,
+        worker_env={"JAX_PLATFORMS": "cpu"}, reservation_timeout=60,
+        working_dir=str(tmp_path / "wd"))
+    try:
+        deadline = time.monotonic() + 30
+        node0 = None
+        while time.monotonic() < deadline:
+            node0 = cluster.metrics()["nodes"].get(0)
+            if node0 and node0.get("goodput") \
+                    and node0["goodput"]["counts"].get("step", 0) > 0 \
+                    and "tfos_test_worker_steps_total" in node0["metrics"]:
+                break
+            time.sleep(0.25)
+        assert node0 is not None and node0.get("goodput"), \
+            "goodput never arrived in the driver's aggregated view"
+        assert node0["goodput"]["counts"]["step"] > 0
+        assert 0.0 < node0["goodput"]["goodput"] <= 1.0
+        samples = node0["metrics"]["tfos_test_worker_steps_total"]["samples"]
+        assert samples and samples[0][1] > 0
+        # the merged exposition page carries the worker series, labeled
+        text = cluster.metrics_text()
+        assert 'tfos_test_worker_steps_total{node="0"}' in text
+        # standalone /metrics endpoint for training-only jobs
+        import urllib.request
+
+        host, port = cluster.serve_metrics()
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5).read().decode()
+        assert "tfos_test_worker_steps_total" in body
+    finally:
+        import contextlib
+
+        with contextlib.suppress(Exception):
+            cluster._client_for(0).kv_set("stop_goodput", "1")
+        cluster.shutdown(timeout=120)
+
+
 def test_event_log_jsonl_roundtrip(tmp_path):
     """EventLog appends one timestamped JSON object per event (creating
     parent dirs) and reads them back — the health monitor's audit trail."""
@@ -200,3 +247,63 @@ def test_latency_histogram_single_sample_and_concurrent_records():
     for t in threads:
         t.join()
     assert len(h2) == 8 * 500          # list.append is GIL-atomic
+
+
+def test_latency_histogram_reservoir_is_bounded():
+    """A long-lived frontend must not grow the sample list forever: the
+    reservoir keeps a ring of the most recent ``cap`` samples, percentile
+    semantics stay nearest-rank on that window, and ``count`` reports the
+    total ever recorded."""
+    h = observability.LatencyHistogram(cap=100)
+    for ms in range(1, 1001):          # 10x the cap
+        h.record(ms / 1000.0)
+    assert len(h._samples) == 100      # memory bounded at cap
+    assert len(h) == 1000              # total recorded preserved
+    s = h.summary()
+    assert s["count"] == 1000
+    # retained window is the most recent 100 samples: 0.901..1.000
+    assert s["p50_secs"] == pytest.approx(0.950)
+    assert s["p99_secs"] == pytest.approx(0.999)
+    assert s["max_secs"] == pytest.approx(1.000)
+    assert 0.901 <= s["mean_secs"] <= 1.0
+    # every reported value is a sample that actually occurred
+    assert s["p95_secs"] in h._samples
+
+    # concurrent records against a small cap: bounded and crash-free
+    import threading
+
+    h2 = observability.LatencyHistogram(cap=64)
+
+    def worker():
+        for i in range(500):
+            h2.record(i / 1000.0)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # bounded: cap + at most one fill-phase straggler append per thread
+    assert len(h2._samples) <= 64 + 8
+    assert h2.summary()["p99_secs"] is not None
+
+
+def test_event_log_emit_after_close_degrades_to_warning(tmp_path, caplog):
+    """A late monitor-thread emit into a closed log must warn, not raise
+    ValueError out of the writer thread."""
+    import logging
+
+    path = str(tmp_path / "events.jsonl")
+    log = observability.EventLog(path)
+    log.emit("monitor_started", workers=1)
+    log.close()
+    with caplog.at_level(logging.WARNING,
+                         logger="tensorflowonspark_tpu.observability"):
+        rec = log.emit("late_event", detail="after close")   # must not raise
+        log.emit("later_still")                              # warns only once
+    assert rec["kind"] == "late_event"
+    warnings = [r for r in caplog.records if "unwritable" in r.message]
+    assert len(warnings) == 1
+    # the file keeps only the pre-close events
+    recs = observability.EventLog.read(path)
+    assert [r["kind"] for r in recs] == ["monitor_started"]

@@ -208,6 +208,95 @@ def test_replica_death_requeues_once_with_exact_stream():
         s.stop()
 
 
+def test_trace_id_survives_requeue_failover(tmp_path):
+    """End-to-end tracing across the failover path: the trace id stamped
+    at admission survives the requeue-once hop to the surviving replica,
+    and ``tracing.stitch_trace`` reconstructs the full
+    admission → route → first-token → requeue → re-route → done timeline
+    (with the untraced ``replica_dead`` folded in as context)."""
+    from tensorflowonspark_tpu import tracing
+    from tensorflowonspark_tpu.observability import EventLog
+
+    world = _FakeWorld(2, token_delay=0.05)
+    log = EventLog(str(tmp_path / "serving_events.jsonl"))
+    s = _scheduler(world, slots_per_replica=1, overcommit=1,
+                   event_log=log).start()
+    try:
+        p = np.asarray([3, 5], np.int32)
+        trace = tracing.new_trace_id()
+        req = s.submit(p, 8, trace=trace)
+        assert req.trace == trace
+        assert req.message()["trace"] == trace   # rides the wire message
+        while not req.tokens:
+            time.sleep(0.01)
+        victim = req.replica
+        world.kill(victim)
+        toks, err = _collect(req, timeout=15)
+        assert err is None and toks == _fake_tokens(p, 8)
+    finally:
+        s.stop()
+        log.close()
+
+    timeline = tracing.stitch_trace(str(tmp_path), trace)
+    kinds = [r["kind"] for r in timeline if not r.get("_context")]
+    assert kinds[0] == "request_admitted" and kinds[-1] == "request_done"
+    routed = [r for r in timeline if r["kind"] == "request_routed"]
+    assert len(routed) == 2, "expected a route before and after failover"
+    assert routed[0]["replica"] == victim != routed[1]["replica"]
+    assert [r["attempt"] for r in routed] == [1, 2]
+    (requeued,) = [r for r in timeline if r["kind"] == "request_requeued"]
+    assert requeued["from_replica"] == victim and requeued["trace"] == trace
+    assert all(r["trace"] == trace for r in timeline
+               if not r.get("_context"))
+    # the replica kill that explains the hop appears as a context row
+    assert any(r["kind"] == "replica_dead" and r.get("_context")
+               for r in timeline)
+    # and the CLI-facing formatter renders it
+    text = tracing.format_timeline(timeline)
+    assert "request_requeued" in text and "[context]" in text
+
+
+def test_scheduler_registry_series_update(tmp_path):
+    """The scheduler's registry instruments: outcome counters tick and
+    the collect hook mirrors queue depth / per-replica gauges into a
+    snapshot."""
+    from tensorflowonspark_tpu import metrics as tpu_metrics
+
+    world = _FakeWorld(2)
+    s = _scheduler(world).start()
+    reg = tpu_metrics.get_registry()
+    c = reg.counter("tfos_serving_requests_total", labelnames=("outcome",))
+    accepted0 = c.value(outcome="accepted")
+    completed0 = c.value(outcome="completed")
+    try:
+        req = s.submit(np.asarray([1, 2], np.int32), 4)
+        _, err = _collect(req)
+        assert err is None
+        assert c.value(outcome="accepted") == accepted0 + 1
+        assert c.value(outcome="completed") == completed0 + 1
+        snap = reg.snapshot()    # runs the collect hook
+        outst = {tuple(sorted(lbl.items())): v for lbl, v in
+                 snap["tfos_serving_replica_outstanding_count"]["samples"]}
+        assert (("replica", "0"),) in outst and (("replica", "1"),) in outst
+        assert snap["tfos_serving_replicas_alive_count"]["samples"] \
+            == [[{}, 2.0]]
+        ((_, ttft),) = snap["tfos_serving_ttft_seconds"]["samples"]
+        assert ttft["count"] >= 1
+        # a dead replica's series are removed, not frozen at last value
+        world.kill(1)
+        deadline = time.monotonic() + 5
+        while 1 not in s.dead_replicas() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        snap = reg.snapshot()
+        labels = [lbl for lbl, _ in
+                  snap["tfos_serving_replica_outstanding_count"]["samples"]]
+        assert {"replica": "0"} in labels and {"replica": "1"} not in labels
+        assert snap["tfos_serving_replicas_alive_count"]["samples"] \
+            == [[{}, 1.0]]
+    finally:
+        s.stop()
+
+
 def test_replica_death_beyond_requeue_limit_fails_typed():
     world = _FakeWorld(2, token_delay=0.05)
     s = _scheduler(world, slots_per_replica=1, overcommit=1,

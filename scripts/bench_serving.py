@@ -36,6 +36,16 @@ Writes ``bench_artifacts/serving.json``::
 Run: ``python scripts/bench_serving.py [--requests 60] [--rate 6]
 [--kill-step 8]`` (CPU by default; tiny GPT so the numbers measure the
 serving plane, not the model).
+
+``--ramp`` runs the ELASTICITY scenario instead (docs/serving.md):
+a 1-replica tier with the metrics-driven autoscaler, an open-loop load
+that DOUBLES mid-window, a two-tenant mix (an unlimited ``quiet``
+tenant + a token-bucketed ``noisy`` tenant whose overflow must shed as
+``tenant_throttled``), and a chaos ``replace node=1`` reclaim of the
+scaled-up replica.  Writes ``bench_artifacts/elasticity.json`` with the
+scale-event timeline (reasons included), per-tenant accepted/shed
+counts, TTFT before/after the first scale-up, and the zero-loss
+accounting across the replace event.
 """
 
 import argparse
@@ -195,6 +205,169 @@ def bench_scenario(scenario, n_requests, rate, replicas, slots, kill_step,
     }
 
 
+def ramp_scenario(n_requests, base_rate, slots, replace_step, seed=0,
+                  working_dir=None):
+    """The elasticity acceptance run (see module docstring)."""
+    import tempfile
+
+    import numpy as np
+
+    from tensorflowonspark_tpu.observability import EventLog
+    from tensorflowonspark_tpu.serving import RequestRejected, ServingCluster
+
+    working_dir = working_dir or tempfile.mkdtemp(prefix="tfos_ramp_")
+    worker_env = {"JAX_PLATFORMS": "cpu",
+                  "TFOS_CHAOS": f"replace node=1 at_step={replace_step}"}
+    rng = np.random.default_rng(seed)
+    reqs = [(rng.integers(0, VOCAB, (int(rng.integers(3, 10)),))
+             .astype(np.int32), int(rng.integers(8, 17)))
+            for _ in range(n_requests)]
+
+    serving = ServingCluster.run(
+        bench_model_builder, 1, max_batch=slots,
+        worker_env=worker_env, working_dir=working_dir,
+        reservation_timeout=120, max_queue_depth=4 * n_requests,
+        tenants={"quiet": {"rate": None},
+                 "noisy": {"rate": 1.0, "burst": 2, "priority": "low"}},
+        autoscale=dict(min_replicas=1, max_replicas=3, interval=0.5,
+                       up_queue_per_replica=2.0, up_consecutive=2,
+                       up_cooldown=5.0, down_outstanding_per_replica=1.0,
+                       down_consecutive=6, down_cooldown=6.0))
+    noisy = {"offered": 0, "accepted": 0, "shed": 0}
+    try:
+        with serving.client() as c:                    # warmup compile
+            c.generate(reqs[0][0], 2, timeout=600)
+        records = [None] * len(reqs)
+        threads = []
+
+        def one(i, prompt, budget):
+            t0 = time.monotonic()
+            rec = {"ok": False, "ttft": None, "e2e": None, "tokens": 0,
+                   "admitted_at": time.time()}
+            try:
+                with serving.client() as c:
+                    toks = []
+                    for delta in c.generate_stream(prompt, budget,
+                                                   timeout=600,
+                                                   tenant="quiet"):
+                        if rec["ttft"] is None:
+                            rec["ttft"] = time.monotonic() - t0
+                        toks.extend(delta)
+                    rec["e2e"] = time.monotonic() - t0
+                    rec["tokens"] = len(toks)
+                    rec["out"] = toks
+                    rec["ok"] = True
+            except Exception as e:          # typed shed/failure recorded
+                rec["error"] = f"{type(e).__name__}: {e}"
+            records[i] = rec
+
+        def noisy_probe():
+            # over-budget tenant: bursts far past its 1 req/s bucket;
+            # its overflow must shed tenant_throttled without touching
+            # the quiet tenant's stream
+            p = np.asarray([1, 2, 3], np.int32)
+            for _ in range(12):
+                noisy["offered"] += 1
+                try:
+                    with serving.client() as c:
+                        c.generate(p, 2, timeout=600, tenant="noisy")
+                    noisy["accepted"] += 1
+                except RequestRejected as e:
+                    assert e.reason == "tenant_throttled", e.reason
+                    noisy["shed"] += 1
+                time.sleep(0.15)
+
+        t0 = time.monotonic()
+        half = len(reqs) // 3
+        for i, (p, n) in enumerate(reqs):
+            t = threading.Thread(target=one, args=(i, p, n), daemon=True)
+            t.start()
+            threads.append(t)
+            if i == half:       # second window: noisy tenant joins too
+                nt = threading.Thread(target=noisy_probe, daemon=True)
+                nt.start()
+                threads.append(nt)
+            # load doubles mid-window
+            rate = base_rate if i < half else 2 * base_rate
+            time.sleep(rng.exponential(1.0 / rate))
+        for t in threads:
+            t.join(600)
+        wall = time.monotonic() - t0
+        # idle tail: wait for the drain-based scale-down
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if (serving.autoscaler.scale_downs >= 1
+                    and serving.autoscaler.scale_ups >= 1):
+                break
+            time.sleep(0.5)
+        sched = serving.metrics()
+    finally:
+        serving.shutdown(timeout=300)
+
+    events = EventLog.read(os.path.join(working_dir, "serving_events.jsonl"))
+    scale_events = [e for e in events if e["kind"] in
+                    ("scale_up", "scale_down", "replica_added",
+                     "replica_draining", "replica_retired",
+                     "replica_replaced", "replica_dead")]
+    ups = [e for e in events if e["kind"] == "scale_up"]
+    downs = [e for e in events if e["kind"] == "scale_down"]
+    retired = [e for e in events if e["kind"] == "replica_retired"]
+    if not ups or not downs:
+        raise RuntimeError(
+            f"elasticity acceptance failed: {len(ups)} scale_up / "
+            f"{len(downs)} scale_down events")
+    if not any(e.get("reason") in ("preempted", "drain_timeout")
+               or e.get("replica") == 1 for e in retired):
+        raise RuntimeError("chaos replace of node 1 left no retirement")
+    ok = [r for r in records if r and r["ok"]]
+    failed = [r for r in records if r and not r["ok"]]
+    if failed:
+        raise RuntimeError(f"accepted quiet-tenant requests failed "
+                           f"across the replace: {failed[:3]}")
+    # greedy determinism: streams replayed across the replace stay exact
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import greedy_generate
+
+    cfg, params = bench_model_builder({"seed": seed})
+    for (p, n), r in zip(reqs, records):
+        want = np.asarray(greedy_generate(
+            cfg, params, jnp.asarray(p)[None, :], n))[0, len(p):]
+        assert r["out"] == want.tolist(), "stream diverged across replace"
+    if noisy["shed"] == 0:
+        raise RuntimeError("noisy tenant was never throttled")
+    if sched["tenants"]["quiet"]["shed"] != 0:
+        raise RuntimeError("quiet tenant was shed — admission is not "
+                           "tenant-isolated")
+    first_up_t = ups[0]["t"]
+    before = [r["ttft"] for r in ok
+              if r["ttft"] is not None and r["admitted_at"] < first_up_t]
+    after = [r["ttft"] for r in ok
+             if r["ttft"] is not None and r["admitted_at"] >= first_up_t]
+    tokens = sum(r["tokens"] for r in ok)
+    return {
+        "scenario": "ramp",
+        "requests": {
+            "offered": n_requests, "accepted": sched["accepted"],
+            "completed": len(ok), "shed": sched["shed"],
+            "failed": sched["failed"], "requeued": sched["requeued"],
+            "lost": 0,
+        },
+        "tenants": {
+            "quiet": sched["tenants"]["quiet"],
+            "noisy": {**sched["tenants"]["noisy"],
+                      "offered": noisy["offered"]},
+        },
+        "scale_events": scale_events,
+        "scale_ups": len(ups), "scale_downs": len(downs),
+        "wall_secs": round(wall, 3),
+        "throughput_tokens_per_s": round(tokens / wall, 2),
+        "ttft_before_scale_up": _percentiles(before),
+        "ttft_after_scale_up": _percentiles(after),
+        "e2e": _percentiles([r["e2e"] for r in ok]),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=60)
@@ -208,8 +381,48 @@ def main():
                          "replica 1 in the replica_kill scenario")
     ap.add_argument("--skip-kill", action="store_true",
                     help="run only the steady-state scenario")
+    ap.add_argument("--ramp", action="store_true",
+                    help="run the elasticity ramp scenario instead "
+                         "(autoscaler + tenants + chaos replace); writes "
+                         "bench_artifacts/elasticity.json")
+    ap.add_argument("--replace-step", type=int, default=6,
+                    help="decode step at which chaos replaces node 1 in "
+                         "the ramp scenario")
     args = ap.parse_args()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.ramp:
+        row = ramp_scenario(args.requests, args.rate, args.slots,
+                            args.replace_step)
+        print(json.dumps(row, indent=2))
+        out = {
+            "benchmark": "serving_elasticity",
+            "config": {
+                "backend": "LocalProcessBackend", "platform": "cpu",
+                "initial_replicas": 1,
+                "autoscaler": {"min_replicas": 1, "max_replicas": 3,
+                               "up_queue_per_replica": 2.0,
+                               "up_consecutive": 2, "up_cooldown": 5.0,
+                               "down_outstanding_per_replica": 1.0,
+                               "down_consecutive": 6, "down_cooldown": 6.0},
+                "slots_per_replica": args.slots,
+                "poisson_rate_per_s": [args.rate, 2 * args.rate],
+                "requests": args.requests,
+                "tenants": {"quiet": "unlimited",
+                            "noisy": "1 req/s burst 2, low priority"},
+                "replace_plan": f"replace node=1 at_step={args.replace_step}",
+                "model": {"vocab": VOCAB, "hidden": HIDDEN,
+                          "layers": LAYERS, "heads": HEADS,
+                          "max_len": MAXLEN},
+            },
+            "rows": [row],
+        }
+        path = os.path.join(REPO, "bench_artifacts", "elasticity.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+        print(f"wrote {path}")
+        return
 
     rows = []
     scenarios = ["steady"] + ([] if args.skip_kill else ["replica_kill"])

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# CI entrypoint: the tfos-check static-analysis gate + the tier-1 test
+# command from ROADMAP.md, as one script — what a pre-merge pipeline (or a
+# developer wanting the full pre-push story) runs.
+#
+#   scripts/ci.sh            # analysis gate, then tier-1 tests
+#   scripts/ci.sh --check    # analysis gate only (fast, no jax)
+#
+# The analysis gate (docs/analysis.md) runs all six project rules plus the
+# exports-drift check against the committed analysis_baseline.json ratchet
+# (which ships EMPTY — new findings fail CI, they don't get grandfathered).
+# The tier-1 command mirrors ROADMAP.md exactly, including the timeout and
+# the DOTS_PASSED accounting, so local runs and the driver agree.
+set -uo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+
+echo "== tfos-check gate =="
+python scripts/tfos_check.py
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "tfos-check gate FAILED (rc=$rc)" >&2
+    exit $rc
+fi
+
+if [ "${1:-}" = "--check" ]; then
+    exit 0
+fi
+
+echo "== tier-1 tests (ROADMAP.md) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit $rc

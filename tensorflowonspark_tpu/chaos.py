@@ -38,6 +38,14 @@ Verbs:
   alive: the wedged-on-a-collective shape the hang watchdog exists for.
 - ``drop`` — stop the node's queue server: feeders and the monitor's kv
   polls lose their connection while training continues.
+- ``replace`` — the elastic-serving kill-and-heal scenario, first
+  class: SIGTERM self (optionally SIGKILL after ``grace``), exactly the
+  reclaim shape a spot host sees.  A serving replica's
+  ``PreemptionGuard`` latches it, drains in flight, and exits cleanly;
+  the driver's ``ServingCluster`` sees heartbeat phase ``preempted``
+  (or the classified exit) and spawns a replacement — same signal as
+  ``term``, named separately so plans and benches state intent:
+  ``replace node=1 at_step=8`` reads as "heal this", not "break this".
 
 Every action fires at most once **per job**, not per attempt: before
 firing, the worker writes a sentinel file ``chaos.<node>.<index>``
@@ -64,7 +72,7 @@ logger = logging.getLogger(__name__)
 PLAN_ENV = "TFOS_CHAOS"
 STATE_DIR_ENV = "TFOS_CHAOS_DIR"
 
-VERBS = ("kill", "term", "stall", "drop")
+VERBS = ("kill", "term", "stall", "drop", "replace")
 
 _INT_KEYS = ("node", "at_step")
 _FLOAT_KEYS = ("after_secs", "grace", "secs")
@@ -205,6 +213,12 @@ class ChaosAgent:
             t.daemon = True
             t.start()
         os.kill(os.getpid(), signal.SIGTERM)
+
+    def _fire_replace(self, action: ChaosAction) -> None:
+        # same reclaim signal as `term`; the distinct verb lets a plan
+        # say "drain-and-replace this node" — on a serving replica the
+        # PreemptionGuard turns it into a clean elastic departure
+        self._fire_term(action)
 
     def _fire_stall(self, action: ChaosAction) -> None:
         if self._reporter is not None:

@@ -282,7 +282,7 @@ class ClusterMonitor:
                  poll_interval: float = 0.5, step_timeout: float | None = None,
                  abort_on_failure: bool = True, event_log=None,
                  client_factory=None, on_failure=None,
-                 keep_polling: bool = False):
+                 keep_polling: bool = False, on_phase=None):
         self.cluster = cluster
         self.hang_timeout = float(hang_timeout)
         self.poll_interval = float(poll_interval)
@@ -298,6 +298,13 @@ class ClusterMonitor:
             lambda info: QueueClient(info["addr"], info["authkey"],
                                      timeout=2.0, shm=False))
         self.on_failure = on_failure
+        #: ``on_phase(eid, phase)`` fires when a node's heartbeat-reported
+        #: lifecycle phase CHANGES (exceptions suppressed, like
+        #: ``on_failure``).  The serving tier subscribes to catch phase
+        #: ``preempted`` while the process is still alive — its grace
+        #: window — and turn it into drain-and-replace instead of waiting
+        #: for the exit.
+        self.on_phase = on_phase
         self.keep_polling = bool(keep_polling)
         #: every classified failure, in detection order (one entry per
         #: failure with ``keep_polling``; at most one without)
@@ -372,6 +379,20 @@ class ClusterMonitor:
                         "step": rec.get("step"), "phase": rec.get("phase"),
                         "age_secs": now - rec.get("seen", now)}
         return out
+
+    def ignore_worker(self, executor_id: int) -> None:
+        """Retire ``executor_id`` from both checks: a deliberately
+        drained-and-stopped member (elastic scale-down, preemption
+        drain) must not be classified as a crash/hang when it exits —
+        nor keep contributing a frozen row to ``node_metrics``.  Its
+        kv client is dropped."""
+        eid = int(executor_id)
+        with self._poll_lock:  # serialize vs an in-flight poll's checks
+            self._handled.add(eid)
+            cli = self._clients.pop(eid, None)
+        if cli is not None:
+            with contextlib.suppress(Exception):
+                cli.close()
 
     def poll_now(self) -> ClusterFailure | None:
         """One synchronous check, returning any (new or prior) failure.
@@ -458,7 +479,8 @@ class ClusterMonitor:
 
     def _check_heartbeats(self, alive: list) -> bool:
         now = time.monotonic()
-        for node in self.cluster.cluster_info:
+        # copy: cluster_info grows in place when workers are added live
+        for node in list(self.cluster.cluster_info):
             eid = node["executor_id"]
             if eid in self._handled:
                 continue  # already reported; keep_polling watches the rest
@@ -471,7 +493,14 @@ class ClusterMonitor:
             if payload and payload.get("seq") != rec["seq"]:
                 rec["seq"] = payload.get("seq")
                 rec["seen"] = now
-                rec["phase"] = payload.get("phase")
+                new_phase = payload.get("phase")
+                if new_phase != rec["phase"]:
+                    rec["phase"] = new_phase
+                    if self.on_phase is not None:
+                        try:
+                            self.on_phase(eid, new_phase)
+                        except Exception:
+                            logger.exception("on_phase subscriber raised")
                 # heartbeat-carried telemetry (metrics.py): keep the last
                 # snapshot/goodput per node for the aggregated cluster view
                 if "metrics" in payload:
@@ -532,6 +561,8 @@ class ClusterMonitor:
             return None
 
     def _fail(self, failure: ClusterFailure) -> None:
+        """Record + publish one classified failure (_poll_lock held by
+        caller — every path here runs inside a _poll_once)."""
         self._failure = failure
         self.failures.append(failure)
         self._failures_total.inc(kind=failure.kind)

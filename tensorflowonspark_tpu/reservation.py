@@ -72,6 +72,16 @@ class Reservations:
         with self._lock:
             self._reservations.append(meta)
 
+    def expect(self, n: int) -> int:
+        """Re-open the rendezvous for ``n`` more registrations (live
+        membership expansion: ``TPUCluster.add_workers``).  ``done()``
+        turns False again until the newcomers register; existing members
+        are unaffected — they only polled during their own bootstrap.
+        Returns the new required total."""
+        with self._lock:
+            self.required += int(n)
+            return self.required
+
     def done(self) -> bool:
         with self._lock:
             return len(self._reservations) >= self.required
@@ -370,6 +380,16 @@ class Server(MessageSocket):
             logger.debug("waiting for %d reservations", self.reservations.remaining())
             time.sleep(0.1)
         return self.reservations.get()
+
+    def open_for(self, n: int) -> int:
+        """Re-open the (still listening) rendezvous for ``n`` more
+        registrations — the accept loop runs for the cluster's whole
+        life, so late joiners register through the same path the
+        original members did.  Returns the new required total."""
+        if self.done.is_set():
+            raise RuntimeError("reservation server already stopped; "
+                               "cannot admit new members")
+        return self.reservations.expect(n)
 
     def stop(self) -> None:
         self.done.set()

@@ -14,20 +14,26 @@ one compiled decode loop behind the node's queue/shm data plane.
         tokens = c.generate(prompt_ids, max_new_tokens=64)
     serving.shutdown()
 
-Layout: ``scheduler`` (admission/routing/failover + typed errors),
-``replica`` (the worker map_fun), ``frontend`` (TCP edge +
-``ServingCluster`` composition), ``client`` (``ServeClient``).
-Architecture, backpressure semantics and the failure model are in
-``docs/serving.md``.
+Layout: ``scheduler`` (tenant-aware admission/routing/failover + typed
+errors + elastic membership), ``replica`` (the worker map_fun, drains
+under preemption), ``frontend`` (TCP edge + ``ServingCluster``
+composition: ``add_replicas``/``retire_replica``/drain-and-replace),
+``autoscaler`` (metrics-driven membership control), ``client``
+(``ServeClient``).  Architecture, backpressure semantics, the failure
+model, and the scale-event taxonomy are in ``docs/serving.md``.
 """
 
+from tensorflowonspark_tpu.serving.autoscaler import (Autoscaler,  # noqa: F401
+                                                      AutoscalerConfig)
 from tensorflowonspark_tpu.serving.client import ServeClient  # noqa: F401
 from tensorflowonspark_tpu.serving.frontend import (ServeFrontend,  # noqa: F401
                                                     ServingCluster)
 from tensorflowonspark_tpu.serving.replica import serve_replica  # noqa: F401
 from tensorflowonspark_tpu.serving.scheduler import (DeadlineExceeded,  # noqa: F401
+                                                     PRIORITIES,
                                                      ReplicaFailed,
                                                      ReplicaScheduler,
                                                      RequestRejected,
                                                      ServeRequest,
-                                                     ServingError)
+                                                     ServingError,
+                                                     TokenBucket)

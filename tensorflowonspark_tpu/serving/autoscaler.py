@@ -1,0 +1,240 @@
+"""Metrics-driven autoscaling for the online serving tier.
+
+Closes the loop between the telemetry the tier already emits (scheduler
+queue depth, per-replica outstanding, TTFT p95 — the PR-6 signals) and
+the elastic membership primitives (``ServingCluster.add_replicas`` /
+``retire_replica``).  The controller is deliberately boring: threshold
+rules with **hysteresis** (a signal must persist for N consecutive
+samples), **cooldowns** (independent up/down, so a scale-up's boot cost
+can't immediately trigger a scale-down of the still-warming replica),
+and hard **min/max bounds**.
+
+Decision rules per sample (every ``interval`` seconds):
+
+- **scale up** when ``queued > up_queue_per_replica x alive`` OR
+  (``up_ttft_p95`` set and the scheduler's recent TTFT p95 exceeds it),
+  sustained for ``up_consecutive`` samples, while
+  ``alive < max_replicas`` and the up-cooldown has passed;
+- **scale down** when ``queued == 0`` AND total outstanding would fit
+  the survivors at ``down_outstanding_per_replica`` per replica,
+  sustained for ``down_consecutive`` samples, while
+  ``alive > min_replicas`` and the down-cooldown has passed.  The
+  victim is the alive, non-draining replica with the fewest outstanding
+  requests (highest executor id on ties — last in, first out), and the
+  removal is DRAIN-BASED: no accepted request is lost.
+
+Every action lands in ``serving_events.jsonl`` as a ``scale_up`` /
+``scale_down`` event with a human-readable ``reason`` and the sampled
+signals, so a trace reader can answer "why did the fleet grow at
+14:03?" from the same log that carries the request lifecycle
+(docs/serving.md has the scale-event taxonomy).
+
+``decide(sample)`` is separated from the sampling/acting loop so tests
+can drive the policy deterministically without threads or clusters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    """Knobs for :class:`Autoscaler` (docs/serving.md has the table)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    interval: float = 1.0            # seconds between samples
+    up_queue_per_replica: float = 4.0
+    up_ttft_p95: float | None = None   # seconds; None = queue signal only
+    up_consecutive: int = 2
+    up_cooldown: float = 10.0
+    up_step: int = 1
+    down_outstanding_per_replica: float = 1.0
+    down_consecutive: int = 5
+    down_cooldown: float = 20.0
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if self.up_consecutive < 1 or self.down_consecutive < 1:
+            raise ValueError("hysteresis windows must be >= 1 sample")
+
+
+class Autoscaler:
+    """Drives ``serving`` (a :class:`~tensorflowonspark_tpu.serving.
+    frontend.ServingCluster`) from its scheduler's live signals.
+
+    The sampling loop runs on a daemon thread; scale actions execute on
+    that same thread (``add_replicas`` blocks on the newcomers'
+    reservations, ``retire_replica`` on the drain) — sampling pauses
+    while the membership change completes, which is exactly the
+    hysteresis a mid-change controller needs anyway.
+    """
+
+    def __init__(self, serving, config: AutoscalerConfig | None = None,
+                 **knobs):
+        if config is None:
+            config = AutoscalerConfig(**knobs)
+        elif knobs:
+            config = dataclasses.replace(config, **knobs)
+        self.serving = serving
+        self.cfg = config
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_up = 0.0      # monotonic stamps; 0 = never
+        self._last_down = 0.0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "Autoscaler":
+        self.serving.scheduler.emit_event(
+            "autoscaler_started", **{
+                k: v for k, v in dataclasses.asdict(self.cfg).items()})
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10.0)
+
+    # -- policy ------------------------------------------------------------
+    def sample(self) -> dict:
+        """One reading of the signals the policy consumes."""
+        sched = self.serving.scheduler
+        m = sched.metrics()
+        alive = [eid for eid, r in m["replicas"].items() if r["alive"]]
+        draining = [eid for eid, r in m["replicas"].items()
+                    if r["alive"] and r["draining"]]
+        return {
+            "alive": len(alive),
+            "routable": len(alive) - len(draining),
+            "queued": m["queued"],
+            "outstanding": sum(r["outstanding"]
+                               for r in m["replicas"].values()),
+            "ttft_p95": m["ttft"]["p95_secs"],
+        }
+
+    def decide(self, s: dict, now: float | None = None) -> tuple[str, str]:
+        """Pure policy step: ``("up"|"down"|"hold", reason)``.  Mutates
+        only the hysteresis streaks and cooldown bookkeeping — the
+        caller performs the action (and must call :meth:`acted`)."""
+        cfg = self.cfg
+        now = time.monotonic() if now is None else now
+        routable = max(1, s["routable"])
+        up_signal = None
+        if s["queued"] > cfg.up_queue_per_replica * routable:
+            up_signal = (f"queued {s['queued']} > "
+                         f"{cfg.up_queue_per_replica:g}/replica x "
+                         f"{routable} routable")
+        elif (cfg.up_ttft_p95 is not None and s["ttft_p95"] is not None
+                and s["ttft_p95"] > cfg.up_ttft_p95):
+            up_signal = (f"ttft p95 {s['ttft_p95']:.3f}s > "
+                         f"{cfg.up_ttft_p95:g}s")
+        down_signal = None
+        if (s["queued"] == 0 and s["alive"] > cfg.min_replicas
+                and s["outstanding"] <= cfg.down_outstanding_per_replica
+                * (s["alive"] - 1)):
+            down_signal = (f"idle: queue empty, {s['outstanding']} "
+                           f"outstanding fits {s['alive'] - 1} replicas at "
+                           f"{cfg.down_outstanding_per_replica:g} each")
+        self._up_streak = self._up_streak + 1 if up_signal else 0
+        self._down_streak = self._down_streak + 1 if down_signal else 0
+        if (up_signal and self._up_streak >= cfg.up_consecutive
+                and s["alive"] < cfg.max_replicas
+                and now - self._last_up >= cfg.up_cooldown):
+            return "up", (f"{up_signal} for {self._up_streak} samples")
+        if (down_signal and self._down_streak >= cfg.down_consecutive
+                and now - self._last_down >= cfg.down_cooldown):
+            return "down", (f"{down_signal} for {self._down_streak} samples")
+        return "hold", up_signal or down_signal or "in band"
+
+    def acted(self, direction: str, now: float | None = None) -> None:
+        """Reset hysteresis + start the cooldown after an action."""
+        now = time.monotonic() if now is None else now
+        self._up_streak = self._down_streak = 0
+        if direction == "up":
+            self._last_up = now
+        else:
+            self._last_down = now
+
+    # -- acting loop -------------------------------------------------------
+    def _loop(self) -> None:
+        # cooldowns start armed at boot: a tier that comes up already
+        # overloaded may scale immediately, but never scale DOWN before
+        # one full down-cooldown of evidence
+        self._last_down = time.monotonic()
+        while not self._stop.wait(self.cfg.interval):
+            try:
+                s = self.sample()
+                direction, reason = self.decide(s)
+                if direction == "up":
+                    self._scale_up(s, reason)
+                elif direction == "down":
+                    self._scale_down(s, reason)
+            except Exception:   # the controller must outlive a bad sample
+                logger.exception("autoscaler step failed")
+
+    def _scale_up(self, s: dict, reason: str) -> None:
+        cfg = self.cfg
+        n = min(cfg.up_step, cfg.max_replicas - s["alive"])
+        logger.warning("autoscaler: scaling UP by %d (%s)", n, reason)
+        self.serving.scheduler.emit_event(
+            "scale_up", replicas=n, reason=reason, **_signals(s))
+        try:
+            self.serving.add_replicas(n)
+            self.scale_ups += 1
+        except Exception:
+            logger.exception("autoscaler: scale-up failed")
+            self.serving.scheduler.emit_event(
+                "scale_failed", direction="up", reason=reason)
+        self.acted("up")
+
+    def _scale_down(self, s: dict, reason: str) -> None:
+        victim = self._pick_victim()
+        if victim is None:
+            return
+        logger.warning("autoscaler: scaling DOWN replica %d (%s)",
+                       victim, reason)
+        self.serving.scheduler.emit_event(
+            "scale_down", replica=victim, reason=reason, **_signals(s))
+        try:
+            self.serving.retire_replica(victim)
+            self.scale_downs += 1
+        except Exception:
+            logger.exception("autoscaler: scale-down failed")
+            self.serving.scheduler.emit_event(
+                "scale_failed", direction="down", reason=reason)
+        self.acted("down")
+
+    def _pick_victim(self) -> int | None:
+        """Least-loaded alive non-draining replica; highest id on ties
+        (newest goes first, keeping the founding members warm)."""
+        m = self.serving.scheduler.metrics()
+        candidates = [(r["outstanding"], -eid, eid)
+                      for eid, r in m["replicas"].items()
+                      if r["alive"] and not r["draining"]]
+        if len(candidates) <= self.cfg.min_replicas:
+            return None
+        return min(candidates)[2]
+
+
+def _signals(s: dict) -> dict:
+    return {"queued": s["queued"], "outstanding": s["outstanding"],
+            "alive": s["alive"],
+            "ttft_p95_secs": None if s["ttft_p95"] is None
+            else round(s["ttft_p95"], 6)}

@@ -13,13 +13,29 @@ concurrent streams with N clients — they are cheap).  Errors are typed:
   request was lost to replica failure beyond the one re-queue;
 - ``ValueError`` — the request itself is invalid (e.g. prompt + budget
   exceed the model's positions), reported by the replica's validator.
+
+Transient transport robustness (mirrors ``agent._AgentConn.request``):
+a socket failure on an IDLE connection — the send of a new request, or
+the wait for its FIRST response frame — reconnects and retries ONCE
+(short backoff, fresh authkey handshake).  Once any frame of a request
+has been consumed the retry window is over: a replayed ``generate``
+would interleave with the half-delivered stream, so mid-stream errors
+propagate.  The second failure propagates the original typed error
+untouched.
+
+``tenant``/``priority`` ride every request (client-level defaults,
+per-call override) into the scheduler's per-tenant token-bucket
+admission — an over-budget tenant sees
+``RequestRejected(reason="tenant_throttled")``.
 """
 
 from __future__ import annotations
 
 import contextlib
+import logging
 import socket
 import threading
+import time
 
 import numpy as np
 
@@ -29,7 +45,10 @@ from tensorflowonspark_tpu.serving.scheduler import (DeadlineExceeded,
                                                      RequestRejected,
                                                      ServingError)
 
-_REJECT_REASONS = ("queue_full", "shutdown", "no_replica")
+logger = logging.getLogger(__name__)
+
+_REJECT_REASONS = ("queue_full", "tenant_throttled", "shutdown",
+                   "no_replica")
 
 
 def _raise_typed(reason: str, message: str):
@@ -48,16 +67,28 @@ class ServeClient(MessageSocket):
     """Blocking client for :class:`~tensorflowonspark_tpu.serving.
     frontend.ServeFrontend` (module docstring has the error contract)."""
 
+    #: backoff before the single reconnect attempt (mirrors _AgentConn)
+    RETRY_BACKOFF_SECS = 0.2
+
     def __init__(self, addr: tuple[str, int], authkey: bytes,
-                 timeout: float = 600.0):
+                 timeout: float = 600.0, tenant: str | None = None,
+                 priority: str | None = None):
         self.addr = tuple(addr)
+        self._authkey = bytes(authkey)
+        self._timeout = float(timeout)
+        self.tenant = tenant
+        self.priority = priority
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._connect()
+
+    def _connect(self) -> None:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.settimeout(timeout)
+        self._sock.settimeout(self._timeout)
         self._sock.connect(self.addr)
-        self._lock = threading.Lock()
         try:
-            self.auth_respond(self._sock, bytes(authkey))
+            self.auth_respond(self._sock, self._authkey)
         except (PermissionError, EOFError, OSError) as e:
             self.close()   # don't leak the connected fd on a bad key
             raise ConnectionError(
@@ -65,52 +96,85 @@ class ServeClient(MessageSocket):
 
     # -- requests ----------------------------------------------------------
     def _gen_msg(self, prompt, max_new_tokens, temperature, top_p, seed,
-                 stream, timeout, trace):
+                 stream, timeout, trace, tenant, priority):
         return {"op": "generate",
                 "prompt": np.asarray(prompt, np.int32).reshape(-1),
                 "max_new_tokens": int(max_new_tokens),
                 "temperature": float(temperature), "top_p": float(top_p),
                 "seed": int(seed), "stream": bool(stream),
-                "timeout": timeout, "trace": trace}
+                "timeout": timeout, "trace": trace,
+                "tenant": tenant if tenant is not None else self.tenant,
+                "priority": (priority if priority is not None
+                             else self.priority)}
+
+    def _request_first(self, msg):
+        """Send ``msg`` and return its FIRST response frame, reconnecting
+        and retrying ONCE on a transient socket failure (the idle-
+        connection shape: a frontend that closed the keep-alive, a reset
+        between requests).  Nothing of the request was delivered to us
+        yet, so the replay is safe; a second failure propagates.  Callers
+        hold ``self._lock``."""
+        try:
+            self.send(self._sock, msg)
+            return self.receive(self._sock)
+        except (OSError, EOFError) as e:
+            if isinstance(e, TimeoutError):
+                # a SLOW response is not a dead connection: the request
+                # was admitted and is decoding — a replay would double-
+                # charge the tenant bucket and decode two copies
+                raise
+            logger.warning("serve frontend %s: %s before any response "
+                           "frame; reconnecting once", self.addr,
+                           type(e).__name__)
+            with contextlib.suppress(OSError):
+                self._sock.close()
+            time.sleep(self.RETRY_BACKOFF_SECS)
+            self._connect()   # propagates if the frontend is really gone
+            self.send(self._sock, msg)
+            return self.receive(self._sock)
 
     def generate(self, prompt, max_new_tokens: int, *,
                  temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
-                 timeout: float | None = None,
-                 trace: str | None = None) -> np.ndarray:
+                 timeout: float | None = None, trace: str | None = None,
+                 tenant: str | None = None,
+                 priority: str | None = None) -> np.ndarray:
         """Generate to completion; returns the token array (prompt
         excluded).  ``timeout`` is the end-to-end deadline (queue wait
         included); greedy (default) output is exact vs a solo
         ``greedy_generate`` run.  ``trace`` propagates a caller-chosen
         trace id through the tier's telemetry (``tracing.new_trace_id()``;
-        the frontend mints one otherwise)."""
+        the frontend mints one otherwise).  ``tenant``/``priority``
+        override the client-level defaults for this request."""
         with self._lock:
-            self.send(self._sock, self._gen_msg(
+            frame = self._request_first(self._gen_msg(
                 prompt, max_new_tokens, temperature, top_p, seed,
-                stream=False, timeout=timeout, trace=trace))
+                stream=False, timeout=timeout, trace=trace,
+                tenant=tenant, priority=priority))
             while True:
-                frame = self.receive(self._sock)
                 kind = frame[0]
                 if kind == "DONE":
                     return np.asarray(frame[1], np.int32)
                 if kind == "ERR":
                     _raise_typed(frame[1], frame[2])
                 # tolerate stray TOK frames (stream flag mismatch)
+                frame = self.receive(self._sock)
 
     def generate_stream(self, prompt, max_new_tokens: int, *,
                         temperature: float = 0.0, top_p: float = 1.0,
                         seed: int = 0, timeout: float | None = None,
-                        trace: str | None = None):
+                        trace: str | None = None, tenant: str | None = None,
+                        priority: str | None = None):
         """Yield token deltas (lists of ints) as the replica commits them;
         exact concatenation == :meth:`generate`'s output.  Consume the
         iterator fully (or ``close()`` the client): abandoning it
         mid-stream closes the connection to avoid frame desync."""
         with self._lock:
-            self.send(self._sock, self._gen_msg(
+            frame = self._request_first(self._gen_msg(
                 prompt, max_new_tokens, temperature, top_p, seed,
-                stream=True, timeout=timeout, trace=trace))
+                stream=True, timeout=timeout, trace=trace,
+                tenant=tenant, priority=priority))
             try:
                 while True:
-                    frame = self.receive(self._sock)
                     kind = frame[0]
                     if kind == "TOK":
                         yield list(frame[1])
@@ -118,6 +182,7 @@ class ServeClient(MessageSocket):
                         return
                     else:
                         _raise_typed(frame[1], frame[2])
+                    frame = self.receive(self._sock)
             except GeneratorExit:
                 # abandoned mid-stream: unread frames would desync the
                 # next request — retire the connection instead
@@ -127,22 +192,21 @@ class ServeClient(MessageSocket):
     # -- control -----------------------------------------------------------
     def stats(self) -> dict:
         """The scheduler's metrics snapshot (counters + ttft/e2e
-        percentile summaries + per-replica state)."""
+        percentile summaries + per-replica/per-tenant state)."""
         with self._lock:
-            self.send(self._sock, {"op": "stats"})
-            frame = self.receive(self._sock)
+            frame = self._request_first({"op": "stats"})
         if frame[0] != "OK":
             _raise_typed(frame[1], frame[2])
         return frame[1]
 
     def ping(self) -> bool:
         with self._lock:
-            self.send(self._sock, {"op": "ping"})
-            return self.receive(self._sock) == "OK"
+            return self._request_first({"op": "ping"}) == "OK"
 
     def close(self) -> None:
-        with contextlib.suppress(OSError):
-            self._sock.close()
+        if self._sock is not None:
+            with contextlib.suppress(OSError):
+                self._sock.close()
 
     def __enter__(self):
         return self

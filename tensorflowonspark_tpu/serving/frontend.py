@@ -50,10 +50,12 @@ import numpy as np
 
 from tensorflowonspark_tpu import metrics as tpu_metrics
 from tensorflowonspark_tpu.cluster import InputMode, TPUCluster
-from tensorflowonspark_tpu.health import ClusterMonitor
+from tensorflowonspark_tpu.health import PREEMPTION, ClusterMonitor
+from tensorflowonspark_tpu.marker import EndOfFeed
 from tensorflowonspark_tpu.reservation import (FrameFormatError,
                                                MessageSocket, _peer_name)
-from tensorflowonspark_tpu.serving.scheduler import (ReplicaScheduler,
+from tensorflowonspark_tpu.serving.scheduler import (REQUEST_QUEUE,
+                                                     ReplicaScheduler,
                                                      RequestRejected,
                                                      ServingError)
 
@@ -182,7 +184,9 @@ class ServeFrontend(MessageSocket):
                 temperature=float(msg.get("temperature", 0.0)),
                 top_p=float(msg.get("top_p", 1.0)),
                 seed=int(msg.get("seed", 0)), timeout=timeout,
-                trace=msg.get("trace"))
+                trace=msg.get("trace"),
+                tenant=str(msg.get("tenant") or "default"),
+                priority=msg.get("priority"))
         except (RequestRejected, ServingError) as e:
             self.send(conn, ("ERR", getattr(e, "reason", "rejected"), str(e)))
             return
@@ -235,7 +239,14 @@ class ServingCluster:
         self.metrics_http = None
         #: ``(host, port)`` of the /metrics + /statusz endpoint, or None
         self.metrics_address: tuple[str, int] | None = None
+        #: the running :class:`~tensorflowonspark_tpu.serving.autoscaler.
+        #: Autoscaler`, when ``run(autoscale=...)`` asked for one
+        self.autoscaler = None
         self._shutdown_done = False
+        self._replace_preempted = True
+        self._drain_timeout = 60.0
+        self._membership_lock = threading.Lock()
+        self._replaced: set[int] = set()  # preempted eids already replaced
 
     # ------------------------------------------------------------------ run
     @classmethod
@@ -246,7 +257,10 @@ class ServingCluster:
             hang_timeout: float = 120.0, step_timeout: float | None = None,
             monitor: bool = True, frontend_mode: str = "local",
             client_timeout: float = 600.0,
-            metrics_port: int | None = 0, **cluster_kwargs) -> "ServingCluster":
+            metrics_port: int | None = 0, tenants: dict | None = None,
+            autoscale=None, replace_preempted: bool = True,
+            drain_timeout: float = 60.0,
+            **cluster_kwargs) -> "ServingCluster":
         """Boot ``num_replicas`` serving workers and the driver-side tier.
 
         ``model_builder(args) -> (cfg, params)`` must be a picklable
@@ -259,6 +273,16 @@ class ServingCluster:
         ``/statusz`` endpoint next to the frontend (0 = an ephemeral
         port, surfaced as ``serving.metrics_address``; ``None``
         disables it).
+
+        ``tenants`` configures per-tenant admission (token buckets +
+        priority classes — see :class:`~tensorflowonspark_tpu.serving.
+        scheduler.ReplicaScheduler`); ``autoscale`` (a dict of
+        :class:`~tensorflowonspark_tpu.serving.autoscaler.
+        AutoscalerConfig` knobs, or a config instance) starts a
+        metrics-driven autoscaler over the tier.  With
+        ``replace_preempted`` (default), a replica whose host is
+        reclaimed (SIGTERM / heartbeat phase ``preempted``) is drained
+        and REPLACED instead of counting as a failure.
         """
         from tensorflowonspark_tpu.serving.replica import serve_replica
 
@@ -274,11 +298,12 @@ class ServingCluster:
         cluster = TPUCluster.run(serve_replica, args, num_replicas,
                                  input_mode=InputMode.SPARK, monitor=False,
                                  **cluster_kwargs)
-        scheduler = mon = frontend = None
+        scheduler = mon = frontend = tier = None
         try:
             scheduler = ReplicaScheduler(
                 cluster, slots_per_replica=max_batch, overcommit=overcommit,
-                max_queue_depth=max_queue_depth, requeue_limit=requeue_limit)
+                max_queue_depth=max_queue_depth, requeue_limit=requeue_limit,
+                tenants=tenants)
             if monitor:
                 mon = ClusterMonitor(
                     cluster, hang_timeout=hang_timeout,
@@ -292,6 +317,22 @@ class ServingCluster:
                 mode=frontend_mode, default_timeout=client_timeout)
             address = frontend.start()
             tier = cls(cluster, scheduler, mon, frontend, address)
+            tier._replace_preempted = bool(replace_preempted)
+            tier._drain_timeout = float(drain_timeout)
+            if mon is not None:
+                # re-point the monitor's hooks at the tier: classified
+                # failures still retire replicas in the scheduler, but
+                # preemptions (exit-shape OR live grace-window phase
+                # flips) now ALSO drive drain-and-replace
+                mon.on_failure = tier._on_cluster_failure
+                mon.on_phase = tier._on_phase
+            if autoscale is not None:
+                from tensorflowonspark_tpu.serving.autoscaler import (
+                    Autoscaler, AutoscalerConfig)
+
+                cfg = (autoscale if isinstance(autoscale, AutoscalerConfig)
+                       else AutoscalerConfig(**dict(autoscale)))
+                tier.autoscaler = Autoscaler(tier, cfg).start()
             if metrics_port is not None:
                 tier.metrics_http = tpu_metrics.MetricsHTTPServer(
                     tier.metrics_text, statusz=tier.metrics,
@@ -305,10 +346,12 @@ class ServingCluster:
                     else bound)
         except Exception:
             # a late failure (e.g. the metrics port is taken) must tear
-            # down everything already live: the frontend's accept thread
-            # and bound port, the scheduler's threads AND its registry
-            # collect hook (scheduler.stop unhooks it), the monitor
-            for part in (frontend, scheduler, mon):
+            # down everything already live: the autoscaler's control
+            # thread, the frontend's accept thread and bound port, the
+            # scheduler's threads AND its registry collect hook
+            # (scheduler.stop unhooks it), the monitor
+            autoscaler = tier.autoscaler if tier is not None else None
+            for part in (autoscaler, frontend, scheduler, mon):
                 if part is not None:
                     with contextlib.suppress(Exception):
                         part.stop()
@@ -328,6 +371,119 @@ class ServingCluster:
 
         return ServeClient(self.address, self.authkey, **kwargs)
 
+    # ----------------------------------------------------- live membership
+    def add_replicas(self, n: int = 1,
+                     timeout: float | None = None) -> list[int]:
+        """Grow the tier by ``n`` replicas, live: the cluster re-opens
+        its reservation path and spawns fresh ``serve_replica`` workers
+        (same model builder/args the tier booted with), the scheduler
+        registers each as it reserves, and queued requests start
+        dispatching to the newcomers immediately.  Returns the new
+        executor ids."""
+        if self._shutdown_done:
+            raise RuntimeError("serving tier is shut down")
+        with self._membership_lock:
+            added = self.cluster.add_workers(n, timeout=timeout)
+            for info in added:
+                self.scheduler.add_replica(info)
+        eids = [int(info["executor_id"]) for info in added]
+        logger.info("serving tier grew by %d replica(s): %s", n, eids)
+        return eids
+
+    def retire_replica(self, executor_id: int,
+                       drain_timeout: float | None = None) -> bool:
+        """Drain-based scale-down of one replica: stop routing to it,
+        wait out its in-flight requests (``drain_timeout``, default the
+        tier's), remove it from the scheduler as a CLEAN departure (it
+        never shows in ``dead_replicas``), then stop the worker with a
+        per-replica ``EndOfFeed``.  Returns True when the drain emptied
+        within the timeout; on False the leftovers were re-queued to the
+        survivors (exactness preserved by the failover skip-dedup), so
+        zero accepted requests are lost either way."""
+        eid = int(executor_id)
+        dt = self._drain_timeout if drain_timeout is None else drain_timeout
+        self.scheduler.mark_draining(eid, reason="scale_down")
+        drained = self.scheduler.drain_replica(eid, timeout=dt)
+        # retire BEFORE EndOfFeed: alive goes False first, so the recv
+        # loop sees a planned departure, not a dead response channel
+        self.scheduler.retire_replica(
+            eid, reason="scale_down" if drained else "drain_timeout")
+        with contextlib.suppress(Exception):
+            self.cluster._client_for(eid).put(REQUEST_QUEUE, EndOfFeed(),
+                                              timeout=5)
+        if self.monitor is not None:
+            self.monitor.ignore_worker(eid)
+        self.cluster.retire_worker(eid)
+        return drained
+
+    # ------------------------------------------------ preemption handling
+    def _on_phase(self, eid: int, phase: str) -> None:
+        """Monitor ``on_phase`` hook: a live replica flipping to
+        ``preempted`` is in its reclaim grace window — drain and replace
+        it NOW instead of waiting for the exit."""
+        if phase == "preempted" and not self._shutdown_done:
+            self._handle_preempted(int(eid))
+
+    def _on_cluster_failure(self, failure) -> None:
+        """Monitor ``on_failure`` hook: always fail over via the
+        scheduler; a PREEMPTION-classified exit (the replica died before
+        or during its grace drain) additionally spawns a replacement —
+        membership flexes, the tier never shrinks by reclaim."""
+        self.scheduler.on_cluster_failure(failure)
+        if (self._replace_preempted and not self._shutdown_done
+                and getattr(failure, "kind", None) == PREEMPTION):
+            for eid in getattr(failure, "failed_workers", ()):
+                self._spawn_replacement(int(eid), source="exit")
+
+    def _handle_preempted(self, eid: int) -> None:
+        # mark_draining is the dedup: False when already draining/dead,
+        # so repeated phase reports (or the exit racing the drain) start
+        # exactly one drain-and-replace
+        if not self.scheduler.mark_draining(eid, reason="preempted"):
+            return
+        threading.Thread(target=self._drain_and_replace, args=(eid,),
+                         name=f"serve-preempt-{eid}", daemon=True).start()
+
+    def _drain_and_replace(self, eid: int) -> None:
+        try:
+            self.scheduler.drain_replica(eid, timeout=self._drain_timeout)
+            # the worker exits by itself after its grace drain; if it
+            # died mid-drain the recv loop's _mark_dead already re-queued
+            # the leftovers and this retire is a no-op
+            self.scheduler.retire_replica(eid, reason="preempted")
+            if self.monitor is not None:
+                self.monitor.ignore_worker(eid)
+            self.cluster.retire_worker(eid)
+        except Exception:
+            logger.exception("preemption drain of replica %d failed", eid)
+        if self._replace_preempted:
+            self._spawn_replacement(eid, source="drain")
+
+    def _spawn_replacement(self, eid: int, source: str) -> None:
+        if self._shutdown_done:
+            return
+        with self._membership_lock:
+            if eid in self._replaced:
+                return   # phase path and exit path both fired; one spawn
+            self._replaced.add(eid)
+
+        def _go():
+            if self._shutdown_done:
+                return
+            try:
+                new = self.add_replicas(1)
+                self.scheduler.emit_event(
+                    "replica_replaced", replica=eid, replacement=new[0],
+                    source=source)
+            except Exception:
+                logger.exception("replacement for preempted replica %d "
+                                 "failed", eid)
+                self.scheduler.emit_event("replace_failed", replica=eid,
+                                          source=source)
+
+        threading.Thread(target=_go, name=f"serve-replace-{eid}",
+                         daemon=True).start()
+
     def metrics(self) -> dict:
         """The scheduler's counters/latency view, plus ``"nodes"``: the
         heartbeat-carried per-replica registry snapshots and goodput
@@ -335,6 +491,9 @@ class ServingCluster:
         m = self.scheduler.metrics()
         m["nodes"] = (self.monitor.node_metrics()
                       if self.monitor is not None else {})
+        if self.autoscaler is not None:
+            m["autoscaler"] = {"scale_ups": self.autoscaler.scale_ups,
+                               "scale_downs": self.autoscaler.scale_downs}
         return m
 
     def metrics_text(self) -> str:
@@ -361,6 +520,10 @@ class ServingCluster:
         if self._shutdown_done:
             return
         self._shutdown_done = True
+        if self.autoscaler is not None:
+            # first: no membership changes may race the teardown
+            with contextlib.suppress(Exception):
+                self.autoscaler.stop()
         if not self.scheduler.drain(drain_timeout):
             logger.warning("serving scheduler still busy after %.0fs drain; "
                            "remaining requests get typed shutdown errors",

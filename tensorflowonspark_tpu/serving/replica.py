@@ -28,8 +28,18 @@ tier:
   :meth:`~tensorflowonspark_tpu.models.serving.ContinuousBatcher.load`
   total, giving the scheduler real queue depth for routing;
 - an :class:`~tensorflowonspark_tpu.marker.EndOfFeed` marker (sent by
-  ``cluster.shutdown`` exactly as for a training feed) stops intake; the
-  loop drains its in-flight requests and exits cleanly.
+  ``cluster.shutdown`` — or per-replica by ``ServingCluster.
+  retire_replica`` — exactly as for a training feed) stops intake; the
+  loop drains its in-flight requests and exits cleanly;
+- the loop runs under a :class:`~tensorflowonspark_tpu.preemption.
+  PreemptionGuard`: a SIGTERM (spot/preemptible reclaim, or the chaos
+  ``replace`` verb) is latched instead of killing the process mid-
+  decode.  The replica flips into DRAIN mode — the heartbeat phase
+  turns ``preempted`` (the driver's serving tier sees it, stops routing
+  and spawns a replacement), intake keeps consuming whatever the
+  dispatcher already queued, in-flight slots decode to completion, and
+  the process exits 0.  Elastic membership turns the reclaim into a
+  planned departure instead of a failure (docs/serving.md).
 
 ``args`` contract (all keys prefixed ``serve_``):
 
@@ -46,10 +56,12 @@ from __future__ import annotations
 
 import logging
 import queue as _queue
+import time as _time
 
 from tensorflowonspark_tpu import metrics as _metrics
 from tensorflowonspark_tpu import tracing
 from tensorflowonspark_tpu.marker import EndOfFeed, Marker
+from tensorflowonspark_tpu.preemption import PreemptionGuard
 from tensorflowonspark_tpu.serving.scheduler import (REQUEST_QUEUE,
                                                      RESPONSE_QUEUE)
 
@@ -75,6 +87,12 @@ def serve_replica(args, ctx) -> None:
                            "(InputMode.SPARK)")
     idle_poll = float(args.get("serve_idle_poll", 0.5))
     busy_poll = float(args.get("serve_busy_poll", 0.005))
+    # how long a preempted replica keeps polling intake after its queue
+    # looks empty: covers the window before the driver notices the
+    # 'preempted' heartbeat phase and stops routing (heartbeat interval
+    # + monitor poll), so a request dispatched into that window is still
+    # served rather than stranded
+    preempt_grace = float(args.get("serve_preempt_grace", 2.0))
 
     deltas: dict[int, list[int]] = {}   # batcher rid -> tokens this step
 
@@ -107,74 +125,104 @@ def serve_replica(args, ctx) -> None:
 
     logger.info("replica %d serving (max_batch=%d)", ctx.executor_id,
                 batcher.max_batch)
-    while True:
-        while not stopping and batcher.has_free_slot():
-            try:
-                item = mgr.queue_get(REQUEST_QUEUE,
-                                     timeout=busy_poll if busy()
-                                     else idle_poll)
-            except (_queue.Empty, TimeoutError):
-                break
-            if isinstance(item, EndOfFeed):
-                stopping = True
-                break
-            if isinstance(item, Marker):
+    draining = False
+    drain_started = 0.0
+    guard = PreemptionGuard()
+    with guard:
+        while True:
+            if guard.preempted and not draining:
+                draining = True
+                drain_started = _time.monotonic()
+                logger.warning(
+                    "replica %d preempted: draining in-flight work, then "
+                    "exiting cleanly (grace poll %.1fs)", ctx.executor_id,
+                    preempt_grace)
+                tracer.event("replica_preempted", None,
+                             replica=ctx.executor_id,
+                             inflight=batcher.load()["total"])
+            queue_idle = False
+            while not stopping and batcher.has_free_slot():
+                try:
+                    item = mgr.queue_get(
+                        REQUEST_QUEUE,
+                        timeout=busy_poll if busy()
+                        else (0.05 if draining else idle_poll))
+                except (_queue.Empty, TimeoutError):
+                    queue_idle = True
+                    break
+                if isinstance(item, EndOfFeed):
+                    stopping = True
+                    break
+                if isinstance(item, Marker):
+                    continue
+                if not (isinstance(item, dict) and item.get("op") == "gen"):
+                    logger.warning("replica %d: ignoring non-request item %r",
+                                   ctx.executor_id, type(item))
+                    continue
+                try:
+                    brid = batcher.submit(
+                        item["prompt"], int(item["max_new_tokens"]),
+                        temperature=float(item.get("temperature", 0.0)),
+                        top_p=float(item.get("top_p", 1.0)),
+                        seed=int(item.get("seed", 0)), on_token=on_token)
+                except ValueError as e:
+                    # a malformed request must not kill the replica; bounce
+                    # the typed error back to the scheduler
+                    mgr.queue_put(RESPONSE_QUEUE,
+                                  {"rid": item.get("rid"), "event": "error",
+                                   "error": str(e)})
+                    continue
+                rid_map[brid] = (item["rid"], item.get("trace"))
+                tracer.event("replica_intake", item.get("trace"),
+                             rid=item["rid"], replica=ctx.executor_id,
+                             prompt_tokens=len(item["prompt"]))
+            if not busy():
+                if stopping:
+                    break
+                if draining and queue_idle and (
+                        _time.monotonic() - drain_started >= preempt_grace):
+                    break   # grace-window drain complete: exit cleanly
                 continue
-            if not (isinstance(item, dict) and item.get("op") == "gen"):
-                logger.warning("replica %d: ignoring non-request item %r",
-                               ctx.executor_id, type(item))
-                continue
-            try:
-                brid = batcher.submit(
-                    item["prompt"], int(item["max_new_tokens"]),
-                    temperature=float(item.get("temperature", 0.0)),
-                    top_p=float(item.get("top_p", 1.0)),
-                    seed=int(item.get("seed", 0)), on_token=on_token)
-            except ValueError as e:
-                # a malformed request must not kill the replica; bounce
-                # the typed error back to the scheduler
+            done = batcher.step()
+            steps += 1
+            # serving-phase heartbeat: arms the hang watchdog on the decode
+            # loop and gives chaos its at_step trigger.  A draining replica
+            # reports phase 'preempted' — every step would otherwise clobber
+            # the preemption flip back to 'serving' and the driver would
+            # never see the grace window (it drains-and-replaces off this).
+            # guard.preempted, not just `draining`: a SIGTERM landing MID-
+            # iteration (after the loop-top check) must not have this very
+            # step publish 'serving' over note_preempted's flip — if the
+            # batcher idles right after, no later step would ever correct it
+            ctx.report_step(steps,
+                            phase="preempted" if (draining or guard.preempted)
+                            else "serving")
+            load = batcher.load()["total"]
+            m_steps.inc()
+            g_load.set(load)
+            for brid, toks in deltas.items():
+                rid, trace = rid_map[brid]
+                if brid not in first_sent:
+                    first_sent.add(brid)
+                    tracer.event("replica_first_token", trace, rid=rid,
+                                 replica=ctx.executor_id)
+                m_tokens.inc(len(toks))
                 mgr.queue_put(RESPONSE_QUEUE,
-                              {"rid": item.get("rid"), "event": "error",
-                               "error": str(e)})
-                continue
-            rid_map[brid] = (item["rid"], item.get("trace"))
-            tracer.event("replica_intake", item.get("trace"),
-                         rid=item["rid"], replica=ctx.executor_id,
-                         prompt_tokens=len(item["prompt"]))
-        if not busy():
-            if stopping:
-                break
-            continue
-        done = batcher.step()
-        steps += 1
-        # serving-phase heartbeat: arms the hang watchdog on the decode
-        # loop and gives chaos its at_step trigger
-        ctx.report_step(steps, phase="serving")
-        load = batcher.load()["total"]
-        m_steps.inc()
-        g_load.set(load)
-        for brid, toks in deltas.items():
-            rid, trace = rid_map[brid]
-            if brid not in first_sent:
-                first_sent.add(brid)
-                tracer.event("replica_first_token", trace, rid=rid,
+                              {"rid": rid, "event": "tok",
+                               "tokens": toks, "load": load})
+            deltas.clear()
+            for brid in done:
+                batcher.result(brid, pop=True)  # tokens already streamed
+                rid, trace = rid_map.pop(brid)
+                first_sent.discard(brid)
+                tracer.event("replica_done", trace, rid=rid,
                              replica=ctx.executor_id)
-            m_tokens.inc(len(toks))
-            mgr.queue_put(RESPONSE_QUEUE,
-                          {"rid": rid, "event": "tok",
-                           "tokens": toks, "load": load})
-        deltas.clear()
-        for brid in done:
-            batcher.result(brid, pop=True)  # tokens already streamed
-            rid, trace = rid_map.pop(brid)
-            first_sent.discard(brid)
-            tracer.event("replica_done", trace, rid=rid,
-                         replica=ctx.executor_id)
-            m_served.inc()
-            mgr.queue_put(RESPONSE_QUEUE,
-                          {"rid": rid, "event": "done", "load": load})
-            served += 1
-    logger.info("replica %d drained: %d requests over %d steps "
+                m_served.inc()
+                mgr.queue_put(RESPONSE_QUEUE,
+                              {"rid": rid, "event": "done", "load": load})
+                served += 1
+    logger.info("replica %d %s: %d requests over %d steps "
                 "(%d prefill + %d decode dispatches)", ctx.executor_id,
+                "drained after preemption" if draining else "drained",
                 served, steps, batcher.prefill_dispatches,
                 batcher.decode_dispatches)

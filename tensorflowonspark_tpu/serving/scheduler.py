@@ -41,6 +41,25 @@ Scheduling policy (docs/serving.md):
   suppresses the first ``len(delivered)`` tokens, so a client mid-stream
   observes an uninterrupted exact stream across the failover.  A second
   death fails the request with a typed :class:`ReplicaFailed`.
+- **Tenant-aware admission** — admission is split per tenant: each
+  configured tenant gets a :class:`TokenBucket` (sustained rate +
+  burst) and a priority class, so load shed is a *policy* — the noisy
+  tenant's overflow is rejected with a typed
+  ``RequestRejected(reason="tenant_throttled")`` while the quiet
+  tenant's traffic sails through, and the global ``max_queue_depth``
+  bound stays the backstop.  Priority classes (``high``/``normal``/
+  ``low``) order the pending queue: a high-priority request dispatches
+  ahead of earlier-admitted low-priority ones (FIFO within a class;
+  failover re-queues go to the front of their own class so the
+  exactness contract is priority-blind).
+- **Elastic membership** — replicas can be added (:meth:`ReplicaScheduler.
+  add_replica`, fed by ``ServingCluster.add_replicas``'s re-opened
+  reservation path) and retired live.  Retirement is drain-based:
+  :meth:`mark_draining` stops new routing, :meth:`drain_replica` waits
+  out the in-flight set, :meth:`retire_replica` removes the replica
+  without it ever counting as *dead* — ``serving_events.jsonl`` carries
+  the ``replica_draining``/``replica_retired``/``replica_added``
+  taxonomy next to the failure events (docs/serving.md).
 """
 
 from __future__ import annotations
@@ -77,12 +96,101 @@ class RequestRejected(ServingError):
     """Load-shed at admission: the request never entered the queue.
 
     ``reason`` is machine-readable: ``queue_full`` (bounded queue depth
-    reached), ``shutdown`` (scheduler stopping), ``no_replica`` (every
-    replica is dead)."""
+    reached), ``tenant_throttled`` (the tenant's token bucket is empty —
+    only THIS tenant is over budget), ``shutdown`` (scheduler stopping),
+    ``no_replica`` (every replica is dead)."""
 
     def __init__(self, reason: str, message: str):
         super().__init__(message)
         self.reason = reason
+
+
+#: priority classes, best first — the pending queue dispatches strictly
+#: in this order (FIFO within a class)
+PRIORITIES = ("high", "normal", "low")
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s sustained, ``burst``
+    capacity.  ``try_take`` is called under the scheduler lock, so no
+    lock of its own; ``now`` is injectable for deterministic tests."""
+
+    __slots__ = ("rate", "burst", "tokens", "stamp")
+
+    def __init__(self, rate: float, burst: float | None = None):
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else max(1.0, rate))
+        self.tokens = self.burst
+        self.stamp: float | None = None
+
+    def try_take(self, now: float | None = None) -> bool:
+        now = time.monotonic() if now is None else now
+        if self.stamp is not None:
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self.stamp) * self.rate)
+        self.stamp = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+class _Tenant:
+    """One tenant's admission policy + live counters."""
+
+    __slots__ = ("name", "bucket", "priority", "accepted", "shed")
+
+    def __init__(self, name: str, spec: dict | None):
+        spec = spec or {}
+        self.name = name
+        rate = spec.get("rate")
+        self.bucket = (None if rate is None
+                       else TokenBucket(rate, spec.get("burst")))
+        self.priority = spec.get("priority", "normal")
+        if self.priority not in PRIORITIES:
+            raise ValueError(f"tenant {name!r}: unknown priority "
+                             f"{self.priority!r} (want one of {PRIORITIES})")
+        self.accepted = 0
+        self.shed = 0
+
+
+class _PendingQueue:
+    """Priority-banded pending queue: one FIFO deque per class, popped
+    best class first.  Exposes the deque surface the scheduler already
+    uses (append/appendleft/popleft/remove/clear/len/iter); appendleft
+    fronts a request within ITS OWN class, so a failover re-queue of a
+    low-priority request can never leapfrog high-priority work."""
+
+    def __init__(self):
+        self._bands = {p: collections.deque() for p in PRIORITIES}
+
+    def _band(self, req) -> collections.deque:
+        return self._bands[getattr(req, "priority", "normal")]
+
+    def append(self, req) -> None:
+        self._band(req).append(req)
+
+    def appendleft(self, req) -> None:
+        self._band(req).appendleft(req)
+
+    def popleft(self):
+        for band in self._bands.values():
+            if band:
+                return band.popleft()
+        raise IndexError("pop from empty pending queue")
+
+    def remove(self, req) -> None:
+        self._band(req).remove(req)   # ValueError when absent, like deque
+
+    def clear(self) -> None:
+        for band in self._bands.values():
+            band.clear()
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self._bands.values())
+
+    def __iter__(self):
+        return itertools.chain.from_iterable(self._bands.values())
 
 
 class DeadlineExceeded(ServingError):
@@ -107,13 +215,16 @@ class ServeRequest:
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature", "top_p",
                  "seed", "deadline", "events", "tokens", "attempts",
                  "replica", "skip", "created", "first_token_at", "finished",
-                 "trace")
+                 "trace", "tenant", "priority")
 
     def __init__(self, rid: int, prompt, max_new_tokens: int,
                  temperature: float, top_p: float, seed: int,
-                 deadline: float | None, trace: str | None = None):
+                 deadline: float | None, trace: str | None = None,
+                 tenant: str = "default", priority: str = "normal"):
         self.rid = rid
         self.trace = trace or tracing.new_trace_id()
+        self.tenant = tenant
+        self.priority = priority
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -148,6 +259,8 @@ class _Replica:
         self.outstanding: dict[int, ServeRequest] = {}
         self.reported_load = 0   # last ContinuousBatcher.load()["total"]
         self.alive = True
+        self.draining = False    # no NEW routes; in-flight runs out
+        self.retired = False     # left cleanly — never counts as dead
         self.send_cli = None
         self.recv_cli = None
         self.served = 0
@@ -160,7 +273,8 @@ class ReplicaScheduler:
     def __init__(self, cluster, *, slots_per_replica: int,
                  overcommit: int = 2, max_queue_depth: int | None = None,
                  poll_interval: float = 0.25, requeue_limit: int = 1,
-                 client_factory=None, event_log=None):
+                 client_factory=None, event_log=None,
+                 tenants: dict | None = None):
         self.cluster = cluster
         feedable = sorted(
             (n for n in cluster.cluster_info
@@ -169,12 +283,21 @@ class ReplicaScheduler:
         if not feedable:
             raise ValueError("serving cluster has no feedable replicas")
         max_inflight = max(1, int(slots_per_replica) * int(overcommit))
+        self._max_inflight = max_inflight  # replicas added live inherit it
         self.replicas: dict[int, _Replica] = {
             n["executor_id"]: _Replica(n, max_inflight) for n in feedable}
         #: bounded admission queue: queued + in-flight across the tier
         self.max_queue_depth = int(
             max_queue_depth if max_queue_depth is not None
             else 2 * max_inflight * len(self.replicas))
+        #: per-tenant admission policies (docs/serving.md): ``{name:
+        #: {"rate": req/s | None, "burst": n, "priority": "high" |
+        #: "normal" | "low"}}``.  Unknown tenants fall back to the
+        #: ``"default"`` entry (unlimited, normal priority, unless
+        #: configured otherwise).
+        self.tenants: dict[str, _Tenant] = {
+            name: _Tenant(name, spec) for name, spec in (tenants or {}).items()}
+        self.tenants.setdefault("default", _Tenant("default", None))
         self.poll_interval = float(poll_interval)
         self.requeue_limit = int(requeue_limit)
         self._client_factory = client_factory or self._default_client
@@ -187,7 +310,7 @@ class ReplicaScheduler:
                 os.path.join(cluster.working_dir, "serving_events.jsonl"),
                 echo=False)
         self.events = event_log
-        self._pending: collections.deque[ServeRequest] = collections.deque()
+        self._pending = _PendingQueue()
         self._requests: dict[int, ServeRequest] = {}
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -213,6 +336,16 @@ class ReplicaScheduler:
             "tfos_serving_requests_total",
             "Serving requests by outcome (accepted/completed/shed/"
             "expired/abandoned/failed/requeued).", labelnames=("outcome",))
+        # label values come from the CONFIGURED tenant set (unknown names
+        # collapse to "default"), so cardinality is operator-bounded
+        self._m_tenant = reg.counter(
+            "tfos_serving_tenant_requests_total",
+            "Per-tenant admission outcomes (accepted/tenant_throttled).",
+            labelnames=("tenant", "outcome"))
+        self._m_scale = reg.counter(
+            "tfos_serving_scale_events_total",
+            "Replica membership changes (added/draining/retired/dead).",
+            labelnames=("change",))
         self._m_ttft = reg.histogram(
             "tfos_serving_ttft_seconds", "Admission to first token.")
         self._m_e2e = reg.histogram(
@@ -273,7 +406,7 @@ class ReplicaScheduler:
                 if not req.finished:
                     self._finish_err(req, "shutdown",
                                      "scheduler stopped before completion")
-        for t in self._threads:
+        for t in list(self._threads):  # add_replica appends recv threads
             if t is not threading.current_thread():
                 t.join(timeout=5.0)
         # the collect hook holds a reference to this scheduler; unhook so
@@ -311,35 +444,68 @@ class ReplicaScheduler:
     def submit(self, prompt, max_new_tokens: int, *, temperature: float = 0.0,
                top_p: float = 1.0, seed: int = 0,
                timeout: float | None = None,
-               trace: str | None = None) -> ServeRequest:
+               trace: str | None = None, tenant: str = "default",
+               priority: str | None = None) -> ServeRequest:
         """Admit one request (typed rejections; see module docstring).
         ``trace`` propagates a caller-supplied trace id; one is minted
-        otherwise — every event for this request carries it."""
+        otherwise — every event for this request carries it.  ``tenant``
+        selects the admission policy (unknown names fall back to the
+        ``default`` tenant); ``priority`` overrides the tenant's class
+        but can only DEMOTE — a tenant configured ``low`` cannot smuggle
+        requests into the high band."""
         with self._lock:
             if self._stop.is_set():
                 raise RequestRejected("shutdown", "serving tier is stopping")
             if not any(rep.alive for rep in self.replicas.values()):
                 raise RequestRejected("no_replica", "no replica alive")
+            ten = self.tenants.get(tenant) or self.tenants["default"]
+            if priority is not None and priority not in PRIORITIES:
+                raise ValueError(f"unknown priority {priority!r} "
+                                 f"(want one of {PRIORITIES})")
+            eff_priority = max(priority or ten.priority, ten.priority,
+                               key=PRIORITIES.index)
+            # depth check BEFORE the bucket take: a queue_full rejection
+            # must not burn the tenant's rate budget for a request that
+            # was never admitted — the bucket meters admissions, not
+            # attempts against a saturated tier
             depth = len(self._pending) + sum(
                 len(rep.outstanding) for rep in self.replicas.values())
             if depth >= self.max_queue_depth:
+                ten.shed += 1
                 self.shed += 1
                 self._m_requests.inc(outcome="shed")
+                self._m_tenant.inc(tenant=ten.name, outcome="queue_full")
                 raise RequestRejected(
                     "queue_full",
                     f"serving queue full ({depth} >= "
                     f"{self.max_queue_depth} queued+in-flight)")
+            if ten.bucket is not None and not ten.bucket.try_take():
+                ten.shed += 1
+                self.shed += 1
+                self._m_requests.inc(outcome="shed")
+                self._m_tenant.inc(tenant=ten.name,
+                                   outcome="tenant_throttled")
+                self._emit("request_shed", tenant=ten.name,
+                           reason="tenant_throttled")
+                raise RequestRejected(
+                    "tenant_throttled",
+                    f"tenant {ten.name!r} over budget "
+                    f"({ten.bucket.rate:g} req/s sustained, burst "
+                    f"{ten.bucket.burst:g})")
             rid = next(self._ids)
             req = ServeRequest(
                 rid, prompt, max_new_tokens, temperature, top_p, seed,
                 deadline=None if timeout is None
-                else time.monotonic() + float(timeout), trace=trace)
+                else time.monotonic() + float(timeout), trace=trace,
+                tenant=ten.name, priority=eff_priority)
             self._requests[rid] = req
             self._pending.append(req)
             self.accepted += 1
+            ten.accepted += 1
             self._m_requests.inc(outcome="accepted")
+            self._m_tenant.inc(tenant=ten.name, outcome="accepted")
             self._emit("request_admitted", rid=rid, trace=req.trace,
-                       depth=depth)
+                       depth=depth, tenant=ten.name, priority=eff_priority)
             self._work.notify()
         return req
 
@@ -378,9 +544,107 @@ class ReplicaScheduler:
                                 f"{failure}")
 
     def dead_replicas(self) -> set[int]:
+        """Replicas lost to FAILURE (cleanly retired members excluded)."""
         with self._lock:
             return {eid for eid, rep in self.replicas.items()
-                    if not rep.alive}
+                    if not rep.alive and not rep.retired}
+
+    def alive_replicas(self) -> set[int]:
+        with self._lock:
+            return {eid for eid, rep in self.replicas.items() if rep.alive}
+
+    def draining_replicas(self) -> set[int]:
+        with self._lock:
+            return {eid for eid, rep in self.replicas.items()
+                    if rep.alive and rep.draining}
+
+    # -- elastic membership ------------------------------------------------
+    def add_replica(self, info: dict) -> None:
+        """Register a freshly reserved replica worker and start routing
+        to it (live scale-up / preemption replacement).  ``info`` is the
+        node's reservation dict, exactly as ``cluster_info`` carries it."""
+        eid = int(info["executor_id"])
+        with self._lock:
+            if self._stop.is_set():
+                raise RuntimeError("scheduler is stopping")
+            existing = self.replicas.get(eid)
+            if existing is not None and existing.alive:
+                raise ValueError(f"replica {eid} already registered")
+            rep = _Replica(info, self._max_inflight)
+            self.replicas[eid] = rep
+            self._m_scale.inc(change="added")
+            self._emit("replica_added", replica=eid,
+                       alive=sum(1 for r in self.replicas.values()
+                                 if r.alive))
+            self._work.notify_all()
+        t = threading.Thread(target=self._recv_loop, args=(rep,),
+                             name=f"serve-recv-{eid}", daemon=True)
+        self._threads.append(t)
+        t.start()
+
+    def mark_draining(self, eid: int, reason: str = "retiring") -> bool:
+        """Stop routing NEW requests to ``eid``; in-flight work runs to
+        completion.  False when the replica is unknown/not alive/already
+        draining."""
+        with self._lock:
+            rep = self.replicas.get(eid)
+            if rep is None or not rep.alive or rep.draining:
+                return False
+            rep.draining = True
+            self._m_scale.inc(change="draining")
+            self._emit("replica_draining", replica=eid, reason=reason,
+                       inflight=len(rep.outstanding))
+            return True
+
+    def drain_replica(self, eid: int, timeout: float = 60.0) -> bool:
+        """Wait until ``eid`` has no driver-tracked in-flight requests
+        (callers ``mark_draining`` first, or new routes refill it);
+        True immediately if the replica is gone.  False on timeout."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                rep = self.replicas.get(eid)
+                if rep is None or not rep.alive or not rep.outstanding:
+                    return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+
+    def retire_replica(self, eid: int, reason: str = "retired") -> None:
+        """Remove ``eid`` from the tier as a CLEAN departure: it never
+        joins ``dead_replicas``, and any request still in flight (a
+        forced retire, or the dispatch-vs-drain race during a preemption
+        grace window) is re-queued to the front of its priority band
+        WITHOUT charging the request's one failover attempt — a planned
+        move must not burn the budget kept for real failures."""
+        with self._lock:
+            rep = self.replicas.get(eid)
+            if rep is None or not rep.alive:
+                return
+            rep.draining = True
+            rep.alive = False        # recv loop exits; gauges drop the row
+            rep.retired = True
+            stranded = list(rep.outstanding.values())
+            rep.outstanding.clear()
+            self._close_clients(rep)
+            self._m_scale.inc(change="retired")
+            self._emit("replica_retired", replica=eid, reason=reason,
+                       requeued=len(stranded),
+                       alive=sum(1 for r in self.replicas.values()
+                                 if r.alive))
+            for req in stranded:
+                if req.finished:
+                    continue
+                self.requeued += 1
+                self._m_requests.inc(outcome="requeued")
+                req.attempts = max(0, req.attempts - 1)
+                req.replica = None
+                req.skip = len(req.tokens)
+                self._pending.appendleft(req)
+                self._emit("request_requeued", rid=req.rid, trace=req.trace,
+                           from_replica=eid, delivered=len(req.tokens),
+                           planned=True)
+            self._work.notify_all()
 
     # -- metrics -----------------------------------------------------------
     def _collect_gauges(self) -> None:
@@ -412,12 +676,26 @@ class ReplicaScheduler:
                 "queued": len(self._pending),
                 "ttft": self.ttft.summary(), "e2e": self.e2e.summary(),
                 "replicas": {
-                    eid: {"alive": rep.alive,
+                    eid: {"alive": rep.alive, "draining": rep.draining,
+                          "retired": rep.retired,
                           "outstanding": len(rep.outstanding),
                           "reported_load": rep.reported_load,
                           "served": rep.served}
                     for eid, rep in self.replicas.items()},
+                "tenants": {
+                    name: {"accepted": t.accepted, "shed": t.shed,
+                           "priority": t.priority,
+                           "rate": None if t.bucket is None
+                           else t.bucket.rate}
+                    for name, t in self.tenants.items()},
             }
+
+    def emit_event(self, kind: str, **fields) -> None:
+        """Public audit-event hook for tier components that share this
+        scheduler's ``serving_events.jsonl`` (the autoscaler's scale
+        events ride here so one log tells the whole membership story)."""
+        with self._lock:
+            self._emit(kind, **fields)
 
     # -- internals ---------------------------------------------------------
     def _default_client(self, info: dict):
@@ -460,10 +738,12 @@ class ReplicaScheduler:
 
     def _pick_replica(self) -> _Replica | None:
         """Least-outstanding alive replica with spare in-flight capacity
-        (ties by last self-reported batcher load); None when saturated."""
+        (ties by last self-reported batcher load); None when saturated.
+        Draining replicas take no new work."""
         best = None
         for rep in self.replicas.values():
-            if not rep.alive or len(rep.outstanding) >= rep.max_inflight:
+            if not rep.alive or rep.draining \
+                    or len(rep.outstanding) >= rep.max_inflight:
                 continue
             key = (len(rep.outstanding), rep.reported_load)
             if best is None or key < (len(best.outstanding),
@@ -627,6 +907,7 @@ class ReplicaScheduler:
             return
         rep.alive = False
         logger.warning("serving replica %d marked dead: %s", eid, reason)
+        self._m_scale.inc(change="dead")
         self._emit("replica_dead", replica=eid, reason=reason,
                    inflight=len(rep.outstanding))
         stranded = list(rep.outstanding.values())

@@ -28,13 +28,17 @@ from tests import cluster_funcs as funcs
 def test_parse_plan_full_grammar():
     plan = parse_plan(
         "kill node=1 at_step=3; term node=2,at_step=4,grace=1.5;"
-        "stall node=0 at_step=2 secs=9.5 ; drop node=3 after_secs=0.25")
-    assert [a.verb for a in plan] == ["kill", "term", "stall", "drop"]
+        "stall node=0 at_step=2 secs=9.5 ; drop node=3 after_secs=0.25;"
+        "replace node=4 at_step=8 grace=30")
+    assert [a.verb for a in plan] == ["kill", "term", "stall", "drop",
+                                      "replace"]
     assert plan[0].node == 1 and plan[0].at_step == 3
     assert plan[1].grace == 1.5
     assert plan[2].secs == 9.5
     assert plan[3].after_secs == 0.25
-    assert [a.index for a in plan] == [0, 1, 2, 3]
+    assert plan[4].node == 4 and plan[4].grace == 30
+    assert plan[4].describe() == "replace node=4 at_step=8"
+    assert [a.index for a in plan] == [0, 1, 2, 3, 4]
 
 
 @pytest.mark.parametrize("bad", [

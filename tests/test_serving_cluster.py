@@ -87,6 +87,26 @@ class _FakeWorld:
         self._dead.add(i)
         self.backend.codes[i] = -9
 
+    def add_replica(self):
+        """Bring up one more fake replica (live scale-up); returns its
+        info dict, shaped like a reservation."""
+        i = len(self.cluster_info)
+        info = {"executor_id": i, "job_name": "worker",
+                "addr": ("127.0.0.1", 0), "authkey": b"x"}
+        self.cluster_info.append(info)
+        self.backend.codes[i] = None
+        self.inq[i] = _queue.Queue()
+        self.outq[i] = _queue.Queue()
+        t = threading.Thread(target=self._run, args=(i,), daemon=True)
+        self.threads.append(t)
+        t.start()
+        return info
+
+    def exit_clean(self, i):
+        """Emulate a clean worker exit (drained retire / preemption)."""
+        self._dead.add(i)
+        self.backend.codes[i] = 0
+
     def client(self, info):
         eid, world = info["executor_id"], self
 
@@ -360,6 +380,333 @@ def test_scheduler_stop_rejects_and_errors_leftovers():
     assert ei.value.reason == "shutdown"
 
 
+# --------------------------------------------- tenant admission units
+
+def test_token_bucket_rate_and_burst():
+    from tensorflowonspark_tpu.serving import TokenBucket
+
+    b = TokenBucket(rate=2.0, burst=3)
+    t = 100.0
+    assert [b.try_take(t) for _ in range(4)] == [True, True, True, False]
+    assert b.try_take(t + 0.5)            # 0.5s x 2/s = 1 token back
+    assert not b.try_take(t + 0.5)
+    # refill caps at burst, no matter how long idle
+    assert [b.try_take(t + 100.0) for _ in range(4)] \
+        == [True, True, True, False]
+
+
+def test_tenant_throttle_sheds_only_the_noisy_tenant():
+    """Acceptance: per-tenant shed hits ONLY the over-budget tenant —
+    the noisy tenant's burst exhausts its bucket and gets typed
+    ``tenant_throttled`` rejections while the quiet tenant's requests,
+    submitted between the noisy ones, all sail through."""
+    world = _FakeWorld(2)
+    s = _scheduler(world, max_queue_depth=256,
+                   tenants={"noisy": {"rate": 0.001, "burst": 3},
+                            "quiet": {"rate": None}}).start()
+    try:
+        accepted, shed = [], []
+        for k in range(8):
+            try:
+                accepted.append(
+                    s.submit(np.asarray([k + 1], np.int32), 2,
+                             tenant="noisy"))
+            except RequestRejected as e:
+                assert e.reason == "tenant_throttled"
+                assert "noisy" in str(e)
+                shed.append(k)
+            # interleaved quiet traffic is never shed
+            accepted.append(s.submit(np.asarray([50 + k], np.int32), 2,
+                                     tenant="quiet"))
+        assert len(shed) == 5            # burst of 3 admitted, rest shed
+        for req in accepted:
+            _, err = _collect(req)
+            assert err is None
+        m = s.metrics()
+        assert m["tenants"]["noisy"]["shed"] == 5
+        assert m["tenants"]["noisy"]["accepted"] == 3
+        assert m["tenants"]["quiet"]["shed"] == 0
+        assert m["tenants"]["quiet"]["accepted"] == 8
+        assert m["shed"] == 5 and m["failed"] == 0
+    finally:
+        s.stop()
+
+
+def test_priority_classes_order_the_pending_queue():
+    """With one busy slot, later-admitted high-priority work dispatches
+    ahead of earlier low-priority work (FIFO within a class)."""
+    world = _FakeWorld(1, token_delay=0.1)
+    s = _scheduler(world, slots_per_replica=1, overcommit=1,
+                   max_queue_depth=16,
+                   tenants={"batch": {"priority": "low"},
+                            "inter": {"priority": "high"}}).start()
+    try:
+        blocker = s.submit(np.asarray([1], np.int32), 3)   # owns the slot
+        low = [s.submit(np.asarray([10 + k], np.int32), 2, tenant="batch")
+               for k in range(2)]
+        high = s.submit(np.asarray([30], np.int32), 2, tenant="inter")
+        for req in (blocker, high, *low):
+            _, err = _collect(req)
+            assert err is None
+        assert high.priority == "high" and low[0].priority == "low"
+        # the replica is strictly serial, so first-token times reflect
+        # dispatch order: high (admitted LAST) ran before both lows
+        assert high.first_token_at < low[0].first_token_at \
+            < low[1].first_token_at
+        assert s.metrics()["completed"] == 4
+    finally:
+        s.stop()
+
+
+def test_priority_override_can_only_demote():
+    world = _FakeWorld(1)
+    s = _scheduler(world, tenants={"t": {"priority": "normal"}}).start()
+    try:
+        up = s.submit(np.asarray([1], np.int32), 1, tenant="t",
+                      priority="high")
+        down = s.submit(np.asarray([2], np.int32), 1, tenant="t",
+                        priority="low")
+        assert up.priority == "normal"      # promotion denied
+        assert down.priority == "low"       # demotion honored
+        with pytest.raises(ValueError):
+            s.submit(np.asarray([3], np.int32), 1, priority="urgent")
+        for req in (up, down):
+            _, err = _collect(req)
+            assert err is None
+    finally:
+        s.stop()
+
+
+# --------------------------------------------- elastic membership units
+
+def test_live_add_replica_takes_traffic():
+    world = _FakeWorld(1)
+    s = _scheduler(world).start()
+    try:
+        _, err = _collect(s.submit(np.asarray([1], np.int32), 3))
+        assert err is None
+        s.add_replica(world.add_replica())
+        assert s.alive_replicas() == {0, 1}
+        # saturate: enough parallel work that least-outstanding routing
+        # must spill onto the newcomer
+        reqs = [s.submit(np.asarray([k + 2], np.int32), 3)
+                for k in range(8)]
+        for req in reqs:
+            _, err = _collect(req)
+            assert err is None
+        m = s.metrics()
+        assert m["replicas"][1]["served"] > 0, "newcomer got no traffic"
+        with pytest.raises(ValueError):
+            s.add_replica(world.cluster_info[1])   # double registration
+    finally:
+        s.stop()
+
+
+def test_drain_based_retire_is_clean_and_loses_nothing():
+    """Mark-drain → drain → retire mid-stream: the in-flight request
+    finishes on the draining replica (exact), no new work routes to it,
+    and the departure never counts as a death."""
+    world = _FakeWorld(2, token_delay=0.05)
+    s = _scheduler(world, slots_per_replica=1, overcommit=1).start()
+    try:
+        p = np.asarray([3, 5], np.int32)
+        req = s.submit(p, 6)
+        while not req.tokens:
+            time.sleep(0.01)
+        victim = req.replica
+        assert s.mark_draining(victim)
+        assert not s.mark_draining(victim)     # idempotent
+        assert s.draining_replicas() == {victim}
+        # new work only lands on the survivor
+        other = [s.submit(np.asarray([9 + k], np.int32), 2)
+                 for k in range(3)]
+        toks, err = _collect(req)
+        assert err is None and toks == _fake_tokens(p, 6)
+        assert s.drain_replica(victim, timeout=10)
+        s.retire_replica(victim)
+        world.exit_clean(victim)
+        for r in other:
+            assert r.replica != victim
+            _, err = _collect(r)
+            assert err is None
+        m = s.metrics()
+        assert s.dead_replicas() == set()       # retired, NOT dead
+        assert m["replicas"][victim]["retired"]
+        assert m["requeued"] == 0 and m["failed"] == 0
+        # traffic continues on the survivor
+        _, err = _collect(s.submit(np.asarray([40], np.int32), 2))
+        assert err is None
+    finally:
+        s.stop()
+
+
+def test_forced_retire_requeues_in_flight_exactly():
+    """Retiring WITHOUT waiting for the drain re-queues the in-flight
+    request to the survivor — stream stays exact and the planned move
+    does not burn the request's failover attempt."""
+    world = _FakeWorld(2, token_delay=0.05)
+    s = _scheduler(world, slots_per_replica=1, overcommit=1).start()
+    try:
+        p = np.asarray([4, 7], np.int32)
+        req = s.submit(p, 8)
+        while not req.tokens:
+            time.sleep(0.01)
+        victim = req.replica
+        s.retire_replica(victim, reason="forced")   # no drain first
+        world.exit_clean(victim)
+        toks, err = _collect(req, timeout=15)
+        assert err is None and toks == _fake_tokens(p, 8)
+        m = s.metrics()
+        assert m["requeued"] == 1 and m["completed"] == 1
+        assert s.dead_replicas() == set()
+        # the replay kept its one real-failure requeue budget: retire the
+        # serving replica mid-flight AGAIN (replacement registered
+        # first) and the request must still complete via a second
+        # planned re-queue — only real deaths charge the failover limit
+        s.add_replica(world.add_replica())
+        req2 = s.submit(p, 8)
+        while not req2.tokens:
+            time.sleep(0.01)
+        s.retire_replica(req2.replica, reason="forced")
+        toks, err = _collect(req2, timeout=15)
+        assert err is None and toks == _fake_tokens(p, 8)
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------------ autoscaler units
+
+class _FakeServing:
+    """Scheduler-facade the Autoscaler drives in units: canned metrics,
+    recorded actions."""
+
+    def __init__(self, replicas=1):
+        self.n = replicas
+        self.queued = 0
+        self.outstanding = 0
+        self.added = 0
+        self.retired = []
+        self.events = []
+        fake = self
+
+        class _Sched:
+            def metrics(self):
+                return {
+                    "queued": fake.queued,
+                    "ttft": {"p95_secs": None},
+                    "replicas": {
+                        i: {"alive": True, "draining": False,
+                            "outstanding": fake.outstanding // max(1, fake.n)}
+                        for i in range(fake.n)},
+                }
+
+            def emit_event(self, kind, **fields):
+                fake.events.append((kind, fields))
+
+        self.scheduler = _Sched()
+
+    def add_replicas(self, n):
+        self.n += n
+        self.added += n
+        return list(range(self.n - n, self.n))
+
+    def retire_replica(self, eid, drain_timeout=None):
+        self.n -= 1
+        self.retired.append(eid)
+        return True
+
+
+def test_autoscaler_decisions_hysteresis_and_cooldown():
+    from tensorflowonspark_tpu.serving import Autoscaler
+
+    fake = _FakeServing(replicas=1)
+    a = Autoscaler(fake, min_replicas=1, max_replicas=3,
+                   up_queue_per_replica=4.0, up_consecutive=2,
+                   up_cooldown=10.0, down_consecutive=2,
+                   down_cooldown=30.0,
+                   down_outstanding_per_replica=1.0)
+    t = 1000.0
+    fake.queued = 9                       # 9 > 4*1: overload
+    assert a.decide(a.sample(), now=t)[0] == "hold"      # 1 sample: wait
+    d, reason = a.decide(a.sample(), now=t + 1)
+    assert d == "up" and "queued 9" in reason            # hysteresis met
+    a.acted("up", now=t + 1)
+    fake.n = 2
+    # still overloaded but inside the up-cooldown: hold
+    assert a.decide(a.sample(), now=t + 2)[0] == "hold"
+    assert a.decide(a.sample(), now=t + 3)[0] == "hold"
+    # past the cooldown (and streak rebuilt): up again, capped at max
+    d, _ = a.decide(a.sample(), now=t + 12)
+    assert d == "up"
+    a.acted("up", now=t + 12)
+    fake.n = 3
+    fake.queued = 20
+    # at max_replicas: no more ups no matter the load
+    for k in range(5):
+        assert a.decide(a.sample(), now=t + 30 + k)[0] == "hold"
+    # load vanishes: scale down only after ITS hysteresis + cooldown
+    fake.queued = 0
+    fake.outstanding = 0
+    assert a.decide(a.sample(), now=t + 40)[0] == "hold"
+    d, reason = a.decide(a.sample(), now=t + 41)
+    assert d == "down" and "idle" in reason
+    a.acted("down", now=t + 41)
+    fake.n = 2
+    # down-cooldown holds the next shrink
+    assert a.decide(a.sample(), now=t + 42)[0] == "hold"
+    assert a.decide(a.sample(), now=t + 43)[0] == "hold"
+    d, _ = a.decide(a.sample(), now=t + 72)
+    assert d == "down"
+
+
+def test_autoscaler_ttft_signal_and_min_bound():
+    from tensorflowonspark_tpu.serving import Autoscaler
+
+    fake = _FakeServing(replicas=2)
+    a = Autoscaler(fake, min_replicas=2, max_replicas=3,
+                   up_ttft_p95=0.5, up_consecutive=1, up_cooldown=0.0,
+                   down_consecutive=1, down_cooldown=0.0)
+    s = a.sample()
+    s["ttft_p95"] = 0.8                   # latency breach, queue empty
+    d, reason = a.decide(s, now=1.0)
+    assert d == "up" and "ttft" in reason
+    a.acted("up", now=1.0)
+    # idle at min_replicas: never below the floor
+    fake.queued = 0
+    fake.outstanding = 0
+    assert a.decide(a.sample(), now=100.0)[0] == "hold"
+
+
+def test_autoscaler_loop_acts_and_emits_events():
+    """The threaded loop end-to-end over the facade: overload → add;
+    idle → drain-based retire; both actions land in the event stream."""
+    from tensorflowonspark_tpu.serving import Autoscaler
+
+    fake = _FakeServing(replicas=1)
+    fake.queued = 50
+    a = Autoscaler(fake, min_replicas=1, max_replicas=2, interval=0.05,
+                   up_queue_per_replica=4.0, up_consecutive=2,
+                   up_cooldown=0.0, down_consecutive=2, down_cooldown=0.0)
+    a.start()
+    try:
+        deadline = time.monotonic() + 5
+        while fake.added == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fake.added >= 1, "no scale-up happened"
+        fake.queued = 0
+        fake.outstanding = 0
+        deadline = time.monotonic() + 5
+        while not fake.retired and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fake.retired, "no scale-down happened"
+    finally:
+        a.stop()
+    kinds = [k for k, _ in fake.events]
+    assert "scale_up" in kinds and "scale_down" in kinds
+    up = dict(fake.events)[("scale_up")]
+    assert "reason" in up and "queued" in up
+
+
 # ------------------------------------------------- frontend/client units
 
 def test_frontend_client_roundtrip_and_typed_shed():
@@ -405,6 +752,81 @@ def test_frontend_deadline_mid_request_is_typed():
     finally:
         fe.stop()
         s.stop()
+
+
+def test_frontend_carries_tenant_and_priority():
+    """Tenant/priority ride the wire: a client bound to the noisy tenant
+    sees typed tenant_throttled shed; the quiet client's traffic (and
+    the default tenant) sails through."""
+    world = _FakeWorld(1)
+    s = _scheduler(world, max_queue_depth=64,
+                   tenants={"noisy": {"rate": 0.001, "burst": 1},
+                            "quiet": {"rate": None,
+                                      "priority": "high"}}).start()
+    fe = ServeFrontend(s, authkey=b"s" * 16)
+    addr = fe.start()
+    try:
+        p = np.asarray([5], np.int32)
+        with ServeClient(addr, b"s" * 16, tenant="noisy") as c:
+            c.generate(p, 2)                       # burst of 1
+            with pytest.raises(RequestRejected) as ei:
+                c.generate(p, 2)
+            assert ei.value.reason == "tenant_throttled"
+            # per-call override outruns the client default
+            c.generate(p, 2, tenant="quiet")
+        with ServeClient(addr, b"s" * 16) as c:    # default tenant
+            c.generate(p, 2)
+            stats = c.stats()
+        assert stats["tenants"]["noisy"]["shed"] == 1
+        assert stats["tenants"]["noisy"]["accepted"] == 1
+        assert stats["tenants"]["quiet"]["accepted"] == 1
+        assert stats["tenants"]["default"]["accepted"] == 1
+    finally:
+        fe.stop()
+        s.stop()
+
+
+def test_client_reconnects_once_on_idle_socket_error():
+    """Satellite: a transient socket failure on an IDLE connection (the
+    frontend closed the keep-alive between requests) is healed by one
+    reconnect-and-retry; a genuinely dead frontend still raises after
+    the single retry — typed, not swallowed."""
+    world = _FakeWorld(1)
+    s = _scheduler(world).start()
+    fe = ServeFrontend(s, authkey=b"s" * 16)
+    addr = fe.start()
+    c = ServeClient(addr, b"s" * 16, timeout=5.0)
+    try:
+        p = np.asarray([2, 3], np.int32)
+        got = c.generate(p, 3)
+        # sever the established connection out from under the client —
+        # the next send/receive fails like a reset idle keep-alive
+        c._sock.shutdown(__import__("socket").SHUT_RDWR)
+        c._sock.close()
+        assert c.ping(), "reconnect-and-retry did not heal the connection"
+        assert c.generate(p, 3).tolist() == got.tolist()
+        # stream path heals the same way
+        c._sock.close()
+        deltas = list(c.generate_stream(p, 3))
+        assert [t for d in deltas for t in d] == got.tolist()
+    finally:
+        c.close()
+        fe.stop()
+    # frontend really gone: the single retry must fail loudly
+    c2_error = None
+    try:
+        c2 = ServeClient(addr, b"s" * 16, timeout=1.0)
+    except (ConnectionError, OSError):
+        c2 = None      # listener already down: constructor refuses
+    if c2 is not None:
+        try:
+            c2.ping()
+        except (ConnectionError, OSError, EOFError) as e:
+            c2_error = e
+        finally:
+            c2.close()
+        assert c2_error is not None, "dead frontend went unnoticed"
+    s.stop()
 
 
 # ------------------------------------------------------ integration
@@ -570,6 +992,173 @@ def test_serving_kill_soak_under_sustained_load(tmp_path, worker_env):
         assert "replica_dead" in events
     finally:
         serving.shutdown(timeout=180)
+
+
+@pytest.mark.integration
+def test_live_add_and_drain_retire_replica(tmp_path, worker_env):
+    """Elastic membership end-to-end on real worker processes: grow a
+    1-replica tier to 2 (reservation path re-opens, newcomer serves
+    oracle-exact traffic), then drain-retire the founding replica — the
+    departure is clean (no dead replicas, no worker error) and the tier
+    keeps serving on the survivor through shutdown."""
+    serving = _run_serving(tmp_path, worker_env, num_replicas=1)
+    try:
+        rng = np.random.default_rng(3)
+        reqs = _requests(rng, 10, bmin=5, bmax=9)
+        with serving.client() as c:
+            p, n = reqs[0]
+            assert c.generate(p, n).tolist() == _oracle(p, n)
+        added = serving.add_replicas(1)
+        assert added == [1]
+        assert serving.scheduler.alive_replicas() == {0, 1}
+        # concurrent traffic so least-outstanding routing uses both
+        results: dict[int, list] = {}
+        errors: list = []
+
+        def run_client(cid):
+            try:
+                with serving.client() as c:
+                    for i in range(cid, len(reqs), 3):
+                        p, n = reqs[i]
+                        results[i] = c.generate(p, n, timeout=120).tolist()
+            except Exception as e:       # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=run_client, args=(cid,))
+                   for cid in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        assert not errors, errors
+        for i, (p, n) in enumerate(reqs):
+            assert results[i] == _oracle(p, n), f"request {i} diverged"
+        m = serving.metrics()
+        assert m["replicas"][1]["served"] > 0, "newcomer got no traffic"
+        # drain-based scale-down of the founder
+        assert serving.retire_replica(0, drain_timeout=60)
+        assert serving.scheduler.dead_replicas() == set()
+        assert serving.scheduler.alive_replicas() == {1}
+        with serving.client() as c:
+            p, n = reqs[1]
+            assert c.generate(p, n, timeout=120).tolist() == _oracle(p, n)
+        m = serving.metrics()
+        assert m["failed"] == 0
+        kinds = [e["kind"] for e in _serving_events(tmp_path)]
+        for kind in ("replica_added", "replica_draining", "replica_retired"):
+            assert kind in kinds, (kind, kinds)
+    finally:
+        serving.shutdown(timeout=120)   # must not raise over the retiree
+
+
+@pytest.mark.integration
+def test_preempted_replica_drains_and_is_replaced(tmp_path, worker_env):
+    """Acceptance: chaos ``replace node=1`` SIGTERMs replica 1 mid-
+    decode.  Its PreemptionGuard latches, the tier sees the grace-window
+    phase flip, drains it, and spawns a replacement — zero accepted
+    requests lost, every stream oracle-exact, and shutdown classifies
+    NO failure (the reclaim was membership flex, not a crash)."""
+    env = dict(worker_env, TFOS_CHAOS="replace node=1 at_step=4")
+    serving = _run_serving(tmp_path, env)
+    try:
+        rng = np.random.default_rng(4)
+        reqs = _requests(rng, 8, bmin=8, bmax=14)
+        results: dict[int, list] = {}
+        errors: list = []
+
+        def run_client(cid):
+            try:
+                with serving.client() as c:
+                    for i in range(cid, len(reqs), 2):
+                        p, n = reqs[i]
+                        results[i] = c.generate(p, n, timeout=180).tolist()
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=run_client, args=(cid,))
+                   for cid in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(240)
+        assert not errors, errors
+        for i, (p, n) in enumerate(reqs):
+            assert results[i] == _oracle(p, n), f"request {i} diverged"
+        # the replacement replica registers live (executor id 2)
+        deadline = time.monotonic() + 90
+        while 2 not in serving.scheduler.alive_replicas() \
+                and time.monotonic() < deadline:
+            time.sleep(0.25)
+        assert 2 in serving.scheduler.alive_replicas(), \
+            "preempted replica was not replaced"
+        m = serving.metrics()
+        assert m["failed"] == 0 and m["completed"] == m["accepted"], m
+        assert m["replicas"][1]["alive"] is False
+        kinds = [e["kind"] for e in _serving_events(tmp_path)]
+        assert "replica_added" in kinds
+        assert "replica_draining" in kinds or "replica_dead" in kinds
+        # the replacement serves traffic
+        with serving.client() as c:
+            p, n = reqs[0]
+            assert c.generate(p, n, timeout=120).tolist() == _oracle(p, n)
+    finally:
+        serving.shutdown(timeout=180)   # a reclaim must not fail shutdown
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_autoscaler_ramp_soak_with_replace_chaos(tmp_path, worker_env):
+    """Soak (the satellite's ramp scenario as a test): a 1-replica tier
+    under a burst 16-deep queue scales itself up; chaos ``replace``
+    reclaims the scaled-up replica mid-run (drain + replacement); after
+    the burst the autoscaler drains back down.  Zero accepted requests
+    lost, every stream oracle-exact."""
+    env = dict(worker_env, TFOS_CHAOS="replace node=1 at_step=6")
+    serving = _run_serving(
+        tmp_path, env, num_replicas=1, max_queue_depth=64,
+        autoscale=dict(min_replicas=1, max_replicas=3, interval=0.5,
+                       up_queue_per_replica=2.0, up_consecutive=2,
+                       up_cooldown=4.0, down_outstanding_per_replica=1.0,
+                       down_consecutive=6, down_cooldown=6.0))
+    try:
+        rng = np.random.default_rng(5)
+        reqs = _requests(rng, 16, bmin=6, bmax=12)
+        results: dict[int, list] = {}
+        errors: list = []
+
+        def run_client(i):
+            try:
+                with serving.client() as c:
+                    p, n = reqs[i]
+                    results[i] = c.generate(p, n, timeout=300).tolist()
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=run_client, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:     # burst: the queue piles onto one replica
+            t.start()
+        for t in threads:
+            t.join(360)
+        assert not errors, errors
+        for i, (p, n) in enumerate(reqs):
+            assert results[i] == _oracle(p, n), f"request {i} diverged"
+        # idle tail: let the autoscaler shrink back toward min_replicas
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if (serving.autoscaler.scale_downs >= 1
+                    and serving.autoscaler.scale_ups >= 1):
+                break
+            time.sleep(0.5)
+        m = serving.metrics()
+        assert m["failed"] == 0 and m["completed"] == m["accepted"], m
+        assert serving.autoscaler.scale_ups >= 1, "no scale-up under burst"
+        assert serving.autoscaler.scale_downs >= 1, "no drain scale-down"
+        kinds = [e["kind"] for e in _serving_events(tmp_path)]
+        assert "scale_up" in kinds and "scale_down" in kinds
+        assert "replica_retired" in kinds
+    finally:
+        serving.shutdown(timeout=300)
 
 
 def _serving_events(tmp_path):

@@ -1,0 +1,255 @@
+"""Batch-inference plane benchmark: data-plane A/B + the resume proof.
+
+Two measured claims (``docs/batch.md``), written to
+``bench_artifacts/batch.json`` — the script FAILS ITSELF if either gate
+misses:
+
+1. **records/s A/B** — the same array-shard manifest scored twice through
+   ``BatchJob.dispatch`` over a live 2-worker cluster: once with the
+   zero-copy shm transport (PR-1 plane), once pinned to the socket
+   fallback (``TFOS_TPU_NO_SHM=1``).  Inline shards ride driver → worker,
+   so the transport is the hot path; shm must win.
+
+2. **SIGKILL resume** — a TFRecord-manifest job whose only worker is
+   SIGKILLed mid-run (``TFOS_CHAOS kill``); ``run_with_recovery``
+   relaunches and the ledger replay must show **zero committed shards
+   reprocessed** (``Replay.reprocessed_committed == []``), at least one
+   shard committed before the restart (the proof is non-vacuous), and the
+   merged output **byte-identical** to an uninterrupted oracle run of the
+   same manifest.
+
+Run:  python scripts/bench_batch.py [--smoke] [--out PATH]
+
+``--smoke`` is the CI gate (``scripts/ci.sh --bench-smoke``): a tiny
+4-shard manifest, same flow, artifact schema validated, but the shm>socket
+speed gate is advisory (transport wins are noise at smoke sizes); writes
+``bench_artifacts/batch_smoke.json`` so the committed full-size artifact
+is never clobbered.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+import numpy as np  # noqa: E402
+
+
+def predict_rowsum(model, records, trial_params):
+    """Array-shard scorer: one 8-byte float64 sum per row (deterministic)."""
+    arr = np.asarray(records, dtype=np.float32)
+    return [float(s).hex().encode() for s in arr.sum(axis=1)]
+
+
+def predict_crc(model, records, trial_params):
+    """TFRecord-shard scorer: length + first/last byte echo per record."""
+    return [b"%d:%d:%d" % (len(r), r[0], r[-1]) for r in records]
+
+
+def _dispatch_timed(job, num_workers, worker_env):
+    """Boot a cluster, time ONLY the dispatch (the transport-bound part),
+    shut down.  Returns (wall_secs, summary)."""
+    from tensorflowonspark_tpu.batch.worker import batch_worker
+    from tensorflowonspark_tpu.cluster import InputMode, TPUCluster
+
+    cluster = TPUCluster.run(batch_worker, job.worker_args(), num_workers,
+                             input_mode=InputMode.SPARK,
+                             reservation_timeout=120, worker_env=worker_env)
+    try:
+        t0 = time.monotonic()
+        job.dispatch(cluster)
+        wall = time.monotonic() - t0
+    finally:
+        cluster.shutdown(timeout=120)
+    return wall, dict(job.last_summary or {})
+
+
+def bench_ab(shards, rows, cols, num_workers):
+    """records/s: shm transport vs TFOS_TPU_NO_SHM=1 socket fallback."""
+    from tensorflowonspark_tpu.batch import BatchJob, ShardManifest
+
+    rng = np.random.default_rng(0)
+    chunks = [rng.standard_normal((rows, cols)).astype(np.float32)
+              for _ in range(shards)]
+    manifest = ShardManifest.from_arrays(chunks)
+    total = shards * rows
+    out = {}
+    oracle = None
+    for mode in ("shm", "socket"):
+        out_dir = tempfile.mkdtemp(prefix=f"tfos_bench_batch_{mode}_")
+        env = {"JAX_PLATFORMS": "cpu"}
+        if mode == "socket":
+            env["TFOS_TPU_NO_SHM"] = "1"
+            os.environ["TFOS_TPU_NO_SHM"] = "1"  # driver-side clients too
+        try:
+            job = BatchJob(manifest, out_dir, predict_rowsum,
+                           batch_size=rows, prefetch=2)
+            wall, summary = _dispatch_timed(job, num_workers, env)
+        finally:
+            os.environ.pop("TFOS_TPU_NO_SHM", None)
+        assert summary.get("scored") == shards, summary
+        results = job.results()
+        if oracle is None:
+            oracle = results
+        elif results != oracle:
+            raise AssertionError("shm and socket outputs differ")
+        out[mode] = {"wall_secs": round(wall, 4), "records": total,
+                     "records_per_sec": round(total / wall, 1),
+                     "mb_per_sec": round(
+                         total * cols * 4 / wall / 1e6, 1)}
+        shutil.rmtree(out_dir, ignore_errors=True)
+        print(f"[ab] {mode}: {out[mode]}")
+    out["speedup"] = round(out["shm"]["records_per_sec"]
+                           / out["socket"]["records_per_sec"], 3)
+    return out
+
+
+def bench_resume(shards, recs_per_shard, kill_at_step):
+    """Mid-job SIGKILL + run_with_recovery restart; returns the proof row."""
+    from tensorflowonspark_tpu import tfrecord
+    from tensorflowonspark_tpu.batch import (BatchJob, ProgressLedger,
+                                             ShardManifest)
+
+    src = tempfile.mkdtemp(prefix="tfos_bench_batch_src_")
+    rng = np.random.default_rng(1)
+    for i in range(shards):
+        tfrecord.write_records(
+            os.path.join(src, f"part-{i:05d}.tfrecord"),
+            [rng.integers(1, 255, size=rng.integers(8, 64),
+                          dtype=np.uint8).tobytes()
+             for _ in range(recs_per_shard)])
+    manifest = ShardManifest.from_tfrecords(os.path.join(src, "part-*.tfrecord"))
+
+    # oracle: uninterrupted single run
+    oracle_dir = tempfile.mkdtemp(prefix="tfos_bench_batch_oracle_")
+    oracle_job = BatchJob(manifest, oracle_dir, predict_crc, batch_size=4)
+    oracle_job.run(num_workers=1, max_restarts=0,
+                   worker_env={"JAX_PLATFORMS": "cpu"},
+                   reservation_timeout=120, shutdown_timeout=120)
+    oracle = oracle_job.results()
+
+    # interrupted run: SIGKILL the only worker mid-job, then recover
+    out_dir = tempfile.mkdtemp(prefix="tfos_bench_batch_resume_")
+    wd = tempfile.mkdtemp(prefix="tfos_bench_batch_wd_")
+    job = BatchJob(manifest, out_dir, predict_crc, batch_size=4, prefetch=1)
+    t0 = time.monotonic()
+    job.run(num_workers=1, max_restarts=2, reassign_dead=False,
+            backoff_base=0.2, working_dir=wd,
+            worker_env={"JAX_PLATFORMS": "cpu",
+                        "TFOS_CHAOS": f"kill node=0 at_step={kill_at_step}"},
+            reservation_timeout=120, shutdown_timeout=120)
+    wall = time.monotonic() - t0
+    replay = ProgressLedger.replay(out_dir)
+    committed_before_restart = sorted(replay.done_at_attempt(2))
+    results = job.results()
+    row = {
+        "scenario": "sigkill_resume", "shards": shards,
+        "records": shards * recs_per_shard,
+        "kill_at_step": kill_at_step,
+        "attempts": replay.attempts,
+        "committed_before_restart": len(committed_before_restart),
+        "reprocessed_committed": len(replay.reprocessed_committed),
+        "output_identical_to_oracle": results == oracle,
+        "total_wall_secs": round(wall, 3),
+    }
+    for d in (src, oracle_dir, out_dir, wd):
+        shutil.rmtree(d, ignore_errors=True)
+    print(f"[resume] {row}")
+    return row
+
+
+def validate_artifact(doc: dict) -> list[str]:
+    """Schema check (the ci.sh --bench-smoke contract): returns problems."""
+    probs = []
+    if doc.get("benchmark") != "batch":
+        probs.append("benchmark != 'batch'")
+    for mode in ("shm", "socket"):
+        row = doc.get("ab", {}).get(mode)
+        if not isinstance(row, dict):
+            probs.append(f"ab.{mode} missing")
+            continue
+        for k in ("wall_secs", "records", "records_per_sec"):
+            if not isinstance(row.get(k), (int, float)):
+                probs.append(f"ab.{mode}.{k} not numeric")
+    if not isinstance(doc.get("ab", {}).get("speedup"), (int, float)):
+        probs.append("ab.speedup not numeric")
+    res = doc.get("resume")
+    if not isinstance(res, dict):
+        probs.append("resume missing")
+    else:
+        for k in ("attempts", "committed_before_restart",
+                  "reprocessed_committed", "records"):
+            if not isinstance(res.get(k), int):
+                probs.append(f"resume.{k} not int")
+        if not isinstance(res.get("output_identical_to_oracle"), bool):
+            probs.append("resume.output_identical_to_oracle not bool")
+    if not isinstance(doc.get("gates"), dict):
+        probs.append("gates missing")
+    return probs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny 4-shard manifest; schema-gated (CI)")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--workers", type=int, default=2)
+    args = ap.parse_args()
+
+    if args.smoke:
+        ab = bench_ab(shards=4, rows=64, cols=64, num_workers=args.workers)
+        resume = bench_resume(shards=4, recs_per_shard=4, kill_at_step=2)
+    else:
+        ab = bench_ab(shards=24, rows=512, cols=1024,
+                      num_workers=args.workers)
+        resume = bench_resume(shards=12, recs_per_shard=16, kill_at_step=10)
+
+    gates = {
+        "zero_reprocess": resume["reprocessed_committed"] == 0,
+        "resume_nonvacuous": (resume["attempts"] >= 2
+                              and resume["committed_before_restart"] >= 1),
+        "oracle_identical": resume["output_identical_to_oracle"],
+        "shm_beats_socket": ab["speedup"] > 1.0,
+    }
+    doc = {
+        "benchmark": "batch",
+        "config": {"backend": "LocalProcessBackend", "platform": "cpu",
+                   "workers": args.workers, "smoke": bool(args.smoke)},
+        "ab": ab,
+        "resume": resume,
+        "gates": gates,
+    }
+    default_name = "batch_smoke.json" if args.smoke else "batch.json"
+    path = args.out or os.path.join(REPO, "bench_artifacts", default_name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(f"wrote {path}")
+
+    probs = validate_artifact(doc)
+    if probs:
+        print(f"ARTIFACT SCHEMA INVALID: {probs}", file=sys.stderr)
+        return 2
+    hard = dict(gates)
+    if args.smoke:
+        # transport wins are noise at smoke sizes; correctness gates stay
+        hard.pop("shm_beats_socket")
+        if not gates["shm_beats_socket"]:
+            print("[smoke] advisory: shm did not beat socket at smoke size")
+    missed = [k for k, ok in hard.items() if not ok]
+    if missed:
+        print(f"GATES MISSED: {missed}", file=sys.stderr)
+        return 1
+    print(f"all gates passed: {gates}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

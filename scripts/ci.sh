@@ -3,8 +3,11 @@
 # command from ROADMAP.md, as one script — what a pre-merge pipeline (or a
 # developer wanting the full pre-push story) runs.
 #
-#   scripts/ci.sh            # analysis gate, then tier-1 tests
-#   scripts/ci.sh --check    # analysis gate only (fast, no jax)
+#   scripts/ci.sh               # analysis gate, then tier-1 tests
+#   scripts/ci.sh --check       # analysis gate only (fast, no jax)
+#   scripts/ci.sh --bench-smoke # analysis gate + bench_batch.py on a tiny
+#                               # 4-shard manifest (artifact schema + the
+#                               # zero-reprocess/oracle resume gates)
 #
 # The analysis gate (docs/analysis.md) runs all six project rules plus the
 # exports-drift check against the committed analysis_baseline.json ratchet
@@ -25,6 +28,19 @@ if [ $rc -ne 0 ]; then
 fi
 
 if [ "${1:-}" = "--check" ]; then
+    exit 0
+fi
+
+if [ "${1:-}" = "--bench-smoke" ]; then
+    echo "== bench smoke (batch plane) =="
+    # bench_batch.py --smoke validates its own artifact schema and fails
+    # on the resume-correctness gates (zero reprocess, oracle-identical)
+    JAX_PLATFORMS=cpu python scripts/bench_batch.py --smoke
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "bench smoke FAILED (rc=$rc)" >&2
+        exit $rc
+    fi
     exit 0
 fi
 

@@ -67,3 +67,8 @@ from tensorflowonspark_tpu import serving  # noqa: F401,E402
 # with heartbeat-carried aggregation + Prometheus exposition, and
 # end-to-end request tracing with the tfos_trace timeline stitcher.
 from tensorflowonspark_tpu import metrics, tracing  # noqa: F401,E402
+
+# Batch-inference plane (docs/batch.md): manifest-driven shard streaming
+# with per-shard checkpointed progress and resumable bulk predict.  Safe
+# to import eagerly — worker-side jax/model imports happen in the map_fun.
+from tensorflowonspark_tpu import batch  # noqa: F401,E402

@@ -1,0 +1,34 @@
+"""Sharded batch-inference plane: manifest-driven, checkpointed, resumable.
+
+The offline half of the serving story (``docs/batch.md``): bulk predict
+over a shard manifest with per-shard committed progress —
+
+- :mod:`~tensorflowonspark_tpu.batch.manifest` — :class:`ShardManifest` /
+  :class:`Shard`, the ordered unit-of-work list (TFRecord files or inline
+  arrays);
+- :mod:`~tensorflowonspark_tpu.batch.ledger` — :class:`ProgressLedger`,
+  the fsync'd JSONL shard-state journal resume replays;
+- :mod:`~tensorflowonspark_tpu.batch.writer` — :class:`ShardWriter`
+  (atomic rename-commit TFRecord parts) + :func:`read_results` (merged,
+  manifest-order output);
+- :mod:`~tensorflowonspark_tpu.batch.worker` — :func:`batch_worker`, the
+  scoring map_fun;
+- :mod:`~tensorflowonspark_tpu.batch.job` — :class:`BatchJob`, the
+  driver-side dispatcher (assignment, reassignment, resume);
+- :mod:`~tensorflowonspark_tpu.batch.gridsearch` — :class:`GridSearch`,
+  K trials multiplexed across one cluster.
+
+Safe to import eagerly: jax/model imports happen inside the worker
+map_fun, not at import time.
+"""
+
+from tensorflowonspark_tpu.batch.gridsearch import (GridSearch,  # noqa: F401
+                                                    expand_param_grid)
+from tensorflowonspark_tpu.batch.job import BatchJob  # noqa: F401
+from tensorflowonspark_tpu.batch.ledger import ProgressLedger  # noqa: F401
+from tensorflowonspark_tpu.batch.manifest import (Shard,  # noqa: F401
+                                                  ShardManifest)
+from tensorflowonspark_tpu.batch.worker import batch_worker  # noqa: F401
+from tensorflowonspark_tpu.batch.writer import (ShardWriter,  # noqa: F401
+                                                iter_part, iter_results,
+                                                read_results)

@@ -735,7 +735,7 @@ def run_with_recovery(map_fun, tf_args, num_workers: int, *,
                       shutdown_timeout: float = 259200.0,
                       backoff_base: float = 1.0, backoff_cap: float = 30.0,
                       restart_budget: tuple[int, float] | None = None,
-                      retry_policy=None, on_restart=None,
+                      retry_policy=None, on_restart=None, driver_fn=None,
                       **run_kwargs) -> None:
     """Run a cluster job to completion, relaunching after worker failures.
 
@@ -769,6 +769,16 @@ def run_with_recovery(map_fun, tf_args, num_workers: int, *,
     attempt (idempotence is the map_fun's contract, as it was with Spark
     task retries); TENSORFLOW mode needs neither.
 
+    ``driver_fn(cluster)`` replaces the built-in feed as each attempt's
+    driver phase — the hook the batch-inference plane's dispatcher uses
+    (``batch.BatchJob``): it runs after every node registered and before
+    ``shutdown``, and its exceptions are classified for the restart
+    decision like any other failure.  It may return a set of executor
+    ids whose failures it already handled in-flight (e.g. a dead
+    worker whose shards were reassigned to survivors): those workers'
+    nonzero exits are then tolerated at shutdown instead of burning a
+    restart on an already-healed death.
+
     Raises the final failure once retries are exhausted or a failure
     classifies as no-retry.
     """
@@ -793,9 +803,25 @@ def run_with_recovery(map_fun, tf_args, num_workers: int, *,
             # still re-provisioning after a preemption) and must be retried
             cluster = TPUCluster.run(map_fun, tf_args, num_workers,
                                      input_mode=input_mode, **run_kwargs)
-            if input_mode == InputMode.SPARK and data is not None:
+            handled = None
+            if driver_fn is not None:
+                handled = driver_fn(cluster)
+            elif input_mode == InputMode.SPARK and data is not None:
                 cluster.train(data, num_epochs)
-            cluster.shutdown(timeout=shutdown_timeout)
+            try:
+                cluster.shutdown(timeout=shutdown_timeout)
+            except Exception as shutdown_exc:
+                # the driver_fn handled-workers contract (see docstring):
+                # a death it already healed must not fail the attempt at
+                # shutdown — but only when EVERY failed worker was handled
+                failed: set[int] = set()
+                with contextlib.suppress(Exception):
+                    failed = set(cluster.backend.failed())
+                if not (handled and failed and failed <= set(handled)):
+                    raise
+                logger.warning(
+                    "tolerating worker exit(s) %s already handled by "
+                    "driver_fn: %s", sorted(failed), shutdown_exc)
             return
         except Exception as e:
             if cluster is not None:

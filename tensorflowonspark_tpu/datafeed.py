@@ -114,7 +114,7 @@ class DataFeed:
             self._buffer.extend(samples)
         return batch
 
-    def next_chunk(self, timeout: float = 600.0):
+    def next_chunk(self, timeout: float | None = 600.0):
         """Next raw queue chunk, zero-copy — the batched-array hot path.
 
         For feeds that push pre-batched device-sized arrays (the
@@ -128,19 +128,27 @@ class DataFeed:
 
         Partition markers are skipped (a pre-batched chunk is already
         batch-aligned); returns ``None`` once the feed has terminated.
-        Don't mix with :meth:`next_batch` on the same queue: this method
-        bypasses (and would reorder against) its carry-over buffer.
+        ``timeout=None`` blocks until a chunk (or the terminal sentinel)
+        arrives — the task-queue consumer shape used by
+        ``batch.batch_worker``, where "no task yet" is an idle fleet,
+        not an error.  Don't mix with :meth:`next_batch` on the same
+        queue: this method bypasses (and would reorder against) its
+        carry-over buffer.
         """
         if self.done_feeding:
             return None
-        deadline = time.monotonic() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             wait_start = time.monotonic()
             try:
                 item = self.mgr.queue_get(
                     self.qname_in,
-                    timeout=max(0.1, deadline - time.monotonic()))
+                    timeout=5.0 if deadline is None
+                    else max(0.1, deadline - time.monotonic()))
             except (_queue.Empty, TimeoutError):
+                if deadline is None:
+                    self._m_wait.record(time.monotonic() - wait_start)
+                    continue
                 raise TimeoutError(
                     f"no data on '{self.qname_in}' after {timeout}s")
             self._m_wait.record(time.monotonic() - wait_start)

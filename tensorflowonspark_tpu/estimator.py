@@ -476,7 +476,7 @@ class Estimator:
         data waits, productive step time, checkpoint stalls, and idle."""
         return self._goodput.summary()
 
-    def predict(self, input_fn, predict_fn=None):
+    def predict(self, input_fn, predict_fn=None, *, params=None):
         """Yield per-batch predictions (tf.estimator's ``predict``).
 
         ``predict_fn(params, batch) -> predictions`` is the forward
@@ -484,6 +484,16 @@ class Estimator:
         aren't predictions — so a missing ``predict_fn`` raises).  Batches
         stream through the same sharded device path as training; outputs
         come back as host numpy, one yield per input batch.
+
+        ``params`` overrides the trained parameters for this call only —
+        a grid-search trial's candidate, EMA/averaged weights, or a
+        donor checkpoint — without touching the estimator's state.  The
+        tree must match ``self.params`` in structure (it feeds the same
+        jitted forward).
+
+        Input waits land in :meth:`goodput` under ``data`` and device
+        time under ``step``, exactly like ``train`` — so a scoring pass
+        shows up in the badput ledger instead of inflating ``idle``.
         """
         import jax
 
@@ -491,9 +501,19 @@ class Estimator:
             raise ValueError("predict needs predict_fn(params, batch)")
         fn = jax.jit(predict_fn)
         sharding = self.strategy.batch_sharding()
-        for batch in input_fn():
-            out = fn(self._state.params, jax.device_put(batch, sharding))
-            yield jax.device_get(out)
+        p = self._state.params if params is None else params
+        _END = object()
+        with self._goodput.time("data"):
+            it = iter(input_fn())
+        while True:
+            with self._goodput.time("data"):
+                batch = next(it, _END)
+            if batch is _END:
+                return
+            with self._goodput.time("step"):
+                out = fn(p, jax.device_put(batch, sharding))
+                host = jax.device_get(out)
+            yield host
 
     def _write_scalars(self, prefix: str, metrics: dict,
                        step: int | None = None) -> None:

@@ -380,6 +380,22 @@ class ClusterMonitor:
                         "age_secs": now - rec.get("seen", now)}
         return out
 
+    def live_unhandled(self) -> list[int]:
+        """Executor ids still alive and not yet retired from watching —
+        the scoring/serving capacity a ``keep_polling`` consumer (the
+        serving tier, the batch dispatcher) can still route work to.
+        One backend sweep; no kv round."""
+        _codes, alive, _failed = self._backend_snapshot()
+        out = []
+        for node in list(self.cluster.cluster_info):
+            eid = node["executor_id"]
+            if eid in self._handled:
+                continue
+            if eid < len(alive) and not alive[eid]:
+                continue
+            out.append(eid)
+        return out
+
     def ignore_worker(self, executor_id: int) -> None:
         """Retire ``executor_id`` from both checks: a deliberately
         drained-and-stopped member (elastic scale-down, preemption

@@ -216,11 +216,25 @@ def frame_record(data: bytes) -> bytes:
 
 
 class TFRecordCorruptError(ValueError):
-    pass
+    """A framing/CRC failure in a TFRecord stream, carrying *where*: the
+    source ``path`` (None for in-memory buffers) and the byte ``offset``
+    of the record whose frame failed — enough to seek straight to the
+    damage with ``dd``/``xxd`` instead of re-deriving it from a bare
+    ``struct.error``."""
+
+    def __init__(self, reason: str, *, path: str | None = None,
+                 offset: int | None = None):
+        where = f" at offset {offset}" if offset is not None else ""
+        src = f" in {path!r}" if path else ""
+        super().__init__(f"{reason}{where}{src}")
+        self.path = path
+        self.offset = offset
 
 
-def iter_records(buf: bytes, verify: bool = True) -> Iterator[bytes]:
-    """Yield record payloads from an in-memory TFRecord file image."""
+def iter_records(buf: bytes, verify: bool = True,
+                 path: str | None = None) -> Iterator[bytes]:
+    """Yield record payloads from an in-memory TFRecord file image.
+    ``path`` only labels corruption errors with the buffer's origin."""
     buf = bytes(buf)
     lib = _native()
     off = 0
@@ -233,11 +247,12 @@ def iter_records(buf: bytes, verify: bool = True) -> Iterator[bytes]:
             if nxt == -1:
                 return
             if nxt == -2:
-                raise TFRecordCorruptError(f"truncated record at offset {off}")
+                raise TFRecordCorruptError("truncated record",
+                                           path=path, offset=off)
             if nxt in (-3, -4):
                 raise TFRecordCorruptError(
-                    f"crc mismatch ({'length' if nxt == -3 else 'data'}) "
-                    f"at offset {off}")
+                    f"crc mismatch ({'length' if nxt == -3 else 'data'})",
+                    path=path, offset=off)
             yield buf[d_off.value:d_off.value + d_len.value]
             off = nxt
         return
@@ -245,18 +260,22 @@ def iter_records(buf: bytes, verify: bool = True) -> Iterator[bytes]:
     n = len(buf)
     while off < n:
         if off + 12 > n:
-            raise TFRecordCorruptError(f"truncated record at offset {off}")
+            raise TFRecordCorruptError("truncated record",
+                                       path=path, offset=off)
         header = buf[off:off + 8]
         (length,) = struct.unpack("<Q", header)
         (len_crc,) = struct.unpack("<I", buf[off + 8:off + 12])
         if verify and len_crc != masked_crc(header):
-            raise TFRecordCorruptError(f"crc mismatch (length) at offset {off}")
+            raise TFRecordCorruptError("crc mismatch (length)",
+                                       path=path, offset=off)
         if off + 16 + length > n:
-            raise TFRecordCorruptError(f"truncated record at offset {off}")
+            raise TFRecordCorruptError("truncated record",
+                                       path=path, offset=off)
         data = buf[off + 12:off + 12 + length]
         (data_crc,) = struct.unpack("<I", buf[off + 12 + length:off + 16 + length])
         if verify and data_crc != masked_crc(data):
-            raise TFRecordCorruptError(f"crc mismatch (data) at offset {off}")
+            raise TFRecordCorruptError("crc mismatch (data)",
+                                       path=path, offset=off)
         yield data
         off += 16 + length
 
@@ -311,17 +330,23 @@ def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
             if not header:
                 return
             if len(header) < 12:
-                raise TFRecordCorruptError(f"truncated record at offset {off}")
+                raise TFRecordCorruptError("truncated record (tail shorter "
+                                           "than the 12-byte frame header)",
+                                           path=path, offset=off)
             (length,) = struct.unpack("<Q", header[:8])
             (len_crc,) = struct.unpack("<I", header[8:])
             if verify and len_crc != masked_crc(header[:8]):
-                raise TFRecordCorruptError(f"crc mismatch (length) at offset {off}")
+                raise TFRecordCorruptError("crc mismatch (length)",
+                                           path=path, offset=off)
             body = f.read(length + 4)
             if len(body) < length + 4:
-                raise TFRecordCorruptError(f"truncated record at offset {off}")
+                raise TFRecordCorruptError(
+                    f"truncated record (payload ends {length + 4 - len(body)}"
+                    " byte(s) early)", path=path, offset=off)
             data = body[:length]
             if verify and struct.unpack("<I", body[length:])[0] != masked_crc(data):
-                raise TFRecordCorruptError(f"crc mismatch (data) at offset {off}")
+                raise TFRecordCorruptError("crc mismatch (data)",
+                                           path=path, offset=off)
             yield data
             off += 16 + length
 

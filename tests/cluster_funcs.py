@@ -578,6 +578,37 @@ def fn_write_cache_env(args, ctx):
                                  "MISSING"))
 
 
+def batch_predict_scale(model, records, trial_params):
+    """Batch-plane scorer over array shards: one bytes record per row,
+    scaled by the grid trial's ``scale`` (default 2.0) — deterministic, so
+    restarted and uninterrupted runs are byte-identical."""
+    import numpy as np
+
+    scale = float((trial_params or {}).get("scale", 2.0))
+    arr = np.asarray(records, dtype=np.float64)
+    return [(row * scale).tobytes() for row in arr]
+
+
+def batch_predict_len(model, records, trial_params):
+    """Batch-plane scorer over tfrecord shards: echo each raw record's
+    length (records arrive as bytes)."""
+    return [len(r).to_bytes(4, "little") for r in records]
+
+
+def batch_model_builder_offset(args):
+    """Model builder fixture: built once per worker process; the returned
+    'model' is an offset the predict fn applies."""
+    return {"offset": float(args.get("offset", 100.0))}
+
+
+def batch_predict_with_model(model, records, trial_params):
+    """Proves the builder's model reaches every predict call."""
+    import numpy as np
+
+    arr = np.asarray(records, dtype=np.float64)
+    return [(row + model["offset"]).tobytes() for row in arr]
+
+
 def serving_tiny_gpt_builder(args):
     """Model builder for serving-tier tests (``serving.ServingCluster``):
     a deterministic seeded tiny GPT, rebuilt identically in every replica

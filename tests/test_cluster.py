@@ -84,6 +84,29 @@ def test_inference_more_partitions_than_nodes(tmp_path):
     assert sorted(preds) == sorted(x * x for x in range(1, 8))
 
 
+def test_inference_ordering_multi_node_uneven_partitions(tmp_path):
+    """Satellite: result ordering across MULTIPLE feedable nodes follows
+    partition index, with uneven partitions and more partitions than
+    nodes — previously asserted only order-insensitively / single-node.
+    Exact list equality: partition p goes to node p % N, results are
+    re-merged by partition index regardless of node finish order."""
+    cluster = _run(funcs.fn_square_inference, 3, tmp_path)
+    parts = [[1, 2, 3], [4], [5, 6], [7, 8, 9, 10], [], [11]]
+    preds = cluster.inference(Partitioned(parts))
+    cluster.shutdown(timeout=60)
+    assert preds == [x * x for x in range(1, 12)]  # exact order, not sorted
+
+
+def test_inference_ordering_uneven_flat_split(tmp_path):
+    """Same contract for a flat list: _partition's uneven split (larger
+    partitions first) must re-merge into the input order."""
+    cluster = _run(funcs.fn_square_inference, 2, tmp_path)
+    data = list(range(23))
+    preds = cluster.inference(data)
+    cluster.shutdown(timeout=60)
+    assert preds == [x * x for x in data]
+
+
 def test_inference_backpressure_tiny_output_batches(tmp_path):
     # regression: worker emits 1 result message per sample; with queue_depth=4
     # the output queue fills while the driver is still feeding — the feeder

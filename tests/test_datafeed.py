@@ -32,6 +32,33 @@ def test_next_batch_reslices_chunks(mgr):
     assert feed.should_stop()
 
 
+def test_next_chunk_blocking_wait(mgr):
+    """``next_chunk(timeout=None)`` parks across empty polls instead of
+    raising — the batch-plane task-consumer shape — and still returns
+    None at EndOfFeed."""
+    import threading
+    import time
+
+    feed = DataFeed(mgr)
+    got = []
+    t = threading.Thread(
+        target=lambda: got.extend((feed.next_chunk(timeout=None),
+                                   feed.next_chunk(timeout=None))),
+        daemon=True)
+    t.start()
+    time.sleep(0.3)            # both gets are parked on an empty queue
+    assert t.is_alive() and got == []
+    mgr.queue_put("input", {"op": "shard", "key": "s0"})
+    mgr.queue_put("input", EndOfFeed())
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert got == [{"op": "shard", "key": "s0"}, None]
+    # finite timeout still raises
+    feed2 = DataFeed(mgr)
+    with pytest.raises(TimeoutError, match="no data"):
+        feed2.next_chunk(timeout=0.2)
+
+
 def test_partition_alignment(mgr):
     feed = DataFeed(mgr)
     mgr.queue_put("input", [1, 2, 3])

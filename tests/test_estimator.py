@@ -193,6 +193,35 @@ def test_predict_streams_batches(tmp_path):
 
 
 @_isolated
+def test_predict_params_override_and_goodput(tmp_path):
+    """Satellite: ``predict(params=...)`` scores a candidate tree (grid
+    trial / EMA weights) without touching trained state, and predict's
+    input waits land in goodput()'s ``data`` bucket like train's."""
+    x, y = _linreg_problem()
+    ones = {"w": np.ones((4, 1), np.float32)}
+    with _make_estimator(tmp_path / "m") as est:
+        est.train(_batches(x, y), max_steps=20)
+        base = est.goodput()
+        w_trained = np.asarray(est.params["w"])
+
+        preds = list(est.predict(_batches(x, y),
+                                 lambda p, b: b["x"] @ p["w"], params=ones))
+        np.testing.assert_allclose(np.concatenate(preds), x @ ones["w"],
+                                   rtol=1e-5)
+        # the override was per-call: trained params still serve by default
+        np.testing.assert_allclose(np.asarray(est.params["w"]), w_trained)
+        preds2 = list(est.predict(_batches(x, y),
+                                  lambda p, b: b["x"] @ p["w"]))
+        np.testing.assert_allclose(np.concatenate(preds2), x @ w_trained,
+                                   rtol=1e-5)
+
+        g = est.goodput()
+        assert g["counts"]["data"] > base["counts"]["data"]
+        assert g["secs"]["data"] >= base["secs"]["data"]
+        assert g["counts"]["step"] > base["counts"]["step"]
+
+
+@_isolated
 def test_profile_steps_writes_trace(tmp_path):
     import glob
     import os

@@ -74,6 +74,43 @@ def test_file_write_read(tmp_path):
     assert list(tfrecord.read_records(path)) == [f"rec{i}".encode() for i in range(50)]
 
 
+def test_truncated_tail_error_names_path_and_offset(tmp_path):
+    """A part file cut mid-record (half-copied shard, killed writer) must
+    raise the typed error carrying the source path and the byte offset of
+    the broken record — not a bare struct/Value error (satellite)."""
+    path = str(tmp_path / "trunc.tfrecord")
+    good = [b"alpha", b"beta-record"]
+    tfrecord.write_records(path, good + [b"tail-record-that-gets-cut"])
+    whole = open(path, "rb").read()
+    good_len = sum(16 + len(r) for r in good)
+
+    # cut inside the tail record's PAYLOAD (header intact)
+    with open(path, "wb") as f:
+        f.write(whole[:good_len + 12 + 5])
+    with pytest.raises(tfrecord.TFRecordCorruptError) as ei:
+        list(tfrecord.read_records(path))
+    assert path in str(ei.value) and str(good_len) in str(ei.value)
+    assert ei.value.path == path and ei.value.offset == good_len
+    # the intact prefix still streams before the error
+    seen = []
+    with pytest.raises(tfrecord.TFRecordCorruptError):
+        for r in tfrecord.read_records(path):
+            seen.append(r)
+    assert seen == good
+
+    # cut inside the tail record's HEADER
+    with open(path, "wb") as f:
+        f.write(whole[:good_len + 7])
+    with pytest.raises(tfrecord.TFRecordCorruptError) as ei:
+        list(tfrecord.read_records(path))
+    assert ei.value.offset == good_len and ei.value.path == path
+
+    # in-memory iter_records carries the offset too (path optional)
+    with pytest.raises(tfrecord.TFRecordCorruptError) as ei:
+        list(tfrecord.iter_records(whole[:good_len + 3], path="<buf>"))
+    assert ei.value.offset == good_len and "<buf>" in str(ei.value)
+
+
 def test_tf_reads_our_files(tmp_path):
     tf = pytest.importorskip("tensorflow")
     path = str(tmp_path / "ours.tfrecord")

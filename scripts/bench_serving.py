@@ -37,6 +37,24 @@ Run: ``python scripts/bench_serving.py [--requests 60] [--rate 6]
 [--kill-step 8]`` (CPU by default; tiny GPT so the numbers measure the
 serving plane, not the model).
 
+``--sharded`` runs the MESH-SHARDED replica scenarios instead
+(docs/serving.md "Sharded replicas") and writes
+``bench_artifacts/sharded_serving.json``:
+
+- a steady A/B line — the same Poisson load against ``mesh={"tp": 1}``
+  and ``mesh={"tp": 2}`` gangs on CPU devices (simulated via
+  ``XLA_FLAGS``), each gate-checked oracle-exact against a solo greedy
+  decode under the SAME mesh (locked-vs-solo, the PR-3 contract,
+  now compiled over a device mesh);
+- a kill-one-shard chaos run: SIGKILL a NON-LEADER shard of a tp=2
+  gang mid-stream; the whole gang must classify dead, its in-flight
+  requests fail over ONCE to the surviving gang, and every accepted
+  request completes oracle-exact — zero lost.
+
+The script FAILS ITSELF on any gate miss (``--smoke``: one 2-device
+tp gang + artifact-schema validation, wired into ``scripts/ci.sh
+--bench-smoke``).
+
 ``--ramp`` runs the ELASTICITY scenario instead (docs/serving.md):
 a 1-replica tier with the metrics-driven autoscaler, an open-loop load
 that DOUBLES mid-window, a two-tenant mix (an unlimited ``quiet``
@@ -72,6 +90,27 @@ def bench_model_builder(args):
 
     cfg = GPTConfig(vocab_size=VOCAB, hidden_size=HIDDEN, num_layers=LAYERS,
                     num_heads=HEADS, intermediate_size=2 * HIDDEN,
+                    max_position_embeddings=MAXLEN, dtype=jnp.float32,
+                    pos_encoding="rope")
+    params = GPT(cfg).init(jax.random.key(int(args.get("seed", 0))),
+                           jnp.ones((1, 4), jnp.int32))["params"]
+    return cfg, params
+
+
+SHARDED_VOCAB = 64   # vocab/heads/ffn must divide by the gang tp
+
+
+def sharded_model_builder(args):
+    """Replica-side model for the sharded scenarios: tp-divisible dims
+    (top level so multiprocessing spawn can pickle it by reference)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab_size=SHARDED_VOCAB, hidden_size=HIDDEN,
+                    num_layers=LAYERS, num_heads=HEADS,
+                    intermediate_size=2 * HIDDEN,
                     max_position_embeddings=MAXLEN, dtype=jnp.float32,
                     pos_encoding="rope")
     params = GPT(cfg).init(jax.random.key(int(args.get("seed", 0))),
@@ -203,6 +242,166 @@ def bench_scenario(scenario, n_requests, rate, replicas, slots, kill_step,
         "e2e": _percentiles([r["e2e"] for r in ok]),
         "scheduler": {k: sched[k] for k in ("ttft", "e2e", "replicas")},
     }
+
+
+def _sharded_oracle(tp, seed, reqs):
+    """Solo greedy decode of every request under the SAME tp mesh the
+    gangs serve on — identical compiled numerics, so the cluster output
+    must be byte-equal (locked-vs-solo, mesh edition)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import greedy_generate
+    from tensorflowonspark_tpu.parallel import make_mesh
+    from tensorflowonspark_tpu.parallel.mesh import MeshSpec
+    from tensorflowonspark_tpu.serving.sharded import (GangSpec,
+                                                       default_shard_params)
+
+    cfg, params = sharded_model_builder({"seed": seed})
+    mesh = make_mesh(MeshSpec(tp=tp, dp=1), devices=jax.devices()[:tp])
+    with mesh:
+        if tp > 1:
+            params = default_shard_params(cfg, params, mesh)
+        return [np.asarray(greedy_generate(
+            cfg, params, jnp.asarray(p)[None, :], n))[0, len(p):].tolist()
+            for p, n in reqs]
+
+
+def sharded_scenario(scenario, n_requests, rate, replicas, slots, tp,
+                     kill_step, seed=0):
+    """One sharded-gang serving run; gates enforced here, not by the
+    reader (the artifact script fails itself on any miss)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.serving import ServingCluster
+    from tensorflowonspark_tpu.serving.sharded import GangSpec
+
+    spec = GangSpec(axes={"tp": tp})
+    worker_env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count="
+                     f"{max(2, spec.devices)}",
+    }
+    if scenario == "kill_shard":
+        if spec.gang_size < 2 or replicas < 2:
+            raise ValueError("kill_shard needs tp >= 2 and >= 2 gangs")
+        # node 1 = the FIRST gang's NON-LEADER shard: the kill must
+        # prove a member death fails the whole gang over, not just a
+        # leader crash
+        worker_env["TFOS_CHAOS"] = f"kill node=1 at_step={kill_step}"
+
+    rng = np.random.default_rng(seed)
+    reqs = [(rng.integers(0, SHARDED_VOCAB, (int(rng.integers(3, 10)),))
+             .astype(np.int32), int(rng.integers(8, 17)))
+            for _ in range(n_requests)]
+
+    serving = ServingCluster.run(
+        sharded_model_builder, replicas, max_batch=slots,
+        mesh={"tp": tp}, worker_env=worker_env, reservation_timeout=180)
+    try:
+        gang_size = serving.gang_spec.gang_size
+        m0 = serving.scheduler.metrics()
+        if m0["gang_size"] != gang_size or m0["capacity_devices"] \
+                != replicas * spec.devices:
+            raise RuntimeError(
+                f"gang registration gate: gang_size={m0['gang_size']} "
+                f"capacity={m0['capacity_devices']} (want {gang_size} / "
+                f"{replicas * spec.devices})")
+
+        def _warm():
+            with serving.client() as c:
+                c.generate(reqs[0][0], 2, timeout=600)
+
+        warmers = [threading.Thread(target=_warm) for _ in range(replicas)]
+        for t in warmers:
+            t.start()
+        for t in warmers:
+            t.join(600)
+        sched0 = serving.metrics()
+        t0 = time.monotonic()
+        records = _run_load(serving, reqs, rate, rng)
+        wall = time.monotonic() - t0
+        sched = serving.metrics()
+        for k in ("accepted", "completed", "shed", "failed", "requeued"):
+            sched[k] -= sched0[k]
+        dead = sorted(serving.scheduler.dead_replicas())
+    finally:
+        serving.shutdown(timeout=300)
+
+    ok = [r for r in records if r and r["ok"]]
+    failed = [r for r in records if r and not r["ok"]]
+    if failed or len(ok) != n_requests:
+        raise RuntimeError(
+            f"{scenario}: {len(failed)} accepted request(s) failed / "
+            f"{n_requests - len(ok)} lost — the zero-loss gate")
+    want = _sharded_oracle(tp, seed, reqs)
+    for i, (r, w) in enumerate(zip(records, want)):
+        if r["out"] != w:
+            raise RuntimeError(
+                f"{scenario}: request {i} diverged from the tp={tp} solo "
+                f"greedy oracle — locked-vs-solo gate ({r['out']} != {w})")
+    if scenario == "kill_shard":
+        if sched["requeued"] < 1:
+            raise RuntimeError("kill_shard: nothing was requeued — the "
+                               "chaos kill landed nowhere?")
+        if dead != [0, 1]:
+            raise RuntimeError(
+                f"kill_shard: dead set {dead} != [0, 1] — killing ONE "
+                "shard must classify the WHOLE gang dead")
+    tokens = sum(r["tokens"] for r in ok)
+    return {
+        "scenario": scenario,
+        "mesh": {"tp": tp},
+        "gang_size": spec.gang_size,
+        "devices_per_replica": spec.devices,
+        "replicas": replicas,
+        "requests": {
+            "offered": n_requests, "accepted": sched["accepted"],
+            "completed": len(ok), "shed": sched["shed"],
+            "failed": sched["failed"], "requeued": sched["requeued"],
+            "lost": 0,
+        },
+        "oracle_exact": True,
+        "dead_gang_eids": dead,
+        "tokens_total": tokens,
+        "wall_secs": round(wall, 3),
+        "throughput_tokens_per_s": round(tokens / wall, 2),
+        "throughput_requests_per_s": round(len(ok) / wall, 2),
+        "ttft": _percentiles([r["ttft"] for r in ok
+                              if r["ttft"] is not None]),
+        "e2e": _percentiles([r["e2e"] for r in ok]),
+    }
+
+
+SHARDED_ROW_KEYS = frozenset({
+    "scenario", "mesh", "gang_size", "devices_per_replica", "replicas",
+    "requests", "oracle_exact", "dead_gang_eids", "tokens_total",
+    "wall_secs", "throughput_tokens_per_s", "throughput_requests_per_s",
+    "ttft", "e2e"})
+
+
+def validate_sharded_artifact(out: dict) -> None:
+    """Schema gate for ``sharded_serving.json`` (ci.sh --bench-smoke)."""
+    if out.get("benchmark") != "sharded_serving":
+        raise RuntimeError("artifact gate: wrong benchmark name")
+    rows = out.get("rows") or []
+    if not rows:
+        raise RuntimeError("artifact gate: no rows")
+    for row in rows:
+        missing = SHARDED_ROW_KEYS - set(row)
+        if missing:
+            raise RuntimeError(f"artifact gate: row {row.get('scenario')} "
+                               f"missing keys {sorted(missing)}")
+        if not row["oracle_exact"] or row["requests"]["lost"] != 0 \
+                or row["requests"]["failed"] != 0:
+            raise RuntimeError(f"artifact gate: row {row['scenario']} "
+                               "violates the zero-loss/oracle gates")
+    scenarios = {row["scenario"] for row in rows}
+    if not out.get("config", {}).get("smoke") and not (
+            {"steady_tp1", "steady_tp2", "kill_shard"} <= scenarios):
+        raise RuntimeError(f"artifact gate: full run needs the tp=1/tp=2 "
+                           f"A/B and the kill-shard row, got {scenarios}")
 
 
 def ramp_scenario(n_requests, base_rate, slots, replace_step, seed=0,
@@ -388,8 +587,64 @@ def main():
     ap.add_argument("--replace-step", type=int, default=6,
                     help="decode step at which chaos replaces node 1 in "
                          "the ramp scenario")
+    ap.add_argument("--sharded", action="store_true",
+                    help="run the mesh-sharded gang scenarios instead "
+                         "(tp=1 vs tp=2 A/B + kill-one-shard); writes "
+                         "bench_artifacts/sharded_serving.json")
+    ap.add_argument("--smoke", action="store_true",
+                    help="with --sharded: one small 2-device tp gang + "
+                         "artifact schema validation (the ci.sh "
+                         "--bench-smoke gate)")
     args = ap.parse_args()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.sharded:
+        # the driver-side solo oracle runs under the same tp mesh the
+        # gangs serve on: simulate the devices BEFORE any jax import
+        # (append to, never clobber or skip, a pre-existing XLA_FLAGS)
+        if "--xla_force_host_platform_device_count" \
+                not in os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                " --xla_force_host_platform_device_count=2").strip()
+        if args.smoke:
+            specs = [("steady_tp2", 6, 4.0, 1, 2, None)]
+        else:
+            specs = [("steady_tp1", args.requests, args.rate,
+                      args.replicas, 1, None),
+                     ("steady_tp2", args.requests, args.rate,
+                      args.replicas, 2, None),
+                     ("kill_shard", args.requests, args.rate,
+                      max(2, args.replicas), 2, args.kill_step)]
+        rows = []
+        for scenario, n, rate, replicas, tp, kill in specs:
+            row = sharded_scenario(scenario, n, rate, replicas,
+                                   args.slots, tp, kill)
+            print(json.dumps(row, indent=2))
+            rows.append(row)
+        out = {
+            "benchmark": "sharded_serving",
+            "config": {
+                "backend": "LocalProcessBackend", "platform": "cpu",
+                "smoke": bool(args.smoke),
+                "slots_per_replica": args.slots,
+                "poisson_rate_per_s": args.rate,
+                "kill_plan": None if args.smoke
+                else f"kill node=1 at_step={args.kill_step} "
+                     f"(non-leader shard of gang 0)",
+                "model": {"vocab": SHARDED_VOCAB, "hidden": HIDDEN,
+                          "layers": LAYERS, "heads": HEADS,
+                          "max_len": MAXLEN},
+            },
+            "rows": rows,
+        }
+        validate_sharded_artifact(out)
+        path = os.path.join(REPO, "bench_artifacts", "sharded_serving.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+        print(f"wrote {path} (all gates passed)")
+        return
 
     if args.ramp:
         row = ramp_scenario(args.requests, args.rate, args.slots,

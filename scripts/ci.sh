@@ -7,7 +7,10 @@
 #   scripts/ci.sh --check       # analysis gate only (fast, no jax)
 #   scripts/ci.sh --bench-smoke # analysis gate + bench_batch.py on a tiny
 #                               # 4-shard manifest (artifact schema + the
-#                               # zero-reprocess/oracle resume gates)
+#                               # zero-reprocess/oracle resume gates) +
+#                               # bench_serving.py --sharded --smoke (a
+#                               # 2-device tp gang: oracle/zero-loss/schema
+#                               # gates on the sharded serving plane)
 #
 # The analysis gate (docs/analysis.md) runs all six project rules plus the
 # exports-drift check against the committed analysis_baseline.json ratchet
@@ -39,6 +42,15 @@ if [ "${1:-}" = "--bench-smoke" ]; then
     rc=$?
     if [ $rc -ne 0 ]; then
         echo "bench smoke FAILED (rc=$rc)" >&2
+        exit $rc
+    fi
+    echo "== bench smoke (sharded serving plane) =="
+    # a real 2-device tp gang behind the serving tier: fails itself on
+    # the locked-vs-solo oracle, zero-loss, and artifact-schema gates
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py --sharded --smoke
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "sharded serving bench smoke FAILED (rc=$rc)" >&2
         exit $rc
     fi
     exit 0

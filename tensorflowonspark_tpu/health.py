@@ -410,6 +410,13 @@ class ClusterMonitor:
             with contextlib.suppress(Exception):
                 cli.close()
 
+    def ignore_workers(self, executor_ids) -> None:
+        """Retire several workers at once — the serving tier's gang verb:
+        a mesh-sharded replica drains/dies as one unit, so its whole
+        executor-id block leaves the watch together."""
+        for eid in executor_ids:
+            self.ignore_worker(int(eid))
+
     def poll_now(self) -> ClusterFailure | None:
         """One synchronous check, returning any (new or prior) failure.
 

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 import flax.linen as nn
 from jax.sharding import PartitionSpec as P
 
+from tensorflowonspark_tpu import compat
+
 
 class ShardedEmbedding(nn.Module):
     """Embedding with the table sharded on the vocab dim over ``axis``.
@@ -62,7 +64,7 @@ def sharded_embedding_lookup(table: jax.Array, ids: jax.Array, axis_name: str = 
     embeddings — one small all-reduce of activations instead of gathering
     the table (the gRPC pull of the reference's PS, as an ICI collective).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     shard_vocab = table.shape[0]
     lo = idx * shard_vocab
@@ -77,7 +79,7 @@ def sharded_embedding_lookup(table: jax.Array, ids: jax.Array, axis_name: str = 
 def apply_sharded_lookup(mesh, table, ids, axis_name: str = "ep"):
     """Convenience wrapper: run :func:`sharded_embedding_lookup` under
     ``shard_map`` with the table vocab-sharded and ids replicated."""
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         lambda t, i: sharded_embedding_lookup(t, i, axis_name),
         mesh=mesh,
         in_specs=(P(axis_name, None), P()),
@@ -147,7 +149,7 @@ def build_sparse_embedding_train_step(mesh, loss_fn, lr: float = 0.05,
         local = i - jax.lax.axis_index(axis_name) * t.shape[0]
         return _sparse_rows_update(t, a, local, g, lr, eps, optimizer)
 
-    upd = jax.shard_map(
+    upd = compat.shard_map(
         shard_update,
         mesh=mesh,
         in_specs=(P(axis_name, None), P(axis_name, None), P(), P()),

@@ -42,19 +42,32 @@ class MeshSpec:
 
     def resolve(self, n_devices: int) -> "MeshSpec":
         sizes = list(self.sizes())
+        for ax, s in zip(AXES, sizes):
+            # catch bad sizes HERE, by name: a 0 would otherwise surface
+            # as an opaque modulo-by-zero / reshape error downstream
+            if s != -1 and (not isinstance(s, int) or s < 1):
+                raise ValueError(
+                    f"mesh axis '{ax}' has invalid size {s!r} "
+                    f"(want a positive int, or -1 to infer it from the "
+                    f"device count)")
         free = [i for i, s in enumerate(sizes) if s == -1]
         if len(free) > 1:
-            raise ValueError("at most one axis may be -1")
+            raise ValueError(
+                f"at most one mesh axis may be -1 (inferred); got "
+                f"{', '.join(repr(AXES[i]) for i in free)}")
+        named = {ax: s for ax, s in zip(AXES, sizes) if s not in (1, -1)}
         fixed = math.prod(s for s in sizes if s != -1)
         if free:
             if n_devices % fixed:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fixed axes product {fixed}")
+                    f"cannot infer mesh axis '{AXES[free[0]]}': fixed axes "
+                    f"{named or '{}'} (product {fixed}) do not divide "
+                    f"{n_devices} devices")
             sizes[free[0]] = n_devices // fixed
         elif fixed != n_devices:
             raise ValueError(
-                f"axis sizes {dict(zip(AXES, sizes))} require {fixed} devices, "
-                f"have {n_devices}")
+                f"mesh axes {named or dict(zip(AXES, sizes))} require "
+                f"{fixed} devices, have {n_devices}")
         return MeshSpec(**dict(zip(AXES, sizes)))
 
 
@@ -72,6 +85,10 @@ def make_mesh(spec: MeshSpec | None = None, devices=None, **axis_sizes):
     import jax
 
     if spec is None:
+        unknown = set(axis_sizes) - set(AXES)
+        if unknown:
+            raise ValueError(
+                f"unknown mesh axes {sorted(unknown)}; valid axes: {AXES}")
         spec = MeshSpec(**{**{"dp": -1}, **axis_sizes})
     devices = np.asarray(devices if devices is not None else jax.devices())
     spec = spec.resolve(devices.size)

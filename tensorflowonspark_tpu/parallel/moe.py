@@ -35,6 +35,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from tensorflowonspark_tpu import compat
+
 
 def make_moe_layer(hidden: int, ffn: int, num_experts: int, *,
                    top_k: int = 2, capacity_factor: float = 1.25,
@@ -110,7 +112,7 @@ def make_moe_layer(hidden: int, ffn: int, num_experts: int, *,
         # ---- to experts: [E, C, H] → all_to_all over ep ----
         expert_in = jnp.einsum("tec,th->ech", dispatch, x.astype(jnp.float32))
         try:
-            n_ep = lax.axis_size(ep_axis)
+            n_ep = compat.axis_size(ep_axis)
         except NameError:  # outside shard_map (single-device testing)
             n_ep = 1
         if n_ep > 1:
@@ -159,7 +161,7 @@ def moe_apply(mesh, moe_fn, params, x, *, param_specs,
         aux = lax.pmean(aux, token_axes)
         return y, aux
 
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         kernel, mesh=mesh,
         in_specs=(param_specs, x_spec),
         out_specs=(x_spec, P()))

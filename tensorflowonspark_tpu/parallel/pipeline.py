@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tensorflowonspark_tpu import compat
 from tensorflowonspark_tpu.parallel import sharding as sh
 from tensorflowonspark_tpu.parallel.mesh import MeshSpec, make_mesh
 from tensorflowonspark_tpu.parallel.strategy import MeshStrategy, TrainState
@@ -156,8 +157,8 @@ def pipeline_apply(mesh, stage_fn, stage_params, x, *,
         # and are marked pp-varying explicitly: each stage's carry holds
         # different values, and shard_map's varying-axes check (vma) requires
         # the scan carry to declare that up front.
-        act0 = jax.lax.pcast(jnp.zeros_like(x_mb[0]), (axis_name,), to="varying")
-        out0 = jax.lax.pcast(jnp.zeros_like(x_mb), (axis_name,), to="varying")
+        act0 = compat.pcast(jnp.zeros_like(x_mb[0]), (axis_name,), to="varying")
+        out0 = compat.pcast(jnp.zeros_like(x_mb), (axis_name,), to="varying")
         (_, out), _ = jax.lax.scan(tick, (act0, out0), jnp.arange(n_ticks))
         # Only the last stage holds real outputs; broadcast over pp so the
         # result is well-defined on every shard (and GSPMD can resume).
@@ -166,7 +167,7 @@ def pipeline_apply(mesh, stage_fn, stage_params, x, *,
             axis_name)
         return out.reshape(x_local.shape)
 
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         schedule, mesh=mesh,
         in_specs=(params_spec, x_spec), out_specs=x_spec)
     return mapped(stage_params, x)
@@ -287,9 +288,9 @@ def pipeline_value_and_grad(mesh, stage_fn, head_fn, stage_params,
             # already varies over (the scan's vma check requires carry
             # input/output types to match exactly)
             def one(a):
-                have = getattr(jax.typeof(a), "vma", frozenset())
+                have = compat.vma_of(a)
                 need = tuple(ax for ax in vary_axes if ax not in have)
-                return jax.lax.pcast(a, need, to="varying") if need else a
+                return compat.pcast(a, need, to="varying") if need else a
             return jax.tree.map(one, z)
 
         # differentiate w.r.t. FULLY-VARYING copies of the parameters:
@@ -363,8 +364,7 @@ def pipeline_value_and_grad(mesh, stage_fn, head_fn, stage_params,
             return jax.tree.map(_norm, out, ref_vma), None
 
         def _norm(o, ref):
-            extra = tuple(a for a in getattr(jax.typeof(o), "vma",
-                                             frozenset()) if a not in ref)
+            extra = tuple(a for a in compat.vma_of(o) if a not in ref)
             for a in extra:
                 if mesh.shape[a] != 1:
                     raise ValueError(
@@ -387,7 +387,7 @@ def pipeline_value_and_grad(mesh, stage_fn, head_fn, stage_params,
             pvary(jnp.zeros((), jnp.float32)),                 # loss
         )
         ref_vma = jax.tree.map(
-            lambda a: getattr(jax.typeof(a), "vma", frozenset()), carry0)
+            lambda a: compat.vma_of(a), carry0)
         (_, _, _, dp, dhp, dx_out, loss), _ = jax.lax.scan(
             tick, carry0, jnp.arange(n_ticks))
 
@@ -410,7 +410,14 @@ def pipeline_value_and_grad(mesh, stage_fn, head_fn, stage_params,
             return axes
 
         def fit(g, allowed):
-            have = getattr(jax.typeof(g), "vma", frozenset())
+            have = compat.vma_of(g)
+            if not have and not compat.has_vma():
+                # pre-vma jax cannot answer "which axes does g still vary
+                # on"; statically it is the schedule's vary_axes minus pp
+                # — every caller either masked-psum'd pp to invariance
+                # already or allows it outright — so data/declared axes
+                # get the intended global mean and size-1 axes are no-ops
+                have = frozenset(a for a in vary_axes if a != axis_name)
             extra = tuple(a for a in have if a not in allowed)
             for a in extra:
                 # data axes and declared activation axes average away
@@ -464,7 +471,7 @@ def pipeline_value_and_grad(mesh, stage_fn, head_fn, stage_params,
             axis_name), spec_axes(x_spec)).reshape(x_local.shape) / dx_div
         return loss, dp, dhp, dx
 
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         schedule, mesh=mesh,
         in_specs=(params_spec, h_spec, x_spec, t_spec),
         out_specs=(P(), params_spec, h_spec, x_spec))

@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from tensorflowonspark_tpu import compat
+
 NEG_INF = -1e30  # large-negative mask value (avoids -inf − -inf = nan)
 
 
@@ -41,7 +43,7 @@ def ring_attention(q, k, v, mask=None, axis_name: str = "sp",
     Returns:
       ``[batch, seq_local, heads, head_dim]`` — this device's output block.
     """
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -53,13 +55,12 @@ def ring_attention(q, k, v, mask=None, axis_name: str = "sp",
     # The accumulators become axis-varying inside the loop (they mix with
     # this device's q/k blocks), so their init must carry q's varying axes
     # (sp plus any sharded batch axes) for shard_map's varying-axes check.
-    try:
-        vma = tuple(jax.typeof(q).vma)
-    except AttributeError:  # outside shard_map (single-device testing)
-        vma = ()
+    # empty on jax versions without the vma system (compat.vma_of) and
+    # outside shard_map (single-device testing)
+    vma = tuple(compat.vma_of(q))
 
     def _vary(x):
-        return lax.pcast(x, vma, to="varying") if vma else x
+        return compat.pcast(x, vma, to="varying") if vma else x
 
     o0 = _vary(jnp.zeros((B, Tq, H, D), jnp.float32))
     m0 = _vary(jnp.full((B, H, Tq), NEG_INF, jnp.float32))
@@ -108,11 +109,11 @@ def ring_self_attention(mesh, q, k, v, mask=None, causal: bool = False,
     spec = P(batch_axes, sp_axis, None, None)
     kernel = functools.partial(ring_attention, axis_name=sp_axis, causal=causal)
     if mask is None:
-        fn = jax.shard_map(kernel, mesh=mesh,
+        fn = compat.shard_map(kernel, mesh=mesh,
                            in_specs=(spec, spec, spec), out_specs=spec)
         return fn(q, k, v)
     mask_spec = P(batch_axes, sp_axis)
-    fn = jax.shard_map(kernel, mesh=mesh,
+    fn = compat.shard_map(kernel, mesh=mesh,
                        in_specs=(spec, spec, spec, mask_spec), out_specs=spec)
     return fn(q, k, v, mask)
 

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from tensorflowonspark_tpu import compat
+
 from tensorflowonspark_tpu.parallel.ring_attention import reference_attention
 
 
@@ -58,15 +60,12 @@ def ulysses_attention(q, k, v, mask=None, axis_name: str = "sp",
     # axis_name" (must fail loudly — a silent n=1 would compute local-only
     # attention with correct shapes and wrong numerics).  Inputs carrying
     # varying manual axes are definitely inside a shard_map.
-    try:
-        vma = tuple(jax.typeof(q).vma)
-    except AttributeError:
-        vma = ()
-    if vma:
-        n = lax.axis_size(axis_name)  # NameError here = real misuse
+    vma = tuple(compat.vma_of(q))
+    if vma or compat.bound_axes():
+        n = compat.axis_size(axis_name)  # NameError here = real misuse
     else:
         try:
-            n = lax.axis_size(axis_name)
+            n = compat.axis_size(axis_name)
         except NameError:
             n = 1
     attn = attn_fn or reference_attention
@@ -108,10 +107,10 @@ def ulysses_self_attention(mesh, q, k, v, mask=None, causal: bool = False,
     # check on so future sharding bugs fail loudly.
     check_vma = attn_fn is None
     if mask is None:
-        fn = jax.shard_map(kernel, mesh=mesh, check_vma=check_vma,
+        fn = compat.shard_map(kernel, mesh=mesh, check_vma=check_vma,
                            in_specs=(spec, spec, spec), out_specs=spec)
         return fn(q, k, v)
     mask_spec = P(batch_axes, sp_axis)
-    fn = jax.shard_map(kernel, mesh=mesh, check_vma=check_vma,
+    fn = compat.shard_map(kernel, mesh=mesh, check_vma=check_vma,
                        in_specs=(spec, spec, spec, mask_spec), out_specs=spec)
     return fn(q, k, v, mask)

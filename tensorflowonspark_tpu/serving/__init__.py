@@ -15,12 +15,15 @@ one compiled decode loop behind the node's queue/shm data plane.
     serving.shutdown()
 
 Layout: ``scheduler`` (tenant-aware admission/routing/failover + typed
-errors + elastic membership), ``replica`` (the worker map_fun, drains
-under preemption), ``frontend`` (TCP edge + ``ServingCluster``
-composition: ``add_replicas``/``retire_replica``/drain-and-replace),
-``autoscaler`` (metrics-driven membership control), ``client``
-(``ServeClient``).  Architecture, backpressure semantics, the failure
-model, and the scale-event taxonomy are in ``docs/serving.md``.
+errors + elastic membership + gang resolution), ``replica`` (the worker
+map_fun, drains under preemption), ``sharded`` (mesh-sharded gang
+replicas: ``GangSpec``, the gang leader/member map_fun, step barriers),
+``frontend`` (TCP edge + ``ServingCluster`` composition:
+``add_replicas``/``retire_replica``/drain-and-replace, whole-gang),
+``autoscaler`` (metrics-driven membership control, device-weighted),
+``client`` (``ServeClient``).  Architecture, backpressure semantics,
+the failure model, and the scale-event taxonomy are in
+``docs/serving.md``.
 """
 
 from tensorflowonspark_tpu.serving.autoscaler import (Autoscaler,  # noqa: F401
@@ -29,6 +32,9 @@ from tensorflowonspark_tpu.serving.client import ServeClient  # noqa: F401
 from tensorflowonspark_tpu.serving.frontend import (ServeFrontend,  # noqa: F401
                                                     ServingCluster)
 from tensorflowonspark_tpu.serving.replica import serve_replica  # noqa: F401
+from tensorflowonspark_tpu.serving.sharded import (GangShardLost,  # noqa: F401
+                                                   GangSpec,
+                                                   serve_sharded_replica)
 from tensorflowonspark_tpu.serving.scheduler import (DeadlineExceeded,  # noqa: F401
                                                      PRIORITIES,
                                                      ReplicaFailed,

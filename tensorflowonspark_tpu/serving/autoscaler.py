@@ -114,15 +114,26 @@ class Autoscaler:
 
     # -- policy ------------------------------------------------------------
     def sample(self) -> dict:
-        """One reading of the signals the policy consumes."""
+        """One reading of the signals the policy consumes.
+
+        ``capacity`` / ``alive_capacity`` are DEVICE-WEIGHTED: a
+        mesh-sharded tp=4 gang contributes 4 capacity units where a
+        plain replica contributes 1, so the queue-pressure and
+        idle-fit thresholds (configured per capacity unit) scale with
+        the hardware behind each endpoint, not the endpoint count.
+        ``victim_weight`` is the capacity the next scale-down would
+        remove (0 when no victim is eligible)."""
         sched = self.serving.scheduler
         m = sched.metrics()
-        alive = [eid for eid, r in m["replicas"].items() if r["alive"]]
-        draining = [eid for eid, r in m["replicas"].items()
-                    if r["alive"] and r["draining"]]
+        alive = [r for r in m["replicas"].values() if r["alive"]]
+        routable = [r for r in alive if not r["draining"]]
+        victim = self._victim(m)
         return {
             "alive": len(alive),
-            "routable": len(alive) - len(draining),
+            "routable": len(routable),
+            "capacity": sum(r.get("weight", 1) for r in routable),
+            "alive_capacity": sum(r.get("weight", 1) for r in alive),
+            "victim_weight": 0 if victim is None else victim[1],
             "queued": m["queued"],
             "outstanding": sum(r["outstanding"]
                                for r in m["replicas"].values()),
@@ -135,12 +146,17 @@ class Autoscaler:
         caller performs the action (and must call :meth:`acted`)."""
         cfg = self.cfg
         now = time.monotonic() if now is None else now
-        routable = max(1, s["routable"])
+        # device-weighted capacity when the sample carries it (sharded
+        # gangs); plain replica counts otherwise — identical numbers at
+        # weight 1, so single-process tiers keep the historical policy
+        capacity = max(1, s.get("capacity", s["routable"]))
+        survivors = s.get("alive_capacity", s["alive"]) \
+            - s.get("victim_weight", 1)
         up_signal = None
-        if s["queued"] > cfg.up_queue_per_replica * routable:
+        if s["queued"] > cfg.up_queue_per_replica * capacity:
             up_signal = (f"queued {s['queued']} > "
-                         f"{cfg.up_queue_per_replica:g}/replica x "
-                         f"{routable} routable")
+                         f"{cfg.up_queue_per_replica:g}/unit x "
+                         f"{capacity} capacity")
         elif (cfg.up_ttft_p95 is not None and s["ttft_p95"] is not None
                 and s["ttft_p95"] > cfg.up_ttft_p95):
             up_signal = (f"ttft p95 {s['ttft_p95']:.3f}s > "
@@ -148,10 +164,10 @@ class Autoscaler:
         down_signal = None
         if (s["queued"] == 0 and s["alive"] > cfg.min_replicas
                 and s["outstanding"] <= cfg.down_outstanding_per_replica
-                * (s["alive"] - 1)):
+                * survivors):
             down_signal = (f"idle: queue empty, {s['outstanding']} "
-                           f"outstanding fits {s['alive'] - 1} replicas at "
-                           f"{cfg.down_outstanding_per_replica:g} each")
+                           f"outstanding fits {survivors} capacity units "
+                           f"at {cfg.down_outstanding_per_replica:g} each")
         self._up_streak = self._up_streak + 1 if up_signal else 0
         self._down_streak = self._down_streak + 1 if down_signal else 0
         if (up_signal and self._up_streak >= cfg.up_consecutive
@@ -221,16 +237,24 @@ class Autoscaler:
                 "scale_failed", direction="down", reason=reason)
         self.acted("down")
 
-    def _pick_victim(self) -> int | None:
-        """Least-loaded alive non-draining replica; highest id on ties
-        (newest goes first, keeping the founding members warm)."""
-        m = self.serving.scheduler.metrics()
-        candidates = [(r["outstanding"], -eid, eid)
+    def _victim(self, m: dict) -> tuple[int, int] | None:
+        """THE scale-down victim rule, shared by ``sample`` (its weight
+        feeds the survivor-capacity math) and ``_scale_down`` (the
+        actual retire): least-loaded alive non-draining replica, highest
+        id on ties (newest goes first, keeping the founding members
+        warm); None while at/below the floor.  Returns ``(eid,
+        capacity_weight)``."""
+        candidates = [(r["outstanding"], -eid, eid, r.get("weight", 1))
                       for eid, r in m["replicas"].items()
                       if r["alive"] and not r["draining"]]
         if len(candidates) <= self.cfg.min_replicas:
             return None
-        return min(candidates)[2]
+        _, _, eid, weight = min(candidates)
+        return eid, weight
+
+    def _pick_victim(self) -> int | None:
+        victim = self._victim(self.serving.scheduler.metrics())
+        return None if victim is None else victim[0]
 
 
 def _signals(s: dict) -> dict:

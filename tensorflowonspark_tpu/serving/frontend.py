@@ -247,6 +247,10 @@ class ServingCluster:
         self._drain_timeout = 60.0
         self._membership_lock = threading.Lock()
         self._replaced: set[int] = set()  # preempted eids already replaced
+        #: the tier's :class:`~tensorflowonspark_tpu.serving.sharded.
+        #: GangSpec` when replicas are mesh-sharded gangs, else None
+        self.gang_spec = None
+        self._reaped: set[int] = set()    # gang leaders already reaped
 
     # ------------------------------------------------------------------ run
     @classmethod
@@ -259,7 +263,8 @@ class ServingCluster:
             client_timeout: float = 600.0,
             metrics_port: int | None = 0, tenants: dict | None = None,
             autoscale=None, replace_preempted: bool = True,
-            drain_timeout: float = 60.0,
+            drain_timeout: float = 60.0, mesh: dict | None = None,
+            gang_size: int | None = None, shard_params=None,
             **cluster_kwargs) -> "ServingCluster":
         """Boot ``num_replicas`` serving workers and the driver-side tier.
 
@@ -283,6 +288,18 @@ class ServingCluster:
         ``replace_preempted`` (default), a replica whose host is
         reclaimed (SIGTERM / heartbeat phase ``preempted``) is drained
         and REPLACED instead of counting as a failure.
+
+        ``mesh`` turns every replica into a MESH-SHARDED GANG
+        (docs/serving.md "Sharded replicas"): an axis-name → size dict
+        (e.g. ``{"tp": 2}``) giving each replica's device mesh.  The
+        tier then boots ``num_replicas x gang_size`` workers (gang_size
+        defaults to the mesh's device count) running
+        :func:`~tensorflowonspark_tpu.serving.sharded.
+        serve_sharded_replica`; each gang is ONE routable endpoint with
+        capacity weight = its device count, and add/retire/failover
+        operate on whole gangs.  ``shard_params`` optionally overrides
+        the parameter layout (a picklable ``(cfg, params, mesh) ->
+        params``; default = the model's own partitioning annotations).
         """
         from tensorflowonspark_tpu.serving.replica import serve_replica
 
@@ -293,9 +310,25 @@ class ServingCluster:
             "serve_eos_id": eos_id,
             "serve_batcher_kwargs": dict(batcher_kwargs or {}),
         })
+        gang = None
+        map_fun, num_workers = serve_replica, num_replicas
+        if mesh is not None:
+            from tensorflowonspark_tpu.serving.sharded import (
+                GangSpec, serve_sharded_replica)
+
+            gang = GangSpec(axes=dict(mesh), gang_size=gang_size)
+            args["serve_mesh"] = dict(gang.axes)
+            args["serve_gang_size"] = gang.gang_size
+            if shard_params is not None:
+                args["serve_shard_params"] = shard_params
+            map_fun = serve_sharded_replica
+            num_workers = num_replicas * gang.gang_size
+        elif gang_size is not None or shard_params is not None:
+            raise ValueError("gang_size=/shard_params= need mesh= "
+                             "(sharded replicas)")
         # monitor=False: the training monitor's fail-fast abort is the
         # wrong policy here — a serving-mode monitor is attached below
-        cluster = TPUCluster.run(serve_replica, args, num_replicas,
+        cluster = TPUCluster.run(map_fun, args, num_workers,
                                  input_mode=InputMode.SPARK, monitor=False,
                                  **cluster_kwargs)
         scheduler = mon = frontend = tier = None
@@ -303,7 +336,9 @@ class ServingCluster:
             scheduler = ReplicaScheduler(
                 cluster, slots_per_replica=max_batch, overcommit=overcommit,
                 max_queue_depth=max_queue_depth, requeue_limit=requeue_limit,
-                tenants=tenants)
+                tenants=tenants,
+                gang_size=1 if gang is None else gang.gang_size,
+                capacity_weight=1 if gang is None else gang.devices)
             if monitor:
                 mon = ClusterMonitor(
                     cluster, hang_timeout=hang_timeout,
@@ -317,6 +352,7 @@ class ServingCluster:
                 mode=frontend_mode, default_timeout=client_timeout)
             address = frontend.start()
             tier = cls(cluster, scheduler, mon, frontend, address)
+            tier.gang_spec = gang
             tier._replace_preempted = bool(replace_preempted)
             tier._drain_timeout = float(drain_timeout)
             if mon is not None:
@@ -375,32 +411,43 @@ class ServingCluster:
     def add_replicas(self, n: int = 1,
                      timeout: float | None = None) -> list[int]:
         """Grow the tier by ``n`` replicas, live: the cluster re-opens
-        its reservation path and spawns fresh ``serve_replica`` workers
-        (same model builder/args the tier booted with), the scheduler
+        its reservation path and spawns fresh serving workers (same
+        model builder/args the tier booted with), the scheduler
         registers each as it reserves, and queued requests start
-        dispatching to the newcomers immediately.  Returns the new
-        executor ids."""
+        dispatching to the newcomers immediately.  With mesh-sharded
+        replicas each added replica is a WHOLE GANG (``gang_size``
+        workers, one routable endpoint).  Returns the new replicas'
+        leader executor ids."""
         if self._shutdown_done:
             raise RuntimeError("serving tier is shut down")
+        gsz = 1 if self.gang_spec is None else self.gang_spec.gang_size
         with self._membership_lock:
-            added = self.cluster.add_workers(n, timeout=timeout)
-            for info in added:
-                self.scheduler.add_replica(info)
-        eids = [int(info["executor_id"]) for info in added]
-        logger.info("serving tier grew by %d replica(s): %s", n, eids)
-        return eids
+            added = self.cluster.add_workers(n * gsz, timeout=timeout)
+            leaders = []
+            for i in range(0, len(added), gsz):
+                block = added[i:i + gsz]
+                self.scheduler.add_replica(
+                    block[0],
+                    members=tuple(int(b["executor_id"])
+                                  for b in block[1:]))
+                leaders.append(int(block[0]["executor_id"]))
+        logger.info("serving tier grew by %d replica(s): %s%s", n, leaders,
+                    f" (gangs of {gsz})" if gsz > 1 else "")
+        return leaders
 
     def retire_replica(self, executor_id: int,
                        drain_timeout: float | None = None) -> bool:
         """Drain-based scale-down of one replica: stop routing to it,
         wait out its in-flight requests (``drain_timeout``, default the
         tier's), remove it from the scheduler as a CLEAN departure (it
-        never shows in ``dead_replicas``), then stop the worker with a
-        per-replica ``EndOfFeed``.  Returns True when the drain emptied
-        within the timeout; on False the leftovers were re-queued to the
-        survivors (exactness preserved by the failover skip-dedup), so
-        zero accepted requests are lost either way."""
-        eid = int(executor_id)
+        never shows in ``dead_replicas``), then stop the worker(s) with
+        per-worker ``EndOfFeed`` s.  ``executor_id`` may be ANY shard of
+        a mesh-sharded gang — the whole gang drains and retires as one
+        unit.  Returns True when the drain emptied within the timeout;
+        on False the leftovers were re-queued to the survivors
+        (exactness preserved by the failover skip-dedup), so zero
+        accepted requests are lost either way."""
+        eid = self.scheduler.resolve_gang(int(executor_id))
         dt = self._drain_timeout if drain_timeout is None else drain_timeout
         self.scheduler.mark_draining(eid, reason="scale_down")
         drained = self.scheduler.drain_replica(eid, timeout=dt)
@@ -408,32 +455,64 @@ class ServingCluster:
         # loop sees a planned departure, not a dead response channel
         self.scheduler.retire_replica(
             eid, reason="scale_down" if drained else "drain_timeout")
-        with contextlib.suppress(Exception):
-            self.cluster._client_for(eid).put(REQUEST_QUEUE, EndOfFeed(),
-                                              timeout=5)
-        if self.monitor is not None:
-            self.monitor.ignore_worker(eid)
-        self.cluster.retire_worker(eid)
+        self._stop_gang_workers(eid)
         return drained
+
+    def _stop_gang_workers(self, leader_eid: int) -> None:
+        """Stop every worker of a replica that LEFT the scheduler
+        (retired or dead): per-worker ``EndOfFeed`` (the leader's serve
+        loop and the members' barrier loops both exit on it; puts to an
+        already-dead shard are best-effort), monitor retirement so late
+        exits are never classified, and cluster retirement so shutdown
+        skips the slot.  Idempotent per gang."""
+        with self._membership_lock:
+            if leader_eid in self._reaped:
+                return
+            self._reaped.add(leader_eid)
+        gang = self.scheduler.gang_members(leader_eid)
+        if self.monitor is not None:
+            self.monitor.ignore_workers(gang)
+        for eid in gang:
+            with contextlib.suppress(Exception):
+                self.cluster._client_for(eid).put(REQUEST_QUEUE,
+                                                  EndOfFeed(), timeout=5)
+            self.cluster.retire_worker(eid)
 
     # ------------------------------------------------ preemption handling
     def _on_phase(self, eid: int, phase: str) -> None:
         """Monitor ``on_phase`` hook: a live replica flipping to
         ``preempted`` is in its reclaim grace window — drain and replace
-        it NOW instead of waiting for the exit."""
+        it NOW instead of waiting for the exit.  A gang SHARD's phase
+        flip drains the whole gang (its leader)."""
         if phase == "preempted" and not self._shutdown_done:
-            self._handle_preempted(int(eid))
+            self._handle_preempted(self.scheduler.resolve_gang(int(eid)))
 
     def _on_cluster_failure(self, failure) -> None:
         """Monitor ``on_failure`` hook: always fail over via the
-        scheduler; a PREEMPTION-classified exit (the replica died before
-        or during its grace drain) additionally spawns a replacement —
-        membership flexes, the tier never shrinks by reclaim."""
+        scheduler — which resolves a gang shard's death to the WHOLE
+        gang, requeueing its in-flight work once — then reap the dead
+        gang's surviving processes (a leaderless member would otherwise
+        idle on its barrier queue forever).  A PREEMPTION-classified
+        exit (the replica died before or during its grace drain)
+        additionally spawns a replacement — membership flexes, the tier
+        never shrinks by reclaim."""
         self.scheduler.on_cluster_failure(failure)
+        failed = [int(e) for e in getattr(failure, "failed_workers", ())]
+        leaders = {self.scheduler.resolve_gang(e) for e in failed}
+        if self.gang_spec is not None and not self._shutdown_done:
+            dead = self.scheduler.dead_replicas()
+            for leader in leaders:
+                if leader in dead:
+                    # off the monitor's poll thread: reaping does queue
+                    # I/O (EndOfFeed puts) and must not delay detection
+                    threading.Thread(
+                        target=self._stop_gang_workers, args=(leader,),
+                        name=f"serve-gang-reap-{leader}",
+                        daemon=True).start()
         if (self._replace_preempted and not self._shutdown_done
                 and getattr(failure, "kind", None) == PREEMPTION):
-            for eid in getattr(failure, "failed_workers", ()):
-                self._spawn_replacement(int(eid), source="exit")
+            for leader in leaders:
+                self._spawn_replacement(leader, source="exit")
 
     def _handle_preempted(self, eid: int) -> None:
         # mark_draining is the dedup: False when already draining/dead,
@@ -451,9 +530,11 @@ class ServingCluster:
             # died mid-drain the recv loop's _mark_dead already re-queued
             # the leftovers and this retire is a no-op
             self.scheduler.retire_replica(eid, reason="preempted")
-            if self.monitor is not None:
-                self.monitor.ignore_worker(eid)
-            self.cluster.retire_worker(eid)
+            # gang case: the reclaim may have hit a MEMBER — the leader
+            # never saw a SIGTERM and would serve forever; EndOfFeed
+            # every shard so the full gang heals (single replicas exit
+            # by themselves, the extra EndOfFeed is consumed harmlessly)
+            self._stop_gang_workers(eid)
         except Exception:
             logger.exception("preemption drain of replica %d failed", eid)
         if self._replace_preempted:

@@ -81,9 +81,24 @@ def serve_replica(args, ctx) -> None:
         max_batch=int(args.get("serve_max_batch", 4)),
         eos_id=args.get("serve_eos_id"),
         **dict(args.get("serve_batcher_kwargs") or {}))
+    run_serve_loop(args, ctx, batcher)
+
+
+def run_serve_loop(args, ctx, batcher, *, step_hook=None,
+                   label: str = "replica") -> None:
+    """THE serving loop (module docstring): intake ⇄ step interleave over
+    the node queue plane until ``EndOfFeed`` / a drained preemption.
+
+    Shared by :func:`serve_replica` (a single-process replica) and the
+    mesh-sharded gang leader (:mod:`~tensorflowonspark_tpu.serving.
+    sharded`), which passes ``step_hook(steps, load)`` — called once per
+    decode step, after the step's deltas are flushed — to run the gang's
+    step barrier; a hook exception (a lost shard) propagates out exactly
+    like a device failure, crashing the worker so the driver classifies
+    the whole gang dead."""
     mgr = ctx.mgr
     if mgr is None:
-        raise RuntimeError("serve_replica needs the node queue server "
+        raise RuntimeError("the serving loop needs the node queue server "
                            "(InputMode.SPARK)")
     idle_poll = float(args.get("serve_idle_poll", 0.5))
     busy_poll = float(args.get("serve_busy_poll", 0.005))
@@ -123,7 +138,7 @@ def serve_replica(args, ctx) -> None:
     def busy() -> bool:
         return batcher.load()["total"] > 0
 
-    logger.info("replica %d serving (max_batch=%d)", ctx.executor_id,
+    logger.info("%s %d serving (max_batch=%d)", label, ctx.executor_id,
                 batcher.max_batch)
     draining = False
     drain_started = 0.0
@@ -221,8 +236,13 @@ def serve_replica(args, ctx) -> None:
                 mgr.queue_put(RESPONSE_QUEUE,
                               {"rid": rid, "event": "done", "load": load})
                 served += 1
-    logger.info("replica %d %s: %d requests over %d steps "
-                "(%d prefill + %d decode dispatches)", ctx.executor_id,
+            if step_hook is not None:
+                # gang barrier AFTER the step's deltas are flushed, so
+                # barrier latency never delays token delivery
+                step_hook(steps, load)
+    logger.info("%s %d %s: %d requests over %d steps "
+                "(%d prefill + %d decode dispatches)", label,
+                ctx.executor_id,
                 "drained after preemption" if draining else "drained",
                 served, steps, batcher.prefill_dispatches,
                 batcher.decode_dispatches)

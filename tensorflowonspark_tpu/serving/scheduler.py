@@ -250,12 +250,18 @@ class ServeRequest:
 
 
 class _Replica:
-    """Driver-side view of one replica worker."""
+    """Driver-side view of one routable replica endpoint — a single
+    worker, or the LEADER of a mesh-sharded gang (``members`` holds the
+    shard workers' executor ids, ``weight`` the gang's device count:
+    its capacity contribution to device-weighted signals)."""
 
-    def __init__(self, info: dict, max_inflight: int):
+    def __init__(self, info: dict, max_inflight: int,
+                 members: tuple = (), weight: int = 1):
         self.info = info
         self.eid = int(info["executor_id"])
         self.max_inflight = int(max_inflight)
+        self.members = tuple(int(m) for m in members)
+        self.weight = max(1, int(weight))
         self.outstanding: dict[int, ServeRequest] = {}
         self.reported_load = 0   # last ContinuousBatcher.load()["total"]
         self.alive = True
@@ -274,7 +280,8 @@ class ReplicaScheduler:
                  overcommit: int = 2, max_queue_depth: int | None = None,
                  poll_interval: float = 0.25, requeue_limit: int = 1,
                  client_factory=None, event_log=None,
-                 tenants: dict | None = None):
+                 tenants: dict | None = None, gang_size: int = 1,
+                 capacity_weight: int | None = None):
         self.cluster = cluster
         feedable = sorted(
             (n for n in cluster.cluster_info
@@ -284,8 +291,37 @@ class ReplicaScheduler:
             raise ValueError("serving cluster has no feedable replicas")
         max_inflight = max(1, int(slots_per_replica) * int(overcommit))
         self._max_inflight = max_inflight  # replicas added live inherit it
-        self.replicas: dict[int, _Replica] = {
-            n["executor_id"]: _Replica(n, max_inflight) for n in feedable}
+        #: processes per routable replica (docs/serving.md "Sharded
+        #: replicas"): with gang_size > 1 the workers partition into
+        #: contiguous, aligned blocks — block head = the gang LEADER
+        #: (the only eid the scheduler routes to / connects queues to),
+        #: the rest are shard members whose deaths resolve to the whole
+        #: gang.  ``capacity_weight`` is each gang's device count, the
+        #: unit the autoscaler's device-weighted signals count in.
+        self.gang_size = max(1, int(gang_size))
+        self._weight = max(1, int(capacity_weight
+                                  if capacity_weight is not None
+                                  else self.gang_size))
+        if len(feedable) % self.gang_size:
+            raise ValueError(
+                f"serving cluster has {len(feedable)} workers, not a "
+                f"multiple of gang_size={self.gang_size}")
+        self.replicas: dict[int, _Replica] = {}
+        self._gang_leader: dict[int, int] = {}  # every gang eid -> leader
+        for i in range(0, len(feedable), self.gang_size):
+            block = feedable[i:i + self.gang_size]
+            ids = [int(n["executor_id"]) for n in block]
+            if ids != list(range(ids[0], ids[0] + self.gang_size)) \
+                    or ids[0] % self.gang_size:
+                raise ValueError(
+                    f"gang block {ids} is not a contiguous, "
+                    f"gang_size-aligned executor range "
+                    f"(gang_size={self.gang_size})")
+            self.replicas[ids[0]] = _Replica(
+                block[0], max_inflight, members=tuple(ids[1:]),
+                weight=self._weight)
+            for e in ids:
+                self._gang_leader[e] = ids[0]
         #: bounded admission queue: queued + in-flight across the tier
         self.max_queue_depth = int(
             max_queue_depth if max_queue_depth is not None
@@ -363,6 +399,10 @@ class ReplicaScheduler:
             labelnames=("replica",))
         self._g_alive = reg.gauge(
             "tfos_serving_replicas_alive_count", "Alive serving replicas.")
+        self._g_capacity = reg.gauge(
+            "tfos_serving_capacity_devices_count",
+            "Device-weighted routable capacity: sum of alive, "
+            "non-draining replica gang weights.")
         reg.add_collect_hook(self._collect_gauges)
         # audit events are enqueued (GIL-atomic append) and written by a
         # dedicated thread: a stalled disk must never block the request
@@ -419,6 +459,7 @@ class ReplicaScheduler:
             self._g_load.remove(replica=str(eid))
         self._g_depth.remove()
         self._g_alive.remove()
+        self._g_capacity.remove()
         for rep in self.replicas.values():
             self._close_clients(rep)
         self._drain_events()     # anything emitted after the writer exited
@@ -536,18 +577,44 @@ class ReplicaScheduler:
 
     # -- failure intake ----------------------------------------------------
     def on_cluster_failure(self, failure) -> None:
-        """`ClusterMonitor` subscriber: classified crash/hang/preemption."""
+        """`ClusterMonitor` subscriber: classified crash/hang/preemption.
+        A gang SHARD's death resolves to its leader — killing one shard
+        of a tp=4 gang kills the whole routable replica, once."""
         with self._lock:
             for eid in getattr(failure, "failed_workers", ()):  # noqa: B007
-                self._mark_dead(int(eid),
-                                f"{getattr(failure, 'kind', 'failure')}: "
-                                f"{failure}")
+                eid = int(eid)
+                leader = self._gang_leader.get(eid, eid)
+                shard = "" if leader == eid else f" (gang shard {eid})"
+                self._mark_dead(leader,
+                                f"{getattr(failure, 'kind', 'failure')}"
+                                f"{shard}: {failure}")
+
+    def resolve_gang(self, executor_id: int) -> int:
+        """The gang LEADER (= routable replica id) owning ``executor_id``
+        — identity for non-gang members/unknown ids."""
+        with self._lock:
+            return self._gang_leader.get(int(executor_id), int(executor_id))
+
+    def gang_members(self, executor_id: int) -> tuple[int, ...]:
+        """Every executor id in ``executor_id``'s gang, leader first
+        (``(executor_id,)`` when unknown)."""
+        with self._lock:
+            leader = self._gang_leader.get(int(executor_id),
+                                           int(executor_id))
+            rep = self.replicas.get(leader)
+            if rep is None:
+                return (int(executor_id),)
+            return (leader, *rep.members)
 
     def dead_replicas(self) -> set[int]:
-        """Replicas lost to FAILURE (cleanly retired members excluded)."""
+        """Every executor id lost to FAILURE — for a dead gang that is
+        the leader AND its shard members, so shutdown's handled-worker
+        tolerance covers the whole gang's corpses (cleanly retired
+        members excluded)."""
         with self._lock:
-            return {eid for eid, rep in self.replicas.items()
-                    if not rep.alive and not rep.retired}
+            return {e for eid, rep in self.replicas.items()
+                    if not rep.alive and not rep.retired
+                    for e in (eid, *rep.members)}
 
     def alive_replicas(self) -> set[int]:
         with self._lock:
@@ -559,21 +626,33 @@ class ReplicaScheduler:
                     if rep.alive and rep.draining}
 
     # -- elastic membership ------------------------------------------------
-    def add_replica(self, info: dict) -> None:
+    def add_replica(self, info: dict, members: tuple = ()) -> None:
         """Register a freshly reserved replica worker and start routing
         to it (live scale-up / preemption replacement).  ``info`` is the
-        node's reservation dict, exactly as ``cluster_info`` carries it."""
+        node's reservation dict, exactly as ``cluster_info`` carries it;
+        ``members`` the shard workers of a gang replica (their deaths
+        resolve to this endpoint, like the founding gangs')."""
         eid = int(info["executor_id"])
+        members = tuple(int(m) for m in members)
+        if len(members) != self.gang_size - 1:
+            raise ValueError(
+                f"replica {eid} registered with {len(members)} gang "
+                f"member(s); this tier's gang_size={self.gang_size} "
+                f"needs {self.gang_size - 1}")
         with self._lock:
             if self._stop.is_set():
                 raise RuntimeError("scheduler is stopping")
             existing = self.replicas.get(eid)
             if existing is not None and existing.alive:
                 raise ValueError(f"replica {eid} already registered")
-            rep = _Replica(info, self._max_inflight)
+            rep = _Replica(info, self._max_inflight, members=members,
+                           weight=self._weight)
             self.replicas[eid] = rep
+            for e in (eid, *members):
+                self._gang_leader[e] = eid
             self._m_scale.inc(change="added")
             self._emit("replica_added", replica=eid,
+                       members=list(members), weight=rep.weight,
                        alive=sum(1 for r in self.replicas.values()
                                  if r.alive))
             self._work.notify_all()
@@ -653,18 +732,22 @@ class ReplicaScheduler:
         with self._lock:
             self._g_depth.set(len(self._pending))
             alive = 0
+            capacity = 0
             for eid, rep in self.replicas.items():
                 if rep.alive:
                     self._g_outstanding.set(len(rep.outstanding),
                                             replica=str(eid))
                     self._g_load.set(rep.reported_load, replica=str(eid))
                     alive += 1
+                    if not rep.draining:
+                        capacity += rep.weight
                 else:
                     # a retired replica must stop being reported, not
                     # freeze at its last values
                     self._g_outstanding.remove(replica=str(eid))
                     self._g_load.remove(replica=str(eid))
             self._g_alive.set(alive)
+            self._g_capacity.set(capacity)
 
     def metrics(self) -> dict:
         with self._lock:
@@ -674,12 +757,21 @@ class ReplicaScheduler:
                 "abandoned": self.abandoned,
                 "failed": self.failed, "requeued": self.requeued,
                 "queued": len(self._pending),
+                "gang_size": self.gang_size,
+                # device-weighted capacity: what the autoscaler's
+                # queue-pressure signal divides by — a tp=4 gang counts
+                # 4 capacity units, not 1 and not 4 replicas
+                "capacity_devices": sum(
+                    rep.weight for rep in self.replicas.values()
+                    if rep.alive and not rep.draining),
                 "ttft": self.ttft.summary(), "e2e": self.e2e.summary(),
                 "replicas": {
                     eid: {"alive": rep.alive, "draining": rep.draining,
                           "retired": rep.retired,
                           "outstanding": len(rep.outstanding),
                           "reported_load": rep.reported_load,
+                          "weight": rep.weight,
+                          "members": list(rep.members),
                           "served": rep.served}
                     for eid, rep in self.replicas.items()},
                 "tenants": {
@@ -894,9 +986,17 @@ class ReplicaScheduler:
                 continue
             with self._lock:
                 for eid, rep in self.replicas.items():
-                    if rep.alive and codes.get(eid) not in (0, None):
+                    if not rep.alive:
+                        continue
+                    # a gang is only as alive as its weakest shard: any
+                    # member's nonzero exit fails the whole endpoint
+                    dead = next((m for m in (eid, *rep.members)
+                                 if codes.get(m) not in (0, None)), None)
+                    if dead is not None:
+                        shard = "" if dead == eid else f"gang shard {dead} "
                         self._mark_dead(
-                            eid, f"process exited (code {codes[eid]})")
+                            eid, f"{shard}process exited "
+                                 f"(code {codes[dead]})")
 
     def _mark_dead(self, eid: int, reason: str) -> None:
         """Retire a replica and fail over its in-flight requests (lock
@@ -909,6 +1009,7 @@ class ReplicaScheduler:
         logger.warning("serving replica %d marked dead: %s", eid, reason)
         self._m_scale.inc(change="dead")
         self._emit("replica_dead", replica=eid, reason=reason,
+                   shards=list((eid, *rep.members)),
                    inflight=len(rep.outstanding))
         stranded = list(rep.outstanding.values())
         rep.outstanding.clear()

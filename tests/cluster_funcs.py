@@ -641,3 +641,21 @@ def shm_crash_server(pipe):
     pipe.send(int(item[0, 0]))  # prove the shm payload arrived intact
     pipe.recv()              # wait for the driver's kill order
     os._exit(1)
+
+
+def serving_sharded_gpt_builder(args):
+    """Model builder for SHARDED serving-tier tests: like
+    ``serving_tiny_gpt_builder`` but with every tp-sharded dimension
+    (vocab, heads, intermediate) divisible by the test gangs' tp=2/4,
+    so the Megatron layout actually shards."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                    intermediate_size=64, max_position_embeddings=64,
+                    dtype=jnp.float32, pos_encoding="rope")
+    params = GPT(cfg).init(jax.random.key(int(args.get("seed", 0))),
+                           jnp.ones((1, 4), jnp.int32))["params"]
+    return cfg, params

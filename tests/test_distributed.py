@@ -192,6 +192,7 @@ def _pipeline_multidev_oracle(steps: int = 2):
     import optax
     from jax.sharding import PartitionSpec as P
 
+    from tensorflowonspark_tpu import compat
     from tensorflowonspark_tpu.parallel import (make_mesh,
                                                 make_transformer_stage,
                                                 stack_stage_params)
@@ -218,7 +219,7 @@ def _pipeline_multidev_oracle(steps: int = 2):
     # check_vma=False: ring_attention's carry init mixes axis-varying and
     # invariant leaves when every axis is size 1 (pipeline_apply disables
     # the check for the same reason)
-    run = jax.shard_map(
+    run = compat.shard_map(
         lambda p0, p1, x: stage_fn(p1, stage_fn(p0, x)),
         mesh=mesh1, in_specs=(P(), P(), P()), out_specs=P(),
         check_vma=False)

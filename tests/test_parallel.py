@@ -35,6 +35,29 @@ def test_make_mesh_rejects_bad_sizes():
         make_mesh(MeshSpec(dp=3, tp=3))
 
 
+def test_mesh_resolve_errors_name_axis_and_device_count():
+    """Bad axis sizes must fail with a single-line error naming the axis
+    and the device count — not an opaque reshape/modulo error."""
+    # non-dividing fixed axis while inferring another
+    with pytest.raises(ValueError, match=r"'dp'.*'tp': 3.*8 devices"):
+        MeshSpec(tp=3).resolve(8)
+    # fixed product mismatch, no free axis
+    with pytest.raises(ValueError, match=r"'tp': 3.*require 3 devices.*8"):
+        MeshSpec(dp=1, tp=3).resolve(8)
+    # zero/negative sizes name the offending axis (historically a
+    # ZeroDivisionError out of the modulo)
+    with pytest.raises(ValueError, match=r"axis 'tp' has invalid size 0"):
+        MeshSpec(tp=0).resolve(8)
+    with pytest.raises(ValueError, match=r"axis 'sp' has invalid size -2"):
+        MeshSpec(sp=-2).resolve(8)
+    # two inferred axes are ambiguous, named
+    with pytest.raises(ValueError, match=r"'dp'.*'tp'"):
+        MeshSpec(dp=-1, tp=-1).resolve(8)
+    # unknown axis kwargs name the valid set
+    with pytest.raises(ValueError, match=r"unknown mesh axes \['xp'\]"):
+        make_mesh(xp=2)
+
+
 def test_mesh_from_num_ps_maps_to_ep():
     mesh = mesh_from_num_ps(4)
     assert mesh.shape["ep"] == 4 and mesh.shape["dp"] == 2

@@ -12,6 +12,7 @@ import numpy as np
 import optax
 import pytest
 
+from tensorflowonspark_tpu import compat
 from tensorflowonspark_tpu.parallel import (PipelineStrategy, make_mesh,
                                             pipeline_apply, stack_stage_params)
 from tensorflowonspark_tpu.parallel.mesh import MeshSpec
@@ -299,10 +300,10 @@ def _tp_serial_stage(mesh, stage_fn, params_i, x, param_specs):
     from jax.sharding import PartitionSpec as P
 
     def wrapped(p, x):
-        x = jax.lax.pcast(x, ("sp",), to="varying")
+        x = compat.pcast(x, ("sp",), to="varying")
         return jax.lax.psum(stage_fn(p, x), ("sp",))
 
-    return jax.shard_map(
+    return compat.shard_map(
         wrapped, mesh=mesh,
         in_specs=(param_specs, P()), out_specs=P())(params_i, x)
 

@@ -574,6 +574,133 @@ def test_forced_retire_requeues_in_flight_exactly():
         s.stop()
 
 
+# ------------------------------------------------- sharded gang units
+
+def test_gang_registration_and_capacity_accounting():
+    """gang_size=2 over 4 workers registers TWO routable endpoints
+    (leaders 0 and 2, members 1 and 3) with device-weighted capacity —
+    a tp gang is one endpoint with a weight, not N replicas."""
+    world = _FakeWorld(4)
+    s = _scheduler(world, gang_size=2, capacity_weight=2).start()
+    try:
+        assert set(s.replicas) == {0, 2}
+        assert s.gang_members(0) == (0, 1) and s.gang_members(2) == (2, 3)
+        assert s.resolve_gang(1) == 0 and s.resolve_gang(3) == 2
+        assert s.resolve_gang(2) == 2        # leaders resolve to selves
+        m = s.metrics()
+        assert m["gang_size"] == 2 and m["capacity_devices"] == 4
+        assert m["replicas"][0]["weight"] == 2
+        assert m["replicas"][0]["members"] == [1]
+        # traffic routes over LEADERS only
+        reqs = [s.submit(np.arange(1, 3 + k, dtype=np.int32), 4)
+                for k in range(6)]
+        for req in reqs:
+            _, err = _collect(req)
+            assert err is None
+        m = s.metrics()
+        assert all(m["replicas"][eid]["served"] > 0 for eid in (0, 2))
+        # live gang add registers leader + member as one endpoint
+        info4 = world.add_replica()
+        world.add_replica()                  # member slot (eid 5)
+        s.add_replica(info4, members=(5,))
+        assert s.alive_replicas() == {0, 2, 4}
+        assert s.resolve_gang(5) == 4
+        assert s.metrics()["capacity_devices"] == 6
+        # a gang endpoint needs exactly gang_size-1 members
+        with pytest.raises(ValueError, match="gang"):
+            s.add_replica({"executor_id": 6, "addr": ("x", 0),
+                           "authkey": b"x"}, members=())
+    finally:
+        s.stop()
+
+
+def test_gang_misaligned_blocks_rejected():
+    world = _FakeWorld(3)
+    with pytest.raises(ValueError, match="not a multiple of gang_size"):
+        _scheduler(world, gang_size=2)
+
+
+def test_gang_member_death_fails_whole_gang_over_once():
+    """SIGKILL one NON-LEADER shard mid-stream: the whole gang
+    classifies dead, its in-flight request re-queues ONCE to the
+    surviving gang, and the client stream is the exact oracle sequence
+    (skip-dedup across the gang failover)."""
+    world = _FakeWorld(4, token_delay=0.05)
+    s = _scheduler(world, gang_size=2, capacity_weight=2,
+                   slots_per_replica=1, overcommit=1).start()
+    try:
+        p = np.asarray([3, 5], np.int32)
+        req = s.submit(p, 8)
+        while not req.tokens:
+            time.sleep(0.01)
+        victim_leader = req.replica
+        member = victim_leader + 1
+        world.kill(member)                  # the member, NOT the leader
+        from tensorflowonspark_tpu.health import ClusterFailure
+
+        s.on_cluster_failure(ClusterFailure(
+            "crash", f"crash: worker {member} exit=-9",
+            failed_workers=(member,)))
+        toks, err = _collect(req, timeout=15)
+        assert err is None
+        assert toks == _fake_tokens(p, 8), "gang failover stream not exact"
+        m = s.metrics()
+        assert m["requeued"] == 1 and m["completed"] == 1
+        assert not m["replicas"][victim_leader]["alive"]
+        # dead set covers the WHOLE gang (shutdown tolerance needs every
+        # corpse), and capacity dropped by the gang's weight
+        assert s.dead_replicas() == {victim_leader, member}
+        assert m["capacity_devices"] == 2
+    finally:
+        s.stop()
+
+
+def test_gang_member_exit_detected_by_supervisor():
+    """The backend-exitcode supervision path alone (no monitor event)
+    must also resolve a member's death to the whole gang."""
+    world = _FakeWorld(4)
+    s = _scheduler(world, gang_size=2, poll_interval=0.05).start()
+    try:
+        world.kill(3)                       # member of gang 2
+        deadline = time.monotonic() + 5
+        while s.alive_replicas() != {0} and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert s.alive_replicas() == {0}
+        assert s.dead_replicas() == {2, 3}
+    finally:
+        s.stop()
+
+
+def test_autoscaler_weights_capacity_by_gang_devices():
+    """A tp=4 gang counts 4 capacity units in the up-pressure signal:
+    the same queue depth that would scale a 4-replica tier up must NOT
+    scale a single 4-device gang tier up at 4x the per-unit threshold,
+    and vice versa must once the weighted threshold is crossed."""
+    from tensorflowonspark_tpu.serving import Autoscaler
+
+    fake = _FakeServing(replicas=1)
+    # graft gang weight onto the fake's metrics
+    base_metrics = fake.scheduler.metrics
+
+    def metrics():
+        m = base_metrics()
+        for r in m["replicas"].values():
+            r["weight"] = 4
+        return m
+
+    fake.scheduler.metrics = metrics
+    a = Autoscaler(fake, min_replicas=1, max_replicas=3,
+                   up_queue_per_replica=4.0, up_consecutive=1,
+                   up_cooldown=0.0)
+    fake.queued = 9        # 9 > 4*1 endpoint, but NOT > 4*4 devices
+    s = a.sample()
+    assert s["capacity"] == 4
+    assert a.decide(s, now=1.0)[0] == "hold"
+    fake.queued = 17       # 17 > 4 units x 4/unit: genuine overload
+    d, reason = a.decide(a.sample(), now=2.0)
+    assert d == "up" and "capacity" in reason
+
+
 # ------------------------------------------------------ autoscaler units
 
 class _FakeServing:
@@ -1159,6 +1286,127 @@ def test_autoscaler_ramp_soak_with_replace_chaos(tmp_path, worker_env):
         assert "replica_retired" in kinds
     finally:
         serving.shutdown(timeout=300)
+
+
+# --------------------------------------------- sharded gang integration
+
+def _sharded_oracle(prompt, n, seed=0):
+    import jax.numpy as jnp
+
+    from tests.cluster_funcs import serving_sharded_gpt_builder
+
+    from tensorflowonspark_tpu.models import greedy_generate
+
+    cfg, params = serving_sharded_gpt_builder({"seed": seed})
+    out = greedy_generate(cfg, params,
+                          jnp.asarray(prompt, jnp.int32)[None, :], n)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _run_sharded_serving(tmp_path, num_replicas=1, chaos=None, **kw):
+    from tests.cluster_funcs import serving_sharded_gpt_builder
+
+    from tensorflowonspark_tpu.serving import ServingCluster
+
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+    if chaos:
+        env["TFOS_CHAOS"] = chaos
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("reservation_timeout", 120)
+    return ServingCluster.run(
+        serving_sharded_gpt_builder, num_replicas, mesh={"tp": 2},
+        worker_env=env, working_dir=str(tmp_path), **kw)
+
+
+@pytest.mark.integration
+def test_sharded_gang_serves_oracle_exact(tmp_path):
+    """Acceptance: one tp=2 gang (leader + barrier member over real
+    worker processes) serves concurrent streams greedy-exact vs the solo
+    oracle, registers as ONE weighted endpoint, and shuts down clean."""
+    serving = _run_sharded_serving(tmp_path)
+    try:
+        m = serving.scheduler.metrics()
+        assert m["gang_size"] == 2 and m["capacity_devices"] == 2
+        assert m["replicas"][0]["members"] == [1]
+        rng = np.random.default_rng(3)
+        reqs = _requests(rng, 6, vocab=64)
+        results: dict[int, list] = {}
+        errors: list = []
+
+        def run_client(cid):
+            try:
+                with serving.client() as c:
+                    for i in range(cid, len(reqs), 2):
+                        p, n = reqs[i]
+                        results[i] = c.generate(p, n, timeout=180).tolist()
+            except Exception as e:                      # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=run_client, args=(cid,))
+                   for cid in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(240)
+        assert not errors, errors
+        for i, (p, n) in enumerate(reqs):
+            assert results[i] == _sharded_oracle(p, n), \
+                f"request {i} diverged from the solo oracle"
+        m = serving.metrics()
+        assert m["failed"] == 0 and m["completed"] == len(reqs)
+        assert serving.scheduler.dead_replicas() == set()
+    finally:
+        serving.shutdown(timeout=180)
+
+
+@pytest.mark.integration
+def test_sharded_gang_member_kill_fails_over_exact(tmp_path):
+    """Chaos: SIGKILL the NON-LEADER shard of gang 0 mid-stream (member
+    executor 1, at_step on ITS barrier-mirrored step counter).  The
+    whole gang must classify dead, its in-flight requests re-queue ONCE
+    to the surviving gang, every accepted request completes oracle-exact
+    (single-requeue skip-dedup), and shutdown tolerates the corpses."""
+    serving = _run_sharded_serving(tmp_path, num_replicas=2,
+                                   chaos="kill node=1 at_step=4")
+    try:
+        rng = np.random.default_rng(5)
+        reqs = _requests(rng, 8, vocab=64, bmin=10, bmax=16)
+        results: dict[int, list] = {}
+        errors: list = []
+
+        def run_client(cid):
+            try:
+                with serving.client() as c:
+                    for i in range(cid, len(reqs), 2):
+                        p, n = reqs[i]
+                        results[i] = c.generate(p, n, timeout=240).tolist()
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=run_client, args=(cid,))
+                   for cid in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        assert not errors, errors
+        for i, (p, n) in enumerate(reqs):
+            assert results[i] == _sharded_oracle(p, n), \
+                f"request {i} diverged across the gang failover"
+        m = serving.metrics()
+        assert m["failed"] == 0 and m["completed"] == len(reqs), m
+        assert m["requeued"] >= 1, "the chaos kill landed nowhere"
+        # ONE shard died; the WHOLE gang is the failure domain
+        assert serving.scheduler.dead_replicas() == {0, 1}, \
+            serving.scheduler.dead_replicas()
+        assert m["replicas"][2]["alive"]
+        events = _serving_events(tmp_path)
+        dead = [e for e in events if e["kind"] == "replica_dead"]
+        assert len(dead) == 1 and sorted(dead[0]["shards"]) == [0, 1], \
+            "gang death must be reported exactly once, naming its shards"
+    finally:
+        serving.shutdown(timeout=180)
 
 
 def _serving_events(tmp_path):

@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tensorflowonspark_tpu import compat
 from tensorflowonspark_tpu.parallel import make_mesh
 from tensorflowonspark_tpu.parallel.mesh import MeshSpec
 from tensorflowonspark_tpu.parallel.ring_attention import reference_attention
@@ -78,7 +79,7 @@ def test_ulysses_typoed_axis_fails_loudly_inside_shard_map():
     mesh = make_mesh(MeshSpec(sp=2), devices=jax.devices()[:2])
     q, k, v = _qkv(jax.random.key(4))
     spec = P(None, "sp", None, None)
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         lambda q, k, v: ulysses_attention(q, k, v, axis_name="sq_typo"),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     with pytest.raises((NameError, Exception), match="sq_typo|unbound"):

@@ -118,6 +118,57 @@ def sharded_model_builder(args):
     return cfg, params
 
 
+#: the prefix-heavy model is sized so a FULL system-prompt prefill costs
+#: visibly more than a tail prefill on CPU (the TTFT gate needs signal,
+#: not noise); --smoke shrinks it back to toy dims
+PREFIX_DIMS = {"vocab": 64, "hidden": 256, "layers": 4, "heads": 8,
+               "ffn": 1024, "max_len": 512}
+PREFIX_SMOKE_DIMS = {"vocab": VOCAB, "hidden": HIDDEN, "layers": LAYERS,
+                     "heads": HEADS, "ffn": 2 * HIDDEN, "max_len": MAXLEN}
+
+
+def prefix_model_builder(args):
+    """Replica-side model for the prefix-heavy scenarios; dims ride
+    ``args['prefix_dims']`` so --smoke can shrink them (top level so
+    multiprocessing spawn can pickle it by reference)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import GPT, GPTConfig
+
+    d = args["prefix_dims"]
+    cfg = GPTConfig(vocab_size=d["vocab"], hidden_size=d["hidden"],
+                    num_layers=d["layers"], num_heads=d["heads"],
+                    intermediate_size=d["ffn"],
+                    max_position_embeddings=d["max_len"],
+                    dtype=jnp.float32, pos_encoding="rope")
+    params = GPT(cfg).init(jax.random.key(int(args.get("seed", 0))),
+                           jnp.ones((1, 4), jnp.int32))["params"]
+    return cfg, params
+
+
+def _one_node_counter(rec: dict | None, name: str,
+                      outcome: str | None = None):
+    total = 0.0
+    fam = ((rec or {}).get("metrics") or {}).get(name)
+    for labels, value in (fam or {}).get("samples", ()):
+        if outcome is None or labels.get("outcome") == outcome:
+            total += value
+    return total
+
+
+def _node_counter_delta(nodes0: dict, nodes1: dict, name: str,
+                        outcome: str | None = None):
+    """Per-node counter delta summed over the nodes still reporting at
+    the end.  Diffing per node (not sum-vs-sum) keeps the arithmetic
+    honest when a node dies mid-window — a killed replica drops out of
+    the final snapshot, and subtracting its baseline from the
+    survivors' totals would go negative."""
+    return sum(_one_node_counter(rec, name, outcome)
+               - _one_node_counter(nodes0.get(eid), name, outcome)
+               for eid, rec in nodes1.items())
+
+
 def _run_load(serving, reqs, rate, rng):
     """Open-loop Poisson arrivals; returns per-request records."""
     from tensorflowonspark_tpu.serving import ServingError
@@ -269,9 +320,12 @@ def _sharded_oracle(tp, seed, reqs):
 
 
 def sharded_scenario(scenario, n_requests, rate, replicas, slots, tp,
-                     kill_step, seed=0):
+                     kill_step, seed=0, batcher_kwargs=None):
     """One sharded-gang serving run; gates enforced here, not by the
-    reader (the artifact script fails itself on any miss)."""
+    reader (the artifact script fails itself on any miss).
+    ``batcher_kwargs`` pass through to each gang leader's
+    ``ContinuousBatcher`` (the paged-KV prefix bench reuses this to run
+    a tp=2 gang in paged mode under the same oracle gate)."""
     import numpy as np
 
     from tensorflowonspark_tpu.serving import ServingCluster
@@ -298,7 +352,8 @@ def sharded_scenario(scenario, n_requests, rate, replicas, slots, tp,
 
     serving = ServingCluster.run(
         sharded_model_builder, replicas, max_batch=slots,
-        mesh={"tp": tp}, worker_env=worker_env, reservation_timeout=180)
+        mesh={"tp": tp}, worker_env=worker_env, reservation_timeout=180,
+        batcher_kwargs=dict(batcher_kwargs or {}))
     try:
         gang_size = serving.gang_spec.gang_size
         m0 = serving.scheduler.metrics()
@@ -353,6 +408,7 @@ def sharded_scenario(scenario, n_requests, rate, replicas, slots, tp,
     return {
         "scenario": scenario,
         "mesh": {"tp": tp},
+        "batcher_kwargs": dict(batcher_kwargs or {}),
         "gang_size": spec.gang_size,
         "devices_per_replica": spec.devices,
         "replicas": replicas,
@@ -402,6 +458,224 @@ def validate_sharded_artifact(out: dict) -> None:
             {"steady_tp1", "steady_tp2", "kill_shard"} <= scenarios):
         raise RuntimeError(f"artifact gate: full run needs the tp=1/tp=2 "
                            f"A/B and the kill-shard row, got {scenarios}")
+
+
+def prefix_scenario(scenario, *, prefix_on, n_requests, n_prefixes,
+                    sys_tokens, tail_tokens, budget, replicas, slots,
+                    page_tokens, pool_pages, rate, dims, kill_step=None,
+                    seed=0):
+    """One prefix-heavy serving run: ``n_prefixes`` distinct system
+    prompts of ``sys_tokens`` tokens, ``n_requests`` requests round-
+    robined over them with unique ``tail_tokens``-token tails and equal
+    budgets (equal budgets keep slot churn in lockstep, so burst
+    admission shares batched tail prefills — the dispatch-amortization
+    gate measures the engine, not arrival jitter).  Paged KV on both
+    arms; ``prefix_on`` toggles ONLY the shared prefix cache, so the
+    A/B isolates cross-request reuse.  Returns the artifact row; the
+    caller enforces the cross-row gates."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.serving import ServingCluster
+
+    worker_env = {"JAX_PLATFORMS": "cpu"}
+    if kill_step is not None:
+        worker_env["TFOS_CHAOS"] = f"kill node=1 at_step={kill_step}"
+    rng = np.random.default_rng(seed)
+    systems = [rng.integers(0, dims["vocab"], (sys_tokens,))
+               .astype(np.int32) for _ in range(n_prefixes)]
+    reqs = [(np.concatenate([systems[i % n_prefixes],
+                             rng.integers(0, dims["vocab"],
+                                          (tail_tokens,))
+                             .astype(np.int32)]), budget)
+            for i in range(n_requests)]
+
+    serving = ServingCluster.run(
+        prefix_model_builder, replicas, max_batch=slots,
+        batcher_kwargs={"kv_page_tokens": page_tokens,
+                        "kv_pool_pages": pool_pages,
+                        "prefix_cache": prefix_on},
+        replica_args={"prefix_dims": dims},
+        max_queue_depth=4 * n_requests,
+        worker_env=worker_env, reservation_timeout=180)
+    try:
+        # Warmup, two jobs: (1) pay every prefill-bucket compile —
+        # (full-prompt bucket, group) AND (tail bucket, group) — outside
+        # the measured window via THROWAWAY prefixes, so the window
+        # measures prefill work, not XLA; (2) seed the REAL system
+        # prompts into the prefix index (one request each, serialized),
+        # because the steady state this bench models is a fleet that
+        # has already served each system prompt at least once.  The OFF
+        # arm runs the identical warmup (same compiles, same traffic —
+        # its index just never matches), so the A/B isolates reuse.
+        def _gen(prompt):
+            with serving.client() as c:
+                c.generate(prompt, 2, timeout=600)
+
+        def _wave(prompts):
+            ts = [threading.Thread(target=_gen, args=(p,))
+                  for p in prompts]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(600)
+
+        def _throwaway():
+            return rng.integers(0, dims["vocab"], (sys_tokens,)) \
+                .astype(np.int32)
+
+        def _with_tail(sys_p):
+            return np.concatenate(
+                [sys_p, rng.integers(0, dims["vocab"], (tail_tokens,))
+                 .astype(np.int32)])
+
+        if kill_step is None:
+            _wave([_with_tail(_throwaway())])      # solo full-prefill
+            for _ in range(2 * max(1, replicas)):  # grouped full-prefill
+                _wave([_with_tail(_throwaway()) for _ in range(slots)])
+            hot = _throwaway()                     # tail-bucket shapes
+            _wave([_with_tail(hot)])
+            _wave([_with_tail(hot) for _ in range(slots)])
+            for sys_p in systems:                  # seed the real prompts
+                _wave([_with_tail(sys_p)])
+        else:
+            # chaos row: the kill fires at decode step `kill_step` of
+            # node 1, which must land in the MEASURED window — keep the
+            # warmup to one short compile-payer per replica (this row
+            # gates zero-loss/oracle/requeue, not latency)
+            _wave([rng.integers(0, dims["vocab"], (5,)).astype(np.int32)
+                   for _ in range(replicas)])
+        time.sleep(2.5)               # heartbeat carries the snapshots
+        m0 = serving.metrics()
+        t0 = time.monotonic()
+        records = _run_load(serving, reqs, rate, rng)
+        wall = time.monotonic() - t0
+        time.sleep(2.5)
+        m1 = serving.metrics()
+        sched = {k: m1[k] - m0[k] for k in
+                 ("accepted", "completed", "shed", "failed", "requeued")}
+        eng = {}
+        for key, name, outcome in (
+                ("prefill_dispatches",
+                 "tfos_replica_prefill_dispatches_total", None),
+                ("decode_dispatches",
+                 "tfos_replica_decode_dispatches_total", None),
+                ("decode_steps", "tfos_replica_steps_total", None),
+                ("prefix_hit",
+                 "tfos_replica_prefix_cache_requests_total", "hit"),
+                ("prefix_miss",
+                 "tfos_replica_prefix_cache_requests_total", "miss"),
+                ("prefix_partial",
+                 "tfos_replica_prefix_cache_requests_total", "partial")):
+            eng[key] = int(_node_counter_delta(m0["nodes"], m1["nodes"],
+                                               name, outcome))
+        free_pages = [rep.get("free_pages", 0)
+                      for rep in m1["replicas"].values()
+                      if rep.get("alive")]
+    finally:
+        serving.shutdown(timeout=300)
+
+    ok = [r for r in records if r and r["ok"]]
+    failed = [r for r in records if r and not r["ok"]]
+    if failed or len(ok) != n_requests:
+        raise RuntimeError(
+            f"{scenario}: {len(failed)} accepted request(s) failed / "
+            f"{n_requests - len(ok)} lost — the zero-loss gate")
+    # locked-vs-solo greedy oracle: hit path and miss path alike must be
+    # token-identical to a dense solo decode of the same request
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import greedy_generate
+
+    cfg, params = prefix_model_builder({"seed": seed,
+                                        "prefix_dims": dims})
+    for i, ((p, n), r) in enumerate(zip(reqs, records)):
+        want = np.asarray(greedy_generate(
+            cfg, params, jnp.asarray(p)[None, :], n))[0, len(p):]
+        if r["out"] != want.tolist():
+            raise RuntimeError(
+                f"{scenario}: request {i} diverged from the solo greedy "
+                f"oracle (prefix_cache={prefix_on}) — the locked-oracle "
+                "gate")
+    if kill_step is not None and sched["requeued"] < 1:
+        raise RuntimeError(f"{scenario}: nothing was requeued — the "
+                           "chaos kill landed nowhere?")
+    tokens = sum(r["tokens"] for r in ok)
+    return {
+        "scenario": scenario,
+        "prefix_cache": bool(prefix_on),
+        "requests": {
+            "offered": n_requests, "accepted": sched["accepted"],
+            "completed": len(ok), "shed": sched["shed"],
+            "failed": sched["failed"], "requeued": sched["requeued"],
+            "lost": 0,
+        },
+        "oracle_exact": True,
+        "engine": eng,
+        "kv_pages_free": free_pages,
+        "tokens_total": tokens,
+        "wall_secs": round(wall, 3),
+        "throughput_tokens_per_s": round(tokens / wall, 2),
+        "throughput_requests_per_s": round(len(ok) / wall, 2),
+        "ttft": _percentiles([r["ttft"] for r in ok
+                              if r["ttft"] is not None]),
+        "e2e": _percentiles([r["e2e"] for r in ok]),
+    }
+
+
+PREFIX_ROW_KEYS = frozenset({
+    "scenario", "prefix_cache", "requests", "oracle_exact", "engine",
+    "kv_pages_free", "tokens_total", "wall_secs",
+    "throughput_tokens_per_s", "throughput_requests_per_s", "ttft",
+    "e2e"})
+
+
+def validate_prefix_artifact(out: dict) -> None:
+    """Schema + self-failing gates for ``prefix_serving.json``
+    (``ci.sh --bench-smoke`` runs this on the --smoke artifact too)."""
+    if out.get("benchmark") != "prefix_serving":
+        raise RuntimeError("artifact gate: wrong benchmark name")
+    rows = {row.get("scenario"): row for row in out.get("rows") or []}
+    if not rows:
+        raise RuntimeError("artifact gate: no rows")
+    for name, row in rows.items():
+        if name == "paged_sharded_tp2":
+            continue            # sharded-row schema has its own keys
+        missing = PREFIX_ROW_KEYS - set(row)
+        if missing:
+            raise RuntimeError(f"artifact gate: row {name} missing keys "
+                               f"{sorted(missing)}")
+        if not row["oracle_exact"] or row["requests"]["lost"] != 0 \
+                or row["requests"]["failed"] != 0:
+            raise RuntimeError(f"artifact gate: row {name} violates the "
+                               "zero-loss/oracle gates")
+    on = rows.get("prefix_on")
+    if on is None:
+        raise RuntimeError("artifact gate: no prefix_on row")
+    if on["engine"]["prefix_hit"] + on["engine"]["prefix_partial"] < 1:
+        raise RuntimeError("artifact gate: the prefix cache never hit")
+    smoke = bool(out.get("config", {}).get("smoke"))
+    if smoke:
+        return                  # speed gates advisory in smoke mode
+    if not {"prefix_on", "prefix_off", "prefix_kill",
+            "paged_sharded_tp2"} <= set(rows):
+        raise RuntimeError(f"artifact gate: full run needs the on/off "
+                           f"A/B, the kill row and the tp=2 sharded row,"
+                           f" got {sorted(rows)}")
+    gates = out.get("gates") or {}
+    n = on["requests"]["completed"]
+    disp = on["engine"]["prefill_dispatches"]
+    if not disp or disp >= 0.5 * n:
+        raise RuntimeError(
+            f"artifact gate: prefill amortization missed — "
+            f"{disp} prefill dispatches for {n} requests (need < 0.5x)")
+    p50_on = on["ttft"]["p50_secs"]
+    p50_off = rows["prefix_off"]["ttft"]["p50_secs"]
+    if p50_on is None or p50_off is None or p50_on > 0.75 * p50_off:
+        raise RuntimeError(
+            f"artifact gate: TTFT win missed — p50 {p50_on} (cache on) "
+            f"vs {p50_off} (off); need >= 25% lower")
+    if gates.get("ttft_p50_win_pct") is None:
+        raise RuntimeError("artifact gate: gates summary missing")
 
 
 def ramp_scenario(n_requests, base_rate, slots, replace_step, seed=0,
@@ -591,12 +865,95 @@ def main():
                     help="run the mesh-sharded gang scenarios instead "
                          "(tp=1 vs tp=2 A/B + kill-one-shard); writes "
                          "bench_artifacts/sharded_serving.json")
+    ap.add_argument("--prefix-heavy", action="store_true",
+                    help="run the paged-KV prefix-cache scenarios "
+                         "instead (M distinct system prompts x N "
+                         "requests; cache on/off A/B + chaos kill + a "
+                         "paged tp=2 gang); writes "
+                         "bench_artifacts/prefix_serving.json")
     ap.add_argument("--smoke", action="store_true",
-                    help="with --sharded: one small 2-device tp gang + "
+                    help="with --sharded / --prefix-heavy: a tiny run + "
                          "artifact schema validation (the ci.sh "
-                         "--bench-smoke gate)")
+                         "--bench-smoke gates; prefix speed gates are "
+                         "advisory in smoke)")
     args = ap.parse_args()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.prefix_heavy:
+        if not args.smoke:
+            # the full run ends with a tp=2 sharded gang whose driver-
+            # side solo oracle needs 2 simulated devices — the flag must
+            # land BEFORE the first in-process jax use (the prefix
+            # rows' oracles), or the backend pins to 1 device
+            if "--xla_force_host_platform_device_count" \
+                    not in os.environ.get("XLA_FLAGS", ""):
+                os.environ["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "") +
+                    " --xla_force_host_platform_device_count=2").strip()
+        rng_cfg = dict(page_tokens=16, pool_pages=512,
+                       n_prefixes=4, sys_tokens=384, tail_tokens=15,
+                       budget=12, slots=8, dims=PREFIX_DIMS)
+        if args.smoke:
+            rng_cfg = dict(page_tokens=8, pool_pages=None,
+                           n_prefixes=2, sys_tokens=24, tail_tokens=7,
+                           budget=6, slots=4, dims=PREFIX_SMOKE_DIMS)
+            rows = [prefix_scenario("prefix_on", prefix_on=True,
+                                    n_requests=8, replicas=1, rate=50.0,
+                                    **rng_cfg)]
+        else:
+            rows = [
+                prefix_scenario("prefix_on", prefix_on=True,
+                                n_requests=args.requests, replicas=1,
+                                rate=400.0, **rng_cfg),
+                prefix_scenario("prefix_off", prefix_on=False,
+                                n_requests=args.requests, replicas=1,
+                                rate=400.0, **rng_cfg),
+                prefix_scenario("prefix_kill", prefix_on=True,
+                                n_requests=max(16, args.requests // 2),
+                                replicas=2, rate=200.0,
+                                kill_step=args.kill_step, **rng_cfg),
+            ]
+            # paged/prefix mode under a tp=2 gang, same oracle gate as
+            # the sharded bench (CPU-simulated devices)
+            rows.append(sharded_scenario(
+                "paged_sharded_tp2", 8, 4.0, 1, 4, 2, None,
+                batcher_kwargs={"kv_page_tokens": 8}))
+        for row in rows:
+            print(json.dumps(row, indent=2))
+        on = next(r for r in rows if r["scenario"] == "prefix_on")
+        off = next((r for r in rows if r["scenario"] == "prefix_off"),
+                   None)
+        gates = {
+            "prefill_dispatches_per_request": None
+            if not on["requests"]["completed"] else round(
+                on["engine"]["prefill_dispatches"]
+                / on["requests"]["completed"], 3),
+            "ttft_p50_win_pct": None if off is None else round(
+                100 * (1 - on["ttft"]["p50_secs"]
+                       / off["ttft"]["p50_secs"]), 1),
+        }
+        out = {
+            "benchmark": "prefix_serving",
+            "config": {
+                "backend": "LocalProcessBackend", "platform": "cpu",
+                "smoke": bool(args.smoke),
+                "requests": (8 if args.smoke else args.requests),
+                "workload": {k: v for k, v in rng_cfg.items()
+                             if k != "dims"},
+                "model": rng_cfg["dims"],
+                "kill_plan": None if args.smoke
+                else f"kill node=1 at_step={args.kill_step}",
+            },
+            "gates": gates,
+            "rows": rows,
+        }
+        validate_prefix_artifact(out)
+        path = os.path.join(REPO, "bench_artifacts", "prefix_serving.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+        print(f"wrote {path} (all gates passed)")
+        return
 
     if args.sharded:
         # the driver-side solo oracle runs under the same tp mesh the

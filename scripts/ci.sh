@@ -53,6 +53,16 @@ if [ "${1:-}" = "--bench-smoke" ]; then
         echo "sharded serving bench smoke FAILED (rc=$rc)" >&2
         exit $rc
     fi
+    echo "== bench smoke (paged-KV prefix cache) =="
+    # paged decode + shared prefix cache behind a real replica: fails
+    # itself on the locked-oracle, prefix-hit, and schema gates (speed
+    # gates advisory in smoke)
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py --prefix-heavy --smoke
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "prefix serving bench smoke FAILED (rc=$rc)" >&2
+        exit $rc
+    fi
     exit 0
 fi
 

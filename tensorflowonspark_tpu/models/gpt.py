@@ -106,6 +106,23 @@ class GPTConfig:
     # Decode-path only; mutually exclusive with rolling_kv_cache (the
     # rolling slot math assumes one shared write position).
     per_row_positions: bool = False
+    # PAGED decode KV cache (vLLM-style): instead of a dense
+    # ``[B, max_len]`` K/V block per layer, allocate a POOL of
+    # ``kv_pool_pages`` fixed-size pages of ``kv_page_tokens`` tokens
+    # (``[pool_pages * page_tokens, Hkv, D]`` per layer — the head axis
+    # keeps its tp sharding) plus a per-row ``block_table`` cache
+    # variable mapping logical page -> physical page.  Each step WRITES
+    # through the table (positions past a row's allocated pages, or past
+    # max_position_embeddings, are dropped — the unallocated sentinel
+    # entry is ``kv_pool_pages``, out of pool range) and READS the full
+    # logical view back with ONE page gather, after which attention is
+    # the identical per-row masked einsum — so paged decode is
+    # token-exact vs the dense cache.  Page accounting (allocation,
+    # prefix sharing, refcounts) is host-side: ``models.kv_pages``.
+    # Decode-path only; needs per_row_positions; incompatible with
+    # rolling_kv_cache and kv_cache_int8.
+    kv_page_tokens: int | None = None
+    kv_pool_pages: int | None = None
 
     def __post_init__(self):
         if self.pos_encoding not in ("learned", "rope"):
@@ -128,6 +145,35 @@ class GPTConfig:
             raise ValueError(
                 "per_row_positions is incompatible with rolling_kv_cache "
                 "(rolling slot arithmetic assumes one shared position)")
+        if self.kv_page_tokens is not None:
+            pt = self.kv_page_tokens
+            if pt < 1 or pt & (pt - 1):
+                raise ValueError(f"kv_page_tokens must be a positive "
+                                 f"power of two, got {pt}")
+            if self.max_position_embeddings % pt:
+                raise ValueError(
+                    f"kv_page_tokens ({pt}) must divide "
+                    f"max_position_embeddings "
+                    f"({self.max_position_embeddings}) — the block table "
+                    "covers whole pages")
+            if self.kv_pool_pages is None or self.kv_pool_pages < 1:
+                raise ValueError(
+                    f"kv_page_tokens needs kv_pool_pages >= 1, got "
+                    f"{self.kv_pool_pages!r}")
+            if not self.per_row_positions:
+                raise ValueError(
+                    "kv_page_tokens needs per_row_positions (the block "
+                    "table is per-row; ContinuousBatcher sets both)")
+            if self.rolling_kv_cache:
+                raise ValueError("kv_page_tokens is incompatible with "
+                                 "rolling_kv_cache")
+            if self.kv_cache_int8:
+                raise ValueError(
+                    "kv_page_tokens is incompatible with kv_cache_int8 "
+                    "(the paged pool stores full-precision K/V; drop one "
+                    "of the two)")
+        elif self.kv_pool_pages is not None:
+            raise ValueError("kv_pool_pages needs kv_page_tokens")
         if self.pos_encoding == "rope" and self.head_dim % 2:
             raise ValueError(
                 f"rope needs an even head_dim, got {self.head_dim} "
@@ -218,31 +264,71 @@ class CausalSelfAttention(nn.Module):
             rolling = cfg.rolling_kv_cache
             C = min(L, cfg.sliding_window) if rolling else L
             idx = ci.value
+            paged = cfg.kv_page_tokens is not None
+            if paged:
+                # Paged pool: per-layer K/V is [P*pt, Hkv, D]; the per-row
+                # block table (a cache variable, written host-side by the
+                # batcher's admission scatter) maps logical page -> physical
+                # page, sentinel P = unallocated.  Writes route each
+                # position through the table and DROP out-of-range ones
+                # (unallocated page, or position >= max_len — e.g. a
+                # parked/finished row whose counter sits at C, or a
+                # speculative verify overshooting its budget); reads
+                # gather the row's full logical view [B, C, Hkv, D] back
+                # in ONE page gather (the sentinel clamps to garbage the
+                # positional mask hides), after which the shared per-row
+                # mask + grouped attention below apply unchanged — only
+                # the store/gather substrate differs from dense.
+                pt = cfg.kv_page_tokens
+                P = cfg.kv_pool_pages
+                npg = C // pt
+                cbt = self.variable(
+                    "cache", "block_table",
+                    lambda: jnp.full((B, npg), P, jnp.int32))
 
-            def store(ref, x):
-                """Write positions idx..idx+T-1 (keeping only the last C
-                under rolling; slot indices stay unique so the scatter is
-                well-defined).  Per-row mode scatters each row at its own
-                offset."""
-                Tw = x.shape[1]
-                if per_row:
-                    rows = jnp.arange(B)[:, None]
-                    slots = idx[:, None] + jnp.arange(Tw)[None, :]
-                    ref.value = ref.value.at[rows, slots].set(x)
+                def store(ref, x):
+                    Tw = x.shape[1]
+                    pos = idx[:, None] + jnp.arange(Tw)[None, :]  # [B, Tw]
+                    page = jnp.take_along_axis(
+                        cbt.value, jnp.clip(pos // pt, 0, npg - 1), axis=1)
+                    phys = jnp.where(pos < C, page * pt + pos % pt, P * pt)
+                    ref.value = ref.value.at[phys].set(
+                        x.astype(ref.value.dtype), mode="drop")
+                    pool = ref.value.reshape(P, pt, *ref.value.shape[1:])
+                    return pool[cbt.value].reshape(B, C,
+                                                   *ref.value.shape[1:])
+            else:
+                def store(ref, x):
+                    """Write positions idx..idx+T-1 (keeping only the last
+                    C under rolling; slot indices stay unique so the
+                    scatter is well-defined).  Per-row mode scatters each
+                    row at its own offset."""
+                    Tw = x.shape[1]
+                    if per_row:
+                        rows = jnp.arange(B)[:, None]
+                        slots = idx[:, None] + jnp.arange(Tw)[None, :]
+                        ref.value = ref.value.at[rows, slots].set(x)
+                        return ref.value
+                    if not rolling:
+                        ref.value = jax.lax.dynamic_update_slice(
+                            ref.value, x, (0, idx, 0, 0))
+                        return ref.value
+                    if Tw > C:
+                        x = x[:, Tw - C:]
+                        slots = (idx + Tw - C + jnp.arange(C)) % C
+                    else:
+                        slots = (idx + jnp.arange(Tw)) % C
+                    ref.value = ref.value.at[:, slots].set(x)
                     return ref.value
-                if not rolling:
-                    ref.value = jax.lax.dynamic_update_slice(
-                        ref.value, x, (0, idx, 0, 0))
-                    return ref.value
-                if Tw > C:
-                    x = x[:, Tw - C:]
-                    slots = (idx + Tw - C + jnp.arange(C)) % C
-                else:
-                    slots = (idx + jnp.arange(Tw)) % C
-                ref.value = ref.value.at[:, slots].set(x)
-                return ref.value
 
-            if cfg.kv_cache_int8:
+            if paged:
+                ck = self.variable("cache", "k", jnp.zeros,
+                                   (P * pt, Hkv, D), cfg.dtype)
+                cv = self.variable("cache", "v", jnp.zeros,
+                                   (P * pt, Hkv, D), cfg.dtype)
+                k_all = store(ck, k.astype(cfg.dtype))
+                v_all = store(cv, v.astype(cfg.dtype))
+            elif cfg.kv_cache_int8:
                 # int8 values + fp32 scale per (batch, position, head);
                 # symmetric over D.  Dequant happens inside the attention
                 # einsum reads, so HBM sees int8 only.
@@ -452,12 +538,18 @@ class GPT(nn.Module):
 
 
 def init_cache(cfg: GPTConfig, params, batch: int):
-    """Allocate the static KV cache by tracing one dummy decode step."""
+    """Allocate the static KV cache by tracing one dummy decode step.
+    Under ``kv_page_tokens`` the per-layer ``block_table`` leaves start
+    at the unallocated sentinel (``kv_pool_pages``) — zeroing them would
+    alias every row onto physical page 0."""
     model = GPT(cfg, decode=True)
     _, vars_ = model.apply(
         {"params": params}, jnp.zeros((batch, 1), jnp.int32),
         mutable=["cache"])
-    return jax.tree.map(jnp.zeros_like, vars_["cache"])
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: jnp.full_like(leaf, cfg.kv_pool_pages)
+        if any(getattr(k, "key", None) == "block_table" for k in path)
+        else jnp.zeros_like(leaf), vars_["cache"])
 
 
 def rewind_cache(cache, position):

@@ -42,6 +42,16 @@ regime continuous batching exists for.  The decode loop itself is
 plain Python — admission decisions are host-side control flow,
 exactly what should NOT be traced.
 
+With ``kv_page_tokens`` the cache substrate goes PAGED (vLLM-shaped):
+per-layer K/V pools behind per-row block tables (``models/gpt.py``),
+host-side page accounting with a refcounted shared-prefix index
+(``models/kv_pages.py``), admission tied to free PAGES instead of free
+slots, and prefix-hit requests prefilling only their tails — the
+fused ``_prefill_paged`` executable prefills, selects first tokens,
+and scatters block tables + counters in one dispatch.  Same O(log)
+executable-count discipline, same output contract (docs/serving.md
+"KV paging & prefix cache").
+
 Output contract (locked by ``tests/test_serving.py``): a request's
 tokens are a pure function of its own (params, prompt, budget,
 temperature, top_p, seed) — never of admission order, slot reuse, or
@@ -64,6 +74,7 @@ import numpy as np
 
 from tensorflowonspark_tpu.models.gpt import (GPT, GPTConfig, init_cache,
                                               nucleus_filter, rewind_cache)
+from tensorflowonspark_tpu.models.kv_pages import KVPagePool
 
 
 def _next_pow2(n: int) -> int:
@@ -78,6 +89,7 @@ class _Slot:
     temperature: float = 0.0                    # 0 = greedy
     top_p: float = 1.0
     seed: int = 0
+    lease: object = None                        # paged mode: PageLease
 
 
 def _decode_one_greedy(model, params, cache, tokens):
@@ -143,7 +155,10 @@ class ContinuousBatcher:
                  speculative_k: int | None = None,
                  speculative_ngram: int = 3,
                  speculative_window: int = 2048,
-                 decode_block_steps: int | None = None):
+                 decode_block_steps: int | None = None,
+                 kv_page_tokens: int | None = None,
+                 kv_pool_pages: int | None = None,
+                 prefix_cache: bool = True):
         if cfg.rolling_kv_cache:
             raise ValueError("ContinuousBatcher requires a full-length "
                              "cache (rolling_kv_cache=False)")
@@ -208,7 +223,37 @@ class ContinuousBatcher:
         #: O(prompt x max_len) — the chunk loop adds executables only for
         #: (one fixed chunk length + the bucketed final chunk)
         self.prefill_chunk = prefill_chunk
-        self.cfg = dataclasses.replace(cfg, per_row_positions=True)
+        #: PAGED KV mode (``kv_page_tokens`` set, a power of two): the
+        #: per-slot dense cache becomes a pool of ``kv_pool_pages``
+        #: fixed-size pages behind per-row block tables (``models/gpt``
+        #: device side, ``models/kv_pages`` host-side accounting), with
+        #: admission tied to FREE PAGES instead of free slots and —
+        #: unless ``prefix_cache=False`` — a refcounted shared-prefix
+        #: index so a request whose prompt starts like a cached one
+        #: skips straight to prefilling the tail.  Token-exact vs the
+        #: dense cache on hit and miss paths alike (the locked greedy
+        #: oracle covers both).
+        if kv_page_tokens is not None:
+            pt = int(kv_page_tokens)
+            per_req = -(-cfg.max_position_embeddings // pt)
+            # default pool = dense-equivalent capacity (every slot can
+            # hold a max-length request); smaller pools are legal — the
+            # memory lever — and ``submit`` rejects any single request
+            # the whole pool cannot hold, so admission stays live
+            pool_pages = (int(kv_pool_pages) if kv_pool_pages is not None
+                          else int(max_batch) * per_req)
+            # dataclass validation (pow2, divisibility, int8/rolling
+            # conflicts) happens in GPTConfig.__post_init__
+            self.cfg = dataclasses.replace(
+                cfg, per_row_positions=True, kv_page_tokens=pt,
+                kv_pool_pages=pool_pages)
+            self._pages = KVPagePool(pool_pages, pt,
+                                     prefix_cache=bool(prefix_cache))
+        else:
+            if kv_pool_pages is not None:
+                raise ValueError("kv_pool_pages needs kv_page_tokens")
+            self._pages = None
+            self.cfg = dataclasses.replace(cfg, per_row_positions=True)
         # prefill runs single-row, where per-row == scalar semantics; one
         # cfg keeps the two paths' traces structurally identical
         self.params = params
@@ -319,12 +364,34 @@ class ContinuousBatcher:
         for that admission, and ``total`` = active + pending — every live
         request counted exactly once.  ``has_free_slot()`` answers "may I
         submit"; this answers "how deep is the queue", which is what
-        least-loaded routing across replicas needs."""
+        least-loaded routing across replicas needs.
+
+        ``free_pages``/``total_pages`` surface KV memory pressure in
+        paged mode (``kv_page_tokens``): free counts allocatable pages
+        RIGHT NOW (free + evictable cached prefix pages) — the signal
+        ``serve_replica`` forwards so the scheduler's least-outstanding
+        routing can tie-break away from memory-starved replicas.  Both
+        are 0 for a dense-cache batcher (no pressure signal: every
+        replica ties equal)."""
         active = sum(s is not None for s in self.slots)
         pending = len(self._pending) + (1 if self._inflight is not None
                                         else 0)
+        pages = self._pages
         return {"active": active, "pending": pending,
-                "reserved": len(self._reserved), "total": active + pending}
+                "reserved": len(self._reserved), "total": active + pending,
+                "free_pages": 0 if pages is None else pages.free_pages(),
+                "total_pages": 0 if pages is None else pages.total_pages}
+
+    def prefix_stats(self) -> dict:
+        """Prefix-cache admission outcomes (zeros for a dense batcher):
+        ``hit`` = every shareable prompt page was already cached,
+        ``partial`` = some were, ``miss`` = none; plus ``evictions`` and
+        the page-capacity gauges — the source for the replica-side
+        ``tfos_replica_prefix_cache_requests_total`` metrics."""
+        if self._pages is None:
+            return {"hit": 0, "miss": 0, "partial": 0, "evictions": 0,
+                    "free_pages": 0, "cached_pages": 0, "total_pages": 0}
+        return self._pages.stats()
 
     # -- admission ---------------------------------------------------------
     def has_free_slot(self) -> bool:
@@ -378,6 +445,16 @@ class ContinuousBatcher:
                 f"({max_new_tokens}) = {total} exceeds "
                 f"max_position_embeddings "
                 f"({self.cfg.max_position_embeddings})")
+        if self._pages is not None \
+                and self._pages.pages_needed(total) > self._pages.total_pages:
+            # liveness guard: a request the WHOLE pool cannot hold would
+            # wait at the head of the queue forever (prefix sharing
+            # could shrink its need, but cached pages are evictable and
+            # cannot be promised at submit time)
+            raise ValueError(
+                f"request needs {self._pages.pages_needed(total)} KV "
+                f"pages ({total} tokens at {self._pages.page_tokens}/"
+                f"page) but the pool holds {self._pages.total_pages}")
         rid = next(self._ids)
         self._pending.append((rid, prompt, int(max_new_tokens),
                               float(temperature), float(top_p), int(seed)))
@@ -521,6 +598,8 @@ class ContinuousBatcher:
         with their slot reserved until the final chunk lands.  The loop
         repeats while finished-at-admission requests keep freeing
         slots."""
+        if self._pages is not None:
+            return self._admit_paged()
         done = []
         if self._inflight is not None:
             done.extend(self._advance_inflight())
@@ -594,11 +673,321 @@ class ContinuousBatcher:
                     self.slots[slot] = s
         return done
 
+    # -- paged admission (kv_page_tokens; docs/serving.md) -----------------
+    def _admit_paged(self) -> list[int]:
+        """Paged-mode admission (see :meth:`_admit` for the slot/burst
+        mechanics): each taken request first LEASES pages — a prefix-
+        index match plus freshly allocated tail pages — and a request
+        the pool cannot serve right now blocks the queue (strict-FIFO
+        page backpressure: pages free as running requests finish, so
+        the head admits eventually; ``submit`` already rejected
+        requests larger than the whole pool, so this cannot deadlock).
+        Burst grouping keys on the pow2 TAIL-length bucket — after its
+        prefix match a 10k-token prompt with a cached system prompt
+        shares the short-tail executable, which is the TTFT win."""
+        done: list[int] = []
+        if self._inflight is not None:
+            done.extend(self._advance_inflight_paged())
+        C = self.prefill_chunk
+        while self._pending:
+            free = [i for i, s in enumerate(self.slots)
+                    if s is None and i not in self._reserved]
+            if not free:
+                break
+            taken_idx: list[int] = []
+            whole = []                           # (req, lease)
+            blocked = False
+            for j, req in enumerate(self._pending):
+                if len(free) - len(whole) == 0:  # every free slot claimed
+                    break
+                prompt, budget = req[1], req[2]
+                # peek order matters: `prompt.size > C` first, so the
+                # hash-chain peek only runs for prompts that could even
+                # need chunking — not for every cache-hot short prompt
+                # on every step while an admission streams
+                if C is not None and self._inflight is not None \
+                        and prompt.size > C \
+                        and prompt.size - self._pages.match_tokens(prompt) \
+                        > C:
+                    # one chunked admission at a time; SKIP before
+                    # leasing (a trial lease's allocation could evict
+                    # cached prefix pages an immediate release cannot
+                    # restore) — shorts behind it still admit while the
+                    # first long prompt streams
+                    continue
+                lease = self._pages.admit(prompt, prompt.size + budget)
+                if lease is None:
+                    blocked = True
+                    break
+                if C is not None and prompt.size - lease.tail_start > C:
+                    if self._inflight is not None:
+                        # the peek said whole-prompt but the index moved
+                        # (shouldn't happen within one round); stay safe
+                        self._pages.release(lease)
+                        continue
+                    slot = free.pop()            # reserve from the tail
+                    self._reserved.add(slot)
+                    self._inflight = {"req": req, "slot": slot,
+                                      "lease": lease, "done_chunks": 0}
+                    taken_idx.append(j)
+                    # first slice; >= 1 full chunk precedes the final
+                    # call, so this cannot finish or emit a token
+                    self._advance_inflight_paged()
+                else:
+                    taken_idx.append(j)
+                    whole.append((req, lease))
+            if not taken_idx:
+                break
+            for j in reversed(taken_idx):
+                del self._pending[j]
+            groups: dict[int, list] = {}
+            for req, lease in whole:
+                Tp = min(_next_pow2(req[1].size - lease.tail_start),
+                         self.cfg.max_position_embeddings)
+                groups.setdefault(Tp, []).append((req, lease))
+            free_iter = iter(free)
+            admitted = []   # (slot, req-fields, first_token, lease)
+            for reqs in groups.values():
+                slots = [next(free_iter) for _ in reqs]
+                firsts = self._prefill_paged(
+                    [(req, lease, lease.tail_start)
+                     for req, lease in reqs], slots)
+                for j, (req, lease) in enumerate(reqs):
+                    rid, _, budget, temp, top_p, seed = req
+                    admitted.append((slots[j], (rid, budget, temp, top_p,
+                                                seed), int(firsts[j]),
+                                     lease))
+            for slot, (rid, budget, temp, top_p, seed), tok, lease \
+                    in admitted:
+                self._emit_token(rid, tok)
+                s = _Slot(request_id=rid, remaining=budget - 1,
+                          tokens=[tok], temperature=temp, top_p=top_p,
+                          seed=seed, lease=lease)
+                if s.remaining <= 0 or tok == self.eos_id:
+                    self._finish(slot, s)   # slot stays free; loop refills
+                    done.append(rid)
+                else:
+                    self.slots[slot] = s
+            if blocked:
+                break
+        return done
+
+    def _prefill_paged(self, entries, slots: list[int]) -> np.ndarray:
+        """THE paged prefill: one fused dispatch per admission group
+        that (1) prefills every row's TAIL tokens (positions after its
+        prefix-cache match) straight into the slot's leased pages via a
+        per-row block-table view over the shared pool — shared prefix
+        pages are only READ, the read-only/copy-on-write contract —
+        (2) selects each row's first token at its true last prompt
+        position, and (3) scatters the rows' block tables and rewound-
+        to-true-total counters into the batch cache: admission lands in
+        ONE executable per (pow2 tail bucket, pow2 group size), no side
+        cache, no separate scatter dispatch.
+
+        ``entries`` = ``[(req_tuple, lease, start)]`` where ``start`` is
+        the first prompt position fed here (the lease's tail start, or
+        past the already-streamed chunks for a chunked admission's
+        final call).  Pad rows carry all-sentinel block tables (their
+        writes drop) and slot ``max_batch`` (their scatter drops).
+        Commits every lease — prefix-index insertion — after the
+        dispatch, so only ALREADY-COMPUTED pages are ever matchable."""
+        cfgC = self.cfg.max_position_embeddings
+        P = self.cfg.kv_pool_pages
+        npg = cfgC // self.cfg.kv_page_tokens
+        Tp = min(_next_pow2(max(req[1].size - start
+                                for req, _, start in entries)), cfgC)
+        rp = _next_pow2(len(entries))
+        row_bt = np.full((rp, npg), P, np.int32)
+        row_start = np.zeros((rp,), np.int32)
+        tokens = np.zeros((rp, Tp), np.int32)
+        true_len = np.ones((rp,), np.int32)
+        true_tot = np.ones((rp,), np.int32)
+        slot_a = np.full((rp,), self.max_batch, np.int32)
+        seed_a = np.zeros((rp,), np.int32)
+        temp_a = np.zeros((rp,), np.float32)
+        top_a = np.ones((rp,), np.float32)
+        for j, (req, lease, start) in enumerate(entries):
+            _, prompt, _, temp, top_p, seed = req
+            tail = prompt[start:]
+            row_bt[j, :len(lease.page_ids)] = lease.page_ids
+            row_start[j] = start
+            tokens[j, :tail.size] = tail
+            true_len[j] = tail.size
+            true_tot[j] = prompt.size
+            slot_a[j] = slots[j]
+            seed_a[j] = seed
+            temp_a[j] = temp
+            top_a[j] = top_p
+        key = ("pfinal", Tp, rp)
+        if key not in self._prefill_jit:
+            model = self.model
+
+            def pfinal_fn(params, cache, tokens, row_bt, row_start,
+                          true_len, true_tot, slot_ids, seeds, temps,
+                          top_ps):
+                def rows(path, leaf):
+                    k = getattr(path[-1], "key", None)
+                    if k == "block_table":
+                        return jnp.broadcast_to(
+                            row_bt, leaf.shape[:-2] + row_bt.shape)
+                    if k in ("index", "pos"):
+                        return jnp.broadcast_to(
+                            row_start, leaf.shape[:-1] + row_start.shape
+                        ).astype(leaf.dtype)
+                    return leaf     # the shared pool
+
+                row_cache = jax.tree_util.tree_map_with_path(rows, cache)
+                logits, vars_ = model.apply(
+                    {"params": params, "cache": row_cache}, tokens,
+                    mutable=["cache"])
+                last = jnp.take_along_axis(
+                    logits, (true_len - 1)[:, None, None], axis=1)[:, 0]
+                first = _select_tokens(
+                    last, seeds, jnp.zeros_like(true_len), temps, top_ps)
+
+                def back(path, b_leaf, r_leaf):
+                    k = getattr(path[-1], "key", None)
+                    if k == "block_table":
+                        m = jnp.moveaxis(b_leaf, -2, 0)
+                        v = jnp.broadcast_to(
+                            row_bt.reshape((row_bt.shape[0],)
+                                           + (1,) * (m.ndim - 2)
+                                           + (row_bt.shape[-1],)),
+                            row_bt.shape[:1] + m.shape[1:])
+                        return jnp.moveaxis(
+                            m.at[slot_ids].set(v, mode="drop"), 0, -2)
+                    if k in ("index", "pos"):
+                        m = jnp.moveaxis(b_leaf, -1, 0)
+                        v = jnp.broadcast_to(
+                            true_tot.reshape(true_tot.shape
+                                             + (1,) * (m.ndim - 1)),
+                            true_tot.shape + m.shape[1:]).astype(m.dtype)
+                        return jnp.moveaxis(
+                            m.at[slot_ids].set(v, mode="drop"), 0, -1)
+                    return r_leaf   # pool leaves: take the prefill writes
+
+                return first, jax.tree_util.tree_map_with_path(
+                    back, cache, vars_["cache"])
+
+            self._prefill_jit[key] = jax.jit(pfinal_fn,
+                                             donate_argnums=(1,))
+        self.prefill_dispatches += 1
+        firsts, self.cache = self._prefill_jit[key](
+            self.params, self.cache, tokens, row_bt,
+            jnp.asarray(row_start), jnp.asarray(true_len),
+            jnp.asarray(true_tot), jnp.asarray(slot_a),
+            jnp.asarray(seed_a), jnp.asarray(temp_a), jnp.asarray(top_a))
+        for _, lease, _ in entries:
+            self._pages.commit(lease)
+        return np.asarray(firsts)
+
+    def _pchunk_jit(self):
+        """One fixed-chunk paged prefill executable: streams a chunk of
+        the in-flight admission's tail into its leased pages (batch
+        block tables/counters untouched — the slot only goes live at
+        the final :meth:`_prefill_paged` call)."""
+        C = self.prefill_chunk
+        key = ("pchunk", C)
+        if key not in self._prefill_jit:
+            model = self.model
+
+            def chunk_fn(params, cache, tokens_row, row_bt, start):
+                def rows(path, leaf):
+                    k = getattr(path[-1], "key", None)
+                    if k == "block_table":
+                        return jnp.broadcast_to(
+                            row_bt, leaf.shape[:-2] + row_bt.shape)
+                    if k in ("index", "pos"):
+                        return jnp.broadcast_to(
+                            start, leaf.shape[:-1] + start.shape
+                        ).astype(leaf.dtype)
+                    return leaf
+
+                row_cache = jax.tree_util.tree_map_with_path(rows, cache)
+                _, vars_ = model.apply(
+                    {"params": params, "cache": row_cache}, tokens_row,
+                    mutable=["cache"])
+                return jax.tree_util.tree_map_with_path(
+                    lambda p, b, r: b
+                    if getattr(p[-1], "key", None)
+                    in ("index", "pos", "block_table") else r,
+                    cache, vars_["cache"])
+
+            self._prefill_jit[key] = jax.jit(chunk_fn, donate_argnums=(1,))
+        return self._prefill_jit[key]
+
+    def _advance_inflight_paged(self) -> list[int]:
+        """Paged edition of :meth:`_advance_inflight`: chunk slices
+        stream the prompt tail straight into the slot's leased pages
+        (no side cache to scatter later), the bucketed final call goes
+        through :meth:`_prefill_paged`.  Same time-slicing contract —
+        one chunk per ``step()``, running slots never stall."""
+        inf = self._inflight
+        C = self.prefill_chunk
+        req = inf["req"]
+        rid, prompt, budget, temp, top_p, seed = req
+        lease = inf["lease"]
+        n_full = (prompt.size - lease.tail_start - 1) // C
+        i = inf["done_chunks"]
+        if i < n_full:
+            start = lease.tail_start + i * C
+            npg = self.cfg.max_position_embeddings \
+                // self.cfg.kv_page_tokens
+            row_bt = np.full((1, npg), self.cfg.kv_pool_pages, np.int32)
+            row_bt[0, :len(lease.page_ids)] = lease.page_ids
+            self.cache = self._pchunk_jit()(
+                self.params, self.cache, prompt[None, start:start + C],
+                row_bt, np.asarray([start], np.int32))
+            inf["done_chunks"] += 1
+            return []
+        slot = inf["slot"]
+        self._reserved.discard(slot)
+        firsts = self._prefill_paged(
+            [(req, lease, lease.tail_start + n_full * C)], [slot])
+        self._inflight = None
+        tok = int(firsts[0])
+        self._emit_token(rid, tok)
+        s = _Slot(request_id=rid, remaining=budget - 1, tokens=[tok],
+                  temperature=temp, top_p=top_p, seed=seed, lease=lease)
+        if s.remaining <= 0 or tok == self.eos_id:
+            self._finish(slot, s)
+            return [rid]
+        self.slots[slot] = s
+        return []
+
+    def _park_slot(self, i: int) -> None:
+        """Paged mode: a finished slot's pages return to the pool, but
+        the batch executables keep stepping every row — park the row by
+        setting its cache counters to max_len so its garbage writes hit
+        the position guard and DROP instead of landing in pages now
+        owned by someone else (the block-table row itself is replaced
+        wholesale at the slot's next admission)."""
+        key = ("park",)
+        if key not in self._prefill_jit:
+            Cmax = self.cfg.max_position_embeddings
+
+            def park_fn(cache, slot):
+                def f(path, leaf):
+                    if getattr(path[-1], "key", None) in ("index", "pos"):
+                        m = jnp.moveaxis(leaf, -1, 0)
+                        return jnp.moveaxis(m.at[slot].set(Cmax), 0, -1)
+                    return leaf
+                return jax.tree_util.tree_map_with_path(f, cache)
+
+            self._prefill_jit[key] = jax.jit(park_fn, donate_argnums=(0,))
+        self.cache = self._prefill_jit[key](self.cache,
+                                            jnp.asarray(i, jnp.int32))
+
     def _finish(self, i: int, s: _Slot) -> None:
         self._results[s.request_id] = np.asarray(s.tokens, np.int32)
         self._prompts.pop(s.request_id, None)
         self._on_token.pop(s.request_id, None)
         self.slots[i] = None
+        if self._pages is not None:
+            if s.lease is not None:
+                self._pages.release(s.lease)
+                s.lease = None
+            self._park_slot(i)
 
     # -- decode ------------------------------------------------------------
     def step(self) -> list[int]:

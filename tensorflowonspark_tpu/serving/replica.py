@@ -133,6 +133,57 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                            "Requests served to completion by this replica.")
     g_load = reg.gauge("tfos_replica_load_count",
                        "Batcher queue depth (active+pending+reserved).")
+    # engine counters the batcher already keeps, surfaced as heartbeat-
+    # carried metrics: tokens-per-dispatch (steps+tokens over dispatches)
+    # is the amortization ratio, spec proposed/accepted the speculation
+    # win, free pages + prefix outcomes the paged-KV story
+    m_disp = reg.counter(
+        "tfos_replica_decode_dispatches_total",
+        "Decode DISPATCHES (a scanned block or fused verify counts "
+        "once; compare tfos_replica_steps_total for the ratio).")
+    m_prefill = reg.counter(
+        "tfos_replica_prefill_dispatches_total",
+        "Prefill dispatches (a batched admission group counts once).")
+    m_spec = reg.counter(
+        "tfos_replica_spec_tokens_total",
+        "Speculative tokens by outcome (proposed/accepted).",
+        labelnames=("outcome",))
+    g_pages = reg.gauge(
+        "tfos_replica_kv_pages_free_count",
+        "Allocatable KV pages (free + evictable cached) in the paged "
+        "pool; 0 for a dense-cache batcher.")
+    m_prefix = reg.counter(
+        "tfos_replica_prefix_cache_requests_total",
+        "Prefix-cache admission outcomes (hit/miss/partial).",
+        labelnames=("outcome",))
+    last = {"decode_dispatches": 0, "prefill_dispatches": 0,
+            "spec_proposed": 0, "spec_accepted": 0,
+            "hit": 0, "miss": 0, "partial": 0}
+
+    def publish_engine_counters() -> None:
+        """Move the batcher's lifetime counters into the registry as
+        deltas (the registry is cumulative per process already)."""
+        for attr, inc in (("decode_dispatches", m_disp.inc),
+                          ("prefill_dispatches", m_prefill.inc)):
+            cur = getattr(batcher, attr, 0)
+            if cur > last[attr]:
+                inc(cur - last[attr])
+                last[attr] = cur
+        for attr, outcome in (("spec_proposed", "proposed"),
+                              ("spec_accepted", "accepted")):
+            cur = getattr(batcher, attr, 0)
+            if cur > last[attr]:
+                m_spec.inc(cur - last[attr], outcome=outcome)
+                last[attr] = cur
+        prefix_stats = getattr(batcher, "prefix_stats", None)
+        if prefix_stats is not None:
+            stats = prefix_stats()
+            for outcome in ("hit", "miss", "partial"):
+                if stats[outcome] > last[outcome]:
+                    m_prefix.inc(stats[outcome] - last[outcome],
+                                 outcome=outcome)
+                    last[outcome] = stats[outcome]
+
     tracer = tracing.tracer_for(ctx.working_dir)
 
     def busy() -> bool:
@@ -212,9 +263,13 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
             ctx.report_step(steps,
                             phase="preempted" if (draining or guard.preempted)
                             else "serving")
-            load = batcher.load()["total"]
+            ld = batcher.load()
+            load = ld["total"]
+            free_pages = int(ld.get("free_pages", 0))
             m_steps.inc()
             g_load.set(load)
+            g_pages.set(free_pages)
+            publish_engine_counters()
             for brid, toks in deltas.items():
                 rid, trace = rid_map[brid]
                 if brid not in first_sent:
@@ -224,7 +279,8 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                 m_tokens.inc(len(toks))
                 mgr.queue_put(RESPONSE_QUEUE,
                               {"rid": rid, "event": "tok",
-                               "tokens": toks, "load": load})
+                               "tokens": toks, "load": load,
+                               "free_pages": free_pages})
             deltas.clear()
             for brid in done:
                 batcher.result(brid, pop=True)  # tokens already streamed
@@ -234,7 +290,8 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                              replica=ctx.executor_id)
                 m_served.inc()
                 mgr.queue_put(RESPONSE_QUEUE,
-                              {"rid": rid, "event": "done", "load": load})
+                              {"rid": rid, "event": "done", "load": load,
+                               "free_pages": free_pages})
                 served += 1
             if step_hook is not None:
                 # gang barrier AFTER the step's deltas are flushed, so

@@ -264,6 +264,9 @@ class _Replica:
         self.weight = max(1, int(weight))
         self.outstanding: dict[int, ServeRequest] = {}
         self.reported_load = 0   # last ContinuousBatcher.load()["total"]
+        #: last self-reported allocatable KV pages (paged-KV replicas;
+        #: 0 for dense ones) — the memory-pressure routing tie-break
+        self.reported_free_pages = 0
         self.alive = True
         self.draining = False    # no NEW routes; in-flight runs out
         self.retired = False     # left cleanly — never counts as dead
@@ -770,6 +773,7 @@ class ReplicaScheduler:
                           "retired": rep.retired,
                           "outstanding": len(rep.outstanding),
                           "reported_load": rep.reported_load,
+                          "free_pages": rep.reported_free_pages,
                           "weight": rep.weight,
                           "members": list(rep.members),
                           "served": rep.served}
@@ -830,17 +834,20 @@ class ReplicaScheduler:
 
     def _pick_replica(self) -> _Replica | None:
         """Least-outstanding alive replica with spare in-flight capacity
-        (ties by last self-reported batcher load); None when saturated.
-        Draining replicas take no new work."""
+        (ties by last self-reported batcher load, then by KV-page
+        pressure — MORE free pages wins, so long prompts stop landing
+        on memory-starved replicas); None when saturated.  Draining
+        replicas take no new work."""
         best = None
+        best_key = None
         for rep in self.replicas.values():
             if not rep.alive or rep.draining \
                     or len(rep.outstanding) >= rep.max_inflight:
                 continue
-            key = (len(rep.outstanding), rep.reported_load)
-            if best is None or key < (len(best.outstanding),
-                                      best.reported_load):
-                best = rep
+            key = (len(rep.outstanding), rep.reported_load,
+                   -rep.reported_free_pages)
+            if best is None or key < best_key:
+                best, best_key = rep, key
         return best
 
     # -- dispatch ----------------------------------------------------------
@@ -929,6 +936,8 @@ class ReplicaScheduler:
         with self._lock:
             if "load" in msg:
                 rep.reported_load = int(msg["load"])
+            if "free_pages" in msg:
+                rep.reported_free_pages = int(msg["free_pages"])
             req = rep.outstanding.get(rid)
             if req is None or req.finished:
                 return          # abandoned, or replayed on another replica

@@ -816,14 +816,15 @@ def test_on_token_fires_before_finish_and_with_eos():
 def test_load_counts_every_live_request_once():
     cfg, params = _make()
     b = ContinuousBatcher(cfg, params, max_batch=2, prefill_chunk=4)
+    # dense mode: no page pool, so the memory-pressure gauges read 0
     assert b.load() == {"active": 0, "pending": 0, "reserved": 0,
-                        "total": 0}
+                        "total": 0, "free_pages": 0, "total_pages": 0}
     rng = np.random.default_rng(31)
     b.submit(rng.integers(0, cfg.vocab_size, (3,)).astype(np.int32), 6)
     b.submit(rng.integers(0, cfg.vocab_size, (18,)).astype(np.int32), 5)
     b.submit(rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32), 6)
     assert b.load() == {"active": 0, "pending": 3, "reserved": 0,
-                        "total": 3}
+                        "total": 3, "free_pages": 0, "total_pages": 0}
     b.step()
     # short prompt active; the long one is the in-flight chunked
     # admission (pending, with its slot reserved); the third queued
@@ -832,7 +833,230 @@ def test_load_counts_every_live_request_once():
     assert load["active"] >= 1 and load["reserved"] == 1, load
     b.run()
     assert b.load() == {"active": 0, "pending": 0, "reserved": 0,
-                        "total": 0}
+                        "total": 0, "free_pages": 0, "total_pages": 0}
+
+
+# -- paged KV + shared prefix cache (kv_page_tokens) ----------------------
+
+@pytest.mark.parametrize("pos_encoding", ["rope", "learned"])
+def test_paged_matches_solo_greedy(pos_encoding):
+    """Paged-KV decode (block-table pool instead of the dense cache) is
+    token-exact vs the solo greedy oracle across staggered mixed-length
+    requests — the locked contract, paged edition."""
+    cfg, params = _make(pos_encoding)
+    rng = np.random.default_rng(40)
+    reqs = [(rng.integers(0, cfg.vocab_size, (t,)).astype(np.int32), n)
+            for t, n in ((5, 7), (3, 12), (8, 4), (9, 9), (2, 6), (6, 1))]
+    b = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8)
+    rids = [b.submit(p, n) for p, n in reqs]
+    results = b.run()
+    for rid, (p, n) in zip(rids, reqs):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(cfg, params, p, n))
+    # pages all returned (free + still-cached prefix pages = the pool)
+    st = b.prefix_stats()
+    assert st["free_pages"] == st["total_pages"], st
+
+
+def test_paged_prefix_hit_skips_reprefill_and_stays_exact():
+    """Same-system-prompt requests: the first admission misses and
+    indexes its full prompt pages; later ones match the chain, prefill
+    only their tails, and stay greedy-exact.  A prompt diverging
+    MID-page matches only up to the divergence page (copy-on-write: it
+    prefills a private copy, the shared original is untouched — the
+    original must still hit afterwards)."""
+    cfg, params = _make()
+    rng = np.random.default_rng(41)
+    pre = rng.integers(0, cfg.vocab_size, (17,)).astype(np.int32)  # 2 pages
+    A = np.concatenate([pre, rng.integers(0, cfg.vocab_size,
+                                          (3,)).astype(np.int32)])
+    B = A.copy()
+    B[11] = (B[11] + 1) % cfg.vocab_size      # diverges inside page 2
+    b = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8)
+    ra = b.submit(A, 5)
+    b.run()
+    assert b.prefix_stats()["miss"] == 1
+    rb = b.submit(B, 5)
+    res = b.run()
+    np.testing.assert_array_equal(res[rb], _oracle(cfg, params, B, 5))
+    assert b.prefix_stats()["partial"] == 1   # shared page 1, private 2
+    ra2 = b.submit(A, 5)
+    res = b.run()
+    np.testing.assert_array_equal(res[ra2], _oracle(cfg, params, A, 5))
+    np.testing.assert_array_equal(b.result(ra), res[ra2])
+    assert b.prefix_stats()["hit"] == 1, b.prefix_stats()
+
+
+def test_paged_exhaustion_backpressures_then_drains_exact():
+    """A pool too small for the queue: admission blocks on free pages
+    (not free slots), requests wait their turn, every one completes
+    greedy-exact, and the pool leaks nothing."""
+    cfg, params = _make()
+    rng = np.random.default_rng(42)
+    b = ContinuousBatcher(cfg, params, max_batch=4, kv_page_tokens=8,
+                          kv_pool_pages=6)
+    # 30 tokens -> 4 pages each: only one fits at a time despite 4 slots
+    reqs = [(rng.integers(0, cfg.vocab_size, (10,)).astype(np.int32), 20)
+            for _ in range(3)]
+    rids = [b.submit(p, n) for p, n in reqs]
+    b.step()
+    assert sum(s is not None for s in b.slots) == 1, \
+        "page exhaustion must hold admissions back"
+    load = b.load()
+    assert load["pending"] == 2 and load["total_pages"] == 6, load
+    res = b.run()
+    for rid, (p, n) in zip(rids, reqs):
+        np.testing.assert_array_equal(res[rid], _oracle(cfg, params, p, n))
+    st = b.prefix_stats()
+    assert st["free_pages"] == st["total_pages"] == 6, st
+    assert all(s is None for s in b.slots)
+
+
+def test_paged_submit_rejects_requests_larger_than_the_pool():
+    cfg, params = _make()
+    b = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8,
+                          kv_pool_pages=3)   # 24 tokens max
+    with pytest.raises(ValueError, match="KV pages"):
+        b.submit(np.arange(20, dtype=np.int32) % cfg.vocab_size, 10)
+    rid = b.submit(np.arange(10, dtype=np.int32) % cfg.vocab_size, 10)
+    np.testing.assert_array_equal(
+        b.run()[rid], _oracle(cfg, params,
+                              np.arange(10, dtype=np.int32)
+                              % cfg.vocab_size, 10))
+
+
+def test_paged_eviction_under_pressure_then_reprefill_exact():
+    """Cached prefix pages are evicted (LRU, refcount 0 only) when the
+    pool runs dry; a later request for the evicted prefix re-prefills
+    from scratch and is still exact."""
+    cfg, params = _make()
+    rng = np.random.default_rng(43)
+    b = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8,
+                          kv_pool_pages=8)
+    A = rng.integers(0, cfg.vocab_size, (17,)).astype(np.int32)
+    b.submit(A, 4)
+    b.run()
+    for _ in range(3):          # churn: evicts A's cached pages
+        b.submit(rng.integers(0, cfg.vocab_size, (20,)).astype(np.int32),
+                 20)
+        b.run()
+    assert b.prefix_stats()["evictions"] > 0
+    ra = b.submit(A, 4)
+    np.testing.assert_array_equal(b.run()[ra], _oracle(cfg, params, A, 4))
+
+
+def test_paged_mixed_greedy_sampled_hit_and_miss_paths():
+    """Hit-vs-miss exactness under mixed traffic: greedy requests stay
+    oracle-exact and a sampled request is the same pure function of
+    (seed, temp, top_p) whether its prefix hits the cache, misses it,
+    or the batcher is dense."""
+    cfg, params = _make()
+    rng = np.random.default_rng(44)
+    pre = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    samp_p = np.concatenate([pre, rng.integers(0, cfg.vocab_size,
+                                               (4,)).astype(np.int32)])
+    greedy_p = np.concatenate([pre, rng.integers(0, cfg.vocab_size,
+                                                 (3,)).astype(np.int32)])
+
+    def run(paged, warm):
+        b = ContinuousBatcher(cfg, params, max_batch=2,
+                              **({"kv_page_tokens": 8} if paged else {}))
+        if warm:    # populate the prefix index so the next admits HIT
+            b.submit(np.concatenate(
+                [pre, np.asarray([1], np.int32)]), 2)
+            b.run()
+        rs = b.submit(samp_p, 8, temperature=0.8, top_p=0.9, seed=7)
+        rg = b.submit(greedy_p, 8)
+        res = b.run()
+        if warm:
+            st = b.prefix_stats()
+            assert st["hit"] >= 2, st
+        return res[rs], res[rg]
+
+    s_hit, g_hit = run(True, True)
+    s_miss, g_miss = run(True, False)
+    s_dense, g_dense = run(False, False)
+    np.testing.assert_array_equal(s_hit, s_dense)
+    np.testing.assert_array_equal(s_miss, s_dense)
+    np.testing.assert_array_equal(g_hit, g_dense)
+    np.testing.assert_array_equal(g_miss, g_dense)
+    np.testing.assert_array_equal(g_dense,
+                                  _oracle(cfg, params, greedy_p, 8))
+
+
+@pytest.mark.parametrize("kw", [{"prefill_chunk": 6},
+                                {"decode_block_steps": 8},
+                                {"speculative_k": 4}])
+def test_paged_composes_with_decode_regimes(kw):
+    """Paged KV under every decode regime (time-sliced chunked prefill,
+    scanned blocks, speculative verify): greedy-exact, including a
+    prefix-hit admission mid-composition."""
+    cfg, params = _make()
+    rng = np.random.default_rng(45)
+    pre = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    reqs = [(np.concatenate([pre, rng.integers(
+        0, cfg.vocab_size, (k,)).astype(np.int32)]), n)
+        for k, n in ((3, 8), (5, 6))]
+    reqs.append((np.tile(np.asarray([7, 11, 23], np.int32), 5), 10))
+    b = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8,
+                          **kw)
+    rids = [b.submit(p, n) for p, n in reqs]
+    results = b.run()
+    for rid, (p, n) in zip(rids, reqs):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(cfg, params, p, n))
+
+
+def test_paged_with_tp_sharded_params_under_mesh():
+    """Paged decode over Megatron-tp-sharded params on a 2-device mesh
+    (the pool's head axis shards with tp): greedy-exact vs the solo
+    sharded oracle, with a prefix hit in the mix."""
+    from tensorflowonspark_tpu.parallel import MeshSpec, make_mesh
+    from tensorflowonspark_tpu.parallel.sharding import flax_shardings
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                    intermediate_size=64, max_position_embeddings=48,
+                    dtype=jnp.float32, pos_encoding="rope")
+    params = GPT(cfg).init(jax.random.key(0),
+                           jnp.ones((1, 4), jnp.int32))["params"]
+    mesh = make_mesh(MeshSpec(tp=2, dp=1), devices=jax.devices()[:2])
+    abstract = jax.eval_shape(
+        lambda: GPT(cfg).init(jax.random.key(0), jnp.ones((1, 4), jnp.int32)))
+    sharded = jax.device_put(params,
+                             flax_shardings(mesh, abstract)["params"])
+
+    rng = np.random.default_rng(46)
+    pre = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    reqs = [(np.concatenate([pre, rng.integers(
+        0, cfg.vocab_size, (k,)).astype(np.int32)]), n)
+        for k, n in ((3, 8), (4, 6))]
+    with mesh:
+        b = ContinuousBatcher(cfg, sharded, max_batch=2, kv_page_tokens=8)
+        results = {}
+        for p, n in reqs:    # serialized so the second admission HITS
+            rid = b.submit(p, n)
+            results[rid] = b.run()[rid]
+        for rid, (p, n) in zip(sorted(results), reqs):
+            want = np.asarray(greedy_generate(
+                cfg, sharded, jnp.asarray(p)[None, :], n))[0, len(p):]
+            np.testing.assert_array_equal(results[rid], want)
+    assert b.prefix_stats()["hit"] >= 1
+
+
+def test_paged_validation():
+    cfg, params = _make()
+    with pytest.raises(ValueError, match="power of two"):
+        ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=6)
+    with pytest.raises(ValueError, match="divide"):
+        ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=32)
+    with pytest.raises(ValueError, match="kv_page_tokens"):
+        ContinuousBatcher(cfg, params, max_batch=2, kv_pool_pages=8)
+    with pytest.raises(ValueError, match="kv_pool_pages"):
+        ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8,
+                          kv_pool_pages=0)
+    cfg8, params8 = _make(kv_cache_int8=True)
+    with pytest.raises(ValueError, match="kv_cache_int8"):
+        ContinuousBatcher(cfg8, params8, max_batch=2, kv_page_tokens=8)
 
 
 def test_block_decode_validation():

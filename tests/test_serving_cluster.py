@@ -170,6 +170,37 @@ def test_scheduler_routes_and_completes():
         s.stop()
 
 
+def test_routing_tie_breaks_on_kv_page_pressure():
+    """Equal outstanding + equal reported load: the replica reporting
+    MORE free KV pages wins the route (memory pressure tie-break); both
+    primary keys still outrank it."""
+    from types import SimpleNamespace
+
+    world = _FakeWorld(2)
+    s = _scheduler(world)            # policy unit: never started
+    try:
+        a, b = s.replicas[0], s.replicas[1]
+        # replicas report page capacity on the response wire
+        s._handle_response(a, {"rid": None, "event": "",
+                               "load": 0, "free_pages": 2})
+        s._handle_response(b, {"rid": None, "event": "",
+                               "load": 0, "free_pages": 9})
+        assert s.metrics()["replicas"][1]["free_pages"] == 9
+        with s._lock:
+            assert s._pick_replica() is b
+        # fewer outstanding outranks page pressure...
+        b.outstanding[99] = SimpleNamespace(finished=True)
+        with s._lock:
+            assert s._pick_replica() is a
+        b.outstanding.clear()
+        # ...and so does lower self-reported load
+        a.reported_load, b.reported_load = 0, 3
+        with s._lock:
+            assert s._pick_replica() is a
+    finally:
+        s.stop()
+
+
 def test_scheduler_sheds_at_bounded_depth():
     world = _FakeWorld(1, token_delay=0.2)   # slow: backlog builds
     s = _scheduler(world, slots_per_replica=1, overcommit=1,

@@ -56,14 +56,30 @@ tp gang + artifact-schema validation, wired into ``scripts/ci.sh
 --bench-smoke``).
 
 ``--ramp`` runs the ELASTICITY scenario instead (docs/serving.md):
-a 1-replica tier with the metrics-driven autoscaler, an open-loop load
-that DOUBLES mid-window, a two-tenant mix (an unlimited ``quiet``
+a 1-replica tier with the metrics-driven autoscaler AND one warm
+standby (the scale-up PROMOTES instead of cold-booting), an open-loop
+load that DOUBLES mid-window, a two-tenant mix (an unlimited ``quiet``
 tenant + a token-bucketed ``noisy`` tenant whose overflow must shed as
 ``tenant_throttled``), and a chaos ``replace node=1`` reclaim of the
-scaled-up replica.  Writes ``bench_artifacts/elasticity.json`` with the
-scale-event timeline (reasons included), per-tenant accepted/shed
-counts, TTFT before/after the first scale-up, and the zero-loss
-accounting across the replace event.
+scaled-up replica.  The full ``--ramp`` run then adds the WARM-VS-COLD
+HEAL A/B (``heal_scenario``): two identical tiers each lose replica 1
+to a chaos SIGKILL mid-stream — one heals by cold spawn
+(``replace_failed``), one by warm-standby promotion + peer weight
+clone — and the run gates on the committed margin (warm
+decision-to-first-token <= 0.5x cold), zero lost requests, and
+oracle-exact streams across the promotion heal.  Writes
+``bench_artifacts/elasticity.json`` with the scale-event timeline
+(reasons included), per-tenant accepted/shed counts, TTFT
+before/after the first scale-up, ``scale_up_to_first_token`` /
+``time_from_kill_to_first_token`` / ``time_from_decision_to_first_
+token`` heal measurements, and the zero-loss accounting.
+
+``--warm`` is the CI smoke (``scripts/ci.sh --bench-smoke``): one warm
+tier (2 replicas + 1 standby), a chaos kill healed via promotion,
+gated on the cold-spawn floor (promotion ready < 3 s — under any cold
+boot's jax import alone) + schema validation; writes
+``bench_artifacts/elasticity_smoke.json`` so the committed full
+artifact is never clobbered by a smoke run.
 """
 
 import argparse
@@ -678,6 +694,245 @@ def validate_prefix_artifact(out: dict) -> None:
         raise RuntimeError("artifact gate: gates summary missing")
 
 
+#: committed heal-window gate: a warm promotion must restore first-token
+#: capacity in at most this fraction of the cold spawn's time
+HEAL_WARM_VS_COLD_RATIO = 0.5
+#: smoke-mode floor: a cold spawn cannot beat its own process boot +
+#: jax import + model build + compile (12.6 s measured on this box,
+#: multiple seconds anywhere); a warm promotion's decision-to-ready
+#: must land under it even on a noisy CI box (~1.2-1.7 s quiet)
+COLD_SPAWN_FLOOR_SECS = 5.0
+
+
+def heal_scenario(mode, n_requests, rate, slots, kill_step, seed=0,
+                  working_dir=None):
+    """One arm of the warm-vs-cold heal A/B: a 2-replica tier loses
+    replica 1 to a chaos SIGKILL mid-stream and HEALS — ``mode="cold"``
+    via ``replace_failed`` (full process boot + compile), ``mode="warm"``
+    via warm-standby promotion + peer weight clone.  Measures the heal
+    window from three clocks (chaos sentinel = the kill, ``heal_started``
+    = the tier's decision, first token ON THE REPLACEMENT = restored
+    capacity) and enforces the zero-loss/oracle gates itself."""
+    import tempfile
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import chaos
+    from tensorflowonspark_tpu.observability import EventLog
+    from tensorflowonspark_tpu.serving import ServingCluster
+
+    warm = mode == "warm"
+    working_dir = working_dir or tempfile.mkdtemp(
+        prefix=f"tfos_heal_{mode}_")
+    worker_env = {"JAX_PLATFORMS": "cpu",
+                  "TFOS_CHAOS": f"kill node=1 at_step={kill_step}"}
+    rng = np.random.default_rng(seed)
+    reqs = [(rng.integers(0, VOCAB, (int(rng.integers(3, 10)),))
+             .astype(np.int32), int(rng.integers(8, 17)))
+            for _ in range(n_requests)]
+
+    serving = ServingCluster.run(
+        bench_model_builder, 2, max_batch=slots,
+        worker_env=worker_env, working_dir=working_dir,
+        reservation_timeout=120, max_queue_depth=4 * n_requests,
+        warm_standbys=1 if warm else 0, replace_failed=not warm)
+    try:
+        if warm and not serving.wait_standbys(timeout=180):
+            raise RuntimeError("heal_warm: standby never reached phase "
+                               "'standby' (warm-up gate)")
+
+        def _warmup():
+            with serving.client() as c:
+                c.generate(reqs[0][0], 2, timeout=600)
+
+        warmers = [threading.Thread(target=_warmup) for _ in range(2)]
+        for t in warmers:
+            t.start()
+        for t in warmers:
+            t.join(600)
+        sched0 = serving.metrics()      # baseline: exclude warmup counts
+        t0 = time.monotonic()
+        records = _run_load(serving, reqs, rate, rng)
+        wall = time.monotonic() - t0
+        # restored capacity = the REPLACEMENT serves: keep probing until
+        # it does (probe bursts spread over replicas; probes are checked
+        # against the oracle like the window's records)
+        probe_records, probe_reqs, replacement = \
+            _probe_until_replacement_serves(serving, reqs, rng,
+                                            timeout=180.0)
+        sched = serving.metrics()
+        for k in ("accepted", "completed", "shed", "failed", "requeued"):
+            sched[k] -= sched0[k]
+        dead = sorted(serving.scheduler.dead_replicas())
+    finally:
+        serving.shutdown(timeout=300)
+
+    all_records = records + probe_records
+    ok = [r for r in all_records if r and r["ok"]]
+    failed = [r for r in all_records if r and not r["ok"]]
+    if failed or len(ok) != len(all_records):
+        raise RuntimeError(
+            f"heal_{mode}: {len(failed)} accepted request(s) failed / "
+            f"{len(all_records) - len(ok)} lost — the zero-loss gate")
+    if dead != [1]:
+        raise RuntimeError(f"heal_{mode}: dead set {dead} != [1]")
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import greedy_generate
+
+    cfg, params = bench_model_builder({"seed": seed})
+    oracle_cache = {}
+
+    def _want(p, n):
+        key = (tuple(int(t) for t in p), n)
+        if key not in oracle_cache:
+            oracle_cache[key] = np.asarray(greedy_generate(
+                cfg, params, jnp.asarray(p)[None, :],
+                n))[0, len(p):].tolist()
+        return oracle_cache[key]
+
+    for (p, n), r in zip(list(reqs) + probe_reqs, all_records):
+        if r["out"] != _want(p, n):
+            raise RuntimeError(f"heal_{mode}: a stream diverged from the "
+                               "solo greedy oracle across the heal")
+
+    events = EventLog.read(
+        os.path.join(working_dir, "serving_events.jsonl"))
+    started = [e for e in events
+               if e["kind"] == "heal_started" and e.get("replica") == 1]
+    replaced = [e for e in events if e["kind"] == "replica_replaced"
+                and e.get("replica") == 1]
+    if not started or not replaced:
+        raise RuntimeError(f"heal_{mode}: no heal_started/"
+                           f"replica_replaced events for replica 1")
+    if replaced[0].get("mode") != mode:
+        raise RuntimeError(
+            f"heal_{mode}: replacement mode {replaced[0].get('mode')!r} "
+            f"— the {mode} arm healed the wrong way")
+    # restored capacity = the replacement's first DELIVERED output:
+    # replica_first_response covers replayed streams too (their
+    # request_first_token already fired on the dead replica)
+    first_tok = min(
+        (e["t"] for e in events
+         if e["kind"] in ("replica_first_response", "request_first_token")
+         and e.get("replica") == replacement), default=None)
+    if first_tok is None:
+        raise RuntimeError(f"heal_{mode}: replacement {replacement} "
+                           "never produced a first token")
+    kill_t = chaos.fired_at(working_dir, node=1)
+    ready = [e for e in events if e["kind"] == "standby_ready"]
+    tokens = sum(r["tokens"] for r in ok)
+    return {
+        "scenario": f"heal_{mode}",
+        "mode": mode,
+        "requests": {
+            "offered": len(all_records), "accepted": sched["accepted"],
+            "completed": len(ok), "shed": sched["shed"],
+            "failed": sched["failed"], "requeued": sched["requeued"],
+            "lost": 0,
+        },
+        "oracle_exact": True,
+        "replacement": int(replacement),
+        "time_from_kill_to_first_token_secs":
+            None if kill_t is None else round(first_tok - kill_t, 3),
+        "time_from_decision_to_first_token_secs":
+            round(first_tok - started[0]["t"], 3),
+        "standby_ready_secs":
+            round(ready[0]["heal_secs"], 3) if ready else None,
+        "tokens_total": tokens,
+        "wall_secs": round(wall, 3),
+        "throughput_tokens_per_s": round(tokens / wall, 2),
+        "ttft": _percentiles([r["ttft"] for r in ok
+                              if r["ttft"] is not None]),
+        "e2e": _percentiles([r["e2e"] for r in ok]),
+    }
+
+
+def _probe_until_replacement_serves(serving, reqs, rng, timeout: float):
+    """Burst probe requests until a replacement replica (eid > 1) has
+    served one; returns (probe records, their requests, replacement
+    eid).  Bursts of 3 outrun least-outstanding ties so the newcomer
+    gets routed work."""
+    deadline = time.monotonic() + timeout
+    probes, probe_reqs = [], []
+    while time.monotonic() < deadline:
+        m = serving.metrics()
+        served = [int(e) for e, r in m["replicas"].items()
+                  if int(e) > 1 and r["alive"] and r["served"] > 0]
+        if served:
+            return probes, probe_reqs, served[0]
+        burst = [reqs[i % len(reqs)] for i in range(3)]
+        probes.extend(_run_load(serving, burst, 50.0, rng))
+        probe_reqs.extend(burst)
+        time.sleep(0.2)
+    raise RuntimeError("no replacement replica served within the heal "
+                       "window — the tier never restored capacity")
+
+
+ELASTICITY_HEAL_KEYS = frozenset({
+    "scenario", "mode", "requests", "oracle_exact", "replacement",
+    "time_from_kill_to_first_token_secs",
+    "time_from_decision_to_first_token_secs", "standby_ready_secs",
+    "tokens_total", "wall_secs", "throughput_tokens_per_s", "ttft",
+    "e2e"})
+
+
+def validate_elasticity_artifact(out: dict) -> None:
+    """Schema + self-failing heal gates for ``elasticity.json`` /
+    ``elasticity_smoke.json`` (``ci.sh --bench-smoke`` runs the smoke)."""
+    if out.get("benchmark") != "serving_elasticity":
+        raise RuntimeError("artifact gate: wrong benchmark name")
+    rows = {row.get("scenario"): row for row in out.get("rows") or []}
+    if not rows:
+        raise RuntimeError("artifact gate: no rows")
+    for name, row in rows.items():
+        if not name.startswith("heal_"):
+            continue
+        missing = ELASTICITY_HEAL_KEYS - set(row)
+        if missing:
+            raise RuntimeError(f"artifact gate: row {name} missing keys "
+                               f"{sorted(missing)}")
+        if not row["oracle_exact"] or row["requests"]["lost"] != 0 \
+                or row["requests"]["failed"] != 0:
+            raise RuntimeError(f"artifact gate: row {name} violates the "
+                               "zero-loss/oracle gates")
+    smoke = bool(out.get("config", {}).get("smoke"))
+    warm = rows.get("heal_warm")
+    if warm is None:
+        raise RuntimeError("artifact gate: no heal_warm row")
+    if warm["standby_ready_secs"] is None:
+        raise RuntimeError("artifact gate: the warm heal never acked "
+                           "standby_ready")
+    if smoke:
+        # the smoke's absolute gate (lightly-loaded tier): promotion
+        # decision-to-ready must beat any cold spawn's floor.  The full
+        # run's committed gate is the warm-vs-cold ratio below instead —
+        # under its saturating burst, absolute numbers are contended.
+        if warm["standby_ready_secs"] >= COLD_SPAWN_FLOOR_SECS:
+            raise RuntimeError(
+                f"artifact gate: warm promotion took "
+                f"{warm['standby_ready_secs']}s decision-to-ready — not "
+                f"under the {COLD_SPAWN_FLOOR_SECS}s cold-spawn floor")
+        return
+    if not {"ramp", "heal_cold", "heal_warm"} <= set(rows):
+        raise RuntimeError(f"artifact gate: full run needs the ramp row "
+                           f"and the heal A/B, got {sorted(rows)}")
+    w = warm["time_from_decision_to_first_token_secs"]
+    c = rows["heal_cold"]["time_from_decision_to_first_token_secs"]
+    if not c or w > HEAL_WARM_VS_COLD_RATIO * c:
+        raise RuntimeError(
+            f"artifact gate: heal-window win missed — warm "
+            f"decision-to-first-token {w}s vs cold {c}s (need <= "
+            f"{HEAL_WARM_VS_COLD_RATIO:g}x)")
+    gates = out.get("gates") or {}
+    if gates.get("warm_vs_cold_first_token_ratio") is None:
+        raise RuntimeError("artifact gate: gates summary missing")
+    ramp = rows["ramp"]
+    if not ramp.get("standby", {}).get("promotions"):
+        raise RuntimeError("artifact gate: the ramp's scale-up never "
+                           "promoted a standby")
+
+
 def ramp_scenario(n_requests, base_rate, slots, replace_step, seed=0,
                   working_dir=None):
     """The elasticity acceptance run (see module docstring)."""
@@ -692,8 +947,12 @@ def ramp_scenario(n_requests, base_rate, slots, replace_step, seed=0,
     worker_env = {"JAX_PLATFORMS": "cpu",
                   "TFOS_CHAOS": f"replace node=1 at_step={replace_step}"}
     rng = np.random.default_rng(seed)
+    # budgets long enough that the doubled window genuinely OUTRUNS one
+    # replica's decode rate — the queue pressure the up-signal needs
+    # (short-budget traffic is absorbed without queueing since the
+    # paged/speculative engine work)
     reqs = [(rng.integers(0, VOCAB, (int(rng.integers(3, 10)),))
-             .astype(np.int32), int(rng.integers(8, 17)))
+             .astype(np.int32), int(rng.integers(24, 49)))
             for _ in range(n_requests)]
 
     serving = ServingCluster.run(
@@ -702,12 +961,18 @@ def ramp_scenario(n_requests, base_rate, slots, replace_step, seed=0,
         reservation_timeout=120, max_queue_depth=4 * n_requests,
         tenants={"quiet": {"rate": None},
                  "noisy": {"rate": 1.0, "burst": 2, "priority": "low"}},
+        warm_standbys=1,      # the burst's scale-up PROMOTES, not boots
         autoscale=dict(min_replicas=1, max_replicas=3, interval=0.5,
                        up_queue_per_replica=2.0, up_consecutive=2,
                        up_cooldown=5.0, down_outstanding_per_replica=1.0,
                        down_consecutive=6, down_cooldown=6.0))
     noisy = {"offered": 0, "accepted": 0, "shed": 0}
     try:
+        # steady state for this scenario = a WARM pool: the burst's
+        # scale-up must measure promotion, not the standby's compile
+        if not serving.wait_standbys(timeout=240):
+            raise RuntimeError("ramp: standby never reached phase "
+                               "'standby' (warm-up gate)")
         with serving.client() as c:                    # warmup compile
             c.generate(reqs[0][0], 2, timeout=600)
         records = [None] * len(reqs)
@@ -818,8 +1083,26 @@ def ramp_scenario(n_requests, base_rate, slots, replace_step, seed=0,
     after = [r["ttft"] for r in ok
              if r["ttft"] is not None and r["admitted_at"] >= first_up_t]
     tokens = sum(r["tokens"] for r in ok)
+    # scale-decision to first token on the replica that scale-up added
+    # (promoted standby): the ROADMAP-4 number elasticity.json never
+    # measured before
+    added_after_up = [e for e in events if e["kind"] == "replica_added"
+                      and e["t"] >= first_up_t]
+    scale_up_first_token = None
+    if added_after_up:
+        new_eid = added_after_up[0]["replica"]
+        first_tok = min(
+            (e["t"] for e in events
+             if e["kind"] in ("replica_first_response",
+                              "request_first_token")
+             and e.get("replica") == new_eid and e["t"] >= first_up_t),
+            default=None)
+        if first_tok is not None:
+            scale_up_first_token = round(first_tok - first_up_t, 3)
     return {
         "scenario": "ramp",
+        "scale_up_to_first_token_secs": scale_up_first_token,
+        "standby": sched.get("standby"),
         "requests": {
             "offered": n_requests, "accepted": sched["accepted"],
             "completed": len(ok), "shed": sched["shed"],
@@ -855,9 +1138,17 @@ def main():
     ap.add_argument("--skip-kill", action="store_true",
                     help="run only the steady-state scenario")
     ap.add_argument("--ramp", action="store_true",
-                    help="run the elasticity ramp scenario instead "
-                         "(autoscaler + tenants + chaos replace); writes "
+                    help="run the elasticity scenarios instead "
+                         "(autoscaler + tenants + chaos replace ramp, "
+                         "then the warm-vs-cold heal A/B); writes "
                          "bench_artifacts/elasticity.json")
+    ap.add_argument("--warm", action="store_true",
+                    help="the warm-heal CI smoke: one warm tier, a "
+                         "chaos kill healed via standby promotion, "
+                         "gated on the cold-spawn floor + artifact "
+                         "schema; writes bench_artifacts/"
+                         "elasticity_smoke.json (never the full "
+                         "artifact)")
     ap.add_argument("--replace-step", type=int, default=6,
                     help="decode step at which chaos replaces node 1 in "
                          "the ramp scenario")
@@ -1003,10 +1294,52 @@ def main():
         print(f"wrote {path} (all gates passed)")
         return
 
-    if args.ramp:
-        row = ramp_scenario(args.requests, args.rate, args.slots,
-                            args.replace_step)
+    if args.warm:
+        # CI smoke: a dedicated artifact so a smoke run can never
+        # clobber the committed full elasticity.json
+        row = heal_scenario("warm", n_requests=10, rate=20.0,
+                            slots=args.slots, kill_step=4)
         print(json.dumps(row, indent=2))
+        out = {
+            "benchmark": "serving_elasticity",
+            "config": {
+                "backend": "LocalProcessBackend", "platform": "cpu",
+                "smoke": True, "replicas": 2, "warm_standbys": 1,
+                "kill_plan": "kill node=1 at_step=4",
+                "cold_spawn_floor_secs": COLD_SPAWN_FLOOR_SECS,
+                "model": {"vocab": VOCAB, "hidden": HIDDEN,
+                          "layers": LAYERS, "heads": HEADS,
+                          "max_len": MAXLEN},
+            },
+            "rows": [row],
+        }
+        validate_elasticity_artifact(out)
+        path = os.path.join(REPO, "bench_artifacts",
+                            "elasticity_smoke.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+        print(f"wrote {path} (all gates passed)")
+        return
+
+    if args.ramp:
+        # the burst must OUTRUN one replica's decode rate (~9 req/s at
+        # these budgets post-paged/speculative engine) or the up-signal
+        # never fires; floor the open-loop knobs accordingly
+        ramp_requests = max(args.requests, 90)
+        ramp_rate = max(args.rate, 12.0)
+        rows = [ramp_scenario(ramp_requests, ramp_rate, args.slots,
+                              args.replace_step)]
+        print(json.dumps(rows[0], indent=2))
+        heal_n = max(16, args.requests // 2)
+        for mode in ("cold", "warm"):
+            row = heal_scenario(mode, heal_n, args.rate, args.slots,
+                                args.kill_step)
+            print(json.dumps(row, indent=2))
+            rows.append(row)
+        by = {r["scenario"]: r for r in rows}
+        w = by["heal_warm"]["time_from_decision_to_first_token_secs"]
+        c = by["heal_cold"]["time_from_decision_to_first_token_secs"]
         out = {
             "benchmark": "serving_elasticity",
             "config": {
@@ -1017,23 +1350,37 @@ def main():
                                "up_consecutive": 2, "up_cooldown": 5.0,
                                "down_outstanding_per_replica": 1.0,
                                "down_consecutive": 6, "down_cooldown": 6.0},
+                "warm_standbys": 1,
                 "slots_per_replica": args.slots,
-                "poisson_rate_per_s": [args.rate, 2 * args.rate],
-                "requests": args.requests,
+                "poisson_rate_per_s": [ramp_rate, 2 * ramp_rate],
+                "requests": ramp_requests,
                 "tenants": {"quiet": "unlimited",
                             "noisy": "1 req/s burst 2, low priority"},
+                "max_new_tokens": "uniform 24..48",
                 "replace_plan": f"replace node=1 at_step={args.replace_step}",
+                "heal": {"requests": heal_n, "replicas": 2,
+                         "kill_plan": f"kill node=1 "
+                                      f"at_step={args.kill_step}",
+                         "ratio_gate": HEAL_WARM_VS_COLD_RATIO,
+                         "cold_spawn_floor_secs": COLD_SPAWN_FLOOR_SECS},
                 "model": {"vocab": VOCAB, "hidden": HIDDEN,
                           "layers": LAYERS, "heads": HEADS,
                           "max_len": MAXLEN},
             },
-            "rows": [row],
+            "gates": {
+                "warm_vs_cold_first_token_ratio":
+                    None if not c else round(w / c, 3),
+                "warm_decision_to_first_token_secs": w,
+                "cold_decision_to_first_token_secs": c,
+            },
+            "rows": rows,
         }
+        validate_elasticity_artifact(out)
         path = os.path.join(REPO, "bench_artifacts", "elasticity.json")
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "w") as f:
             json.dump(out, f, indent=2)
-        print(f"wrote {path}")
+        print(f"wrote {path} (all gates passed)")
         return
 
     rows = []

@@ -63,6 +63,17 @@ if [ "${1:-}" = "--bench-smoke" ]; then
         echo "prefix serving bench smoke FAILED (rc=$rc)" >&2
         exit $rc
     fi
+    echo "== bench smoke (warm-standby heal) =="
+    # a chaos kill healed via warm-standby promotion + peer weight
+    # clone: fails itself on the cold-spawn floor, zero-loss, oracle,
+    # and artifact-schema gates; writes elasticity_smoke.json (never
+    # the committed full artifact)
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py --warm
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "warm-standby heal bench smoke FAILED (rc=$rc)" >&2
+        exit $rc
+    fi
     exit 0
 fi
 

@@ -13,7 +13,7 @@ Plan grammar (full reference: ``docs/robustness.md``)::
     TFOS_CHAOS = action [';' action]...
     action     = verb SP assignments          # 'kill node=1 at_step=3'
     assignments= key'='value [[',' | SP] key'='value]...
-    verb       = 'kill' | 'term' | 'stall' | 'drop'
+    verb       = 'kill' | 'term' | 'stall' | 'drop' | 'replace' | 'flap'
 
 Keys:
 
@@ -25,6 +25,8 @@ Keys:
   after this many seconds, modelling a preemption grace window.
 - ``secs=<float>`` (``stall`` only) — how long to stall heartbeats
   (default: forever).
+- ``every=<float>`` / ``count=<int>`` (``flap`` only) — ``every`` is the
+  flap verb's own trigger (no ``at_step``/``after_secs`` needed).
 
 Verbs:
 
@@ -46,6 +48,15 @@ Verbs:
   (or the classified exit) and spawns a replacement — same signal as
   ``term``, named separately so plans and benches state intent:
   ``replace node=1 at_step=8`` reads as "heal this", not "break this".
+- ``flap`` — REPEATED failure: SIGKILL this node ``count`` times
+  (default 1), once per process incarnation, each time the incarnation
+  has been up for ``every`` seconds.  A flapping replica is the
+  sustained-churn shape that exercises ``run_with_recovery`` restart
+  budgets and the serving tier's warm-pool backfill — each kill's
+  replacement/backfill survives ``every`` seconds, then dies too, until
+  the count is spent.  Unlike the one-shot verbs, flap keeps ONE
+  sentinel per firing (``chaos.<node>.<index>.f<k>``), so the
+  once-per-job rule bounds the total at ``count`` across all attempts.
 
 Every action fires at most once **per job**, not per attempt: before
 firing, the worker writes a sentinel file ``chaos.<node>.<index>``
@@ -72,10 +83,10 @@ logger = logging.getLogger(__name__)
 PLAN_ENV = "TFOS_CHAOS"
 STATE_DIR_ENV = "TFOS_CHAOS_DIR"
 
-VERBS = ("kill", "term", "stall", "drop", "replace")
+VERBS = ("kill", "term", "stall", "drop", "replace", "flap")
 
-_INT_KEYS = ("node", "at_step")
-_FLOAT_KEYS = ("after_secs", "grace", "secs")
+_INT_KEYS = ("node", "at_step", "count")
+_FLOAT_KEYS = ("after_secs", "grace", "secs", "every")
 
 
 class ChaosPlanError(ValueError):
@@ -94,9 +105,14 @@ class ChaosAction:
     after_secs: float | None = None
     grace: float | None = None
     secs: float | None = None
+    every: float | None = None   # flap: kill each incarnation after this
+    count: int | None = None     # flap: total kills across the job
     index: int = 0  # position in the plan → sentinel-file identity
 
     def describe(self) -> str:
+        if self.verb == "flap":
+            return (f"flap node={self.node} every={self.every:g} "
+                    f"count={self.count or 1}")
         trig = (f"at_step={self.at_step}" if self.at_step is not None
                 else f"after_secs={self.after_secs}")
         return f"{self.verb} node={self.node} {trig}"
@@ -132,9 +148,32 @@ def parse_plan(spec: str) -> list[ChaosAction]:
                 raise ChaosPlanError(f"bad value for {key!r} in {raw!r}: {val!r}")
         if "node" not in kwargs:
             raise ChaosPlanError(f"chaos action {raw!r} needs node=<int>")
-        if kwargs.get("at_step") is None and kwargs.get("after_secs") is None:
-            raise ChaosPlanError(
-                f"chaos action {raw!r} needs a trigger: at_step= or after_secs=")
+        if verb == "flap":
+            if kwargs.get("every") is None:
+                raise ChaosPlanError(
+                    f"chaos action {raw!r} needs every=<secs> "
+                    f"(flap's own trigger)")
+            if kwargs.get("at_step") is not None \
+                    or kwargs.get("after_secs") is not None:
+                # a one-shot trigger on flap would route it through the
+                # single-fire path and silently drop every=/count=
+                raise ChaosPlanError(
+                    f"chaos action {raw!r}: at_step=/after_secs= do not "
+                    f"apply to flap (every= is its trigger)")
+            if kwargs.get("count") is not None and kwargs["count"] < 1:
+                raise ChaosPlanError(
+                    f"chaos action {raw!r}: count must be >= 1, "
+                    f"got {kwargs['count']}")
+        else:
+            if kwargs.get("every") is not None \
+                    or kwargs.get("count") is not None:
+                raise ChaosPlanError(
+                    f"chaos action {raw!r}: every=/count= are flap-only")
+            if kwargs.get("at_step") is None \
+                    and kwargs.get("after_secs") is None:
+                raise ChaosPlanError(
+                    f"chaos action {raw!r} needs a trigger: at_step= or "
+                    f"after_secs=")
         actions.append(ChaosAction(verb=verb, index=idx, **kwargs))
     return actions
 
@@ -177,13 +216,49 @@ class ChaosAgent:
     def on_tick(self) -> None:
         elapsed = time.monotonic() - self._armed_at
         for a in self.actions:
-            if a.after_secs is not None and elapsed >= a.after_secs:
+            if a.verb == "flap":
+                self._maybe_flap(a, elapsed)
+            elif a.after_secs is not None and elapsed >= a.after_secs:
                 self._fire(a)
 
     # -- firing ----------------------------------------------------------
     def _sentinel(self, action: ChaosAction) -> str:
         return os.path.join(self.state_dir,
                             f"chaos.{action.node}.{action.index}")
+
+    def _flap_sentinel(self, action: ChaosAction, k: int) -> str:
+        return f"{self._sentinel(action)}.f{k}"
+
+    def flap_fired_count(self, action: ChaosAction) -> int:
+        """Kills this flap action already delivered across ALL attempts
+        (one ``.f<k>`` sentinel per firing)."""
+        k = 0
+        while os.path.exists(self._flap_sentinel(action, k)):
+            k += 1
+        return k
+
+    def _maybe_flap(self, action: ChaosAction, elapsed: float) -> None:
+        """One kill per incarnation once it has lived ``every`` seconds,
+        until ``count`` total kills were delivered across the job."""
+        if action.index in self._fired:      # this incarnation's kill is
+            return                           # already on its way
+        k = self.flap_fired_count(action)
+        if k >= (action.count or 1) or elapsed < action.every:
+            return
+        self._fired.add(action.index)
+        try:
+            with open(self._flap_sentinel(action, k), "w") as f:
+                f.write(f"{time.time():.6f}")
+        except OSError:
+            logger.warning("chaos: cannot write flap sentinel; firing "
+                           "anyway")
+        logger.warning("chaos FLAP %d/%d on node %d: %s", k + 1,
+                       action.count or 1, self.executor_id,
+                       action.describe())
+        self._fire_flap(action)
+
+    def _fire_flap(self, action: ChaosAction) -> None:
+        os.kill(os.getpid(), signal.SIGKILL)
 
     def _fire(self, action: ChaosAction) -> None:
         if action.index in self._fired:

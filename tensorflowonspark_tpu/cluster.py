@@ -762,7 +762,11 @@ def run_with_recovery(map_fun, tf_args, num_workers: int, *,
     wait ``health.backoff_delay`` (exponential from ``backoff_base`` capped
     at ``backoff_cap``, with jitter), and ``restart_budget=(R, T)`` bounds
     the restart *rate* to R per sliding T seconds on top of the per-job
-    ``max_restarts``.  ``on_restart(attempt, exc, kind)`` runs before each
+    ``max_restarts``.  Exhausting the budget emits a classified
+    ``budget_exhausted`` event to the job's health ``EventLog`` and a
+    ``tfos_restarts_total{kind="budget_exhausted"}`` count before
+    re-raising, so "gave up" is observable as distinct from "still
+    retrying".  ``on_restart(attempt, exc, kind)`` runs before each
     relaunch (metrics, cache-warming, paging).
 
     ``data``/``num_epochs`` replay the InputMode.SPARK feed on every
@@ -839,9 +843,19 @@ def run_with_recovery(map_fun, tf_args, num_workers: int, *,
                 logger.error("giving up after %d restart(s)", max_restarts)
                 raise
             if budget is not None and not budget.allow():
+                # "gave up" must be tellable from "still retrying": a
+                # classified event in the job's health log + a terminal
+                # restart-counter kind, BEFORE the re-raise
                 logger.error(
                     "restart budget exhausted (%d restarts within %.0fs); "
                     "raising", restart_budget[0], restart_budget[1])
+                restarts_total.inc(kind=tpu_health.BUDGET_EXHAUSTED)
+                _emit_health_event(
+                    run_kwargs.get("working_dir"),
+                    tpu_health.BUDGET_EXHAUSTED,
+                    failure_kind=kind, attempt=attempt,
+                    max_restarts=restart_budget[0],
+                    window_secs=restart_budget[1])
                 raise
             restarts_total.inc(kind=kind)
             delay = tpu_health.backoff_delay(attempt, backoff_base, backoff_cap)
@@ -856,6 +870,23 @@ def run_with_recovery(map_fun, tf_args, num_workers: int, *,
 
 
 # -- helpers ---------------------------------------------------------------
+
+def _emit_health_event(working_dir, kind: str, **fields) -> None:
+    """Append one classified event to the job's ``health_events.jsonl``
+    from the DRIVER loop (the per-cluster monitor that usually owns the
+    log is already torn down when run_with_recovery gives up)."""
+    if not working_dir:
+        return
+    with contextlib.suppress(Exception):
+        from tensorflowonspark_tpu import observability
+
+        log = observability.EventLog(
+            os.path.join(working_dir, "health_events.jsonl"))
+        try:
+            log.emit(kind, **fields)
+        finally:
+            log.close()
+
 
 def _log_tail_detail(backend, failed: list) -> str:
     """The implicated workers' captured log tails, formatted for an error

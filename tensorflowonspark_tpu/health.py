@@ -63,6 +63,12 @@ HANG = "hang"              # heartbeat stale or step progress stalled
 PREEMPTION = "preemption"  # SIGTERM-shaped exit (spot/preemptible reclaim)
 USER = "user"              # deterministic error raised by the map_fun
 INFRA = "infra"            # everything environmental (sockets, timeouts...)
+#: terminal outcome kind: ``run_with_recovery``'s sliding-window restart
+#: budget overflowed — the driver GAVE UP (emitted to the health
+#: EventLog and as ``tfos_restarts_total{kind="budget_exhausted"}``
+#: before the final re-raise, so operators can tell "gave up" from
+#: "still retrying")
+BUDGET_EXHAUSTED = "budget_exhausted"
 
 # Exception types that mean "the user's code is wrong and will be wrong
 # again on the next attempt" — retrying burns the restart budget for

@@ -351,6 +351,47 @@ class ContinuousBatcher:
                 "after its KV cache was donated, so in-flight requests "
                 "and the cache are unrecoverable. Build a new batcher "
                 f"and resubmit. Original error: {self._poisoned}")
+        if self.params is None:
+            raise RuntimeError(
+                "ContinuousBatcher has no parameters loaded "
+                "(unload_params() — warm-standby mode); call "
+                "load_params() before submitting")
+
+    # -- warm-standby parameter swap --------------------------------------
+    def unload_params(self) -> None:
+        """Drop the parameter tree while KEEPING every compiled
+        executable (the jitted step/prefill registry is keyed on shapes,
+        not values) — the warm-standby posture: a batcher that has paid
+        its compiles but holds no weights.  Refuses while any request is
+        live; ``submit`` raises until :meth:`load_params` re-arms it."""
+        if self.load()["total"] or self._reserved:
+            raise RuntimeError(
+                "cannot unload params with live requests "
+                f"(load={self.load()})")
+        self.params = None
+
+    def load_params(self, params) -> None:
+        """(Re)arm the batcher with a parameter tree of the SAME
+        structure/shapes it compiled against — a peer-cloned or
+        checkpoint-restored replica state.  The compiled dispatches are
+        reused as-is, so the cost is the weight transfer, not a
+        recompile.  Dense-row KV state from before the swap is dead
+        (every admission prefills its own rows from scratch), and the
+        paged pool's PREFIX INDEX is rebuilt empty — cached pages hold
+        KV computed under the OLD weights, and a post-swap prefix hit
+        against them would silently decode wrong tokens when the new
+        tree differs (e.g. a later-checkpoint restore)."""
+        if params is None:
+            raise ValueError("load_params needs a parameter tree")
+        if self._pages is not None:
+            # idle by the unload_params contract: every page is free or
+            # parked in the (now-stale) prefix cache — a fresh pool of
+            # the same geometry drops the index without touching the
+            # device-side tables (idle rows are parked at the sentinel)
+            self._pages = KVPagePool(
+                self._pages.total_pages, self._pages.page_tokens,
+                prefix_cache=self._pages.prefix_cache)
+        self.params = params
 
     def _emit_token(self, rid: int, tok: int) -> None:
         cb = self._on_token.get(rid)

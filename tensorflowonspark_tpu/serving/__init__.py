@@ -16,14 +16,16 @@ one compiled decode loop behind the node's queue/shm data plane.
 
 Layout: ``scheduler`` (tenant-aware admission/routing/failover + typed
 errors + elastic membership + gang resolution), ``replica`` (the worker
-map_fun, drains under preemption), ``sharded`` (mesh-sharded gang
-replicas: ``GangSpec``, the gang leader/member map_fun, step barriers),
-``frontend`` (TCP edge + ``ServingCluster`` composition:
-``add_replicas``/``retire_replica``/drain-and-replace, whole-gang),
-``autoscaler`` (metrics-driven membership control, device-weighted),
-``client`` (``ServeClient``).  Architecture, backpressure semantics,
-the failure model, and the scale-event taxonomy are in
-``docs/serving.md``.
+map_fun, drains under preemption, serves peer weight clones), ``sharded``
+(mesh-sharded gang replicas: ``GangSpec``, the gang leader/member
+map_fun, step barriers), ``standby`` (warm-standby gangs: pre-compiled
+spare replicas + the driver pool that heal paths promote instead of
+cold-spawning), ``frontend`` (TCP edge + ``ServingCluster`` composition:
+``add_replicas``/``retire_replica``/``scale_up``/drain-and-replace,
+whole-gang), ``autoscaler`` (metrics-driven membership control,
+device-weighted, promotes standbys first), ``client`` (``ServeClient``).
+Architecture, backpressure semantics, the failure model, and the
+scale-event taxonomy are in ``docs/serving.md``.
 """
 
 from tensorflowonspark_tpu.serving.autoscaler import (Autoscaler,  # noqa: F401
@@ -35,6 +37,8 @@ from tensorflowonspark_tpu.serving.replica import serve_replica  # noqa: F401
 from tensorflowonspark_tpu.serving.sharded import (GangShardLost,  # noqa: F401
                                                    GangSpec,
                                                    serve_sharded_replica)
+from tensorflowonspark_tpu.serving.standby import (StandbyPool,  # noqa: F401
+                                                   serve_standby)
 from tensorflowonspark_tpu.serving.scheduler import (DeadlineExceeded,  # noqa: F401
                                                      PRIORITIES,
                                                      ReplicaFailed,

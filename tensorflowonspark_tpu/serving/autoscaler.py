@@ -212,7 +212,14 @@ class Autoscaler:
         self.serving.scheduler.emit_event(
             "scale_up", replicas=n, reason=reason, **_signals(s))
         try:
-            self.serving.add_replicas(n)
+            # prefer the tier's warm path (ServingCluster.scale_up:
+            # standby promotion first, cold spawn for the remainder);
+            # plain facades without it keep the historical add_replicas
+            grow = getattr(self.serving, "scale_up", None)
+            if grow is not None:
+                grow(n)
+            else:
+                self.serving.add_replicas(n)
             self.scale_ups += 1
         except Exception:
             logger.exception("autoscaler: scale-up failed")

@@ -49,6 +49,7 @@ import time
 import numpy as np
 
 from tensorflowonspark_tpu import metrics as tpu_metrics
+from tensorflowonspark_tpu import observability
 from tensorflowonspark_tpu.cluster import InputMode, TPUCluster
 from tensorflowonspark_tpu.health import PREEMPTION, ClusterMonitor
 from tensorflowonspark_tpu.marker import EndOfFeed
@@ -251,6 +252,32 @@ class ServingCluster:
         #: GangSpec` when replicas are mesh-sharded gangs, else None
         self.gang_spec = None
         self._reaped: set[int] = set()    # gang leaders already reaped
+        #: the warm-standby pool (:class:`~tensorflowonspark_tpu.serving.
+        #: standby.StandbyPool`) when ``run(warm_standbys=N)``, else None
+        self.standbys = None
+        self._serve_args: dict = {}       # standby gangs re-use the args
+        self._standby_clone = True
+        self._replace_failed = False
+        #: promoted standby leader -> (decision monotonic, source,
+        #: ready event) until its ``standby_ready`` ack closes the heal
+        #: measurement — the event also gates the pool's deferred
+        #: backfill (heal first, restock second).  Own leaf lock (never
+        #: wraps scheduler/membership calls): the ack path reads it
+        #: UNDER the scheduler lock
+        self._promotions: dict[int, tuple] = {}
+        self._promotions_lock = threading.Lock()
+        self._promoted: dict[str, int] = {}   # source -> promotions
+        #: decision-to-restored-capacity latencies of warm promotions
+        self.heal = observability.LatencyHistogram()
+        reg = tpu_metrics.get_registry()
+        self._m_promotions = reg.counter(
+            "tfos_serving_promotions_total",
+            "Warm-standby promotions by trigger "
+            "(failure/preemption/scale_up).", labelnames=("source",))
+        self._h_heal = reg.histogram(
+            "tfos_serving_heal_seconds",
+            "Heal-decision to restored-capacity latency of warm "
+            "promotions (standby_ready ack).")
 
     # ------------------------------------------------------------------ run
     @classmethod
@@ -263,8 +290,11 @@ class ServingCluster:
             client_timeout: float = 600.0,
             metrics_port: int | None = 0, tenants: dict | None = None,
             autoscale=None, replace_preempted: bool = True,
+            replace_failed: bool = False,
             drain_timeout: float = 60.0, mesh: dict | None = None,
             gang_size: int | None = None, shard_params=None,
+            warm_standbys: int = 0, standby_clone: bool = True,
+            compile_cache=None,
             **cluster_kwargs) -> "ServingCluster":
         """Boot ``num_replicas`` serving workers and the driver-side tier.
 
@@ -300,6 +330,22 @@ class ServingCluster:
         operate on whole gangs.  ``shard_params`` optionally overrides
         the parameter layout (a picklable ``(cfg, params, mesh) ->
         params``; default = the model's own partitioning annotations).
+
+        ``warm_standbys`` keeps N fully-initialized spare replica gangs
+        (process up, mesh built, serve step compiled, params UNLOADED,
+        heartbeat phase ``standby``) that heal paths PROMOTE instead of
+        cold-spawning — replica deaths, preemption drain-and-replace,
+        and autoscaler scale-ups all consume the pool first, and the
+        pool backfills itself in the background (docs/robustness.md
+        "Warm standbys").  ``standby_clone`` (default) lets a promoted
+        standby pull weights from a live peer replica over the queue/shm
+        data plane instead of re-running the model builder (the
+        checkpoint-restore fallback).  ``replace_failed`` spawns a
+        replacement for CRASH/HANG deaths too (cold when no pool), so
+        the tier never shrinks by failure; with a warm pool, crash heals
+        promote regardless.  ``compile_cache`` overrides the
+        fleet-shared persistent XLA compilation cache directory (default
+        ``<working_dir>/jax_cache``; ``False`` disables it).
         """
         from tensorflowonspark_tpu.serving.replica import serve_replica
 
@@ -310,6 +356,11 @@ class ServingCluster:
             "serve_eos_id": eos_id,
             "serve_batcher_kwargs": dict(batcher_kwargs or {}),
         })
+        if compile_cache is not None:
+            args["serve_compile_cache"] = compile_cache
+        if warm_standbys < 0:
+            raise ValueError(f"warm_standbys must be >= 0, "
+                             f"got {warm_standbys}")
         gang = None
         map_fun, num_workers = serve_replica, num_replicas
         if mesh is not None:
@@ -354,7 +405,11 @@ class ServingCluster:
             tier = cls(cluster, scheduler, mon, frontend, address)
             tier.gang_spec = gang
             tier._replace_preempted = bool(replace_preempted)
+            tier._replace_failed = bool(replace_failed)
             tier._drain_timeout = float(drain_timeout)
+            tier._serve_args = args
+            tier._standby_clone = bool(standby_clone)
+            scheduler.on_replica_ready = tier._on_standby_ready
             if mon is not None:
                 # re-point the monitor's hooks at the tier: classified
                 # failures still retire replicas in the scheduler, but
@@ -362,6 +417,14 @@ class ServingCluster:
                 # flips) now ALSO drive drain-and-replace
                 mon.on_failure = tier._on_cluster_failure
                 mon.on_phase = tier._on_phase
+            if warm_standbys:
+                from tensorflowonspark_tpu.serving.standby import \
+                    StandbyPool
+
+                # pool before the autoscaler: its first scale-up must
+                # already see promotable standbys
+                tier.standbys = StandbyPool(tier, int(warm_standbys))
+                tier.standbys.fill()
             if autoscale is not None:
                 from tensorflowonspark_tpu.serving.autoscaler import (
                     Autoscaler, AutoscalerConfig)
@@ -387,7 +450,8 @@ class ServingCluster:
             # scheduler's threads AND its registry collect hook
             # (scheduler.stop unhooks it), the monitor
             autoscaler = tier.autoscaler if tier is not None else None
-            for part in (autoscaler, frontend, scheduler, mon):
+            standbys = tier.standbys if tier is not None else None
+            for part in (autoscaler, standbys, frontend, scheduler, mon):
                 if part is not None:
                     with contextlib.suppress(Exception):
                         part.stop()
@@ -434,6 +498,126 @@ class ServingCluster:
         logger.info("serving tier grew by %d replica(s): %s%s", n, leaders,
                     f" (gangs of {gsz})" if gsz > 1 else "")
         return leaders
+
+    def scale_up(self, n: int = 1, timeout: float | None = None,
+                 source: str = "scale_up") -> list[int]:
+        """Grow the tier by ``n`` replicas, consuming the warm-standby
+        pool FIRST (promotion: control message + weight clone, capacity
+        restored in well under a cold boot) and cold-spawning only the
+        remainder through :meth:`add_replicas`.  The autoscaler's
+        scale-up path calls this.  Returns the new replicas' leader
+        executor ids."""
+        added: list[int] = []
+        for _ in range(int(n)):
+            eid = self.promote_standby(source)
+            if eid is None:
+                break
+            added.append(eid)
+        remaining = int(n) - len(added)
+        if remaining:
+            added.extend(self.add_replicas(remaining, timeout=timeout))
+        return added
+
+    def promote_standby(self, source: str = "scale_up") -> int | None:
+        """Promote one warm standby into a routable replica: pop it from
+        the pool (atomic — a concurrent failure + scale decision can
+        never double-promote the same standby), send it the promote
+        control message naming a live CLONE PEER (or None → it restores
+        through the model builder), register it with the scheduler, and
+        backfill the pool in the background.  Returns the promoted
+        leader's executor id, or None when the pool is empty/absent
+        (callers fall back to a cold spawn)."""
+        pool = self.standbys
+        if pool is None or self._shutdown_done:
+            return None
+        got = pool.acquire()
+        if got is None:
+            return None
+        eid, entry = got
+        peer = (self.scheduler.peer_replica_info()
+                if self._standby_clone else None)
+        ready = threading.Event()
+        with self._promotions_lock:
+            self._promotions[eid] = (time.monotonic(), source, ready)
+        # register FIRST: if the promote message were sent and the
+        # registration then failed, the standby would clone weights and
+        # serve unregistered forever (early-routed requests just queue
+        # on its plane until the post-promote serve loop drains them)
+        try:
+            self.scheduler.add_replica(entry["info"],
+                                       members=entry["members"])
+        except Exception:
+            # scheduler stopping / registration guard: the caller
+            # cold-spawns instead; the pool backfills
+            logger.exception("promotion of standby %d failed to "
+                             "register", eid)
+            with self._promotions_lock:
+                self._promotions.pop(eid, None)
+            self.scheduler.emit_event("promote_failed", replica=eid,
+                                      source=source)
+            pool.backfill_async()
+            return None
+        try:
+            self.cluster._client_for(eid).put(
+                REQUEST_QUEUE,
+                {"op": "standby", "event": "promote", "source": source,
+                 "peer": peer}, timeout=10)
+        except Exception:
+            # the standby died under us: roll the registration back as
+            # a planned departure (anything already routed re-queues
+            # without charging its failover budget)
+            logger.exception("promotion of standby %d failed", eid)
+            with self._promotions_lock:
+                self._promotions.pop(eid, None)
+            self.scheduler.retire_replica(eid, reason="promote_failed")
+            self.scheduler.emit_event("promote_failed", replica=eid,
+                                      source=source)
+            pool.backfill_async()
+            return None
+        with self._promotions_lock:
+            self._promoted[source] = self._promoted.get(source, 0) + 1
+        self._m_promotions.inc(source=source)
+        self.scheduler.emit_event(
+            "standby_promoted", replica=eid, source=source,
+            peer=None if peer is None else int(peer["executor_id"]))
+        logger.info("promoted warm standby %d (source=%s, clone peer %s)",
+                    eid, source,
+                    "none" if peer is None else peer["executor_id"])
+
+        def _backfill_after_ready():
+            # restock AFTER the promotion restores capacity (or a grace
+            # timeout): a fresh standby's boot + compile must not
+            # compete with the heal it was triggered by
+            ready.wait(30.0)
+            pool.backfill_async()
+
+        threading.Thread(target=_backfill_after_ready,
+                         name=f"standby-restock-{eid}",
+                         daemon=True).start()
+        return eid
+
+    def wait_standbys(self, timeout: float = 120.0) -> bool:
+        """Block until every pooled standby is WARM (serve step
+        compiled, params unloaded, heartbeating phase ``standby``) —
+        what a bench/test gates on before injecting the failure it wants
+        healed warm.  False on timeout or when no pool/monitor exists."""
+        return (self.standbys is not None
+                and self.standbys.wait_warm(timeout))
+
+    def _on_standby_ready(self, eid: int) -> dict | None:
+        """Scheduler ``on_replica_ready`` hook (runs under the scheduler
+        lock — no re-entry): close the heal-time measurement for a
+        promotion this tier initiated."""
+        with self._promotions_lock:
+            rec = self._promotions.pop(eid, None)
+        if rec is None:
+            return None
+        t0, source, ready = rec
+        secs = time.monotonic() - t0
+        self._h_heal.record(secs)
+        self.heal.record(secs)
+        ready.set()     # capacity restored: the deferred backfill may go
+        return {"heal_secs": round(secs, 6), "promote_source": source}
 
     def retire_replica(self, executor_id: int,
                        drain_timeout: float | None = None) -> bool:
@@ -488,16 +672,22 @@ class ServingCluster:
             self._handle_preempted(self.scheduler.resolve_gang(int(eid)))
 
     def _on_cluster_failure(self, failure) -> None:
-        """Monitor ``on_failure`` hook: always fail over via the
-        scheduler — which resolves a gang shard's death to the WHOLE
-        gang, requeueing its in-flight work once — then reap the dead
-        gang's surviving processes (a leaderless member would otherwise
-        idle on its barrier queue forever).  A PREEMPTION-classified
-        exit (the replica died before or during its grace drain)
-        additionally spawns a replacement — membership flexes, the tier
-        never shrinks by reclaim."""
-        self.scheduler.on_cluster_failure(failure)
+        """Monitor ``on_failure`` hook: absorb UNPROMOTED-standby deaths
+        into the pool (shrink + backfill — the scheduler never knew
+        them), then always fail over via the scheduler — which resolves
+        a gang shard's death to the WHOLE gang, requeueing its in-flight
+        work once — then reap the dead gang's surviving processes (a
+        leaderless member would otherwise idle on its barrier queue
+        forever).  A PREEMPTION-classified exit (the replica died before
+        or during its grace drain) additionally spawns a replacement;
+        with a warm pool (or ``replace_failed``), CRASH/HANG deaths heal
+        the same way — membership flexes, the tier never shrinks."""
         failed = [int(e) for e in getattr(failure, "failed_workers", ())]
+        standby_owned: set[int] = set()
+        if self.standbys is not None and not self._shutdown_done:
+            standby_owned = self.standbys.handle_failure(failed)
+        self.scheduler.on_cluster_failure(failure)
+        failed = [e for e in failed if e not in standby_owned]
         leaders = {self.scheduler.resolve_gang(e) for e in failed}
         if self.gang_spec is not None and not self._shutdown_done:
             dead = self.scheduler.dead_replicas()
@@ -509,10 +699,21 @@ class ServingCluster:
                         target=self._stop_gang_workers, args=(leader,),
                         name=f"serve-gang-reap-{leader}",
                         daemon=True).start()
-        if (self._replace_preempted and not self._shutdown_done
-                and getattr(failure, "kind", None) == PREEMPTION):
+        if self._shutdown_done:
+            return
+        kind = getattr(failure, "kind", None)
+        if self._replace_preempted and kind == PREEMPTION:
             for leader in leaders:
                 self._spawn_replacement(leader, source="exit")
+        elif kind != PREEMPTION and (self.standbys is not None
+                                     or self._replace_failed):
+            # crash/hang heal: only replicas the scheduler actually lost
+            # (a failure naming an unknown worker must not grow the tier)
+            dead = self.scheduler.dead_replicas()
+            for leader in leaders:
+                if leader in dead:
+                    self._spawn_replacement(leader, source="failure",
+                                            promote_source="failure")
 
     def _handle_preempted(self, eid: int) -> None:
         # mark_draining is the dedup: False when already draining/dead,
@@ -540,24 +741,35 @@ class ServingCluster:
         if self._replace_preempted:
             self._spawn_replacement(eid, source="drain")
 
-    def _spawn_replacement(self, eid: int, source: str) -> None:
+    def _spawn_replacement(self, eid: int, source: str,
+                           promote_source: str = "preemption") -> None:
         if self._shutdown_done:
             return
         with self._membership_lock:
             if eid in self._replaced:
                 return   # phase path and exit path both fired; one spawn
             self._replaced.add(eid)
+        # the heal clock starts at the DECISION, before any boot/promote
+        # work — bench_serving's heal-time rows measure from this event
+        self.scheduler.emit_event("heal_started", replica=eid,
+                                  source=source)
 
         def _go():
             if self._shutdown_done:
+                return
+            promoted = self.promote_standby(promote_source)
+            if promoted is not None:
+                self.scheduler.emit_event(
+                    "replica_replaced", replica=eid, replacement=promoted,
+                    source=source, mode="warm")
                 return
             try:
                 new = self.add_replicas(1)
                 self.scheduler.emit_event(
                     "replica_replaced", replica=eid, replacement=new[0],
-                    source=source)
+                    source=source, mode="cold")
             except Exception:
-                logger.exception("replacement for preempted replica %d "
+                logger.exception("replacement for lost replica %d "
                                  "failed", eid)
                 self.scheduler.emit_event("replace_failed", replica=eid,
                                           source=source)
@@ -575,6 +787,12 @@ class ServingCluster:
         if self.autoscaler is not None:
             m["autoscaler"] = {"scale_ups": self.autoscaler.scale_ups,
                                "scale_downs": self.autoscaler.scale_downs}
+        if self.standbys is not None:
+            with self._promotions_lock:
+                promotions = dict(self._promoted)
+            m["standby"] = {**self.standbys.stats(),
+                            "promotions": promotions,
+                            "heal": self.heal.summary()}
         return m
 
     def metrics_text(self) -> str:
@@ -601,8 +819,13 @@ class ServingCluster:
         if self._shutdown_done:
             return
         self._shutdown_done = True
+        if self.standbys is not None:
+            # no backfills may race the teardown; unpromoted standbys
+            # exit on the cluster shutdown's EndOfFeed like replicas
+            with contextlib.suppress(Exception):
+                self.standbys.stop()
         if self.autoscaler is not None:
-            # first: no membership changes may race the teardown
+            # no membership changes may race the teardown
             with contextlib.suppress(Exception):
                 self.autoscaler.stop()
         if not self.scheduler.drain(drain_timeout):
@@ -610,6 +833,9 @@ class ServingCluster:
                            "remaining requests get typed shutdown errors",
                            drain_timeout)
         handled = self.scheduler.dead_replicas()
+        if self.standbys is not None:
+            # dead UNPROMOTED standbys were handled too (pool backfilled)
+            handled |= self.standbys.dead
         if self.metrics_http is not None:
             with contextlib.suppress(Exception):
                 self.metrics_http.stop()

@@ -15,6 +15,10 @@ tier:
 
 - intake is near-non-blocking while any slot is decoding (a blocking
   wait would stall every in-flight request) and blocks briefly when idle;
+  with every slot busy it still sweeps the queue per step so CONTROL
+  messages (a standby's weight-clone request, ``EndOfFeed``) never
+  starve behind a full decode convoy — a gen request read during the
+  sweep is carried to the next free slot;
 - every committed token streams back immediately through the batcher's
   ``on_token`` hook, flushed as one ``{"event": "tok"}`` delta message
   per request per step (so a K-token block/speculative commit costs one
@@ -55,7 +59,9 @@ tier:
 from __future__ import annotations
 
 import logging
+import os
 import queue as _queue
+import threading
 import time as _time
 
 from tensorflowonspark_tpu import metrics as _metrics
@@ -68,11 +74,77 @@ from tensorflowonspark_tpu.serving.scheduler import (REQUEST_QUEUE,
 logger = logging.getLogger(__name__)
 
 
+def enable_serving_compile_cache(args, ctx) -> None:
+    """Persistent XLA compilation cache shared across the serving fleet.
+
+    Every replica, gang leader, and warm standby of one tier points at
+    the same on-disk cache (default: ``<working_dir>/jax_cache``), so the
+    first process to compile a serve-step executable pays for the whole
+    fleet — a cold spawn or standby warm-up after that is a cache read,
+    not a recompile.  ``args["serve_compile_cache"]``: ``False`` disables,
+    a string overrides the directory (e.g. a cross-job persistent path)."""
+    spec = args.get("serve_compile_cache")
+    if spec is False:
+        return
+    from tensorflowonspark_tpu import util as _util
+
+    _util.enable_compilation_cache(
+        spec if isinstance(spec, str)
+        else os.path.join(ctx.working_dir, "jax_cache"))
+
+
+def serve_clone_request(batcher, item: dict, ctx) -> None:
+    """Source side of peer weight cloning: ship this replica's params to
+    the requester named in ``item`` (a promoted warm standby), off the
+    decode thread so a bulk transfer never stalls in-flight streams.
+
+    The transfer rides the requester's own node queue plane — a
+    ``QueueClient`` to ``item["reply_addr"]`` (zero-copy shm negotiated
+    automatically on a shared host) carrying one
+    ``{"op": "standby", "event": "params"}`` message."""
+    reg = _metrics.get_registry()
+    m_clones = reg.counter(
+        "tfos_replica_clones_served_total",
+        "Peer weight-clone transfers served by this replica.")
+
+    def _send():
+        import jax
+        import numpy as np
+
+        from tensorflowonspark_tpu.queues import QueueClient
+
+        try:
+            # host-gather ONE copy; the queue plane's pickle-5 path moves
+            # it out-of-band (shm zero-copy when driver-negotiated)
+            params = jax.tree.map(lambda x: np.asarray(x), batcher.params)
+            cli = QueueClient(tuple(item["reply_addr"]),
+                              item["reply_authkey"], timeout=60.0)
+            try:
+                cli.put(REQUEST_QUEUE,
+                        {"op": "standby", "event": "params",
+                         "params": params, "src": ctx.executor_id},
+                        timeout=60)
+            finally:
+                cli.close()
+            m_clones.inc()
+            logger.info("replica %d served a weight clone to %s",
+                        ctx.executor_id, item.get("reply_addr"))
+        # tfos: ignore[broad-except] — a failed clone must not kill the
+        # serving replica; the standby's clone timeout falls back to
+        # checkpoint restore
+        except Exception:
+            logger.exception("replica %d: peer weight clone failed",
+                             ctx.executor_id)
+
+    threading.Thread(target=_send, name="serve-clone", daemon=True).start()
+
+
 def serve_replica(args, ctx) -> None:
     """The serving-tier ``map_fun``: serve generate requests until the
     driver sends ``EndOfFeed``."""
     # jax (and the model stack) import inside the worker process only —
     # the harness contract is that no jax import happens before map_fun
+    enable_serving_compile_cache(args, ctx)
     from tensorflowonspark_tpu.models.serving import ContinuousBatcher
 
     cfg, params = args["serve_model_builder"](args)
@@ -110,6 +182,7 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
     preempt_grace = float(args.get("serve_preempt_grace", 2.0))
 
     deltas: dict[int, list[int]] = {}   # batcher rid -> tokens this step
+    carry = None   # gen request read during a full-slots control sweep
 
     def on_token(brid: int, tok: int) -> None:
         deltas.setdefault(brid, []).append(int(tok))
@@ -207,19 +280,42 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                              replica=ctx.executor_id,
                              inflight=batcher.load()["total"])
             queue_idle = False
-            while not stopping and batcher.has_free_slot():
-                try:
-                    item = mgr.queue_get(
-                        REQUEST_QUEUE,
-                        timeout=busy_poll if busy()
-                        else (0.05 if draining else idle_poll))
-                except (_queue.Empty, TimeoutError):
-                    queue_idle = True
-                    break
+            while not stopping:
+                free = batcher.has_free_slot()
+                if carry is not None:
+                    if not free:
+                        break
+                    item, carry = carry, None
+                else:
+                    try:
+                        # even with every slot busy, sweep the queue with
+                        # a near-zero timeout: CONTROL messages (clone,
+                        # EndOfFeed) must not starve behind a full batch
+                        # — a promoted standby's weight clone would
+                        # otherwise wait out the whole decode convoy
+                        item = mgr.queue_get(
+                            REQUEST_QUEUE,
+                            timeout=(busy_poll if busy()
+                                     else (0.05 if draining else idle_poll))
+                            if free else 0.001)
+                    except (_queue.Empty, TimeoutError):
+                        queue_idle = True
+                        break
+                    if not free and isinstance(item, dict) \
+                            and item.get("op") == "gen":
+                        # a gen request read during the control sweep:
+                        # hold it for the next free slot (it would have
+                        # sat at the queue head anyway)
+                        carry = item
+                        break
                 if isinstance(item, EndOfFeed):
                     stopping = True
                     break
                 if isinstance(item, Marker):
+                    continue
+                if isinstance(item, dict) and item.get("op") == "clone":
+                    # a promoted standby asks for this replica's weights
+                    serve_clone_request(batcher, item, ctx)
                     continue
                 if not (isinstance(item, dict) and item.get("op") == "gen"):
                     logger.warning("replica %d: ignoring non-request item %r",

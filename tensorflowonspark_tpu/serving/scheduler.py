@@ -273,6 +273,11 @@ class _Replica:
         self.send_cli = None
         self.recv_cli = None
         self.served = 0
+        #: first tok/done message seen from this replica (one-shot
+        #: ``replica_first_response`` event: the heal-time benches'
+        #: restored-capacity clock — request_first_token alone misses
+        #: replayed streams, whose first token already happened)
+        self.responded = False
 
 
 class ReplicaScheduler:
@@ -339,6 +344,13 @@ class ReplicaScheduler:
         self.tenants.setdefault("default", _Tenant("default", None))
         self.poll_interval = float(poll_interval)
         self.requeue_limit = int(requeue_limit)
+        #: ``on_replica_ready(eid) -> dict | None`` fires when a replica
+        #: acks ``standby_ready`` on its response channel (a promoted
+        #: warm standby finished loading weights — restored capacity).
+        #: Runs under the scheduler lock and must not re-enter it; any
+        #: returned fields ride the emitted ``standby_ready`` event.
+        #: The serving tier uses it to close its heal-time measurement.
+        self.on_replica_ready = None
         self._client_factory = client_factory or self._default_client
         self._own_events = event_log is None and bool(
             getattr(cluster, "working_dir", None))
@@ -608,6 +620,21 @@ class ReplicaScheduler:
             if rep is None:
                 return (int(executor_id),)
             return (leader, *rep.members)
+
+    def peer_replica_info(self, exclude=()) -> dict | None:
+        """Reservation info of the least-loaded alive, non-draining
+        replica — the clone SOURCE a promoted warm standby pulls weights
+        from; None when no healthy peer exists (the promotion then falls
+        back to checkpoint restore via the model builder)."""
+        with self._lock:
+            best = None
+            for eid, rep in self.replicas.items():
+                if not rep.alive or rep.draining or eid in exclude:
+                    continue
+                if best is None \
+                        or len(rep.outstanding) < len(best.outstanding):
+                    best = rep
+            return None if best is None else dict(best.info)
 
     def dead_replicas(self) -> set[int]:
         """Every executor id lost to FAILURE — for a dead gang that is
@@ -938,6 +965,21 @@ class ReplicaScheduler:
                 rep.reported_load = int(msg["load"])
             if "free_pages" in msg:
                 rep.reported_free_pages = int(msg["free_pages"])
+            if event == "standby_ready":
+                # a promoted standby finished loading weights: capacity
+                # is restored — let the tier close its heal measurement
+                fields = {}
+                if self.on_replica_ready is not None:
+                    try:
+                        fields = self.on_replica_ready(rep.eid) or {}
+                    except Exception:
+                        logger.exception("on_replica_ready hook raised")
+                self._emit("standby_ready", replica=rep.eid,
+                           source=msg.get("source"), **fields)
+                return
+            if not rep.responded and event in ("tok", "done"):
+                rep.responded = True
+                self._emit("replica_first_response", replica=rep.eid)
             req = rep.outstanding.get(rid)
             if req is None or req.finished:
                 return          # abandoned, or replayed on another replica

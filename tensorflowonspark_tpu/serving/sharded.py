@@ -318,6 +318,10 @@ def serve_sharded_replica(args, ctx) -> None:
         _member_loop(args, ctx, spec, leader_eid, rank)
         return
     # leader: jax/model imports stay inside the worker process
+    from tensorflowonspark_tpu.serving.replica import \
+        enable_serving_compile_cache
+
+    enable_serving_compile_cache(args, ctx)
     from tensorflowonspark_tpu.models.serving import ContinuousBatcher
 
     mesh = build_gang_mesh(spec)

@@ -92,6 +92,75 @@ def test_parse_plan_error_names_offending_action_in_multiaction_plan():
     assert "stall" in msg and "kill node=0" not in msg
 
 
+def test_parse_plan_flap_grammar():
+    plan = parse_plan("flap node=2 every=1.5 count=3")
+    (a,) = plan
+    assert a.verb == "flap" and a.node == 2
+    assert a.every == 1.5 and a.count == 3
+    assert a.describe() == "flap node=2 every=1.5 count=3"
+    # count defaults to 1; every alone is the trigger
+    (b,) = parse_plan("flap node=0 every=2")
+    assert b.count is None and b.describe().endswith("count=1")
+
+
+@pytest.mark.parametrize("bad,tokens", [
+    # flap without its trigger: the required key is named
+    ("flap node=0", ["'flap node=0'", "every=<secs>"]),
+    ("flap node=0 count=2", ["every=<secs>"]),
+    # non-numeric every/count: key and offending value are both named
+    ("flap node=0 every=soon", ["'every'", "'soon'"]),
+    ("flap node=0 every=1 count=lots", ["'count'", "'lots'"]),
+    # zero/negative count
+    ("flap node=0 every=1 count=0", ["count", ">= 1"]),
+    # one-shot triggers on flap would silently drop every=/count=
+    ("flap node=0 every=1 at_step=2", ["at_step=", "every="]),
+    ("flap node=0 every=1 after_secs=3", ["after_secs=", "every="]),
+    # flap-only keys leak onto other verbs
+    ("kill node=0 at_step=3 every=1", ["flap-only"]),
+    ("term node=0 at_step=3 count=2", ["flap-only"]),
+])
+def test_parse_plan_rejects_malformed_flap(bad, tokens):
+    with pytest.raises(ChaosPlanError) as ei:
+        parse_plan(bad)
+    msg = str(ei.value)
+    assert "\n" not in msg, f"multi-line chaos error: {msg!r}"
+    for token in tokens:
+        assert token in msg, f"error {msg!r} does not name {token!r}"
+
+
+def test_flap_fires_once_per_incarnation_until_count_spent(tmp_path,
+                                                          monkeypatch):
+    """Each 'process incarnation' (a fresh ChaosAgent over the same
+    sentinel dir, as a restarted attempt would build) delivers at most
+    one flap kill after ``every`` seconds of uptime, and the ``.f<k>``
+    sentinels bound the job-wide total at ``count``."""
+    kills = []
+    monkeypatch.setattr(chaos.ChaosAgent, "_fire_flap",
+                        lambda self, a: kills.append(a.index))
+
+    def incarnation(uptime):
+        agent = chaos.ChaosAgent(parse_plan("flap node=0 every=5 count=2"),
+                                 executor_id=0, state_dir=str(tmp_path))
+        agent._armed_at -= uptime          # fast-forward this process
+        return agent
+
+    young = incarnation(uptime=1.0)
+    young.on_tick()
+    assert kills == []                     # not up for `every` yet
+
+    a1 = incarnation(uptime=6.0)
+    a1.on_tick()
+    a1.on_tick()                           # same incarnation: no re-fire
+    assert kills == [0]
+    a2 = incarnation(uptime=6.0)           # the restarted replacement
+    a2.on_tick()
+    assert kills == [0, 0]
+    a3 = incarnation(uptime=60.0)          # count=2 spent: disarmed
+    a3.on_tick()
+    assert kills == [0, 0]
+    assert a3.flap_fired_count(a3.actions[0]) == 2
+
+
 def test_from_env_filters_to_this_executor(monkeypatch, tmp_path):
     monkeypatch.setenv(chaos.PLAN_ENV, "kill node=1 at_step=3")
     assert chaos.from_env(0, state_dir=str(tmp_path)) is None  # not targeted
@@ -202,6 +271,71 @@ def test_chaos_sigterm_classified_preemption(tmp_path):
     assert failure is not None and failure.kind == "preemption"
     with pytest.raises(ClusterFailure, match="preemption"):
         cluster.shutdown(timeout=60)
+
+
+@pytest.mark.integration
+def test_restart_budget_exhausted_emits_classified_event(tmp_path):
+    """When run_with_recovery's sliding-window budget is exhausted, the
+    give-up is OBSERVABLE before the re-raise: a classified
+    ``budget_exhausted`` event in the job's health EventLog and a
+    ``tfos_restarts_total{kind="budget_exhausted"}`` count — operators
+    can tell "gave up" from "still retrying"."""
+    import os
+
+    from tensorflowonspark_tpu import metrics as tpu_metrics
+    from tensorflowonspark_tpu.cluster import run_with_recovery
+    from tensorflowonspark_tpu.observability import EventLog
+
+    c = tpu_metrics.get_registry().counter("tfos_restarts_total",
+                                           labelnames=("kind",))
+    before = c.value(kind="budget_exhausted") or 0
+    with pytest.raises(RuntimeError):
+        run_with_recovery(
+            funcs.fn_crash_infra, {}, num_workers=1,
+            max_restarts=5, restart_budget=(0, 60.0), backoff_base=0.1,
+            working_dir=str(tmp_path),
+            worker_env={"JAX_PLATFORMS": "cpu"},
+            reservation_timeout=60, shutdown_timeout=60)
+    assert c.value(kind="budget_exhausted") == before + 1
+    path = os.path.join(str(tmp_path), "health_events.jsonl")
+    events = [e for e in EventLog.read(path)
+              if e["kind"] == "budget_exhausted"]
+    assert len(events) == 1, events
+    assert events[0]["failure_kind"] == "infra"
+    assert events[0]["max_restarts"] == 0
+    assert events[0]["window_secs"] == 60.0
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_flap_churn_exhausts_restart_budget(tmp_path):
+    """Sustained churn end-to-end: a flapping worker (SIGKILL every
+    incarnation after 1s, 3 kills total) burns run_with_recovery's
+    restart budget — the driver retries the first kills, then gives up
+    with the classified budget_exhausted signal."""
+    import os
+
+    from tensorflowonspark_tpu.cluster import run_with_recovery
+    from tensorflowonspark_tpu.observability import EventLog
+
+    restarts = []
+    with pytest.raises(RuntimeError):
+        run_with_recovery(
+            funcs.fn_report_steps, {"total_steps": 400, "step_secs": 0.05},
+            num_workers=1, max_restarts=5, restart_budget=(1, 300.0),
+            backoff_base=0.1,
+            on_restart=lambda attempt, exc, kind: restarts.append(kind),
+            working_dir=str(tmp_path),
+            worker_env={"JAX_PLATFORMS": "cpu",
+                        "TFOS_CHAOS": "flap node=0 every=1 count=3"},
+            reservation_timeout=60, shutdown_timeout=60, hang_timeout=60)
+    assert restarts == ["crash"], restarts   # one retry, then budget gone
+    flap_sentinels = [f for f in os.listdir(str(tmp_path))
+                      if f.startswith("chaos.0.0.f")]
+    assert len(flap_sentinels) >= 2, flap_sentinels
+    events = [e["kind"] for e in EventLog.read(
+        os.path.join(str(tmp_path), "health_events.jsonl"))]
+    assert "budget_exhausted" in events
 
 
 @pytest.mark.integration

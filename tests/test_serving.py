@@ -49,6 +49,55 @@ def test_staggered_requests_match_solo_greedy(pos_encoding):
                                       _oracle(cfg, params, prompt, n))
 
 
+def test_unload_load_params_keeps_compiled_exactness():
+    """The warm-standby posture: unload drops the weights but keeps the
+    compiled executables; a reloaded (host-numpy, peer-cloned-shaped)
+    tree decodes token-identically with no live-state carryover.
+    Guards: submit while weightless raises; unload with live work
+    refuses."""
+    cfg, params = _make()
+    prompt = np.asarray([3, 1, 4, 1, 5], np.int32)
+    b = ContinuousBatcher(cfg, params, max_batch=2)
+    rid = b.submit(prompt, 6)
+    with pytest.raises(RuntimeError, match="live requests"):
+        b.unload_params()                 # in-flight work: refuse
+    want = b.run()[rid]
+    b.unload_params()
+    assert b.params is None
+    with pytest.raises(RuntimeError, match="no parameters"):
+        b.submit(prompt, 2)
+    with pytest.raises(ValueError):
+        b.load_params(None)
+    # reload a HOST tree (what a peer clone delivers) — same executables
+    b.load_params(jax.tree.map(lambda x: np.asarray(x), params))
+    rid2 = b.submit(prompt, 6)
+    np.testing.assert_array_equal(b.run()[rid2], want)
+    np.testing.assert_array_equal(want, _oracle(cfg, params, prompt, 6))
+
+
+def test_load_params_drops_stale_prefix_cache():
+    """Paged mode: a parameter swap must rebuild the prefix index empty —
+    cached pages hold KV computed under the OLD weights, and a post-swap
+    hit against them would decode wrong tokens when the trees differ."""
+    cfg, params = _make()
+    prompt = np.arange(1, 25, dtype=np.int32)      # spans whole pages
+    b = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8)
+    rid = b.submit(prompt, 4)
+    b.run()
+    b.result(rid, pop=True)
+    assert b.prefix_stats()["cached_pages"] > 0    # index is warm
+    b.unload_params()
+    # a DIFFERENT tree (fresh seed): the old pages are poison now
+    params2 = GPT(cfg).init(jax.random.key(7),
+                            jnp.ones((1, 4), jnp.int32))["params"]
+    b.load_params(jax.device_put(params2))
+    assert b.prefix_stats()["cached_pages"] == 0   # index flushed
+    rid2 = b.submit(prompt, 4)
+    out = b.run()[rid2]
+    assert b.prefix_stats()["hit"] == 0, "stale prefix page was reused"
+    np.testing.assert_array_equal(out, _oracle(cfg, params2, prompt, 4))
+
+
 def test_mid_flight_admission_does_not_disturb_running_slots():
     """Submit while another request is mid-decode; both stay exact."""
     cfg, params = _make()

@@ -865,6 +865,156 @@ def test_autoscaler_loop_acts_and_emits_events():
     assert "reason" in up and "queued" in up
 
 
+# ---------------------------------------------- warm-standby pool units
+
+class _PoolWorld(_FakeWorld):
+    """_FakeWorld + the cluster surface StandbyPool/ServingCluster need:
+    ``add_workers`` spawns fake replicas in gang-sized blocks,
+    ``_client_for`` swallows driver control messages (promote etc.)."""
+
+    def add_workers(self, n, map_fun=None, tf_args=None, timeout=None):
+        return [self.add_replica() for _ in range(n)]
+
+    def _client_for(self, eid):
+        class _Null:
+            def put(self, qname, item, timeout=None):
+                pass
+        return _Null()
+
+    def retire_worker(self, eid):
+        pass
+
+
+def _standby_tier(world, scheduler, pool_size):
+    """A driver-side ServingCluster over fakes (no frontend/monitor),
+    with a filled warm-standby pool — the unit harness for promotion
+    race-safety."""
+    from tensorflowonspark_tpu.serving import ServingCluster, StandbyPool
+
+    tier = ServingCluster(world, scheduler, monitor=None, frontend=None,
+                          address=("127.0.0.1", 0))
+    scheduler.on_replica_ready = tier._on_standby_ready
+    tier.standbys = StandbyPool(tier, pool_size)
+    tier.standbys.fill()
+    return tier
+
+
+def test_standby_promotion_race_promotes_two_different_standbys():
+    """Acceptance (race-safety): a concurrent replica failure and an
+    autoscaler scale-up each acquire a standby — with two pooled, they
+    promote two DIFFERENT ones (acquire pops atomically; a double
+    promotion would blow up scheduler.add_replica's double-registration
+    guard)."""
+    world = _PoolWorld(2)
+    s = _scheduler(world).start()
+    tier = _standby_tier(world, s, pool_size=2)
+    try:
+        assert tier.standbys.stats()["standbys"] == 2    # eids 2 and 3
+        got = []
+        threads = [threading.Thread(
+            target=lambda src=src: got.append(tier.promote_standby(src)))
+            for src in ("failure", "scale_up")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert sorted(got) == [2, 3], got
+        assert {2, 3} <= s.alive_replicas()
+        # both promoted gangs serve traffic
+        for k in range(4):
+            _, err = _collect(s.submit(np.asarray([k + 1], np.int32), 2))
+            assert err is None
+        # the standby_ready acks close the heal measurements AND release
+        # the deferred backfills (restock waits for restored capacity)
+        for eid in got:
+            s._handle_response(s.replicas[eid],
+                               {"rid": None, "event": "standby_ready"})
+        deadline = time.monotonic() + 5
+        while tier.standbys.stats()["standbys"] < 2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert tier.standbys.stats()["standbys"] == 2
+        m = tier.metrics()
+        assert m["standby"]["promotions"] == {"failure": 1, "scale_up": 1}
+        assert m["standby"]["heal"]["count"] == 2
+    finally:
+        tier.standbys.stop()
+        s.stop()
+
+
+def test_standby_promotion_race_with_one_standby_falls_back_cold():
+    """With ONE pooled standby, a concurrent failure-heal + scale-up
+    yield one promotion + one COLD spawn — never the same standby twice,
+    and the tier still grows by two distinct replicas."""
+    world = _PoolWorld(2)
+    s = _scheduler(world).start()
+    tier = _standby_tier(world, s, pool_size=1)
+    try:
+        standby_eid = tier.standbys.stats()["ready"][0]
+        world.kill(1)
+        s.on_cluster_failure(__import__(
+            "tensorflowonspark_tpu.health", fromlist=["ClusterFailure"]
+        ).ClusterFailure("crash", "crash: worker 1", (1,)))
+        threads = [
+            threading.Thread(target=tier._spawn_replacement,
+                             kwargs=dict(eid=1, source="failure",
+                                         promote_source="failure")),
+            threading.Thread(target=lambda: tier.scale_up(1)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        deadline = time.monotonic() + 10
+        while len(s.alive_replicas()) < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        alive = s.alive_replicas()
+        assert standby_eid in alive, "the standby was never promoted"
+        assert len(alive) == 3, alive    # 0 + promoted + one cold spawn
+        for k in range(4):
+            _, err = _collect(s.submit(np.asarray([k + 1], np.int32), 2))
+            assert err is None
+    finally:
+        tier.standbys.stop()
+        s.stop()
+
+
+def test_standby_death_shrinks_pool_backfills_never_registers():
+    """Acceptance (standby churn): a DEAD standby leaves the pool and is
+    backfilled by a fresh one — and at no point does an unpromoted
+    standby register with the scheduler."""
+    from tensorflowonspark_tpu.health import ClusterFailure
+
+    world = _PoolWorld(1)
+    s = _scheduler(world).start()
+    tier = _standby_tier(world, s, pool_size=1)
+    try:
+        standby_eid = tier.standbys.stats()["ready"][0]
+        assert standby_eid == 1 and s.alive_replicas() == {0}
+        world.kill(standby_eid)
+        tier._on_cluster_failure(ClusterFailure(
+            "crash", f"crash: worker {standby_eid}",
+            (standby_eid,)))
+        assert tier.standbys.leader_of(standby_eid) is None
+        assert standby_eid in tier.standbys.dead
+        deadline = time.monotonic() + 5
+        while tier.standbys.stats()["standbys"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        fresh = tier.standbys.stats()["ready"]
+        assert fresh and fresh[0] != standby_eid, fresh
+        # the scheduler never saw either standby: no registration, no
+        # death, no capacity change
+        assert s.alive_replicas() == {0}
+        assert s.dead_replicas() == set()
+        assert standby_eid not in s.replicas
+        _, err = _collect(s.submit(np.asarray([5], np.int32), 3))
+        assert err is None
+    finally:
+        tier.standbys.stop()
+        s.stop()
+
+
 # ------------------------------------------------- frontend/client units
 
 def test_frontend_client_roundtrip_and_typed_shed():
@@ -1261,6 +1411,124 @@ def test_preempted_replica_drains_and_is_replaced(tmp_path, worker_env):
             assert c.generate(p, n, timeout=120).tolist() == _oracle(p, n)
     finally:
         serving.shutdown(timeout=180)   # a reclaim must not fail shutdown
+
+
+@pytest.mark.integration
+def test_warm_standby_promotes_on_replica_kill(tmp_path, worker_env):
+    """Acceptance (the heal window, closed): a tier with a warm standby
+    loses replica 1 to a chaos SIGKILL mid-decode.  The heal PROMOTES
+    the standby — control message + peer weight clone from replica 0 —
+    instead of cold-spawning: zero accepted requests lost, every stream
+    oracle-exact across the failover, the promoted standby serves, the
+    pool backfills, and the event log tells the warm story
+    (heal_started → standby_promoted → standby_ready with heal_secs)."""
+    env = dict(worker_env, TFOS_CHAOS="kill node=1 at_step=4")
+    serving = _run_serving(tmp_path, env, num_replicas=2, warm_standbys=1)
+    try:
+        assert serving.wait_standbys(timeout=120), "standby never warmed"
+        assert serving.standbys.stats() == {"standbys": 1, "ready": [2]}
+        rng = np.random.default_rng(6)
+        reqs = _requests(rng, 8, bmin=10, bmax=16)
+        results: dict[int, list] = {}
+        errors: list = []
+
+        def run_client(cid):
+            try:
+                with serving.client() as c:
+                    for i in range(cid, len(reqs), 2):
+                        p, n = reqs[i]
+                        results[i] = c.generate(p, n, timeout=180).tolist()
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=run_client, args=(cid,))
+                   for cid in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(240)
+        assert not errors, errors
+        for i, (p, n) in enumerate(reqs):
+            assert results[i] == _oracle(p, n), f"request {i} diverged"
+        # the standby (executor 2) was promoted into the scheduler
+        deadline = time.monotonic() + 90
+        while 2 not in serving.scheduler.alive_replicas() \
+                and time.monotonic() < deadline:
+            time.sleep(0.25)
+        assert 2 in serving.scheduler.alive_replicas(), \
+            "standby was never promoted"
+        assert serving.scheduler.dead_replicas() == {1}
+        m = serving.metrics()
+        assert m["failed"] == 0 and m["completed"] == m["accepted"], m
+        assert m["standby"]["promotions"] == {"failure": 1}
+        # the promoted replica serves traffic (probe until routed there)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if serving.metrics()["replicas"][2]["served"] > 0:
+                break
+            ts = [threading.Thread(target=lambda: _probe(serving, reqs[0]))
+                  for _ in range(3)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60)
+        assert serving.metrics()["replicas"][2]["served"] > 0, \
+            "promoted standby never served"
+        # the pool backfilled a fresh standby (executor 3)
+        deadline = time.monotonic() + 90
+        while serving.standbys.stats()["standbys"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.25)
+        assert serving.standbys.stats()["ready"] == [3]
+        kinds = [e["kind"] for e in _serving_events(tmp_path)]
+        for kind in ("heal_started", "standby_promoted", "standby_ready",
+                     "standby_booted", "replica_replaced"):
+            assert kind in kinds, (kind, kinds)
+        ready = [e for e in _serving_events(tmp_path)
+                 if e["kind"] == "standby_ready"]
+        assert ready and ready[0]["heal_secs"] > 0
+        assert m["standby"]["heal"]["count"] >= 1
+    finally:
+        serving.shutdown(timeout=180)
+
+
+def _probe(serving, req):
+    with serving.client() as c:
+        p, n = req
+        assert c.generate(p, n, timeout=60).tolist() == _oracle(p, n)
+
+
+@pytest.mark.integration
+def test_standby_death_backfills_and_never_registers_live(tmp_path,
+                                                          worker_env):
+    """Chaos kills the STANDBY itself (node 1, time-triggered — a
+    standby reports no steps): the pool shrinks, backfills a fresh
+    standby, the scheduler never registered either, and the tier keeps
+    serving oracle-exact through shutdown (the corpse is tolerated)."""
+    env = dict(worker_env, TFOS_CHAOS="kill node=1 after_secs=2")
+    serving = _run_serving(tmp_path, env, num_replicas=1, warm_standbys=1)
+    try:
+        # wait for the kill to land and the backfill to replace it
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            stats = serving.standbys.stats()
+            if stats["ready"] and stats["ready"][0] != 1:
+                break
+            time.sleep(0.25)
+        assert serving.standbys.stats()["ready"] == [2], \
+            serving.standbys.stats()
+        assert 1 in serving.standbys.dead
+        assert serving.scheduler.alive_replicas() == {0}
+        assert serving.scheduler.dead_replicas() == set()
+        assert 1 not in serving.scheduler.replicas
+        rng = np.random.default_rng(7)
+        p, n = _requests(rng, 1)[0]
+        with serving.client() as c:
+            assert c.generate(p, n, timeout=120).tolist() == _oracle(p, n)
+        kinds = [e["kind"] for e in _serving_events(tmp_path)]
+        assert "standby_dead" in kinds and kinds.count("standby_booted") >= 2
+    finally:
+        serving.shutdown(timeout=120)   # must tolerate the standby corpse
 
 
 @pytest.mark.integration

@@ -164,25 +164,28 @@ def prefix_model_builder(args):
 
 
 def _one_node_counter(rec: dict | None, name: str,
-                      outcome: str | None = None):
+                      outcome: str | None = None, label: str = "outcome"):
     total = 0.0
     fam = ((rec or {}).get("metrics") or {}).get(name)
     for labels, value in (fam or {}).get("samples", ()):
-        if outcome is None or labels.get("outcome") == outcome:
+        if outcome is None or labels.get(label) == outcome:
             total += value
     return total
 
 
 def _node_counter_delta(nodes0: dict, nodes1: dict, name: str,
-                        outcome: str | None = None):
+                        outcome: str | None = None,
+                        label: str = "outcome", eids=None):
     """Per-node counter delta summed over the nodes still reporting at
     the end.  Diffing per node (not sum-vs-sum) keeps the arithmetic
     honest when a node dies mid-window — a killed replica drops out of
     the final snapshot, and subtracting its baseline from the
-    survivors' totals would go negative."""
-    return sum(_one_node_counter(rec, name, outcome)
-               - _one_node_counter(nodes0.get(eid), name, outcome)
-               for eid, rec in nodes1.items())
+    survivors' totals would go negative.  ``eids`` restricts the sum to
+    a node subset (the disagg bench's per-pool accounting)."""
+    return sum(_one_node_counter(rec, name, outcome, label)
+               - _one_node_counter(nodes0.get(eid), name, outcome, label)
+               for eid, rec in nodes1.items()
+               if eids is None or eid in eids)
 
 
 def _run_load(serving, reqs, rate, rng):
@@ -694,6 +697,269 @@ def validate_prefix_artifact(out: dict) -> None:
         raise RuntimeError("artifact gate: gates summary missing")
 
 
+#: the disagg bench reuses the prefix-bench model dims: a long prompt's
+#: full prefill must visibly stall a unified replica's decode loop (the
+#: head-of-line blocking the split removes), which needs real per-token
+#: compute — toy dims would measure queueing noise
+DISAGG_DIMS = PREFIX_DIMS
+DISAGG_SMOKE_DIMS = PREFIX_SMOKE_DIMS
+
+
+def disagg_scenario(scenario, *, disagg, replicas, n_short, n_long,
+                    short_tokens, long_tokens, short_budget, long_budget,
+                    rate, slots, page_tokens, pool_pages, prefill_chunk,
+                    dims, kill_plan=None, expect_dead=None, seed=0):
+    """One arm of the disaggregated-serving bench: a mixed open-loop
+    workload of fixed-length SHORT prompts (the TTFT-sensitive traffic)
+    with LONG prompts interleaved (the head-of-line pressure), against
+    either a unified tier (``disagg=None``) or specialized pools.  Both
+    arms run the identical paged engine; the disagg arm's prefill pool
+    adds chunked streaming admission (``prefill_chunk``) — its design
+    posture, since a pool that never decodes has nothing to stall.
+    In-scenario gates: zero loss, solo-greedy oracle exactness, and for
+    disagg arms ZERO prefill dispatches on decode gangs / zero decode
+    dispatches on prefill gangs + every request handed off; kill arms
+    additionally gate requeue-once and the expected dead set.  The
+    cross-arm TTFT gate lives in ``validate_disagg_artifact``."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.serving import ServingCluster
+
+    worker_env = {"JAX_PLATFORMS": "cpu"}
+    if kill_plan:
+        worker_env["TFOS_CHAOS"] = kill_plan
+    rng = np.random.default_rng(seed)
+    shorts = [(rng.integers(0, dims["vocab"], (short_tokens,))
+               .astype(np.int32), short_budget) for _ in range(n_short)]
+    longs = [(rng.integers(0, dims["vocab"], (long_tokens,))
+              .astype(np.int32), long_budget) for _ in range(n_long)]
+    # interleave a long every `stride` shorts so long-prefill pressure
+    # spans the whole window instead of clustering
+    reqs, kinds = [], []
+    stride = max(1, n_short // max(1, n_long))
+    si = li = 0
+    for i in range(n_short + n_long):
+        if li < n_long and (si >= n_short or i % (stride + 1) == stride):
+            reqs.append(longs[li])
+            kinds.append("long")
+            li += 1
+        else:
+            reqs.append(shorts[si])
+            kinds.append("short")
+            si += 1
+    run_kwargs = {}
+    if disagg is not None:
+        spec = dict(disagg)
+        if prefill_chunk:
+            spec["prefill_kwargs"] = {"prefill_chunk": prefill_chunk}
+        run_kwargs["disagg"] = spec
+        assert replicas == disagg["prefill"] + disagg["decode"]
+    serving = ServingCluster.run(
+        prefix_model_builder, replicas, max_batch=slots,
+        batcher_kwargs={"kv_page_tokens": page_tokens,
+                        "kv_pool_pages": pool_pages},
+        replica_args={"prefix_dims": dims},
+        max_queue_depth=4 * len(reqs),
+        worker_env=worker_env, reservation_timeout=240, **run_kwargs)
+    try:
+        def _wave(prompts_budgets):
+            def _gen(p, b):
+                with serving.client() as c:
+                    c.generate(p, b, timeout=600)
+
+            ts = [threading.Thread(target=_gen, args=(p, b))
+                  for p, b in prompts_budgets]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(600)
+
+        def _tshort():
+            return rng.integers(0, dims["vocab"], (short_tokens,)) \
+                .astype(np.int32)
+
+        def _tlong():
+            return rng.integers(0, dims["vocab"], (long_tokens,)) \
+                .astype(np.int32)
+
+        if kill_plan is None:
+            # pay every (bucket, group) compile — short solo/grouped,
+            # long solo, long+shorts mixed — outside the window, through
+            # the FULL pipeline (the disagg arm's adopt executables
+            # compile here too).  Throwaway prompts: unique content, so
+            # nothing the window serves is pre-cached.
+            _wave([(_tshort(), 2)])
+            for _ in range(max(1, replicas)):
+                _wave([(_tshort(), 2) for _ in range(slots)])
+            _wave([(_tlong(), 2)])
+            _wave([(_tlong(), 2)]
+                  + [(_tshort(), 2) for _ in range(slots - 1)])
+        else:
+            # chaos arm: the kill must land in the measured window —
+            # minimal warmup (this arm gates loss/exactness, not TTFT)
+            _wave([(_tshort(), 2) for _ in range(replicas)])
+        time.sleep(2.5)               # heartbeat carries the snapshots
+        m0 = serving.metrics()
+        t0 = time.monotonic()
+        records = _run_load(serving, reqs, rate, rng)
+        wall = time.monotonic() - t0
+        time.sleep(2.5)
+        m1 = serving.metrics()
+        sched = {k: m1[k] - m0[k] for k in
+                 ("accepted", "completed", "shed", "failed", "requeued",
+                  "handoffs")}
+        roles = {eid: r.get("role") for eid, r in m1["replicas"].items()}
+        prefill_eids = {e for e, r in roles.items() if r == "prefill"}
+        decode_eids = {e for e, r in roles.items() if r == "decode"}
+        eng = {
+            "decode_gang_prefill_dispatches": int(_node_counter_delta(
+                m0["nodes"], m1["nodes"],
+                "tfos_replica_prefill_dispatches_total",
+                eids=decode_eids)) if disagg else None,
+            "prefill_gang_decode_dispatches": int(_node_counter_delta(
+                m0["nodes"], m1["nodes"],
+                "tfos_replica_decode_dispatches_total",
+                eids=prefill_eids)) if disagg else None,
+            "sessions_exported": int(_node_counter_delta(
+                m0["nodes"], m1["nodes"], "tfos_replica_sessions_total",
+                "exported", label="direction")),
+            "sessions_adopted": int(_node_counter_delta(
+                m0["nodes"], m1["nodes"], "tfos_replica_sessions_total",
+                "adopted", label="direction")),
+        }
+        dead = sorted(serving.scheduler.dead_replicas())
+    finally:
+        serving.shutdown(timeout=300)
+
+    ok = [r for r in records if r and r["ok"]]
+    failed = [r for r in records if r and not r["ok"]]
+    if failed or len(ok) != len(reqs):
+        raise RuntimeError(
+            f"{scenario}: {len(failed)} accepted request(s) failed / "
+            f"{len(reqs) - len(ok)} lost — the zero-loss gate")
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import greedy_generate
+
+    cfg, params = prefix_model_builder({"seed": seed,
+                                        "prefix_dims": dims})
+    for i, ((p, n), r) in enumerate(zip(reqs, records)):
+        want = np.asarray(greedy_generate(
+            cfg, params, jnp.asarray(p)[None, :], n))[0, len(p):]
+        if r["out"] != want.tolist():
+            raise RuntimeError(
+                f"{scenario}: request {i} ({kinds[i]}) diverged from the "
+                "solo greedy oracle — the locked-oracle gate")
+    if disagg is not None:
+        if eng["decode_gang_prefill_dispatches"] != 0:
+            raise RuntimeError(
+                f"{scenario}: {eng['decode_gang_prefill_dispatches']} "
+                "prefill dispatch(es) ran on DECODE gangs — the "
+                "specialization gate")
+        if eng["prefill_gang_decode_dispatches"] != 0:
+            raise RuntimeError(
+                f"{scenario}: {eng['prefill_gang_decode_dispatches']} "
+                "decode dispatch(es) ran on PREFILL gangs — the "
+                "specialization gate")
+        if sched["handoffs"] < len(reqs):
+            raise RuntimeError(
+                f"{scenario}: only {sched['handoffs']} handoffs for "
+                f"{len(reqs)} requests — sessions are not moving over "
+                "the page-transfer plane")
+    if kill_plan is not None:
+        if sched["requeued"] < 1:
+            raise RuntimeError(f"{scenario}: nothing was requeued — the "
+                               "chaos kill landed nowhere?")
+        if expect_dead is not None and dead != expect_dead:
+            raise RuntimeError(f"{scenario}: dead set {dead} != "
+                               f"{expect_dead}")
+    tokens = sum(r["tokens"] for r in ok)
+    by_kind = {}
+    for kind in ("short", "long"):
+        rs = [r for r, k in zip(records, kinds) if k == kind and r["ok"]]
+        by_kind[kind] = {
+            "count": len(rs),
+            "ttft": _percentiles([r["ttft"] for r in rs
+                                  if r["ttft"] is not None]),
+            "e2e": _percentiles([r["e2e"] for r in rs]),
+        }
+    return {
+        "scenario": scenario,
+        "arm": "disagg" if disagg else "unified",
+        "disagg": None if disagg is None
+        else {k: v for k, v in disagg.items()},
+        "prefill_chunk": prefill_chunk if disagg else None,
+        "requests": {
+            "offered": len(reqs), "accepted": sched["accepted"],
+            "completed": len(ok), "shed": sched["shed"],
+            "failed": sched["failed"], "requeued": sched["requeued"],
+            "lost": 0,
+        },
+        "oracle_exact": True,
+        "handoffs": sched["handoffs"],
+        "engine": eng,
+        "dead_gang_eids": dead,
+        "short": by_kind["short"],
+        "long": by_kind["long"],
+        "tokens_total": tokens,
+        "wall_secs": round(wall, 3),
+        "throughput_tokens_per_s": round(tokens / wall, 2),
+    }
+
+
+DISAGG_ROW_KEYS = frozenset({
+    "scenario", "arm", "disagg", "requests", "oracle_exact", "handoffs",
+    "engine", "dead_gang_eids", "short", "long", "tokens_total",
+    "wall_secs", "throughput_tokens_per_s"})
+
+
+def validate_disagg_artifact(out: dict) -> None:
+    """Schema + self-failing gates for ``disagg_serving.json`` (the
+    smoke artifact validates here too; its TTFT gate is advisory)."""
+    if out.get("benchmark") != "disagg_serving":
+        raise RuntimeError("artifact gate: wrong benchmark name")
+    rows = {row.get("scenario"): row for row in out.get("rows") or []}
+    if not rows:
+        raise RuntimeError("artifact gate: no rows")
+    for name, row in rows.items():
+        missing = DISAGG_ROW_KEYS - set(row)
+        if missing:
+            raise RuntimeError(f"artifact gate: row {name} missing keys "
+                               f"{sorted(missing)}")
+        if not row["oracle_exact"] or row["requests"]["lost"] != 0 \
+                or row["requests"]["failed"] != 0:
+            raise RuntimeError(f"artifact gate: row {name} violates the "
+                               "zero-loss/oracle gates")
+        if row["arm"] == "disagg" and (
+                row["engine"]["decode_gang_prefill_dispatches"] != 0
+                or row["handoffs"] < row["requests"]["completed"]):
+            raise RuntimeError(
+                f"artifact gate: row {name} violates the specialization "
+                "gates (prefill on a decode gang, or missing handoffs)")
+    smoke = bool(out.get("config", {}).get("smoke"))
+    if "disagg" not in rows:
+        raise RuntimeError("artifact gate: no disagg row")
+    if smoke:
+        return
+    if not {"unified", "disagg", "kill_prefill", "kill_decode"} \
+            <= set(rows):
+        raise RuntimeError(f"artifact gate: full run needs the unified/"
+                           f"disagg A/B and both chaos rows, got "
+                           f"{sorted(rows)}")
+    for name in ("kill_prefill", "kill_decode"):
+        if rows[name]["requests"]["requeued"] < 1:
+            raise RuntimeError(f"artifact gate: {name} requeued nothing")
+    p95_d = rows["disagg"]["short"]["ttft"]["p95_secs"]
+    p95_u = rows["unified"]["short"]["ttft"]["p95_secs"]
+    if p95_d is None or p95_u is None or p95_d >= p95_u:
+        raise RuntimeError(
+            f"artifact gate: short-prompt TTFT p95 under long-prompt "
+            f"pressure — disagg {p95_d}s vs unified {p95_u}s (must "
+            "beat the unified baseline)")
+    if (out.get("gates") or {}).get("short_ttft_p95_win_pct") is None:
+        raise RuntimeError("artifact gate: gates summary missing")
+
+
 #: committed heal-window gate: a warm promotion must restore first-token
 #: capacity in at most this fraction of the cold spawn's time
 HEAL_WARM_VS_COLD_RATIO = 0.5
@@ -705,14 +971,23 @@ COLD_SPAWN_FLOOR_SECS = 5.0
 
 
 def heal_scenario(mode, n_requests, rate, slots, kill_step, seed=0,
-                  working_dir=None):
+                  working_dir=None, batcher_kwargs=None,
+                  prefix_probe=False):
     """One arm of the warm-vs-cold heal A/B: a 2-replica tier loses
     replica 1 to a chaos SIGKILL mid-stream and HEALS — ``mode="cold"``
     via ``replace_failed`` (full process boot + compile), ``mode="warm"``
     via warm-standby promotion + peer weight clone.  Measures the heal
     window from three clocks (chaos sentinel = the kill, ``heal_started``
     = the tier's decision, first token ON THE REPLACEMENT = restored
-    capacity) and enforces the zero-loss/oracle gates itself."""
+    capacity) and enforces the zero-loss/oracle gates itself.
+
+    ``prefix_probe`` (with a paged ``batcher_kwargs``) adds the warm-vs-
+    cold PREFIX-HIT row: a system prompt is seeded into both replicas'
+    prefix caches before the kill, and after the heal the promoted
+    replacement is probed with (a) the seeded prompt — its CLONED pages
+    must hit — and (b) a fresh prompt — a guaranteed miss, the cold-
+    cache contrast.  The row gates that promotion cloned the peer's
+    prefix-cache pages, not just its weights."""
     import tempfile
 
     import numpy as np
@@ -730,9 +1005,15 @@ def heal_scenario(mode, n_requests, rate, slots, kill_step, seed=0,
     reqs = [(rng.integers(0, VOCAB, (int(rng.integers(3, 10)),))
              .astype(np.int32), int(rng.integers(8, 17)))
             for _ in range(n_requests)]
+    sysp = rng.integers(0, VOCAB, (17,)).astype(np.int32)
+
+    def _sys_probe():
+        return (np.concatenate(
+            [sysp, rng.integers(0, VOCAB, (3,)).astype(np.int32)]), 4)
 
     serving = ServingCluster.run(
         bench_model_builder, 2, max_batch=slots,
+        batcher_kwargs=dict(batcher_kwargs or {}),
         worker_env=worker_env, working_dir=working_dir,
         reservation_timeout=120, max_queue_depth=4 * n_requests,
         warm_standbys=1 if warm else 0, replace_failed=not warm)
@@ -750,6 +1031,14 @@ def heal_scenario(mode, n_requests, rate, slots, kill_step, seed=0,
             t.start()
         for t in warmers:
             t.join(600)
+        probe_records, probe_reqs = [], []
+        if prefix_probe:
+            # seed the system prompt into BOTH replicas' prefix caches
+            # (concurrent pair: least-outstanding routing lands one on
+            # each) — the clone source must hold the pages to donate
+            seed_reqs = [_sys_probe(), _sys_probe()]
+            probe_records.extend(_run_load(serving, seed_reqs, 50.0, rng))
+            probe_reqs.extend(seed_reqs)
         sched0 = serving.metrics()      # baseline: exclude warmup counts
         t0 = time.monotonic()
         records = _run_load(serving, reqs, rate, rng)
@@ -757,9 +1046,21 @@ def heal_scenario(mode, n_requests, rate, slots, kill_step, seed=0,
         # restored capacity = the REPLACEMENT serves: keep probing until
         # it does (probe bursts spread over replicas; probes are checked
         # against the oracle like the window's records)
-        probe_records, probe_reqs, replacement = \
+        more_records, more_reqs, replacement = \
             _probe_until_replacement_serves(serving, reqs, rng,
                                             timeout=180.0)
+        probe_records.extend(more_records)
+        probe_reqs.extend(more_reqs)
+        post_heal_prefix = None
+        if prefix_probe:
+            post_heal_prefix, pr, pq = _probe_post_heal_prefix(
+                serving, replacement, _sys_probe,
+                lambda: (np.concatenate(
+                    [rng.integers(0, VOCAB, (17,)).astype(np.int32),
+                     rng.integers(0, VOCAB, (3,)).astype(np.int32)]), 4),
+                rng)
+            probe_records.extend(pr)
+            probe_reqs.extend(pq)
         sched = serving.metrics()
         for k in ("accepted", "completed", "shed", "failed", "requeued"):
             sched[k] -= sched0[k]
@@ -825,6 +1126,7 @@ def heal_scenario(mode, n_requests, rate, slots, kill_step, seed=0,
     return {
         "scenario": f"heal_{mode}",
         "mode": mode,
+        "post_heal_prefix": post_heal_prefix,
         "requests": {
             "offered": len(all_records), "accepted": sched["accepted"],
             "completed": len(ok), "shed": sched["shed"],
@@ -867,6 +1169,56 @@ def _probe_until_replacement_serves(serving, reqs, rng, timeout: float):
         time.sleep(0.2)
     raise RuntimeError("no replacement replica served within the heal "
                        "window — the tier never restored capacity")
+
+
+def _probe_post_heal_prefix(serving, replacement, mk_seeded, mk_fresh,
+                            rng):
+    """The warm-vs-cold prefix-hit contrast on the REPLACEMENT replica:
+    burst probes carrying the pre-kill SEEDED system prompt until the
+    replacement's prefix counters first move — cloned pages make that
+    first movement a HIT; a weights-only heal would miss (and only then
+    self-commit) — then fresh-prompt probes for the guaranteed-miss
+    contrast row.  Every probe uses a unique tail so nothing but the
+    system prefix can match.  Returns ``(row, records, reqs)``."""
+    records, reqs = [], []
+
+    def counters():
+        rec = serving.metrics()["nodes"].get(replacement)
+        return {o: _one_node_counter(
+            rec, "tfos_replica_prefix_cache_requests_total", o)
+            for o in ("hit", "miss", "partial")}
+
+    def settle():
+        # the heartbeat lags the replacement-detection probes (random
+        # prompts, guaranteed misses): wait until two consecutive reads
+        # agree, or their stale misses pollute the seeded delta
+        prev = counters()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            time.sleep(1.2)
+            cur = counters()
+            if cur == prev:
+                return
+            prev = cur
+
+    def probe_until_moved(mk):
+        settle()
+        base = counters()
+        delta = {o: 0.0 for o in base}
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            burst = [mk() for _ in range(3)]
+            records.extend(_run_load(serving, burst, 50.0, rng))
+            reqs.extend(burst)
+            time.sleep(1.6)         # heartbeat carries the counters
+            cur = counters()
+            delta = {o: int(cur[o] - base[o]) for o in cur}
+            if sum(delta.values()):  # the replacement served a probe
+                return delta
+        return delta
+    return ({"cloned_prompt": probe_until_moved(mk_seeded),
+             "fresh_prompt": probe_until_moved(mk_fresh)},
+            records, reqs)
 
 
 ELASTICITY_HEAL_KEYS = frozenset({
@@ -913,6 +1265,19 @@ def validate_elasticity_artifact(out: dict) -> None:
                 f"artifact gate: warm promotion took "
                 f"{warm['standby_ready_secs']}s decision-to-ready — not "
                 f"under the {COLD_SPAWN_FLOOR_SECS}s cold-spawn floor")
+        prefix = warm.get("post_heal_prefix")
+        if prefix is not None:
+            cloned, fresh = prefix["cloned_prompt"], prefix["fresh_prompt"]
+            if cloned["hit"] + cloned["partial"] < 1 or cloned["miss"]:
+                raise RuntimeError(
+                    f"artifact gate: the promoted replica's FIRST seeded"
+                    f"-prompt probe did not hit ({cloned}) — promotion "
+                    "failed to clone the peer's prefix-cache pages")
+            if fresh["miss"] < 1:
+                raise RuntimeError(
+                    f"artifact gate: the fresh-prompt contrast probe "
+                    f"never missed ({fresh}) — the prefix-hit row is "
+                    "not measuring the cache")
         return
     if not {"ramp", "heal_cold", "heal_warm"} <= set(rows):
         raise RuntimeError(f"artifact gate: full run needs the ramp row "
@@ -1156,6 +1521,13 @@ def main():
                     help="run the mesh-sharded gang scenarios instead "
                          "(tp=1 vs tp=2 A/B + kill-one-shard); writes "
                          "bench_artifacts/sharded_serving.json")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the disaggregated prefill/decode scenarios "
+                         "instead (mixed long/short open-loop workload: "
+                         "unified vs disagg A/B + chaos kills of a "
+                         "prefill gang mid-prefill and a decode gang "
+                         "post-handoff); writes "
+                         "bench_artifacts/disagg_serving.json")
     ap.add_argument("--prefix-heavy", action="store_true",
                     help="run the paged-KV prefix-cache scenarios "
                          "instead (M distinct system prompts x N "
@@ -1169,6 +1541,85 @@ def main():
                          "advisory in smoke)")
     args = ap.parse_args()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.disagg:
+        if args.smoke:
+            base = dict(n_short=8, n_long=2, short_tokens=6,
+                        long_tokens=40, short_budget=8, long_budget=6,
+                        rate=20.0, slots=4, page_tokens=8,
+                        pool_pages=None, prefill_chunk=16,
+                        dims=DISAGG_SMOKE_DIMS)
+            rows = [disagg_scenario(
+                "disagg", disagg={"prefill": 1, "decode": 1}, replicas=2,
+                **base)]
+        else:
+            base = dict(n_short=40, n_long=8, short_tokens=12,
+                        long_tokens=320, short_budget=16, long_budget=8,
+                        rate=args.rate, slots=args.slots,
+                        page_tokens=16, pool_pages=512,
+                        prefill_chunk=64, dims=DISAGG_DIMS)
+            rows = [
+                disagg_scenario("unified", disagg=None, replicas=2,
+                                **base),
+                disagg_scenario("disagg",
+                                disagg={"prefill": 1, "decode": 1},
+                                replicas=2, **base),
+                disagg_scenario(
+                    "kill_prefill",
+                    disagg={"prefill": 2, "decode": 1}, replicas=3,
+                    kill_plan="kill node=0 at_step=4",
+                    expect_dead=[0],
+                    **{**base, "n_short": 16, "n_long": 4,
+                       "rate": min(args.rate, 8.0)}),
+                disagg_scenario(
+                    "kill_decode",
+                    disagg={"prefill": 1, "decode": 2}, replicas=3,
+                    kill_plan="kill node=1 at_step=8",
+                    expect_dead=[1],
+                    **{**base, "n_short": 16, "n_long": 4,
+                       "rate": min(args.rate, 8.0)}),
+            ]
+        for row in rows:
+            print(json.dumps(row, indent=2))
+        by = {r["scenario"]: r for r in rows}
+        uni = by.get("unified")
+        dis = by["disagg"]
+        gates = {
+            "short_ttft_p95_disagg_secs": dis["short"]["ttft"]["p95_secs"],
+            "short_ttft_p95_unified_secs":
+                None if uni is None else uni["short"]["ttft"]["p95_secs"],
+            "short_ttft_p95_win_pct": None if uni is None else round(
+                100 * (1 - dis["short"]["ttft"]["p95_secs"]
+                       / uni["short"]["ttft"]["p95_secs"]), 1),
+            "decode_gang_prefill_dispatches":
+                dis["engine"]["decode_gang_prefill_dispatches"],
+        }
+        out = {
+            "benchmark": "disagg_serving",
+            "config": {
+                "backend": "LocalProcessBackend", "platform": "cpu",
+                "smoke": bool(args.smoke),
+                "workload": {k: v for k, v in base.items()
+                             if k != "dims"},
+                "model": base["dims"],
+                "kill_plans": None if args.smoke else {
+                    "kill_prefill": "kill node=0 at_step=4 (a prefill "
+                                    "gang, mid-prefill)",
+                    "kill_decode": "kill node=1 at_step=8 (a decode "
+                                   "gang, post-handoff)"},
+            },
+            "gates": gates,
+            "rows": rows,
+        }
+        validate_disagg_artifact(out)
+        name = ("disagg_serving_smoke.json" if args.smoke
+                else "disagg_serving.json")   # smoke never clobbers
+        path = os.path.join(REPO, "bench_artifacts", name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+        print(f"wrote {path} (all gates passed)")
+        return
 
     if args.prefix_heavy:
         if not args.smoke:
@@ -1296,9 +1747,13 @@ def main():
 
     if args.warm:
         # CI smoke: a dedicated artifact so a smoke run can never
-        # clobber the committed full elasticity.json
+        # clobber the committed full elasticity.json.  Paged batcher +
+        # prefix_probe: the promotion must clone the peer's PREFIX-CACHE
+        # PAGES alongside its weights (the warm-vs-cold prefix-hit row).
         row = heal_scenario("warm", n_requests=10, rate=20.0,
-                            slots=args.slots, kill_step=4)
+                            slots=args.slots, kill_step=4,
+                            batcher_kwargs={"kv_page_tokens": 8},
+                            prefix_probe=True)
         print(json.dumps(row, indent=2))
         out = {
             "benchmark": "serving_elasticity",
@@ -1307,6 +1762,7 @@ def main():
                 "smoke": True, "replicas": 2, "warm_standbys": 1,
                 "kill_plan": "kill node=1 at_step=4",
                 "cold_spawn_floor_secs": COLD_SPAWN_FLOOR_SECS,
+                "batcher": {"kv_page_tokens": 8},
                 "model": {"vocab": VOCAB, "hidden": HIDDEN,
                           "layers": LAYERS, "heads": HEADS,
                           "max_len": MAXLEN},

@@ -10,7 +10,10 @@
 #                               # zero-reprocess/oracle resume gates) +
 #                               # bench_serving.py --sharded --smoke (a
 #                               # 2-device tp gang: oracle/zero-loss/schema
-#                               # gates on the sharded serving plane)
+#                               # gates on the sharded serving plane) +
+#                               # --prefix-heavy --smoke + --disagg --smoke
+#                               # (disaggregated pools: handoff/oracle/
+#                               # zero-prefill-on-decode gates) + --warm
 #
 # The analysis gate (docs/analysis.md) runs all six project rules plus the
 # exports-drift check against the committed analysis_baseline.json ratchet
@@ -61,6 +64,17 @@ if [ "${1:-}" = "--bench-smoke" ]; then
     rc=$?
     if [ $rc -ne 0 ]; then
         echo "prefix serving bench smoke FAILED (rc=$rc)" >&2
+        exit $rc
+    fi
+    echo "== bench smoke (disaggregated prefill/decode) =="
+    # specialized prefill/decode pools with KV-page handoff: fails
+    # itself on the oracle, zero-loss, handoff, zero-prefill-on-decode
+    # and artifact-schema gates; writes disagg_serving_smoke.json
+    # (never the committed full artifact)
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py --disagg --smoke
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "disagg serving bench smoke FAILED (rc=$rc)" >&2
         exit $rc
     fi
     echo "== bench smoke (warm-standby heal) =="

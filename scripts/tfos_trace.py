@@ -6,10 +6,13 @@ telemetry streams of a cluster working dir (docs/observability.md).
     python scripts/tfos_trace.py --dir /tmp/tfos_tpu_xxxx <trace_id>
 
 The timeline merges ``serving_events.jsonl`` (admission, routing, first
-token, requeue hops, completion), ``trace_events.jsonl`` (replica-side
-intake/decode spans) and ``health_events.jsonl``; cluster failures inside
-the request's window (e.g. the chaos replica kill that caused a requeue)
-appear as ``[context]`` rows.
+token, requeue hops, the disaggregated tiers' handoff span —
+``request_handoff`` with page count/bytes, ``request_handoff_routed``
+with the adopting decode gang — and completion), ``trace_events.jsonl``
+(replica-side intake/handoff/adopt/decode spans) and
+``health_events.jsonl``; cluster failures inside the request's window
+(e.g. the chaos replica kill that caused a requeue) appear as
+``[context]`` rows.
 """
 
 import argparse

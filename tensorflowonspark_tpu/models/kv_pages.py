@@ -63,6 +63,37 @@ def _page_key(prev: bytes, tokens: np.ndarray) -> bytes:
     return h.digest()
 
 
+def chain_keys(prompt: np.ndarray, page_tokens: int) -> list[bytes]:
+    """Chained content keys for every FULL page of ``prompt`` — computed
+    identically by the exporting (prefill) and adopting (decode) sides
+    of a KV-page handoff, so a transfer keyed on them can never seat a
+    session against the wrong prefix."""
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    keys: list[bytes] = []
+    prev = b""
+    for i in range(prompt.size // page_tokens):
+        prev = _page_key(prev, prompt[i * page_tokens:(i + 1) * page_tokens])
+        keys.append(prev)
+    return keys
+
+
+def hash_page_data(arrays, n_pages: int) -> list[bytes]:
+    """Per-page content hash of gathered KV page data: page ``j``'s
+    digest covers its slice of EVERY leaf (all layers, K and V), so a
+    corrupt or torn transfer of any byte of a page fails verification.
+    ``arrays`` are the batcher's gathered pool leaves — page axis at
+    ``ndim - 4`` (``[..., page, page_tokens, heads, head_dim]``)."""
+    out: list[bytes] = []
+    for j in range(int(n_pages)):
+        h = hashlib.blake2b(digest_size=16)
+        for a in arrays:
+            a = np.asarray(a)
+            h.update(np.ascontiguousarray(
+                np.take(a, j, axis=a.ndim - 4)).tobytes())
+        out.append(h.digest())
+    return out
+
+
 class PageLease:
     """One request's hold on pool pages: the physical page per logical
     page (``page_ids[i]`` backs token positions ``i*page_tokens ..``),
@@ -202,6 +233,80 @@ class KVPagePool:
                   for i in range(len(matched), n_full)]
         return PageLease(matched + fresh, len(matched), pt, outcome,
                          insert)
+
+    def adopt(self, prompt: np.ndarray, total_tokens: int) \
+            -> PageLease | None:
+        """Lease pages to ADOPT a handed-off session whose prompt K/V
+        was computed elsewhere (a prefill gang) and arrives as imported
+        page data instead of a local prefill.
+
+        Like :meth:`admit`, the longest indexed chain over the prompt's
+        full pages is shared (those pages need no data import at all —
+        cross-request prefix reuse composes with the handoff), and fresh
+        pages cover the rest of ``total_tokens``.  Unlike ``admit``
+        there is no ">= 1 prompt token re-runs" cap: nothing is
+        prefilled here, the session already carries its first token, so
+        EVERY full prompt page is shareable and indexable.  The caller
+        imports data into ``page_ids[n_shared : ceil(prompt/page_tokens)]``
+        and then :meth:`commit` s, making the imported pages matchable.
+        None when the pool cannot allocate (admission backpressure)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        pt = self.page_tokens
+        if not 0 < prompt.size <= total_tokens:
+            raise ValueError(f"bad adopt shape: prompt {prompt.size}, "
+                             f"total {total_tokens}")
+        n_logical = self.pages_needed(total_tokens)
+        n_full = prompt.size // pt if self.prefix_cache else 0
+        keys = chain_keys(prompt, pt) if self.prefix_cache else []
+        matched: list[int] = []
+        for i in range(n_full):
+            pid = self._index.get(keys[i])
+            if pid is None:
+                break
+            matched.append(pid)
+        fresh = self._allocate(n_logical - len(matched), protect=matched)
+        if fresh is None:
+            return None
+        for pid in matched:         # hold AFTER allocation succeeded
+            self._ref[pid] += 1
+            self._lru.pop(pid, None)
+        outcome = ("miss" if not matched
+                   else "hit" if len(matched) == n_full else "partial")
+        insert = [(keys[i], fresh[i - len(matched)])
+                  for i in range(len(matched), n_full)]
+        return PageLease(matched + fresh, len(matched), pt, outcome,
+                         insert)
+
+    def adopt_cached(self, keys) -> dict[bytes, int]:
+        """Import bare CACHED prefix pages (a peer's cloned prefix index
+        at standby promotion): allocate a page per unseen key off the
+        free list — never evicting resident cached pages for imported
+        ones — and park it in the LRU at refcount 0, indexed and
+        matchable once the caller has written its K/V.  Keys must arrive
+        in the donor's insertion order (chain parents precede children),
+        so truncating at capacity keeps every imported chain reachable.
+        Returns ``{key: page_id}`` for the pages actually allocated."""
+        out: dict[bytes, int] = {}
+        if not self.prefix_cache:
+            return out
+        for key in keys:
+            if key in self._index:
+                continue
+            if not self._free:
+                break
+            pid = self._free.pop()
+            self._index[key] = pid
+            self._key_of[pid] = key
+            self._ref[pid] = 0
+            self._lru[pid] = None
+            out[key] = pid
+        return out
+
+    def export_index(self) -> list[tuple[bytes, int]]:
+        """Every indexed (chain key, physical page) pair in insertion
+        order — parents precede children, so an importer consuming a
+        prefix of this list never creates an unreachable chain."""
+        return list(self._index.items())
 
     def _allocate(self, n: int, protect: list[int]) -> list[int] | None:
         """``n`` pages off the free list, evicting oldest refcount-0

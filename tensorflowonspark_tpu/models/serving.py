@@ -74,7 +74,7 @@ import numpy as np
 
 from tensorflowonspark_tpu.models.gpt import (GPT, GPTConfig, init_cache,
                                               nucleus_filter, rewind_cache)
-from tensorflowonspark_tpu.models.kv_pages import KVPagePool
+from tensorflowonspark_tpu.models.kv_pages import KVPagePool, hash_page_data
 
 
 def _next_pow2(n: int) -> int:
@@ -158,7 +158,8 @@ class ContinuousBatcher:
                  decode_block_steps: int | None = None,
                  kv_page_tokens: int | None = None,
                  kv_pool_pages: int | None = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 prefill_only: bool = False):
         if cfg.rolling_kv_cache:
             raise ValueError("ContinuousBatcher requires a full-length "
                              "cache (rolling_kv_cache=False)")
@@ -185,6 +186,16 @@ class ContinuousBatcher:
             # are alternatives, not composable
             raise ValueError("decode_block_steps and speculative_k are "
                              "mutually exclusive")
+        if prefill_only:
+            if kv_page_tokens is None:
+                raise ValueError("prefill_only needs kv_page_tokens: the "
+                                 "KV-page handoff a prefill pool emits is "
+                                 "page-granular (docs/serving.md "
+                                 "\"Disaggregated prefill/decode\")")
+            if speculative_k is not None or decode_block_steps is not None:
+                raise ValueError("prefill_only is a prefill-pool posture; "
+                                 "speculative_k/decode_block_steps are "
+                                 "decode-time knobs")
         #: multi-step decode: when no admission work is pending, run up
         #: to this many decode steps inside ONE ``lax.scan`` dispatch
         #: (power-of-two block sizes -> O(log block) compiles).  The
@@ -262,6 +273,29 @@ class ContinuousBatcher:
         self.model = GPT(self.cfg, decode=True)
         self.cache = init_cache(self.cfg, params, self.max_batch)
         self.slots: list[_Slot | None] = [None] * self.max_batch
+        #: PREFILL-ONLY mode (disaggregated serving's prefill-pool
+        #: posture, docs/serving.md "Disaggregated prefill/decode"): the
+        #: batcher admits and prefills exactly as usual — shared prefix
+        #: index, chunked streaming, batched bucket dispatches — but a
+        #: seated request never decode-steps.  Instead its session
+        #: (prompt KV pages + first token + sampler state) is EXPORTED
+        #: for :meth:`take_sessions` to drain, and its pages release
+        #: immediately (full prompt pages stay in the prefix index, so
+        #: repeat system prompts keep amortizing).  The receiving decode
+        #: pool seats such a session via :meth:`adopt_session` without
+        #: re-prefilling a single token.
+        self.prefill_only = bool(prefill_only)
+        #: (request_id, session) pairs exported since the last
+        #: :meth:`take_sessions` drain (prefill-only mode)
+        self._sessions: list[tuple[int, dict]] = []
+        #: (request_id, session) adoptions awaiting a slot + pages
+        self._pending_adopt: list[tuple[int, dict]] = []
+        #: lifetime handoff counters: sessions this batcher exported
+        #: (prefill pool) / seated via :meth:`adopt_session` (decode
+        #: pool) — the bench's "prefill never ran on a decode gang"
+        #: accounting reads these, not ``prefill_dispatches``
+        self.sessions_exported = 0
+        self.sessions_adopted = 0
         #: lifetime dispatch counters — ``prefill_dispatches`` (a batched
         #: group admission counts ONCE; chunk-loop calls excluded) and
         #: ``decode_dispatches`` (one per decode DISPATCH with active
@@ -415,8 +449,8 @@ class ContinuousBatcher:
         are 0 for a dense-cache batcher (no pressure signal: every
         replica ties equal)."""
         active = sum(s is not None for s in self.slots)
-        pending = len(self._pending) + (1 if self._inflight is not None
-                                        else 0)
+        pending = len(self._pending) + len(self._pending_adopt) \
+            + (1 if self._inflight is not None else 0)
         pages = self._pages
         return {"active": active, "pending": pending,
                 "reserved": len(self._reserved), "total": active + pending,
@@ -434,6 +468,346 @@ class ContinuousBatcher:
                     "free_pages": 0, "cached_pages": 0, "total_pages": 0}
         return self._pages.stats()
 
+    # -- KV-page session handoff (docs/serving.md "Disaggregated
+    # prefill/decode"): a prefill-only batcher EXPORTS each admitted
+    # request as a session — its prompt KV pages (host numpy, hashed per
+    # page), first token, and sampler state — and a decode-pool batcher
+    # ADOPTS it into a slot without re-running a single prompt token.
+    def _kv_struct(self) -> list:
+        """Per-page layout signature of this batcher's pool leaves:
+        ``(shape-with-page-axis-removed, dtype)`` per K/V leaf, in cache
+        traversal order.  Exported with every transfer and compared on
+        import, so a raced handoff from an incompatible replica (other
+        model dims, other dtype) is rejected before any device write."""
+        pt = self.cfg.kv_page_tokens
+        out = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.cache)[0]:
+            if getattr(path[-1], "key", None) in ("k", "v"):
+                ax = leaf.ndim - 3
+                out.append((tuple(int(d) for d in
+                            leaf.shape[:ax] + (pt,) + leaf.shape[ax + 1:]),
+                            str(leaf.dtype)))
+        return out
+
+    def _gather_pages(self, page_ids: list[int]) -> list[np.ndarray]:
+        """Host numpy copies of the pool pages ``page_ids`` from every
+        K/V leaf — ONE compiled gather per power-of-two page count (the
+        cache is read, never donated: a concurrent prefix-cache clone
+        must not invalidate the serving cache)."""
+        n = len(page_ids)
+        if n == 0:
+            return []
+        P = self.cfg.kv_pool_pages
+        pt = self.cfg.kv_page_tokens
+        npad = _next_pow2(n)
+        key = ("pexport", npad)
+        if key not in self._prefill_jit:
+            def export_fn(cache, ids):
+                out = []
+
+                def walk(path, leaf):
+                    if getattr(path[-1], "key", None) in ("k", "v"):
+                        ax = leaf.ndim - 3
+                        pool = leaf.reshape(leaf.shape[:ax] + (P, pt)
+                                            + leaf.shape[ax + 1:])
+                        out.append(jnp.take(pool, ids, axis=ax))
+                    return leaf
+
+                jax.tree_util.tree_map_with_path(walk, cache)
+                return out
+
+            self._prefill_jit[key] = jax.jit(export_fn)
+        ids = np.zeros((npad,), np.int32)
+        ids[:n] = page_ids
+        got = self._prefill_jit[key](self.cache, jnp.asarray(ids))
+        out = []
+        for a in got:
+            a = np.asarray(a)
+            if npad != n:   # drop the pad pages (they gathered page 0)
+                a = np.take(a, range(n), axis=a.ndim - 4)
+            out.append(a)
+        return out
+
+    def _seat_pages_device(self, slot: int, row_pages: list[int],
+                           import_ids: list[int],
+                           kv_sel: list[np.ndarray], counter: int) -> None:
+        """ONE fused dispatch that (1) scatters imported page data into
+        the K/V pools at ``import_ids`` and (2) seats ``slot``'s block-
+        table row (``row_pages``) and cache counters (``counter``).
+        ``slot == max_batch`` drops the seat (pure page import — the
+        standby prefix-cache clone path); sentinel page ids drop their
+        writes.  Compiled once per power-of-two import count."""
+        P = self.cfg.kv_pool_pages
+        pt = self.cfg.kv_page_tokens
+        npg = self.cfg.max_position_embeddings // pt
+        n = len(import_ids)
+        npad = _next_pow2(max(1, n))
+        key = ("padopt", npad)
+        if key not in self._prefill_jit:
+            def seat_fn(cache, ids, kv, slot_i, row_bt, true_tot):
+                it = iter(kv)
+
+                def put(path, leaf):
+                    k = getattr(path[-1], "key", None)
+                    if k in ("k", "v"):
+                        ax = leaf.ndim - 3
+                        pool = leaf.reshape(leaf.shape[:ax] + (P, pt)
+                                            + leaf.shape[ax + 1:])
+                        m = jnp.moveaxis(pool, ax, 0)
+                        blk = jnp.moveaxis(next(it).astype(leaf.dtype),
+                                           ax, 0)
+                        m = m.at[ids].set(blk, mode="drop")
+                        return jnp.moveaxis(m, 0, ax).reshape(leaf.shape)
+                    if k == "block_table":
+                        m = jnp.moveaxis(leaf, -2, 0)
+                        v = jnp.broadcast_to(row_bt,
+                                             m.shape[1:]).astype(m.dtype)
+                        return jnp.moveaxis(
+                            m.at[slot_i].set(v, mode="drop"), 0, -2)
+                    if k in ("index", "pos"):
+                        m = jnp.moveaxis(leaf, -1, 0)
+                        v = jnp.broadcast_to(true_tot,
+                                             m.shape[1:]).astype(m.dtype)
+                        return jnp.moveaxis(
+                            m.at[slot_i].set(v, mode="drop"), 0, -1)
+                    return leaf
+
+                return jax.tree_util.tree_map_with_path(put, cache)
+
+            self._prefill_jit[key] = jax.jit(seat_fn, donate_argnums=(0,))
+        ids = np.full((npad,), P, np.int32)   # sentinel pads drop
+        ids[:n] = import_ids
+        kv_pad = []
+        for i, (shape, dt) in enumerate(self._kv_struct()):
+            ax = len(shape) - 3
+            buf = np.zeros(shape[:ax] + (npad,) + shape[ax:], dt)
+            if n:
+                buf[(slice(None),) * ax + (slice(0, n),)] = kv_sel[i]
+            kv_pad.append(buf)
+        row_bt = np.full((npg,), P, np.int32)
+        row_bt[:len(row_pages)] = row_pages
+        self.cache = self._prefill_jit[key](
+            self.cache, jnp.asarray(ids), kv_pad,
+            jnp.asarray(slot, jnp.int32), jnp.asarray(row_bt),
+            jnp.asarray(int(counter), jnp.int32))
+
+    def _export_session(self, s: _Slot) -> dict:
+        """The handoff descriptor for one just-prefilled request: prompt
+        + first token + sampler state + every page of computed prompt
+        K/V (shared prefix pages included — the export is a read), each
+        page content-hashed so the adopting side can verify the transfer
+        byte-for-byte."""
+        pt = self.cfg.kv_page_tokens
+        prompt = self._prompts[s.request_id]
+        n_pp = -(-prompt.size // pt)
+        kv = self._gather_pages(s.lease.page_ids[:n_pp])
+        return {"v": 1, "prompt": np.asarray(prompt, np.int32),
+                "tokens": [int(t) for t in s.tokens],
+                "remaining": int(s.remaining),
+                "temperature": float(s.temperature),
+                "top_p": float(s.top_p), "seed": int(s.seed),
+                "page_tokens": int(pt), "pages": int(n_pp),
+                "kv": kv, "page_hashes": hash_page_data(kv, n_pp),
+                "struct": self._kv_struct()}
+
+    def take_sessions(self) -> list[tuple[int, dict]]:
+        """Drain the exported sessions (prefill-only mode): ``(request_id,
+        session)`` pairs since the last call.  The serving loop ships
+        each as a ``handoff`` message; a taken request's stored result is
+        dropped here (its completion belongs to the adopting pool)."""
+        out, self._sessions = self._sessions, []
+        for rid, _ in out:
+            self._results.pop(rid, None)
+        return out
+
+    def adopt_session(self, session: dict, on_token=None) -> int:
+        """Queue a handed-off session for adoption: verified here —
+        layout signature AND per-page content hashes, so a corrupt or
+        raced transfer raises ``ValueError`` loudly without touching the
+        device or poisoning the batcher — then seated into a slot on the
+        next ``step()`` with a free slot and pages (strict-FIFO page
+        backpressure, like ``submit``).  The seated request decodes from
+        its first token on without re-prefilling; its stream stays the
+        pure function of (params, prompt, budget, temperature, top_p,
+        seed) the oracle locks.  Returns the local request id."""
+        self._check_usable()
+        if self._pages is None:
+            raise ValueError("adopt_session needs paged KV mode "
+                             "(kv_page_tokens)")
+        if self.prefill_only:
+            raise ValueError("a prefill-only batcher cannot adopt "
+                             "sessions (it never decode-steps)")
+        if not isinstance(session, dict) or session.get("v") != 1:
+            raise ValueError("malformed session descriptor")
+        missing = [k for k in ("prompt", "tokens", "remaining",
+                               "page_tokens", "pages", "kv",
+                               "page_hashes", "struct")
+                   if k not in session]
+        if missing:
+            # every rejection here must be the documented ValueError —
+            # a KeyError would escape the serve loop's typed-error
+            # bounce and crash the decode worker over one bad message
+            raise ValueError(f"malformed session descriptor: missing "
+                             f"key(s) {missing}")
+        pt = self._pages.page_tokens
+        if int(session["page_tokens"]) != pt:
+            raise ValueError(
+                f"session page_tokens {session['page_tokens']} != this "
+                f"pool's {pt} — prefill and decode pools must agree")
+        prompt = np.asarray(session["prompt"], np.int32).reshape(-1)
+        tokens = [int(t) for t in session["tokens"]]
+        remaining = int(session["remaining"])
+        if prompt.size == 0 or len(tokens) != 1 or remaining < 1:
+            raise ValueError("a handoff session carries exactly the "
+                             "first token and a positive remaining "
+                             f"budget (got {len(tokens)} token(s), "
+                             f"remaining {remaining})")
+        n_pp = -(-prompt.size // pt)
+        kv = session["kv"]
+        struct = self._kv_struct()
+        ok_shape = int(session.get("pages", -1)) == n_pp \
+            and len(kv) == len(struct)
+        if ok_shape:
+            for a, (shape, dt) in zip(kv, struct):
+                a = np.asarray(a)
+                ax = a.ndim - 4
+                if a.ndim < 4 or a.shape[ax] != n_pp \
+                        or tuple(a.shape[:ax] + a.shape[ax + 1:]) != shape \
+                        or str(a.dtype) != dt:
+                    ok_shape = False
+                    break
+        if not ok_shape:
+            raise ValueError(
+                "session KV layout mismatch — the transfer raced a "
+                "replica with a different model/cache geometry; "
+                "rejecting the session")
+        got = hash_page_data(kv, n_pp)
+        want = list(session["page_hashes"])
+        if got != want:
+            bad = [j for j, (g, w) in enumerate(zip(got, want)) if g != w]
+            raise ValueError(
+                f"corrupt KV-page transfer: content hash mismatch on "
+                f"page(s) {bad} of {n_pp} — rejecting the session")
+        total = prompt.size + len(tokens) + remaining
+        if total > self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"session needs {total} positions, exceeding "
+                f"max_position_embeddings "
+                f"({self.cfg.max_position_embeddings})")
+        if self._pages.pages_needed(total) > self._pages.total_pages:
+            raise ValueError(
+                f"session needs {self._pages.pages_needed(total)} KV "
+                f"pages but the pool holds {self._pages.total_pages}")
+        rid = next(self._ids)
+        self._pending_adopt.append(
+            (rid, {**session, "prompt": prompt, "tokens": tokens,
+                   "remaining": remaining}))
+        if on_token is not None:
+            self._on_token[rid] = on_token
+        if self.spec_k is not None:
+            self._prompts[rid] = prompt[-self.spec_window:]
+        return rid
+
+    def _admit_adopts(self) -> None:
+        """Seat queued session adoptions: lease pages (prefix-index
+        matches need no data import — handoff composes with cross-
+        request reuse), import the unmatched prompt pages' K/V, seat the
+        block-table row and counters, and activate the slot mid-stream
+        (first token already emitted by the prefill side, so no token is
+        re-surfaced here).  Strict FIFO on page backpressure."""
+        while self._pending_adopt:
+            free = [i for i, s in enumerate(self.slots)
+                    if s is None and i not in self._reserved]
+            if not free:
+                return
+            rid, sess = self._pending_adopt[0]
+            prompt = sess["prompt"]
+            total = prompt.size + len(sess["tokens"]) + sess["remaining"]
+            lease = self._pages.adopt(prompt, total)
+            if lease is None:
+                return          # pages free as running requests finish
+            self._pending_adopt.pop(0)
+            pt = self._pages.page_tokens
+            n_pp = -(-prompt.size // pt)
+            import_ids = lease.page_ids[lease.n_shared:n_pp]
+            kv_sel = []
+            if import_ids:
+                sel = range(lease.n_shared, n_pp)
+                kv_sel = [np.take(np.asarray(a), sel, axis=a.ndim - 4)
+                          for a in (np.asarray(x) for x in sess["kv"])]
+            # counters seat at prompt.size: the next decode step feeds
+            # the session's first token and writes its K/V there, exactly
+            # where a locally-prefilled slot would
+            self._seat_pages_device(free[0], lease.page_ids, import_ids,
+                                    kv_sel, prompt.size)
+            # commit AFTER the import dispatch: only written pages are
+            # ever matchable (the _prefill_paged contract)
+            self._pages.commit(lease)
+            self.sessions_adopted += 1
+            s = _Slot(request_id=rid, remaining=int(sess["remaining"]),
+                      tokens=list(sess["tokens"]),
+                      temperature=float(sess.get("temperature", 0.0)),
+                      top_p=float(sess.get("top_p", 1.0)),
+                      seed=int(sess.get("seed", 0)), lease=lease)
+            self.slots[free[0]] = s
+
+    # -- prefix-cache cloning (warm-standby promotion; docs/robustness.md)
+    def export_prefix_cache(self, max_pages: int | None = None) \
+            -> dict | None:
+        """Snapshot this batcher's SHARED prefix-cache pages (every
+        indexed page, donor insertion order, content-hashed) for a peer
+        to import — the page-transfer plane's bulk edition, ridden by
+        the standby promotion clone so a healed replica keeps its
+        peer's prefix hits.  None when dense or empty.  Must run on the
+        batcher's driving thread (the gather reads the live cache)."""
+        if self._pages is None:
+            return None
+        entries = self._pages.export_index()
+        if max_pages is not None:
+            entries = entries[:max_pages]
+        if not entries:
+            return None
+        pids = [pid for _, pid in entries]
+        kv = self._gather_pages(pids)
+        return {"v": 1, "keys": [k for k, _ in entries],
+                "pages": len(pids), "kv": kv,
+                "page_hashes": hash_page_data(kv, len(pids)),
+                "page_tokens": int(self._pages.page_tokens),
+                "struct": self._kv_struct()}
+
+    def import_prefix_cache(self, export: dict | None) -> int:
+        """Adopt a peer's cloned prefix-cache pages into this (fresh)
+        pool as refcount-0 cached pages — matchable by the very next
+        admission, evictable under pressure.  Layout + per-page hashes
+        verified first (corrupt transfers raise, they never reach the
+        device); capacity truncation keeps chains reachable (donor
+        order).  Returns the number of pages imported."""
+        if self._pages is None or not export:
+            return 0
+        if int(export.get("page_tokens", -1)) != self._pages.page_tokens \
+                or export.get("struct") != self._kv_struct():
+            raise ValueError("prefix-cache transfer layout mismatch — "
+                             "donor and importer cache geometries differ")
+        n = int(export["pages"])
+        kv = export["kv"]
+        if hash_page_data(kv, n) != list(export["page_hashes"]):
+            raise ValueError("corrupt prefix-cache transfer: content "
+                             "hash mismatch — rejecting the import")
+        mapping = self._pages.adopt_cached(export["keys"])
+        if not mapping:
+            return 0
+        pos_of = {k: i for i, k in enumerate(export["keys"])}
+        keys = list(mapping)
+        sel = [pos_of[k] for k in keys]
+        kv_sel = [np.take(np.asarray(a), sel, axis=np.asarray(a).ndim - 4)
+                  for a in kv]
+        # slot = max_batch: the seat drops — this dispatch only writes
+        # the imported pages into the pools
+        self._seat_pages_device(self.max_batch, [],
+                                [mapping[k] for k in keys], kv_sel, 0)
+        return len(mapping)
+
     # -- admission ---------------------------------------------------------
     def has_free_slot(self) -> bool:
         """True while another ``submit`` would find a slot: queued-but-
@@ -442,7 +816,7 @@ class ContinuousBatcher:
         looping ``while b.has_free_slot(): b.submit(...)`` terminates."""
         free = sum(s is None and i not in self._reserved
                    for i, s in enumerate(self.slots))
-        return len(self._pending) < free
+        return len(self._pending) + len(self._pending_adopt) < free
 
     def submit(self, prompt_ids, max_new_tokens: int, *,
                temperature: float = 0.0, top_p: float = 1.0,
@@ -504,6 +878,9 @@ class ContinuousBatcher:
         if self.spec_k is not None:   # only drafting reads the history,
             # and only its trailing window of it
             self._prompts[rid] = prompt[-self.spec_window:]
+        elif self.prefill_only:       # session export needs the FULL
+            # prompt (page chain keys + the decode pool's replay input)
+            self._prompts[rid] = prompt
         return rid
 
     def _fresh_rows_cache(self, rows: int):
@@ -727,6 +1104,8 @@ class ContinuousBatcher:
         prefix match a 10k-token prompt with a cached system prompt
         shares the short-tail executable, which is the TTFT win."""
         done: list[int] = []
+        self._admit_adopts()   # handed-off sessions seat before new
+        # prompts: their prefill compute is already spent elsewhere
         if self._inflight is not None:
             done.extend(self._advance_inflight_paged())
         C = self.prefill_chunk
@@ -1167,6 +1546,20 @@ class ContinuousBatcher:
 
     def _step_inner(self) -> list[int]:
         done = self._admit()
+        if self.prefill_only:
+            # prefill-pool posture: a seated request's prompt KV is
+            # computed — export the session for handoff instead of ever
+            # decode-stepping it.  The release inside _finish keeps the
+            # pool's prefix index warm (full prompt pages park in the
+            # LRU, matchable by the next same-system-prompt admission).
+            for i, s in enumerate(self.slots):
+                if s is None or i in self._reserved:
+                    continue
+                self._sessions.append((s.request_id,
+                                       self._export_session(s)))
+                self.sessions_exported += 1
+                self._finish(i, s)
+            return done
         if not any(self.slots):
             return done
         if self.spec_k is not None:
@@ -1326,7 +1719,7 @@ class ContinuousBatcher:
     def run(self) -> dict[int, np.ndarray]:
         """Drive ``step()`` until every submitted request has finished;
         returns ``{request_id: generated tokens}`` (prompt excluded)."""
-        while self._pending or self._inflight is not None \
-                or any(self.slots):
+        while self._pending or self._pending_adopt \
+                or self._inflight is not None or any(self.slots):
             self.step()
         return dict(self._results)

@@ -15,15 +15,19 @@ one compiled decode loop behind the node's queue/shm data plane.
     serving.shutdown()
 
 Layout: ``scheduler`` (tenant-aware admission/routing/failover + typed
-errors + elastic membership + gang resolution), ``replica`` (the worker
-map_fun, drains under preemption, serves peer weight clones), ``sharded``
+errors + elastic membership + gang resolution + role-aware disaggregated
+routing), ``replica`` (the worker map_fun, drains under preemption,
+serves peer weight clones, specializes on ``serve_role``), ``sharded``
 (mesh-sharded gang replicas: ``GangSpec``, the gang leader/member
-map_fun, step barriers), ``standby`` (warm-standby gangs: pre-compiled
-spare replicas + the driver pool that heal paths promote instead of
-cold-spawning), ``frontend`` (TCP edge + ``ServingCluster`` composition:
-``add_replicas``/``retire_replica``/``scale_up``/drain-and-replace,
-whole-gang), ``autoscaler`` (metrics-driven membership control,
-device-weighted, promotes standbys first), ``client`` (``ServeClient``).
+map_fun, step barriers), ``disagg`` (disaggregated prefill/decode pools:
+role arithmetic + the pool map_fun; sessions move as KV-page transfers),
+``standby`` (warm-standby gangs: pre-compiled spare replicas + the
+driver pool that heal paths promote instead of cold-spawning — cloning
+prefix-cache pages alongside weights), ``frontend`` (TCP edge +
+``ServingCluster`` composition: ``add_replicas``/``retire_replica``/
+``scale_up``/drain-and-replace, whole-gang, per-pool autoscaling),
+``autoscaler`` (metrics-driven membership control, device-weighted,
+role-filterable, promotes standbys first), ``client`` (``ServeClient``).
 Architecture, backpressure semantics, the failure model, and the
 scale-event taxonomy are in ``docs/serving.md``.
 """
@@ -31,6 +35,8 @@ scale-event taxonomy are in ``docs/serving.md``.
 from tensorflowonspark_tpu.serving.autoscaler import (Autoscaler,  # noqa: F401
                                                       AutoscalerConfig)
 from tensorflowonspark_tpu.serving.client import ServeClient  # noqa: F401
+from tensorflowonspark_tpu.serving.disagg import \
+    serve_disagg_replica  # noqa: F401
 from tensorflowonspark_tpu.serving.frontend import (ServeFrontend,  # noqa: F401
                                                     ServingCluster)
 from tensorflowonspark_tpu.serving.replica import serve_replica  # noqa: F401

@@ -50,6 +50,13 @@ class AutoscalerConfig:
     min_replicas: int = 1
     max_replicas: int = 4
     interval: float = 1.0            # seconds between samples
+    #: pool filter for a disaggregated tier (docs/serving.md): with
+    #: ``role="prefill"`` the controller sees only prefill gangs and the
+    #: PROMPT queue (plus the TTFT signal — prefill owns TTFT); with
+    #: ``role="decode"`` only decode gangs and the HANDOFF queue.  The
+    #: two pools therefore scale on independent signals with independent
+    #: bounds/cooldowns.  None = the whole tier (unified behavior).
+    role: str | None = None
     up_queue_per_replica: float = 4.0
     up_ttft_p95: float | None = None   # seconds; None = queue signal only
     up_consecutive: int = 2
@@ -125,18 +132,25 @@ class Autoscaler:
         remove (0 when no victim is eligible)."""
         sched = self.serving.scheduler
         m = sched.metrics()
-        alive = [r for r in m["replicas"].values() if r["alive"]]
+        pool = {eid: r for eid, r in m["replicas"].items()
+                if self.cfg.role is None
+                or r.get("role") == self.cfg.role}
+        alive = [r for r in pool.values() if r["alive"]]
         routable = [r for r in alive if not r["draining"]]
         victim = self._victim(m)
+        # the decode pool's backlog is the HANDOFF queue (sessions
+        # awaiting adoption), the prefill pool's (and a unified tier's)
+        # the prompt queue
+        queued = (m.get("queued_handoffs", 0)
+                  if self.cfg.role == "decode" else m["queued"])
         return {
             "alive": len(alive),
             "routable": len(routable),
             "capacity": sum(r.get("weight", 1) for r in routable),
             "alive_capacity": sum(r.get("weight", 1) for r in alive),
             "victim_weight": 0 if victim is None else victim[1],
-            "queued": m["queued"],
-            "outstanding": sum(r["outstanding"]
-                               for r in m["replicas"].values()),
+            "queued": queued,
+            "outstanding": sum(r["outstanding"] for r in pool.values()),
             "ttft_p95": m["ttft"]["p95_secs"],
         }
 
@@ -208,18 +222,22 @@ class Autoscaler:
     def _scale_up(self, s: dict, reason: str) -> None:
         cfg = self.cfg
         n = min(cfg.up_step, cfg.max_replicas - s["alive"])
-        logger.warning("autoscaler: scaling UP by %d (%s)", n, reason)
+        logger.warning("autoscaler%s: scaling UP by %d (%s)",
+                       f" [{cfg.role}]" if cfg.role else "", n, reason)
         self.serving.scheduler.emit_event(
-            "scale_up", replicas=n, reason=reason, **_signals(s))
+            "scale_up", replicas=n, reason=reason, role=cfg.role,
+            **_signals(s))
         try:
             # prefer the tier's warm path (ServingCluster.scale_up:
             # standby promotion first, cold spawn for the remainder);
             # plain facades without it keep the historical add_replicas
             grow = getattr(self.serving, "scale_up", None)
-            if grow is not None:
-                grow(n)
-            else:
+            if grow is None:
                 self.serving.add_replicas(n)
+            elif cfg.role is not None:
+                grow(n, role=cfg.role)
+            else:
+                grow(n)
             self.scale_ups += 1
         except Exception:
             logger.exception("autoscaler: scale-up failed")
@@ -234,7 +252,8 @@ class Autoscaler:
         logger.warning("autoscaler: scaling DOWN replica %d (%s)",
                        victim, reason)
         self.serving.scheduler.emit_event(
-            "scale_down", replica=victim, reason=reason, **_signals(s))
+            "scale_down", replica=victim, reason=reason,
+            role=self.cfg.role, **_signals(s))
         try:
             self.serving.retire_replica(victim)
             self.scale_downs += 1
@@ -253,7 +272,9 @@ class Autoscaler:
         capacity_weight)``."""
         candidates = [(r["outstanding"], -eid, eid, r.get("weight", 1))
                       for eid, r in m["replicas"].items()
-                      if r["alive"] and not r["draining"]]
+                      if r["alive"] and not r["draining"]
+                      and (self.cfg.role is None
+                           or r.get("role") == self.cfg.role)]
         if len(candidates) <= self.cfg.min_replicas:
             return None
         _, _, eid, weight = min(candidates)

@@ -40,6 +40,7 @@ Wiring (docs/serving.md has the picture):
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import logging
 import queue
 import socket
@@ -243,6 +244,12 @@ class ServingCluster:
         #: the running :class:`~tensorflowonspark_tpu.serving.autoscaler.
         #: Autoscaler`, when ``run(autoscale=...)`` asked for one
         self.autoscaler = None
+        #: per-pool autoscalers of a disaggregated tier (one per role,
+        #: independent signals/bounds/cooldowns); empty otherwise
+        self.autoscalers: list = []
+        #: the normalized ``disagg=`` spec when this tier runs
+        #: specialized prefill/decode pools, else None
+        self.disagg = None
         self._shutdown_done = False
         self._replace_preempted = True
         self._drain_timeout = 60.0
@@ -294,7 +301,7 @@ class ServingCluster:
             drain_timeout: float = 60.0, mesh: dict | None = None,
             gang_size: int | None = None, shard_params=None,
             warm_standbys: int = 0, standby_clone: bool = True,
-            compile_cache=None,
+            compile_cache=None, disagg: dict | None = None,
             **cluster_kwargs) -> "ServingCluster":
         """Boot ``num_replicas`` serving workers and the driver-side tier.
 
@@ -330,6 +337,23 @@ class ServingCluster:
         operate on whole gangs.  ``shard_params`` optionally overrides
         the parameter layout (a picklable ``(cfg, params, mesh) ->
         params``; default = the model's own partitioning annotations).
+
+        ``disagg`` specializes the tier into DISAGGREGATED PREFILL/
+        DECODE POOLS (docs/serving.md "Disaggregated prefill/decode"):
+        ``{"prefill": P, "decode": D}`` boots P prefill gangs (compute
+        the prompt KV once, never decode-step) and D decode gangs (only
+        ever step), with each session handed off as a verified KV-page
+        transfer on the queue/shm plane.  ``num_replicas`` must equal
+        ``P + D``; ``batcher_kwargs`` must set ``kv_page_tokens`` (the
+        handoff is page-granular); optional ``"prefill_kwargs"`` /
+        ``"decode_kwargs"`` entries overlay per-pool batcher knobs
+        (e.g. ``prefill_chunk`` for the prefill pool's streaming
+        admission).  With ``autoscale={"prefill": {...}, "decode":
+        {...}}`` each pool gets its own independent autoscaler —
+        TTFT-p95/queue pressure drives prefill, handoff-queue depth
+        drives decode.  Composes with ``mesh=`` (every pool gang is a
+        device-mesh gang); ``warm_standbys`` is not yet supported with
+        disagg (standbys are role-less until promotion).
 
         ``warm_standbys`` keeps N fully-initialized spare replica gangs
         (process up, mesh built, serve step compiled, params UNLOADED,
@@ -377,6 +401,30 @@ class ServingCluster:
         elif gang_size is not None or shard_params is not None:
             raise ValueError("gang_size=/shard_params= need mesh= "
                              "(sharded replicas)")
+        roles = None
+        if disagg is not None:
+            from tensorflowonspark_tpu.serving.disagg import (
+                boot_roles, serve_disagg_replica, validate_disagg)
+
+            disagg = validate_disagg(disagg)
+            if num_replicas != disagg["prefill"] + disagg["decode"]:
+                raise ValueError(
+                    f"disagg pools sum to "
+                    f"{disagg['prefill'] + disagg['decode']} gangs but "
+                    f"num_replicas={num_replicas} — pass their sum")
+            if warm_standbys:
+                raise ValueError(
+                    "warm_standbys is not yet supported with disagg "
+                    "(a standby is role-less until promotion)")
+            if (batcher_kwargs or {}).get("kv_page_tokens") is None:
+                raise ValueError(
+                    "disagg needs paged KV: set batcher_kwargs="
+                    "{'kv_page_tokens': ...} — the prefill→decode "
+                    "handoff is a KV-page transfer")
+            args["serve_disagg"] = disagg
+            gsz = 1 if gang is None else gang.gang_size
+            roles = boot_roles(disagg, gsz)
+            map_fun = serve_disagg_replica
         # monitor=False: the training monitor's fail-fast abort is the
         # wrong policy here — a serving-mode monitor is attached below
         cluster = TPUCluster.run(map_fun, args, num_workers,
@@ -389,7 +437,8 @@ class ServingCluster:
                 max_queue_depth=max_queue_depth, requeue_limit=requeue_limit,
                 tenants=tenants,
                 gang_size=1 if gang is None else gang.gang_size,
-                capacity_weight=1 if gang is None else gang.devices)
+                capacity_weight=1 if gang is None else gang.devices,
+                roles=roles)
             if monitor:
                 mon = ClusterMonitor(
                     cluster, hang_timeout=hang_timeout,
@@ -404,6 +453,7 @@ class ServingCluster:
             address = frontend.start()
             tier = cls(cluster, scheduler, mon, frontend, address)
             tier.gang_spec = gang
+            tier.disagg = disagg
             tier._replace_preempted = bool(replace_preempted)
             tier._replace_failed = bool(replace_failed)
             tier._drain_timeout = float(drain_timeout)
@@ -429,9 +479,28 @@ class ServingCluster:
                 from tensorflowonspark_tpu.serving.autoscaler import (
                     Autoscaler, AutoscalerConfig)
 
-                cfg = (autoscale if isinstance(autoscale, AutoscalerConfig)
-                       else AutoscalerConfig(**dict(autoscale)))
-                tier.autoscaler = Autoscaler(tier, cfg).start()
+                if disagg is not None:
+                    # one independent controller per pool: prefill
+                    # scales on prompt-queue/TTFT pressure, decode on
+                    # handoff-queue/outstanding pressure
+                    if not (isinstance(autoscale, dict)
+                            and set(autoscale) <= {"prefill", "decode"}
+                            and autoscale):
+                        raise ValueError(
+                            "a disagg tier autoscales per pool: pass "
+                            "autoscale={'prefill': {...}, 'decode': "
+                            "{...}} (either subset)")
+                    for role, spec in autoscale.items():
+                        cfg = (spec if isinstance(spec, AutoscalerConfig)
+                               else AutoscalerConfig(**dict(spec)))
+                        cfg = dataclasses.replace(cfg, role=role)
+                        tier.autoscalers.append(
+                            Autoscaler(tier, cfg).start())
+                else:
+                    cfg = (autoscale
+                           if isinstance(autoscale, AutoscalerConfig)
+                           else AutoscalerConfig(**dict(autoscale)))
+                    tier.autoscaler = Autoscaler(tier, cfg).start()
             if metrics_port is not None:
                 tier.metrics_http = tpu_metrics.MetricsHTTPServer(
                     tier.metrics_text, statusz=tier.metrics,
@@ -450,8 +519,10 @@ class ServingCluster:
             # scheduler's threads AND its registry collect hook
             # (scheduler.stop unhooks it), the monitor
             autoscaler = tier.autoscaler if tier is not None else None
+            autoscalers = tier.autoscalers if tier is not None else []
             standbys = tier.standbys if tier is not None else None
-            for part in (autoscaler, standbys, frontend, scheduler, mon):
+            for part in (autoscaler, *autoscalers, standbys, frontend,
+                         scheduler, mon):
                 if part is not None:
                     with contextlib.suppress(Exception):
                         part.stop()
@@ -472,41 +543,62 @@ class ServingCluster:
         return ServeClient(self.address, self.authkey, **kwargs)
 
     # ----------------------------------------------------- live membership
-    def add_replicas(self, n: int = 1,
-                     timeout: float | None = None) -> list[int]:
+    def add_replicas(self, n: int = 1, timeout: float | None = None,
+                     role: str | None = None) -> list[int]:
         """Grow the tier by ``n`` replicas, live: the cluster re-opens
         its reservation path and spawns fresh serving workers (same
         model builder/args the tier booted with), the scheduler
         registers each as it reserves, and queued requests start
         dispatching to the newcomers immediately.  With mesh-sharded
         replicas each added replica is a WHOLE GANG (``gang_size``
-        workers, one routable endpoint).  Returns the new replicas'
-        leader executor ids."""
+        workers, one routable endpoint).  A disaggregated tier grows
+        one POOL at a time: ``role`` ("prefill" | "decode") pins the
+        newcomers' specialization (mandatory — eid arithmetic cannot
+        classify late joiners).  Returns the new replicas' leader
+        executor ids."""
         if self._shutdown_done:
             raise RuntimeError("serving tier is shut down")
+        if (role is not None) != (self.disagg is not None):
+            raise ValueError(
+                "add_replicas(role=) and a disagg tier go together: "
+                f"role={role!r} on a tier with disagg={self.disagg!r}")
         gsz = 1 if self.gang_spec is None else self.gang_spec.gang_size
+        spawn_kwargs = {}
+        if role is not None:
+            from tensorflowonspark_tpu.serving.disagg import \
+                serve_disagg_replica
+
+            spawn_kwargs = {"map_fun": serve_disagg_replica,
+                            "tf_args": dict(self._serve_args,
+                                            serve_role=role)}
         with self._membership_lock:
-            added = self.cluster.add_workers(n * gsz, timeout=timeout)
+            added = self.cluster.add_workers(n * gsz, timeout=timeout,
+                                             **spawn_kwargs)
             leaders = []
             for i in range(0, len(added), gsz):
                 block = added[i:i + gsz]
                 self.scheduler.add_replica(
                     block[0],
                     members=tuple(int(b["executor_id"])
-                                  for b in block[1:]))
+                                  for b in block[1:]), role=role)
                 leaders.append(int(block[0]["executor_id"]))
-        logger.info("serving tier grew by %d replica(s): %s%s", n, leaders,
-                    f" (gangs of {gsz})" if gsz > 1 else "")
+        logger.info("serving tier grew by %d replica(s): %s%s%s", n,
+                    leaders, f" (gangs of {gsz})" if gsz > 1 else "",
+                    f" (role {role})" if role else "")
         return leaders
 
     def scale_up(self, n: int = 1, timeout: float | None = None,
-                 source: str = "scale_up") -> list[int]:
+                 source: str = "scale_up",
+                 role: str | None = None) -> list[int]:
         """Grow the tier by ``n`` replicas, consuming the warm-standby
         pool FIRST (promotion: control message + weight clone, capacity
         restored in well under a cold boot) and cold-spawning only the
         remainder through :meth:`add_replicas`.  The autoscaler's
-        scale-up path calls this.  Returns the new replicas' leader
-        executor ids."""
+        scale-up path calls this.  A disaggregated pool (``role=``)
+        always cold-spawns into its pool — standbys are role-less.
+        Returns the new replicas' leader executor ids."""
+        if role is not None:
+            return self.add_replicas(int(n), timeout=timeout, role=role)
         added: list[int] = []
         for _ in range(int(n)):
             eid = self.promote_standby(source)
@@ -753,21 +845,26 @@ class ServingCluster:
         # work — bench_serving's heal-time rows measure from this event
         self.scheduler.emit_event("heal_started", replica=eid,
                                   source=source)
+        # capture the lost replica's pool NOW: the replacement must
+        # re-arm the SAME specialization (a decode gang replaced by a
+        # prefill gang would starve the other pool)
+        role = self.scheduler.replica_role(eid)
 
         def _go():
             if self._shutdown_done:
                 return
-            promoted = self.promote_standby(promote_source)
-            if promoted is not None:
-                self.scheduler.emit_event(
-                    "replica_replaced", replica=eid, replacement=promoted,
-                    source=source, mode="warm")
-                return
+            if role is None:
+                promoted = self.promote_standby(promote_source)
+                if promoted is not None:
+                    self.scheduler.emit_event(
+                        "replica_replaced", replica=eid,
+                        replacement=promoted, source=source, mode="warm")
+                    return
             try:
-                new = self.add_replicas(1)
+                new = self.add_replicas(1, role=role)
                 self.scheduler.emit_event(
                     "replica_replaced", replica=eid, replacement=new[0],
-                    source=source, mode="cold")
+                    source=source, mode="cold", role=role)
             except Exception:
                 logger.exception("replacement for lost replica %d "
                                  "failed", eid)
@@ -787,6 +884,11 @@ class ServingCluster:
         if self.autoscaler is not None:
             m["autoscaler"] = {"scale_ups": self.autoscaler.scale_ups,
                                "scale_downs": self.autoscaler.scale_downs}
+        if self.autoscalers:
+            m["autoscalers"] = {
+                s.cfg.role: {"scale_ups": s.scale_ups,
+                             "scale_downs": s.scale_downs}
+                for s in self.autoscalers}
         if self.standbys is not None:
             with self._promotions_lock:
                 promotions = dict(self._promoted)
@@ -824,10 +926,11 @@ class ServingCluster:
             # exit on the cluster shutdown's EndOfFeed like replicas
             with contextlib.suppress(Exception):
                 self.standbys.stop()
-        if self.autoscaler is not None:
+        for scaler in ([self.autoscaler] if self.autoscaler is not None
+                       else []) + list(self.autoscalers):
             # no membership changes may race the teardown
             with contextlib.suppress(Exception):
-                self.autoscaler.stop()
+                scaler.stop()
         if not self.scheduler.drain(drain_timeout):
             logger.warning("serving scheduler still busy after %.0fs drain; "
                            "remaining requests get typed shutdown errors",

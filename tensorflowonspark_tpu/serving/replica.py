@@ -93,7 +93,8 @@ def enable_serving_compile_cache(args, ctx) -> None:
         else os.path.join(ctx.working_dir, "jax_cache"))
 
 
-def serve_clone_request(batcher, item: dict, ctx) -> None:
+def serve_clone_request(batcher, item: dict, ctx,
+                        export_pages: bool = True) -> None:
     """Source side of peer weight cloning: ship this replica's params to
     the requester named in ``item`` (a promoted warm standby), off the
     decode thread so a bulk transfer never stalls in-flight streams.
@@ -101,11 +102,32 @@ def serve_clone_request(batcher, item: dict, ctx) -> None:
     The transfer rides the requester's own node queue plane — a
     ``QueueClient`` to ``item["reply_addr"]`` (zero-copy shm negotiated
     automatically on a shared host) carrying one
-    ``{"op": "standby", "event": "params"}`` message."""
+    ``{"op": "standby", "event": "params"}`` message.  A paged batcher's
+    message ALSO carries its shared prefix-cache pages
+    (``ContinuousBatcher.export_prefix_cache``: content-hashed KV page
+    data + chain keys over the page-transfer plane), so the promoted
+    standby inherits this replica's prefix hits instead of starting
+    cold.  The page gather runs HERE, on the serve-loop thread — the
+    decode steps donate the cache buffer, so a concurrent off-thread
+    gather would read freed device memory.  ``export_pages=False``
+    (mesh-sharded gang tiers) skips the snapshot entirely: the sharded
+    importer discards pages anyway, so gathering them would only stall
+    serving and bloat the heal-critical transfer."""
     reg = _metrics.get_registry()
     m_clones = reg.counter(
         "tfos_replica_clones_served_total",
         "Peer weight-clone transfers served by this replica.")
+    prefix_pages = None
+    try:
+        export = (getattr(batcher, "export_prefix_cache", None)
+                  if export_pages else None)
+        if export is not None:
+            prefix_pages = export()
+    # tfos: ignore[broad-except] — the weight clone is the heal-critical
+    # payload; a failed prefix-page snapshot only costs post-heal TTFT
+    except Exception:
+        logger.exception("replica %d: prefix-cache export for clone "
+                         "failed; shipping weights only", ctx.executor_id)
 
     def _send():
         import jax
@@ -122,7 +144,8 @@ def serve_clone_request(batcher, item: dict, ctx) -> None:
             try:
                 cli.put(REQUEST_QUEUE,
                         {"op": "standby", "event": "params",
-                         "params": params, "src": ctx.executor_id},
+                         "params": params, "src": ctx.executor_id,
+                         "prefix_pages": prefix_pages},
                         timeout=60)
             finally:
                 cli.close()
@@ -139,6 +162,23 @@ def serve_clone_request(batcher, item: dict, ctx) -> None:
     threading.Thread(target=_send, name="serve-clone", daemon=True).start()
 
 
+def serving_batcher_kwargs(args) -> dict:
+    """The ``ContinuousBatcher`` kwargs for this worker's role:
+    ``serve_batcher_kwargs`` overlaid with the role's entry from
+    ``serve_disagg`` (``{"prefill_kwargs": ..., "decode_kwargs": ...}``)
+    and — for a prefill-pool worker — ``prefill_only=True``.  Shared by
+    the plain replica, the gang leader, and the warm standby, so every
+    specialization builds the identical engine."""
+    kwargs = dict(args.get("serve_batcher_kwargs") or {})
+    role = args.get("serve_role")
+    if role:
+        kwargs.update(dict(
+            (args.get("serve_disagg") or {}).get(f"{role}_kwargs") or {}))
+    if role == "prefill":
+        kwargs["prefill_only"] = True
+    return kwargs
+
+
 def serve_replica(args, ctx) -> None:
     """The serving-tier ``map_fun``: serve generate requests until the
     driver sends ``EndOfFeed``."""
@@ -152,12 +192,12 @@ def serve_replica(args, ctx) -> None:
         cfg, params,
         max_batch=int(args.get("serve_max_batch", 4)),
         eos_id=args.get("serve_eos_id"),
-        **dict(args.get("serve_batcher_kwargs") or {}))
-    run_serve_loop(args, ctx, batcher)
+        **serving_batcher_kwargs(args))
+    run_serve_loop(args, ctx, batcher, role=args.get("serve_role"))
 
 
 def run_serve_loop(args, ctx, batcher, *, step_hook=None,
-                   label: str = "replica") -> None:
+                   label: str = "replica", role: str | None = None) -> None:
     """THE serving loop (module docstring): intake ⇄ step interleave over
     the node queue plane until ``EndOfFeed`` / a drained preemption.
 
@@ -167,7 +207,17 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
     decode step, after the step's deltas are flushed — to run the gang's
     step barrier; a hook exception (a lost shard) propagates out exactly
     like a device failure, crashing the worker so the driver classifies
-    the whole gang dead."""
+    the whole gang dead.
+
+    ``role`` specializes the loop for a disaggregated pool
+    (docs/serving.md "Disaggregated prefill/decode"): every response
+    message carries the role so the scheduler can audit routing;
+    ``"prefill"`` flushes each admitted request's exported session as a
+    ``{"event": "handoff"}`` message (the batcher never decode-steps
+    it); ``"decode"`` accepts ``{"op": "adopt"}`` intake items and seats
+    them via ``batcher.adopt_session`` — a corrupt/raced transfer's
+    ``ValueError`` bounces back as a typed error without touching the
+    engine."""
     mgr = ctx.mgr
     if mgr is None:
         raise RuntimeError("the serving loop needs the node queue server "
@@ -229,8 +279,13 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
         "tfos_replica_prefix_cache_requests_total",
         "Prefix-cache admission outcomes (hit/miss/partial).",
         labelnames=("outcome",))
+    m_sessions = reg.counter(
+        "tfos_replica_sessions_total",
+        "KV-page handoff sessions by direction (exported by a prefill "
+        "pool / adopted by a decode pool).", labelnames=("direction",))
     last = {"decode_dispatches": 0, "prefill_dispatches": 0,
             "spec_proposed": 0, "spec_accepted": 0,
+            "sessions_exported": 0, "sessions_adopted": 0,
             "hit": 0, "miss": 0, "partial": 0}
 
     def publish_engine_counters() -> None:
@@ -248,6 +303,12 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
             if cur > last[attr]:
                 m_spec.inc(cur - last[attr], outcome=outcome)
                 last[attr] = cur
+        for attr, direction in (("sessions_exported", "exported"),
+                                ("sessions_adopted", "adopted")):
+            cur = getattr(batcher, attr, 0)
+            if cur > last[attr]:
+                m_sessions.inc(cur - last[attr], direction=direction)
+                last[attr] = cur
         prefix_stats = getattr(batcher, "prefix_stats", None)
         if prefix_stats is not None:
             stats = prefix_stats()
@@ -258,6 +319,9 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                     last[outcome] = stats[outcome]
 
     tracer = tracing.tracer_for(ctx.working_dir)
+    #: role piggyback on every response message — the scheduler audits
+    #: that a pool member really serves its registered specialization
+    role_extra = {} if role is None else {"role": role}
 
     def busy() -> bool:
         return batcher.load()["total"] > 0
@@ -315,7 +379,30 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                     continue
                 if isinstance(item, dict) and item.get("op") == "clone":
                     # a promoted standby asks for this replica's weights
-                    serve_clone_request(batcher, item, ctx)
+                    serve_clone_request(
+                        batcher, item, ctx,
+                        export_pages=not args.get("serve_mesh"))
+                    continue
+                if isinstance(item, dict) and item.get("op") == "adopt":
+                    # a handed-off session: seat it without re-prefilling.
+                    # adopt_session verifies layout + per-page content
+                    # hashes HERE — a corrupt or raced transfer raises
+                    # before any device write and bounces back typed,
+                    # the engine stays healthy
+                    try:
+                        brid = batcher.adopt_session(item["session"],
+                                                     on_token=on_token)
+                    except ValueError as e:
+                        mgr.queue_put(RESPONSE_QUEUE,
+                                      {"rid": item.get("rid"),
+                                       "event": "error", "error": str(e),
+                                       **role_extra})
+                        continue
+                    rid_map[brid] = (item["rid"], item.get("trace"))
+                    tracer.event(
+                        "replica_adopt", item.get("trace"),
+                        rid=item["rid"], replica=ctx.executor_id,
+                        pages=int(item["session"].get("pages", 0)))
                     continue
                 if not (isinstance(item, dict) and item.get("op") == "gen"):
                     logger.warning("replica %d: ignoring non-request item %r",
@@ -332,7 +419,7 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                     # the typed error back to the scheduler
                     mgr.queue_put(RESPONSE_QUEUE,
                                   {"rid": item.get("rid"), "event": "error",
-                                   "error": str(e)})
+                                   "error": str(e), **role_extra})
                     continue
                 rid_map[brid] = (item["rid"], item.get("trace"))
                 tracer.event("replica_intake", item.get("trace"),
@@ -376,7 +463,7 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                 mgr.queue_put(RESPONSE_QUEUE,
                               {"rid": rid, "event": "tok",
                                "tokens": toks, "load": load,
-                               "free_pages": free_pages})
+                               "free_pages": free_pages, **role_extra})
             deltas.clear()
             for brid in done:
                 batcher.result(brid, pop=True)  # tokens already streamed
@@ -387,8 +474,28 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                 m_served.inc()
                 mgr.queue_put(RESPONSE_QUEUE,
                               {"rid": rid, "event": "done", "load": load,
-                               "free_pages": free_pages})
+                               "free_pages": free_pages, **role_extra})
                 served += 1
+            if role == "prefill":
+                # prefill pool: flush each admitted request's exported
+                # session AFTER its first-token delta (same queue, FIFO:
+                # the driver sees TTFT close before the handoff).  The
+                # session's KV pages ride the queue/shm plane like any
+                # bulk tensor — zero-copy on a shared host.
+                for brid, session in batcher.take_sessions():
+                    rid, trace = rid_map.pop(brid)
+                    first_sent.discard(brid)
+                    tracer.event(
+                        "replica_handoff", trace, rid=rid,
+                        replica=ctx.executor_id,
+                        pages=int(session.get("pages", 0)),
+                        bytes=int(sum(a.nbytes for a in session["kv"])))
+                    mgr.queue_put(RESPONSE_QUEUE,
+                                  {"rid": rid, "event": "handoff",
+                                   "session": session, "load": load,
+                                   "free_pages": free_pages,
+                                   **role_extra})
+                    served += 1
             if step_hook is not None:
                 # gang barrier AFTER the step's deltas are flushed, so
                 # barrier latency never delays token delivery

@@ -52,6 +52,19 @@ Scheduling policy (docs/serving.md):
   ahead of earlier-admitted low-priority ones (FIFO within a class;
   failover re-queues go to the front of their own class so the
   exactness contract is priority-blind).
+- **Disaggregated routing** (``roles=``; docs/serving.md "Disaggregated
+  prefill/decode") — in a role-aware tier a prompt routes only to the
+  least-loaded PREFILL gang, which computes the prompt KV and hands the
+  session back as a first-class KV-page transfer (``handoff`` response);
+  the scheduler then dispatches the session to the DECODE gang with the
+  fewest outstanding requests, tie-broken toward MORE free KV pages
+  (``op="adopt"``).  The adopt hop continues the same attempt, so the
+  requeue-once failover contract spans the handoff boundary: a death on
+  either side replays the request exactly once through the full
+  prefill→handoff→decode pipeline, skip-dedup keeping the client stream
+  oracle-exact.  ``submit`` on a tier whose prefill pool is gone raises
+  a typed ``RequestRejected(reason="role_mismatch")`` instead of
+  silently queueing a bare prompt on a decode-only gang.
 - **Elastic membership** — replicas can be added (:meth:`ReplicaScheduler.
   add_replica`, fed by ``ServingCluster.add_replicas``'s re-opened
   reservation path) and retired live.  Retirement is drain-based:
@@ -98,7 +111,9 @@ class RequestRejected(ServingError):
     ``reason`` is machine-readable: ``queue_full`` (bounded queue depth
     reached), ``tenant_throttled`` (the tenant's token bucket is empty —
     only THIS tenant is over budget), ``shutdown`` (scheduler stopping),
-    ``no_replica`` (every replica is dead)."""
+    ``no_replica`` (every replica is dead), ``role_mismatch`` (a
+    disaggregated tier with no routable prefill-capable replica —
+    refusing to queue a bare prompt on a decode-only gang)."""
 
     def __init__(self, reason: str, message: str):
         super().__init__(message)
@@ -215,7 +230,7 @@ class ServeRequest:
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature", "top_p",
                  "seed", "deadline", "events", "tokens", "attempts",
                  "replica", "skip", "created", "first_token_at", "finished",
-                 "trace", "tenant", "priority")
+                 "trace", "tenant", "priority", "session")
 
     def __init__(self, rid: int, prompt, max_new_tokens: int,
                  temperature: float, top_p: float, seed: int,
@@ -239,6 +254,9 @@ class ServeRequest:
         self.created = time.monotonic()
         self.first_token_at: float | None = None
         self.finished = False
+        #: the KV-page session a prefill gang handed back, held only
+        #: between the ``handoff`` response and its adopt dispatch
+        self.session: dict | None = None
 
     def message(self) -> dict:
         """The wire message the replica loop consumes (``trace`` rides
@@ -256,12 +274,18 @@ class _Replica:
     its capacity contribution to device-weighted signals)."""
 
     def __init__(self, info: dict, max_inflight: int,
-                 members: tuple = (), weight: int = 1):
+                 members: tuple = (), weight: int = 1,
+                 role: str | None = None):
         self.info = info
         self.eid = int(info["executor_id"])
         self.max_inflight = int(max_inflight)
         self.members = tuple(int(m) for m in members)
         self.weight = max(1, int(weight))
+        #: disaggregated-tier specialization: ``"prefill"`` (computes
+        #: prompt KV, never decode-steps), ``"decode"`` (only adopts
+        #: handed-off sessions and steps them), or None (unified — the
+        #: historical replica, serves the whole request)
+        self.role = role
         self.outstanding: dict[int, ServeRequest] = {}
         self.reported_load = 0   # last ContinuousBatcher.load()["total"]
         #: last self-reported allocatable KV pages (paged-KV replicas;
@@ -279,6 +303,13 @@ class _Replica:
         #: replayed streams, whose first token already happened)
         self.responded = False
 
+    def accepts(self, kind: str) -> bool:
+        """Whether this replica may take a ``"gen"`` dispatch (unified
+        or prefill role) or an ``"adopt"`` one (decode role only)."""
+        if kind == "adopt":
+            return self.role == "decode"
+        return self.role in (None, "prefill")
+
 
 class ReplicaScheduler:
     """Routes generate requests over a cluster of ContinuousBatcher
@@ -289,7 +320,8 @@ class ReplicaScheduler:
                  poll_interval: float = 0.25, requeue_limit: int = 1,
                  client_factory=None, event_log=None,
                  tenants: dict | None = None, gang_size: int = 1,
-                 capacity_weight: int | None = None):
+                 capacity_weight: int | None = None,
+                 roles: dict | None = None):
         self.cluster = cluster
         feedable = sorted(
             (n for n in cluster.cluster_info
@@ -314,6 +346,18 @@ class ReplicaScheduler:
             raise ValueError(
                 f"serving cluster has {len(feedable)} workers, not a "
                 f"multiple of gang_size={self.gang_size}")
+        #: role-aware (disaggregated) tier: ``roles`` maps every gang
+        #: LEADER eid to ``"prefill"`` or ``"decode"`` (docs/serving.md
+        #: "Disaggregated prefill/decode").  Prompts route only to
+        #: prefill-capable replicas; handed-off sessions only to decode
+        #: gangs.  A plain tier passes no roles and keeps the unified
+        #: behavior exactly.
+        roles = {int(k): v for k, v in (roles or {}).items()}
+        for eid, role in roles.items():
+            if role not in ("prefill", "decode"):
+                raise ValueError(f"replica {eid}: unknown role {role!r} "
+                                 "(want 'prefill' or 'decode')")
+        self._has_roles = bool(roles)
         self.replicas: dict[int, _Replica] = {}
         self._gang_leader: dict[int, int] = {}  # every gang eid -> leader
         for i in range(0, len(feedable), self.gang_size):
@@ -325,9 +369,13 @@ class ReplicaScheduler:
                     f"gang block {ids} is not a contiguous, "
                     f"gang_size-aligned executor range "
                     f"(gang_size={self.gang_size})")
+            if self._has_roles and ids[0] not in roles:
+                raise ValueError(
+                    f"role-aware tier: gang leader {ids[0]} has no role "
+                    f"(roles cover {sorted(roles)})")
             self.replicas[ids[0]] = _Replica(
                 block[0], max_inflight, members=tuple(ids[1:]),
-                weight=self._weight)
+                weight=self._weight, role=roles.get(ids[0]))
             for e in ids:
                 self._gang_leader[e] = ids[0]
         #: bounded admission queue: queued + in-flight across the tier
@@ -362,6 +410,11 @@ class ReplicaScheduler:
                 echo=False)
         self.events = event_log
         self._pending = _PendingQueue()
+        #: sessions a prefill gang handed back, awaiting their adopt
+        #: dispatch onto a decode gang (FIFO; dispatched ahead of new
+        #: prompts — their prefill compute is already spent)
+        self._pending_handoff: collections.deque = collections.deque()
+        self.handoffs = 0
         self._requests: dict[int, ServeRequest] = {}
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -404,6 +457,10 @@ class ReplicaScheduler:
         self._g_depth = reg.gauge(
             "tfos_serving_queue_depth_count",
             "Requests queued in the scheduler, not yet dispatched.")
+        self._g_handoff_depth = reg.gauge(
+            "tfos_serving_handoff_queue_depth_count",
+            "Handed-off sessions awaiting their decode-gang adopt "
+            "dispatch (disaggregated tiers; 0 otherwise).")
         self._g_outstanding = reg.gauge(
             "tfos_serving_replica_outstanding_count",
             "Driver-tracked in-flight requests per replica.",
@@ -428,7 +485,10 @@ class ReplicaScheduler:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ReplicaScheduler":
         self._emit("scheduler_started", replicas=sorted(self.replicas),
-                   max_queue_depth=self.max_queue_depth)
+                   max_queue_depth=self.max_queue_depth,
+                   roles={eid: rep.role
+                          for eid, rep in self.replicas.items()
+                          if rep.role is not None} or None)
         self._threads = [
             threading.Thread(target=self._dispatch_loop, name="serve-dispatch",
                              daemon=True),
@@ -451,10 +511,12 @@ class ReplicaScheduler:
         with self._lock:
             self._stop.set()
             self._work.notify_all()
-            leftovers = list(self._pending) + [
+            leftovers = list(self._pending) \
+                + list(self._pending_handoff) + [
                 r for rep in self.replicas.values()
                 for r in rep.outstanding.values()]
             self._pending.clear()
+            self._pending_handoff.clear()
             for rep in self.replicas.values():
                 rep.outstanding.clear()
             for req in leftovers:
@@ -473,6 +535,7 @@ class ReplicaScheduler:
             self._g_outstanding.remove(replica=str(eid))
             self._g_load.remove(replica=str(eid))
         self._g_depth.remove()
+        self._g_handoff_depth.remove()
         self._g_alive.remove()
         self._g_capacity.remove()
         for rep in self.replicas.values():
@@ -489,8 +552,9 @@ class ReplicaScheduler:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
-                busy = bool(self._pending) or any(
-                    rep.outstanding for rep in self.replicas.values())
+                busy = bool(self._pending) or bool(self._pending_handoff) \
+                    or any(rep.outstanding
+                           for rep in self.replicas.values())
             if not busy:
                 return True
             time.sleep(0.05)
@@ -514,6 +578,15 @@ class ReplicaScheduler:
                 raise RequestRejected("shutdown", "serving tier is stopping")
             if not any(rep.alive for rep in self.replicas.values()):
                 raise RequestRejected("no_replica", "no replica alive")
+            if self._has_roles and not any(
+                    rep.alive and not rep.draining and rep.accepts("gen")
+                    for rep in self.replicas.values()):
+                # fail typed at ADMISSION, not after a silent queue on a
+                # decode-only gang that will never prefill the prompt
+                raise RequestRejected(
+                    "role_mismatch",
+                    "no prefill-capable replica is routable: refusing to "
+                    "queue a bare prompt on a decode-only gang")
             ten = self.tenants.get(tenant) or self.tenants["default"]
             if priority is not None and priority not in PRIORITIES:
                 raise ValueError(f"unknown priority {priority!r} "
@@ -576,6 +649,9 @@ class ReplicaScheduler:
             self._requests.pop(req.rid, None)
             with contextlib.suppress(ValueError):
                 self._pending.remove(req)
+            with contextlib.suppress(ValueError):
+                self._pending_handoff.remove(req)
+            req.session = None
             if req.replica is not None:
                 rep = self.replicas.get(req.replica)
                 if rep is not None:
@@ -650,18 +726,28 @@ class ReplicaScheduler:
         with self._lock:
             return {eid for eid, rep in self.replicas.items() if rep.alive}
 
+    def replica_role(self, eid: int) -> str | None:
+        """The registered role of replica ``eid`` (None for unified or
+        unknown) — replacement spawns re-arm the SAME pool."""
+        with self._lock:
+            rep = self.replicas.get(int(eid))
+            return None if rep is None else rep.role
+
     def draining_replicas(self) -> set[int]:
         with self._lock:
             return {eid for eid, rep in self.replicas.items()
                     if rep.alive and rep.draining}
 
     # -- elastic membership ------------------------------------------------
-    def add_replica(self, info: dict, members: tuple = ()) -> None:
+    def add_replica(self, info: dict, members: tuple = (),
+                    role: str | None = None) -> None:
         """Register a freshly reserved replica worker and start routing
         to it (live scale-up / preemption replacement).  ``info`` is the
         node's reservation dict, exactly as ``cluster_info`` carries it;
         ``members`` the shard workers of a gang replica (their deaths
-        resolve to this endpoint, like the founding gangs')."""
+        resolve to this endpoint, like the founding gangs').  In a
+        role-aware (disaggregated) tier ``role`` is mandatory — an
+        unspecialized replica cannot join specialized pools."""
         eid = int(info["executor_id"])
         members = tuple(int(m) for m in members)
         if len(members) != self.gang_size - 1:
@@ -669,6 +755,13 @@ class ReplicaScheduler:
                 f"replica {eid} registered with {len(members)} gang "
                 f"member(s); this tier's gang_size={self.gang_size} "
                 f"needs {self.gang_size - 1}")
+        if role is not None and role not in ("prefill", "decode"):
+            raise ValueError(f"unknown role {role!r} "
+                             "(want 'prefill' or 'decode')")
+        if self._has_roles and role is None:
+            raise ValueError(
+                f"role-aware tier: add_replica({eid}) needs role= "
+                "('prefill' or 'decode')")
         with self._lock:
             if self._stop.is_set():
                 raise RuntimeError("scheduler is stopping")
@@ -676,13 +769,15 @@ class ReplicaScheduler:
             if existing is not None and existing.alive:
                 raise ValueError(f"replica {eid} already registered")
             rep = _Replica(info, self._max_inflight, members=members,
-                           weight=self._weight)
+                           weight=self._weight, role=role)
             self.replicas[eid] = rep
+            self._has_roles = self._has_roles or role is not None
             for e in (eid, *members):
                 self._gang_leader[e] = eid
             self._m_scale.inc(change="added")
             self._emit("replica_added", replica=eid,
                        members=list(members), weight=rep.weight,
+                       role=role,
                        alive=sum(1 for r in self.replicas.values()
                                  if r.alive))
             self._work.notify_all()
@@ -748,6 +843,7 @@ class ReplicaScheduler:
                 self._m_requests.inc(outcome="requeued")
                 req.attempts = max(0, req.attempts - 1)
                 req.replica = None
+                req.session = None
                 req.skip = len(req.tokens)
                 self._pending.appendleft(req)
                 self._emit("request_requeued", rid=req.rid, trace=req.trace,
@@ -761,6 +857,7 @@ class ReplicaScheduler:
         queue-depth / per-replica gauges at snapshot (scrape) time."""
         with self._lock:
             self._g_depth.set(len(self._pending))
+            self._g_handoff_depth.set(len(self._pending_handoff))
             alive = 0
             capacity = 0
             for eid, rep in self.replicas.items():
@@ -787,6 +884,8 @@ class ReplicaScheduler:
                 "abandoned": self.abandoned,
                 "failed": self.failed, "requeued": self.requeued,
                 "queued": len(self._pending),
+                "handoffs": self.handoffs,
+                "queued_handoffs": len(self._pending_handoff),
                 "gang_size": self.gang_size,
                 # device-weighted capacity: what the autoscaler's
                 # queue-pressure signal divides by — a tp=4 gang counts
@@ -802,6 +901,7 @@ class ReplicaScheduler:
                           "reported_load": rep.reported_load,
                           "free_pages": rep.reported_free_pages,
                           "weight": rep.weight,
+                          "role": rep.role,
                           "members": list(rep.members),
                           "served": rep.served}
                     for eid, rep in self.replicas.items()},
@@ -859,16 +959,19 @@ class ReplicaScheduler:
                     cli.close()
         rep.send_cli = rep.recv_cli = None
 
-    def _pick_replica(self) -> _Replica | None:
+    def _pick_replica(self, kind: str = "gen") -> _Replica | None:
         """Least-outstanding alive replica with spare in-flight capacity
         (ties by last self-reported batcher load, then by KV-page
         pressure — MORE free pages wins, so long prompts stop landing
-        on memory-starved replicas); None when saturated.  Draining
-        replicas take no new work."""
+        on memory-starved replicas, and a handed-off session seats on
+        the decode gang with the most page headroom); None when
+        saturated.  Draining replicas take no new work.  ``kind``
+        selects the pool in a role-aware tier: ``"gen"`` considers
+        unified/prefill replicas, ``"adopt"`` decode gangs only."""
         best = None
         best_key = None
         for rep in self.replicas.values():
-            if not rep.alive or rep.draining \
+            if not rep.alive or rep.draining or not rep.accepts(kind) \
                     or len(rep.outstanding) >= rep.max_inflight:
                 continue
             key = (len(rep.outstanding), rep.reported_load,
@@ -881,11 +984,17 @@ class ReplicaScheduler:
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
             with self._work:
-                while not self._pending and not self._stop.is_set():
+                while not (self._pending or self._pending_handoff) \
+                        and not self._stop.is_set():
                     self._work.wait(0.2)
                 if self._stop.is_set():
                     return
-                req = self._pending.popleft()
+                # handed-off sessions dispatch ahead of new prompts:
+                # their prefill compute is already spent, and seating
+                # them frees prefill-pool pages
+                handoff = bool(self._pending_handoff)
+                req = (self._pending_handoff.popleft() if handoff
+                       else self._pending.popleft())
                 if req.finished:
                     continue
                 if req.deadline is not None \
@@ -895,21 +1004,55 @@ class ReplicaScheduler:
                 if not any(rep.alive for rep in self.replicas.values()):
                     self._finish_err(req, "no_replica", "no replica alive")
                     continue
-                rep = self._pick_replica()
-                if rep is None:            # all replicas saturated: wait
-                    self._pending.appendleft(req)
+                rep = self._pick_replica("adopt" if handoff else "gen")
+                if rep is None:
+                    if handoff and not any(
+                            r.alive and r.accepts("adopt")
+                            for r in self.replicas.values()):
+                        self._finish_err(
+                            req, "no_replica",
+                            "no decode gang survives to adopt the "
+                            "handed-off session")
+                        continue
+                    if not handoff and self._has_roles and not any(
+                            r.alive and r.accepts("gen")
+                            for r in self.replicas.values()):
+                        self._finish_err(
+                            req, "no_replica",
+                            "no prefill-capable replica survives to run "
+                            "the prompt")
+                        continue
+                    # the pool is saturated: wait for capacity
+                    if handoff:
+                        self._pending_handoff.appendleft(req)
+                    else:
+                        self._pending.appendleft(req)
                     self._work.wait(0.05)
                     continue
                 req.replica = rep.eid
-                req.attempts += 1
                 rep.outstanding[req.rid] = req
-                self._emit("request_routed", rid=req.rid, trace=req.trace,
-                           replica=rep.eid, attempt=req.attempts)
+                if handoff:
+                    # the adopt hop CONTINUES the same attempt — only gen
+                    # dispatches charge the requeue-once failover budget,
+                    # so a death on either side of the handoff boundary
+                    # leaves exactly one replay
+                    session, req.session = req.session, None
+                    msg = {"op": "adopt", "rid": req.rid,
+                           "trace": req.trace, "session": session}
+                    self._emit("request_handoff_routed", rid=req.rid,
+                               trace=req.trace, replica=rep.eid,
+                               pages=int((session or {}).get("pages", 0)))
+                else:
+                    req.attempts += 1
+                    msg = req.message()
+                    self._emit("request_routed", rid=req.rid,
+                               trace=req.trace, replica=rep.eid,
+                               attempt=req.attempts)
             # the put may block on the socket — never under the lock
             try:
                 if rep.send_cli is None:
                     rep.send_cli = self._client_factory(rep.info)
-                rep.send_cli.put(REQUEST_QUEUE, req.message(), timeout=30)
+                rep.send_cli.put(REQUEST_QUEUE, msg, timeout=30)
             except Exception as e:
                 # a dead/wedged replica: everything it holds (including
                 # this request) is re-queued or failed by _mark_dead
@@ -965,6 +1108,16 @@ class ReplicaScheduler:
                 rep.reported_load = int(msg["load"])
             if "free_pages" in msg:
                 rep.reported_free_pages = int(msg["free_pages"])
+            role = msg.get("role")
+            if role is not None and role != rep.role:
+                # a replica serving a different specialization than it
+                # registered with would silently break the pools — keep
+                # serving (the stream is still exact) but say so loudly
+                logger.error(
+                    "replica %d reports role %r but registered as %r",
+                    rep.eid, role, rep.role)
+                self._emit("role_mismatch", replica=rep.eid,
+                           reported=role, registered=rep.role)
             if event == "standby_ready":
                 # a promoted standby finished loading weights: capacity
                 # is restored — let the tier close its heal measurement
@@ -983,6 +1136,28 @@ class ReplicaScheduler:
             req = rep.outstanding.get(rid)
             if req is None or req.finished:
                 return          # abandoned, or replayed on another replica
+            if event == "handoff":
+                # the prefill gang finished the prompt: the request's
+                # session (KV pages + first token + sampler state) moves
+                # to the driver, awaiting its decode-gang adopt dispatch.
+                # The outstanding guard above makes this race-safe: a
+                # handoff from a replica _mark_dead already swept is
+                # dropped here, and the requeued gen replay wins.
+                rep.outstanding.pop(rid, None)
+                session = msg.get("session") or {}
+                req.replica = None
+                req.session = session
+                self.handoffs += 1
+                self._m_requests.inc(outcome="handoff")
+                self._pending_handoff.append(req)
+                self._emit(
+                    "request_handoff", rid=rid, trace=req.trace,
+                    from_replica=rep.eid,
+                    pages=int(session.get("pages", 0)),
+                    bytes=int(sum(getattr(a, "nbytes", 0)
+                                  for a in session.get("kv", ()))))
+                self._work.notify_all()
+                return
             if event == "tok":
                 toks = [int(t) for t in msg.get("tokens", ())]
                 if req.skip:    # replay after failover: dedup the prefix
@@ -1081,10 +1256,16 @@ class ReplicaScheduler:
                     f"{self.requeue_limit})")
             else:
                 # replay from scratch on a survivor; decode determinism
-                # + the skip counter make the client's stream exact
+                # + the skip counter make the client's stream exact.  A
+                # request lost POST-HANDOFF replays the same way: the
+                # gen replay re-prefills (on a prefill gang in a
+                # disaggregated tier), hands off again, and the skip
+                # counter dedups everything already delivered — the
+                # requeue-once budget spans the whole pipeline
                 self.requeued += 1
                 self._m_requests.inc(outcome="requeued")
                 req.replica = None
+                req.session = None
                 req.skip = len(req.tokens)
                 self._pending.appendleft(req)
                 self._emit("request_requeued", rid=req.rid, trace=req.trace,
@@ -1093,4 +1274,7 @@ class ReplicaScheduler:
             for req in list(self._pending):
                 self._finish_err(req, "no_replica", "no replica alive")
             self._pending.clear()
+            for req in list(self._pending_handoff):
+                self._finish_err(req, "no_replica", "no replica alive")
+            self._pending_handoff.clear()
         self._work.notify_all()

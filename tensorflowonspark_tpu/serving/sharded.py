@@ -76,7 +76,8 @@ from tensorflowonspark_tpu import metrics as _metrics
 from tensorflowonspark_tpu.marker import EndOfFeed, Marker
 from tensorflowonspark_tpu.preemption import PreemptionGuard
 from tensorflowonspark_tpu.queues import QueueClient
-from tensorflowonspark_tpu.serving.replica import run_serve_loop
+from tensorflowonspark_tpu.serving.replica import (run_serve_loop,
+                                                   serving_batcher_kwargs)
 from tensorflowonspark_tpu.serving.scheduler import (REQUEST_QUEUE,
                                                      RESPONSE_QUEUE)
 
@@ -349,7 +350,7 @@ def serve_sharded_replica(args, ctx) -> None:
             cfg, params,
             max_batch=int(args.get("serve_max_batch", 4)),
             eos_id=args.get("serve_eos_id"),
-            **dict(args.get("serve_batcher_kwargs") or {}))
+            **serving_batcher_kwargs(args))
         barrier = GangBarrier(
             members,
             boot_timeout=float(args.get("serve_gang_boot_timeout", 120.0)),
@@ -357,7 +358,8 @@ def serve_sharded_replica(args, ctx) -> None:
         try:
             barrier.hello()
             run_serve_loop(args, ctx, batcher, step_hook=barrier.step,
-                           label=f"gang-{leader_eid} leader")
+                           label=f"gang-{leader_eid} leader",
+                           role=args.get("serve_role"))
         finally:
             # clean exit or GangShardLost alike: tell surviving members
             # to stop idling on their barrier queue

@@ -103,7 +103,8 @@ def serve_standby(args, ctx) -> None:
 
 def _standby_leader(args, ctx, spec) -> None:
     from tensorflowonspark_tpu.serving.replica import (
-        enable_serving_compile_cache, run_serve_loop)
+        enable_serving_compile_cache, run_serve_loop,
+        serving_batcher_kwargs)
 
     mgr = ctx.mgr
     if mgr is None:
@@ -139,7 +140,7 @@ def _standby_leader(args, ctx, spec) -> None:
             cfg, params,
             max_batch=int(args.get("serve_max_batch", 4)),
             eos_id=args.get("serve_eos_id"),
-            **dict(args.get("serve_batcher_kwargs") or {}))
+            **serving_batcher_kwargs(args))
         try:
             if barrier is not None:
                 barrier.hello()
@@ -152,14 +153,15 @@ def _standby_leader(args, ctx, spec) -> None:
             if promote is None:         # EndOfFeed: tier shutdown
                 logger.info("standby %d retired unpromoted", ctx.executor_id)
                 return
-            params = _acquire_params(args, ctx, mgr, promote, cfg)
-            if params is _STOP:
+            got = _acquire_params(args, ctx, mgr, promote, cfg)
+            if got is _STOP:
                 # EndOfFeed landed mid-promotion (tier shutdown, or the
                 # autoscaler retired us before the clone finished):
                 # exit cleanly instead of serving unregistered forever
                 logger.info("standby %d stopped during promotion",
                             ctx.executor_id)
                 return
+            params, prefix_pages = got
             if shard_fn is not None:
                 params = shard_fn(cfg, params, mesh)
             else:
@@ -170,6 +172,25 @@ def _standby_leader(args, ctx, spec) -> None:
 
                 params = jax.device_put(params)
             batcher.load_params(params)
+            if prefix_pages is not None and spec is None:
+                # the peer's prefix-cache pages rode the clone (KV
+                # computed under the very weights just loaded): import
+                # them so post-heal same-system-prompt TTFT keeps its
+                # hits.  Single-process replicas only — a gang's pool
+                # leaves are mesh-sharded, host pages would need a
+                # resharding pass.  Best-effort: a failed import costs
+                # TTFT, never the promotion.
+                try:
+                    n = batcher.import_prefix_cache(prefix_pages)
+                    logger.info("standby %d imported %d cloned prefix-"
+                                "cache page(s)", ctx.executor_id, n)
+                # tfos: ignore[broad-except] — the heal must complete
+                # even when the page clone is unusable (hash mismatch,
+                # geometry drift); the warm pool exists for capacity
+                except Exception:
+                    logger.exception("standby %d: cloned prefix-cache "
+                                     "import failed; serving cold-cache",
+                                     ctx.executor_id)
             mgr.queue_put(RESPONSE_QUEUE,
                           {"rid": None, "event": "standby_ready",
                            "load": 0, "source": promote.get("source")})
@@ -243,27 +264,35 @@ def _standby_wait(mgr) -> dict | None:
 
 def _acquire_params(args, ctx, mgr, promote: dict, cfg):
     """The promoted standby's weights: peer clone first, model-builder
-    (checkpoint restore) fallback.  ``_STOP`` when an ``EndOfFeed``
+    (checkpoint restore) fallback.  Returns ``(params, prefix_pages)``
+    — ``prefix_pages`` is the peer's cloned prefix-cache export, and
+    ONLY rides the clone path: builder-restored weights may differ from
+    any peer's, and prefix K/V computed under other weights would
+    silently decode wrong tokens.  ``_STOP`` when an ``EndOfFeed``
     interrupted the clone wait (tier shutdown / concurrent retire)."""
     peer = promote.get("peer")
     if peer is not None:
-        params = _clone_from_peer(
+        got = _clone_from_peer(
             ctx, mgr, peer,
             timeout=float(args.get("serve_clone_timeout", 60.0)))
-        if params is _STOP or params is not None:
-            return params
+        if got is _STOP:
+            return _STOP
+        if got is not None:
+            return got["params"], got.get("prefix_pages")
         logger.warning("standby %d: peer clone from %s failed/timed out; "
                        "falling back to the model builder",
                        ctx.executor_id, peer.get("executor_id"))
     _cfg, params = args["serve_model_builder"](args)
-    return params
+    return params, None
 
 
 def _clone_from_peer(ctx, mgr, peer: dict, timeout: float):
     """Pull params from a live peer replica over the queue/shm plane:
     post a ``clone`` request carrying OUR reply address onto the peer's
     request queue, then wait for the params message on our own.  Returns
-    the (host numpy) parameter tree, or None on any failure."""
+    the whole params message (host-numpy ``"params"`` tree plus the
+    peer's optional ``"prefix_pages"`` export), or None on any
+    failure."""
     from tensorflowonspark_tpu.queues import QueueClient
 
     me = next(n for n in ctx.cluster_info
@@ -293,7 +322,7 @@ def _clone_from_peer(ctx, mgr, peer: dict, timeout: float):
                 continue
             if isinstance(item, dict) and item.get("op") == "standby" \
                     and item.get("event") == "params":
-                return item["params"]
+                return item
             if isinstance(item, EndOfFeed):
                 return _STOP        # shutdown/retire raced the promotion
             if isinstance(item, Marker):

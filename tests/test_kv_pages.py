@@ -181,3 +181,96 @@ def test_prefix_cache_disabled_never_shares():
     pool.release(a)
     pool.release(b)
     assert pool.free_pages() == 8 and pool.cached_pages() == 0
+
+
+def test_adopt_indexes_every_full_prompt_page():
+    """Session adoption has no ">= 1 token re-runs" cap: an exact
+    k-page prompt shares/indexes ALL k pages (nothing is prefilled; the
+    session already carries its first token)."""
+    pool = KVPagePool(8, 4)
+    prompt = np.arange(8, dtype=np.int32)       # exactly 2 pages
+    a = pool.adopt(prompt, 12)                  # 3 logical pages
+    assert a is not None and a.n_shared == 0 and a.outcome == "miss"
+    assert len(a.page_ids) == 3
+    pool.commit(a)
+    # both full pages are matchable now (probe with a tail so the
+    # admit-side peek's own re-run cap doesn't hide the second page)
+    probe = np.concatenate([prompt, np.asarray([99], np.int32)])
+    assert pool.match_tokens(probe) == 8
+    b = pool.admit(prompt, 12)
+    assert b.n_shared == 1, "admit must keep its re-run cap"
+    pool.release(b)
+    c = pool.adopt(prompt, 12)
+    assert c.outcome == "hit" and c.n_shared == 2
+    assert c.page_ids[:2] == a.page_ids[:2]
+    pool.release(c)
+    pool.release(a)
+
+
+def test_adopt_matches_seeded_prefix_and_imports_only_the_tail():
+    """An adopt against a pool already holding the session's system
+    prefix shares those pages — the handoff imports only the unmatched
+    remainder."""
+    pool = KVPagePool(16, 4)
+    sysp = np.arange(8, dtype=np.int32)
+    seeded = pool.adopt(sysp, 8)
+    pool.commit(seeded)
+    pool.release(seeded)
+    prompt = np.concatenate([sysp, np.asarray([9, 10], np.int32)])
+    a = pool.adopt(prompt, 14)
+    assert a.outcome == "hit" and a.n_shared == 2
+    # pages to import = ceil(10/4) - 2 = 1 (the partial tail page)
+    n_pp = -(-prompt.size // 4)
+    assert len(a.page_ids[a.n_shared:n_pp]) == 1
+    pool.release(a)
+
+
+def test_adopt_backpressures_when_pool_dry():
+    pool = KVPagePool(2, 4)
+    a = pool.adopt(np.arange(4, dtype=np.int32), 8)
+    assert a is not None
+    assert pool.adopt(np.arange(4, dtype=np.int32) + 50, 8) is None
+    pool.release(a)
+    assert pool.adopt(np.arange(4, dtype=np.int32) + 50, 8) is not None
+
+
+def test_adopt_cached_imports_in_order_and_respects_capacity():
+    """Bare cached-page import (the standby prefix-cache clone): pages
+    land in the LRU at refcount 0 — matchable immediately, evictable
+    under pressure — and capacity truncation keeps chains reachable."""
+    from tensorflowonspark_tpu.models.kv_pages import chain_keys
+
+    donor = KVPagePool(8, 4)
+    prompt = np.arange(12, dtype=np.int32)      # 3 full pages
+    a = donor.adopt(prompt, 12)
+    donor.commit(a)
+    donor.release(a)
+    keys = [k for k, _ in donor.export_index()]
+    assert keys == chain_keys(prompt, 4)
+
+    probe = np.concatenate([prompt, np.asarray([99], np.int32)])
+    imp = KVPagePool(8, 4)
+    got = imp.adopt_cached(keys)
+    assert len(got) == 3 and imp.cached_pages() == 3
+    assert imp.free_pages() == 8                # cached pages evictable
+    assert imp.match_tokens(probe) == 12
+    # re-import is a no-op (keys already indexed)
+    assert imp.adopt_cached(keys) == {}
+
+    tiny = KVPagePool(2, 4)
+    trunc = tiny.adopt_cached(keys)
+    assert len(trunc) == 2, "capacity truncation"
+    # the truncated import keeps the chain PREFIX: 2 pages matchable
+    assert tiny.match_tokens(probe) == 8
+
+
+def test_hash_page_data_detects_single_byte_corruption():
+    from tensorflowonspark_tpu.models.kv_pages import hash_page_data
+
+    arrays = [np.arange(2 * 4 * 2 * 3, dtype=np.float32)
+              .reshape(2, 4, 2, 3)]
+    good = hash_page_data(arrays, 2)
+    bad = [np.array(arrays[0], copy=True)]
+    bad[0][1, 2, 1, 1] += 1e-3
+    hashes = hash_page_data(bad, 2)
+    assert hashes[0] == good[0] and hashes[1] != good[1]

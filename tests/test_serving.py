@@ -1115,3 +1115,208 @@ def test_block_decode_validation():
     with pytest.raises(ValueError, match="mutually exclusive"):
         ContinuousBatcher(cfg, params, max_batch=2, decode_block_steps=4,
                           speculative_k=2)
+
+
+# ---------------------------------------------------------------------------
+# KV-page session handoff (disaggregated prefill/decode; docs/serving.md)
+
+def _drive_handoff(pre, max_steps=30):
+    """Step a prefill-only batcher until its pending work is exported;
+    returns every (request_id, session) pair."""
+    sessions = []
+    for _ in range(max_steps):
+        pre.step()
+        sessions.extend(pre.take_sessions())
+        if not pre.load()["total"]:
+            break
+    return sessions
+
+
+def test_handoff_greedy_exact_on_miss_path():
+    """Prefill-only export → decode adopt: the stitched stream (first
+    token from the prefill side + the decode side's tokens) equals the
+    solo greedy oracle, and the decode batcher never runs a prefill
+    dispatch."""
+    cfg, params = _make()
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(0, cfg.vocab_size, (t,)).astype(np.int32), n)
+            for t, n in ((5, 7), (11, 5), (16, 6), (3, 9))]
+    pre = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8,
+                            prefill_only=True)
+    rids = [pre.submit(p, n) for p, n in reqs]
+    sessions = dict(_drive_handoff(pre))
+    assert sorted(sessions) == sorted(rids)
+    assert pre.sessions_exported == len(reqs)
+    assert pre.decode_dispatches == 0, "a prefill pool must never step"
+
+    dec = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8)
+    dmap = {dec.adopt_session(sessions[rid]): rid for rid in rids}
+    results = dec.run()
+    assert dec.prefill_dispatches == 0, \
+        "a decode gang must never re-prefill an adopted session"
+    assert dec.sessions_adopted == len(reqs)
+    for drid, prid in dmap.items():
+        prompt, n = reqs[rids.index(prid)]
+        np.testing.assert_array_equal(results[drid],
+                                      _oracle(cfg, params, prompt, n))
+
+
+def test_handoff_sampled_exact():
+    """A sampled session hands off with its sampler state: the decode
+    side's continuation is token-identical to an unsplit batcher run of
+    the same (prompt, budget, temperature, top_p, seed)."""
+    cfg, params = _make()
+    prompt = np.asarray([7, 3, 9, 1, 4, 2, 8], np.int32)
+    kw = dict(temperature=0.8, top_p=0.9, seed=123)
+    pre = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8,
+                            prefill_only=True)
+    rid = pre.submit(prompt, 9, **kw)
+    [(_, sess)] = _drive_handoff(pre)
+    dec = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8)
+    drid = dec.adopt_session(sess)
+    got = dec.run()[drid]
+
+    solo = ContinuousBatcher(cfg, params, max_batch=1, kv_page_tokens=8)
+    srid = solo.submit(prompt, 9, **kw)
+    np.testing.assert_array_equal(got, solo.run()[srid])
+
+
+def test_handoff_prefix_hit_path_exact_and_imports_only_tail():
+    """A decode pool already holding the session's system prefix adopts
+    WITHOUT importing the matched pages (cross-request reuse composes
+    with the handoff) and stays oracle-exact."""
+    cfg, params = _make()
+    rng = np.random.default_rng(1)
+    sysp = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    dec = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8)
+    seed_p = np.concatenate([sysp, rng.integers(0, cfg.vocab_size, (3,))
+                             .astype(np.int32)])
+    dec.submit(seed_p, 4)
+    dec.run()                               # seeds sysp's 2 full pages
+    h0 = dec.prefix_stats()
+
+    prompt = np.concatenate([sysp, rng.integers(0, cfg.vocab_size, (5,))
+                             .astype(np.int32)])
+    pre = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8,
+                            prefill_only=True)
+    pre.submit(prompt, 6)
+    [(_, sess)] = _drive_handoff(pre)
+    drid = dec.adopt_session(sess)
+    got = dec.run()[drid]
+    h1 = dec.prefix_stats()
+    assert h1["hit"] == h0["hit"] + 1, "adopt missed the seeded prefix"
+    np.testing.assert_array_equal(got, _oracle(cfg, params, prompt, 6))
+
+
+def test_adopt_rejects_corrupt_and_mismatched_sessions_loudly():
+    """A transfer whose per-page content hashes or layout signature
+    don't verify raises a typed ``ValueError`` from ``adopt_session``
+    itself — before any device write, without poisoning the batcher."""
+    cfg, params = _make()
+    prompt = np.asarray([5, 4, 3, 2, 1, 6, 7, 8, 9], np.int32)
+    pre = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8,
+                            prefill_only=True)
+    pre.submit(prompt, 5)
+    [(_, sess)] = _drive_handoff(pre)
+
+    dec = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8)
+    corrupt = dict(sess)
+    corrupt["kv"] = [np.array(a, copy=True) for a in sess["kv"]]
+    corrupt["kv"][0].flat[5] += 1
+    with pytest.raises(ValueError, match="content hash mismatch"):
+        dec.adopt_session(corrupt)
+    mismatched = dict(sess, page_tokens=16)
+    with pytest.raises(ValueError, match="page_tokens"):
+        dec.adopt_session(mismatched)
+    # a key-skewed descriptor is ValueError too — a KeyError would
+    # escape the serve loop's typed-error bounce and crash the worker
+    truncated = {k: v for k, v in sess.items() if k != "page_hashes"}
+    with pytest.raises(ValueError, match="missing key"):
+        dec.adopt_session(truncated)
+    raced = dict(sess)
+    raced["kv"] = [a[..., :-1] for a in sess["kv"]]
+    with pytest.raises(ValueError, match="layout mismatch"):
+        dec.adopt_session(raced)
+    # the rejections never touched the engine: it still serves exactly
+    drid = dec.adopt_session(sess)
+    np.testing.assert_array_equal(dec.run()[drid],
+                                  _oracle(cfg, params, prompt, 5))
+
+
+def test_prefill_only_validation_and_direct_finish():
+    cfg, params = _make()
+    with pytest.raises(ValueError, match="kv_page_tokens"):
+        ContinuousBatcher(cfg, params, max_batch=2, prefill_only=True)
+    with pytest.raises(ValueError, match="decode-time"):
+        ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8,
+                          prefill_only=True, speculative_k=2)
+    pre = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8,
+                            prefill_only=True)
+    with pytest.raises(ValueError, match="prefill-only"):
+        pre.adopt_session({"v": 1})
+    # a budget-1 request finishes AT the prefill (no session to hand
+    # off): the prefill pool completes it directly
+    prompt = np.asarray([1, 2, 3], np.int32)
+    rid = pre.submit(prompt, 1)
+    done = []
+    for _ in range(5):
+        done += pre.step()
+        if done:
+            break
+    assert done == [rid] and not pre.take_sessions()
+    np.testing.assert_array_equal(pre.result(rid),
+                                  _oracle(cfg, params, prompt, 1))
+
+
+def test_handoff_composes_with_chunked_prefill():
+    """A long prompt streamed through the prefill pool's chunked
+    admission exports the identical session a whole-prompt prefill
+    would: the decode side stays oracle-exact."""
+    cfg, params = _make()
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, cfg.vocab_size, (30,)).astype(np.int32)
+    pre = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8,
+                            prefill_chunk=8, prefill_only=True)
+    pre.submit(prompt, 6)
+    sessions = _drive_handoff(pre)
+    assert len(sessions) == 1
+    dec = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8)
+    drid = dec.adopt_session(sessions[0][1])
+    np.testing.assert_array_equal(dec.run()[drid],
+                                  _oracle(cfg, params, prompt, 6))
+
+
+def test_export_import_prefix_cache_roundtrip_exact():
+    """The standby promotion's page clone: a donor's prefix-cache
+    export imports into a fresh batcher as matchable cached pages, and
+    decoding against them is oracle-exact (hash-verified; corrupt
+    imports rejected)."""
+    cfg, params = _make()
+    rng = np.random.default_rng(4)
+    sysp = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    donor = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8)
+    donor.submit(np.concatenate(
+        [sysp, rng.integers(0, cfg.vocab_size, (3,)).astype(np.int32)]), 4)
+    donor.run()
+    export = donor.export_prefix_cache()
+    assert export is not None and export["pages"] >= 2
+
+    imp = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8)
+    assert imp.import_prefix_cache(export) == export["pages"]
+    probe = np.concatenate(
+        [sysp, rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)])
+    rid = imp.submit(probe, 5)
+    got = imp.run()[rid]
+    assert imp.prefix_stats()["hit"] == 1, "imported pages never matched"
+    np.testing.assert_array_equal(got, _oracle(cfg, params, probe, 5))
+
+    bad = dict(export)
+    bad["kv"] = [np.array(a, copy=True) for a in export["kv"]]
+    bad["kv"][0].flat[0] += 1
+    fresh = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8)
+    with pytest.raises(ValueError, match="content hash mismatch"):
+        fresh.import_prefix_cache(bad)
+    # dense batchers have nothing to export/import
+    dense = ContinuousBatcher(cfg, params, max_batch=2)
+    assert dense.export_prefix_cache() is None
+    assert dense.import_prefix_cache(export) == 0

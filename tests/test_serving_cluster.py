@@ -1715,3 +1715,478 @@ def _serving_events(tmp_path):
 
     path = os.path.join(str(tmp_path), "serving_events.jsonl")
     return EventLog.read(path) if os.path.exists(path) else []
+
+
+# --------------------------------------- disaggregated prefill/decode
+
+class _DisaggWorld(_FakeWorld):
+    """Fake specialized pools speaking the handoff protocol: prefill
+    fakes answer a gen with the FIRST token + a ``handoff`` session;
+    decode fakes answer an ``adopt`` by streaming the remainder.  The
+    deterministic ``_fake_tokens`` stream spans the boundary, so replay
+    exactness is assertable exactly like the unified fakes."""
+
+    def __init__(self, n_prefill, n_decode, token_delay=0.0,
+                 prefill_delay=0.0):
+        self.roles = {i: ("prefill" if i < n_prefill else "decode")
+                      for i in range(n_prefill + n_decode)}
+        self.prefill_delay = prefill_delay
+        super().__init__(n_prefill + n_decode, token_delay=token_delay)
+
+    def _run(self, i):
+        role = self.roles.get(i, "decode")   # late adds join decode
+        while i not in self._dead:
+            try:
+                item = self.inq[i].get(timeout=0.02)
+            except _queue.Empty:
+                continue
+            rid = item["rid"]
+            if role == "prefill":
+                p, n = item["prompt"], item["max_new_tokens"]
+                toks = _fake_tokens(p, n)
+                if self.prefill_delay:
+                    time.sleep(self.prefill_delay)
+                if i in self._dead:
+                    return                   # died mid-prefill
+                self.outq[i].put({"rid": rid, "event": "tok",
+                                  "tokens": [toks[0]], "load": 0,
+                                  "role": "prefill"})
+                if n == 1:
+                    self.outq[i].put({"rid": rid, "event": "done",
+                                      "load": 0, "role": "prefill"})
+                    continue
+                self.outq[i].put(
+                    {"rid": rid, "event": "handoff", "role": "prefill",
+                     "load": 0, "free_pages": 7,
+                     "session": {"prompt": p, "tokens": [toks[0]],
+                                 "remaining": n - 1, "pages": 2,
+                                 "kv": []}})
+            else:
+                sess = item["session"]
+                p, g = sess["prompt"], len(sess["tokens"])
+                toks = _fake_tokens(p, g + sess["remaining"])[g:]
+                for tok in toks:
+                    if i in self._dead:
+                        return               # died post-handoff
+                    if self.token_delay:
+                        time.sleep(self.token_delay)
+                    self.outq[i].put({"rid": rid, "event": "tok",
+                                      "tokens": [tok], "load": 1,
+                                      "role": "decode"})
+                self.outq[i].put({"rid": rid, "event": "done", "load": 0,
+                                  "role": "decode"})
+
+
+def _disagg_scheduler(world, **kw):
+    kw.setdefault("roles", dict(world.roles))
+    return _scheduler(world, **kw)
+
+
+def test_disagg_routes_prompt_to_prefill_then_session_to_decode():
+    world = _DisaggWorld(1, 1)
+    s = _disagg_scheduler(world).start()
+    try:
+        prompts = [np.arange(1, 4 + i, dtype=np.int32) for i in range(5)]
+        reqs = [s.submit(p, 6) for p in prompts]
+        for req, p in zip(reqs, prompts):
+            toks, err = _collect(req)
+            assert err is None and toks == _fake_tokens(p, 6)
+        m = s.metrics()
+        assert m["handoffs"] == 5 and m["completed"] == 5
+        assert m["queued_handoffs"] == 0
+        assert m["replicas"][0]["role"] == "prefill"
+        assert m["replicas"][1]["role"] == "decode"
+        # every DONE came from the decode gang; the prefill gang only
+        # ever prefilled (its served count tracks done events)
+        assert m["replicas"][1]["served"] == 5
+        assert m["replicas"][0]["served"] == 0
+        # the handoff message's free_pages piggyback reached the router
+        assert m["replicas"][0]["free_pages"] == 7
+    finally:
+        s.stop()
+
+
+def test_submit_rejects_bare_prompt_on_decode_only_tier():
+    """The routing safety fix: a tier whose prefill pool is gone (or was
+    never configured) rejects prompts TYPED at admission instead of
+    queueing them on a decode-only gang forever."""
+    world = _DisaggWorld(1, 1)
+    s = _disagg_scheduler(world, roles={0: "decode", 1: "decode"}).start()
+    try:
+        with pytest.raises(RequestRejected) as ei:
+            s.submit(np.asarray([1, 2], np.int32), 4)
+        assert ei.value.reason == "role_mismatch"
+        assert "refusing to queue a bare prompt on a decode-only gang" \
+            in str(ei.value)
+    finally:
+        s.stop()
+    # the same rejection when the prefill pool DIES out from under a
+    # live tier
+    world = _DisaggWorld(1, 1, prefill_delay=0.05)
+    s = _disagg_scheduler(world).start()
+    try:
+        world.kill(0)
+        deadline = time.monotonic() + 5
+        while 0 not in s.dead_replicas() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        with pytest.raises(RequestRejected) as ei:
+            s.submit(np.asarray([1], np.int32), 3)
+        assert ei.value.reason == "role_mismatch"
+    finally:
+        s.stop()
+
+
+def test_disagg_prefill_death_mid_prefill_requeues_once_exact():
+    world = _DisaggWorld(2, 1, prefill_delay=0.4)
+    s = _disagg_scheduler(world, slots_per_replica=1, overcommit=1).start()
+    try:
+        p = np.asarray([2, 7], np.int32)
+        req = s.submit(p, 6)
+        deadline = time.monotonic() + 5
+        while req.replica is None and time.monotonic() < deadline:
+            time.sleep(0.005)
+        victim = req.replica
+        assert victim in (0, 1), "prompt routed off the prefill pool"
+        world.kill(victim)
+        toks, err = _collect(req, timeout=15)
+        assert err is None and toks == _fake_tokens(p, 6)
+        m = s.metrics()
+        assert m["requeued"] == 1 and m["completed"] == 1
+        assert s.dead_replicas() == {victim}
+    finally:
+        s.stop()
+
+
+def test_disagg_decode_death_post_handoff_replays_full_pipeline():
+    """A decode gang dying POST-handoff replays the request through the
+    whole prefill→handoff→adopt pipeline once: the client stream stays
+    exact (skip-dedup spans the boundary) and the request hands off
+    TWICE."""
+    world = _DisaggWorld(1, 2, token_delay=0.05)
+    s = _disagg_scheduler(world, slots_per_replica=1, overcommit=1).start()
+    try:
+        p = np.asarray([3, 5, 8], np.int32)
+        req = s.submit(p, 10)
+        # wait until the DECODE side is streaming (>= 2 tokens: first
+        # came from prefill, the rest from the adopted session)
+        deadline = time.monotonic() + 10
+        while len(req.tokens) < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        victim = req.replica
+        assert world.roles[victim] == "decode", "request not in decode"
+        world.kill(victim)
+        toks, err = _collect(req, timeout=15)
+        assert err is None and toks == _fake_tokens(p, 10), \
+            "post-handoff failover stream not exact"
+        m = s.metrics()
+        assert m["requeued"] == 1 and m["completed"] == 1
+        assert m["handoffs"] == 2, "the replay must re-handoff"
+    finally:
+        s.stop()
+
+
+def test_disagg_requeue_once_budget_spans_the_boundary():
+    """One failover attempt TOTAL across the pipeline: the adopt hop
+    never charges the budget (a normal request = 1 attempt), and the
+    second decode-side death fails typed."""
+    world = _DisaggWorld(1, 2, token_delay=0.08)
+    s = _disagg_scheduler(world, slots_per_replica=1, overcommit=1).start()
+    try:
+        p = np.asarray([9, 1], np.int32)
+        req = s.submit(p, 12)
+        deadline = time.monotonic() + 10
+        while len(req.tokens) < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert req.attempts == 1, \
+            "the adopt dispatch must not charge the failover budget"
+        world.kill(req.replica)          # first decode death: replays
+        deadline = time.monotonic() + 10
+        while s.metrics()["requeued"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # wait for the replay to reach the surviving decode gang
+        deadline = time.monotonic() + 10
+        while (req.replica is None
+               or world.roles.get(req.replica) != "decode"
+               or req.replica in world._dead) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        world.kill(req.replica)          # second death: budget exhausted
+        toks, err = _collect(req, timeout=15)
+        assert err is not None and err[1] == "replica_failed"
+        assert s.metrics()["failed"] == 1
+    finally:
+        s.stop()
+
+
+def test_trace_id_survives_handoff_and_post_handoff_requeue(tmp_path):
+    """Satellite: the stitched timeline gains the handoff span — one
+    trace id covers admission → prefill route → handoff (pages/bytes) →
+    adopt route → requeue → re-prefill → re-handoff → done."""
+    from tensorflowonspark_tpu import tracing
+    from tensorflowonspark_tpu.observability import EventLog
+
+    world = _DisaggWorld(1, 2, token_delay=0.05)
+    log = EventLog(str(tmp_path / "serving_events.jsonl"))
+    s = _disagg_scheduler(world, slots_per_replica=1, overcommit=1,
+                          event_log=log).start()
+    try:
+        p = np.asarray([4, 4], np.int32)
+        trace = tracing.new_trace_id()
+        req = s.submit(p, 10, trace=trace)
+        deadline = time.monotonic() + 10
+        while len(req.tokens) < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        world.kill(req.replica)          # decode side, post-handoff
+        toks, err = _collect(req, timeout=15)
+        assert err is None and toks == _fake_tokens(p, 10)
+    finally:
+        s.stop()
+        log.close()
+
+    timeline = tracing.stitch_trace(str(tmp_path), trace)
+    kinds = [r["kind"] for r in timeline if not r.get("_context")]
+    assert kinds[0] == "request_admitted" and kinds[-1] == "request_done"
+    handoffs = [r for r in timeline if r["kind"] == "request_handoff"]
+    assert len(handoffs) == 2, "replay must re-handoff under ONE trace"
+    assert all(h["trace"] == trace for h in handoffs)
+    assert handoffs[0]["from_replica"] == 0
+    assert handoffs[0]["pages"] == 2 and "bytes" in handoffs[0]
+    adopt_routes = [r for r in timeline
+                    if r["kind"] == "request_handoff_routed"]
+    assert len(adopt_routes) == 2
+    assert all(world.roles[r["replica"]] == "decode"
+               for r in adopt_routes)
+    (requeued,) = [r for r in timeline if r["kind"] == "request_requeued"]
+    assert requeued["trace"] == trace
+    assert all(r["trace"] == trace for r in timeline
+               if not r.get("_context"))
+    # the CLI-facing formatter renders the handoff span
+    assert "request_handoff" in tracing.format_timeline(timeline)
+
+
+class _FakeDisaggServing(_FakeServing):
+    """Two-pool facade: per-role replica sets + both backlog queues, so
+    the per-pool autoscalers can be driven deterministically."""
+
+    def __init__(self, n_prefill=1, n_decode=1):
+        super().__init__(replicas=n_prefill + n_decode)
+        fake = self
+        self.by_role = {"prefill": n_prefill, "decode": n_decode}
+        self.queued_handoffs = 0
+        self.outstanding_by_role = {"prefill": 0, "decode": 0}
+        self.added_roles = []
+
+        class _Sched:
+            def metrics(self):
+                reps = {}
+                eid = 0
+                for role in ("prefill", "decode"):
+                    for _ in range(fake.by_role[role]):
+                        reps[eid] = {
+                            "alive": True, "draining": False,
+                            "role": role,
+                            "outstanding":
+                                fake.outstanding_by_role[role]
+                                // max(1, fake.by_role[role])}
+                        eid += 1
+                return {"queued": fake.queued,
+                        "queued_handoffs": fake.queued_handoffs,
+                        "ttft": {"p95_secs": None},
+                        "replicas": reps}
+
+            def emit_event(self, kind, **fields):
+                fake.events.append((kind, fields))
+
+        self.scheduler = _Sched()
+
+    def scale_up(self, n, role=None):
+        self.by_role[role] += n
+        self.added_roles.extend([role] * n)
+        return list(range(n))
+
+
+def test_autoscaler_per_pool_signals_and_independence():
+    """Per-pool controllers read DIFFERENT backlogs: prompt-queue
+    pressure moves only the prefill pool, handoff-queue pressure only
+    the decode pool — each within its own bounds."""
+    from tensorflowonspark_tpu.serving import Autoscaler, AutoscalerConfig
+
+    fake = _FakeDisaggServing(n_prefill=1, n_decode=1)
+    pre = Autoscaler(fake, AutoscalerConfig(
+        role="prefill", min_replicas=1, max_replicas=3,
+        up_queue_per_replica=2.0, up_consecutive=1, up_cooldown=0.0))
+    dec = Autoscaler(fake, AutoscalerConfig(
+        role="decode", min_replicas=1, max_replicas=3,
+        up_queue_per_replica=2.0, up_consecutive=1, up_cooldown=0.0))
+
+    # prompt backlog only: prefill scales, decode holds
+    fake.queued, fake.queued_handoffs = 9, 0
+    sp, sd = pre.sample(), dec.sample()
+    assert sp["alive"] == 1 and sd["alive"] == 1, "role filter leaked"
+    assert sp["queued"] == 9 and sd["queued"] == 0
+    assert pre.decide(sp, now=1.0)[0] == "up"
+    assert dec.decide(sd, now=1.0)[0] == "hold"
+
+    # handoff backlog only: decode scales, prefill holds
+    fake.queued, fake.queued_handoffs = 0, 9
+    fake.outstanding_by_role = {"prefill": 5, "decode": 5}  # not idle
+    sp, sd = pre.sample(), dec.sample()
+    assert sp["queued"] == 0 and sd["queued"] == 9
+    assert pre.decide(sp, now=2.0)[0] == "hold"
+    assert dec.decide(sd, now=2.0)[0] == "up"
+    dec._scale_up(sd, "test")
+    assert fake.added_roles == ["decode"], \
+        "the decode controller must grow the decode pool"
+    ups = [f for k, f in fake.events if k == "scale_up"]
+    assert ups and ups[-1]["role"] == "decode"
+
+    # per-pool victim selection: the decode controller's scale-down
+    # victim must be a decode gang even when a prefill gang is idler
+    fake.queued = fake.queued_handoffs = 0
+    fake.outstanding_by_role = {"prefill": 0, "decode": 4}
+    m = fake.scheduler.metrics()
+    victim = dec._victim(m)
+    assert victim is not None \
+        and m["replicas"][victim[0]]["role"] == "decode"
+
+
+@pytest.mark.integration
+def test_disagg_cluster_end_to_end(tmp_path, worker_env):
+    """Acceptance: a real 1-prefill + 1-decode tier serves concurrent
+    clients oracle-exact, every request moves as a KV-page handoff, and
+    the specialization holds — zero prefill dispatches on the decode
+    gang, zero decode dispatches on the prefill gang."""
+    serving = _run_serving(
+        tmp_path, worker_env, num_replicas=2,
+        disagg={"prefill": 1, "decode": 1},
+        batcher_kwargs={"kv_page_tokens": 8})
+    try:
+        rng = np.random.default_rng(2)
+        reqs = _requests(rng, 8)
+        results: dict[int, list] = {}
+        errors: list = []
+
+        def run_client(cid):
+            try:
+                with serving.client() as c:
+                    for i in range(cid, len(reqs), 2):
+                        p, n = reqs[i]
+                        results[i] = c.generate(p, n, timeout=120).tolist()
+            except Exception as e:                    # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=run_client, args=(cid,))
+                   for cid in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        assert not errors, errors
+        for i, (p, n) in enumerate(reqs):
+            assert results[i] == _oracle(p, n), f"request {i} diverged"
+        m = serving.metrics()
+        assert m["handoffs"] >= len(reqs) and m["failed"] == 0
+        assert m["replicas"][0]["role"] == "prefill"
+        assert m["replicas"][1]["role"] == "decode"
+        # heartbeat-carried engine counters prove the specialization
+        time.sleep(2.5)
+        nodes = serving.metrics()["nodes"]
+
+        def _counter(eid, name):
+            fam = (nodes.get(eid, {}).get("metrics") or {}).get(name)
+            return sum(v for _, v in (fam or {}).get("samples", ()))
+
+        assert _counter(1, "tfos_replica_prefill_dispatches_total") == 0, \
+            "the decode gang ran a prefill"
+        assert _counter(0, "tfos_replica_decode_dispatches_total") == 0, \
+            "the prefill gang ran a decode step"
+        assert _counter(0, "tfos_replica_sessions_total") >= len(reqs)
+    finally:
+        serving.shutdown(timeout=120)
+
+
+@pytest.mark.integration
+def test_disagg_prefill_gang_kill_mid_prefill_stays_exact(tmp_path,
+                                                          worker_env):
+    """Chaos, prefill side: SIGKILL prefill gang 0 mid-run; its
+    in-flight prompts requeue ONCE to the surviving prefill gang and
+    every accepted request completes oracle-exact."""
+    env = dict(worker_env, TFOS_CHAOS="kill node=0 at_step=1")
+    serving = _run_serving(
+        tmp_path, env, num_replicas=3,
+        disagg={"prefill": 2, "decode": 1},
+        batcher_kwargs={"kv_page_tokens": 8})
+    try:
+        rng = np.random.default_rng(3)
+        reqs = _requests(rng, 8, tmin=6, tmax=12, bmin=8, bmax=14)
+        results: dict[int, list] = {}
+        errors: list = []
+
+        def run_client(cid):
+            try:
+                with serving.client() as c:
+                    for i in range(cid, len(reqs), 2):
+                        p, n = reqs[i]
+                        results[i] = c.generate(p, n, timeout=120).tolist()
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=run_client, args=(cid,))
+                   for cid in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        assert not errors, errors
+        for i, (p, n) in enumerate(reqs):
+            assert results[i] == _oracle(p, n), f"request {i} diverged"
+        m = serving.metrics()
+        assert m["failed"] == 0 and m["requeued"] >= 1, m
+        assert serving.scheduler.dead_replicas() == {0}
+    finally:
+        serving.shutdown(timeout=120)
+
+
+@pytest.mark.integration
+def test_disagg_decode_gang_kill_post_handoff_stays_exact(tmp_path,
+                                                          worker_env):
+    """Chaos, decode side: SIGKILL decode gang 1 while it streams
+    adopted sessions; the stranded requests replay through the FULL
+    prefill→handoff→adopt pipeline onto the surviving decode gang,
+    skip-dedup keeping every client stream exact."""
+    env = dict(worker_env, TFOS_CHAOS="kill node=1 at_step=3")
+    serving = _run_serving(
+        tmp_path, env, num_replicas=3,
+        disagg={"prefill": 1, "decode": 2},
+        batcher_kwargs={"kv_page_tokens": 8})
+    try:
+        rng = np.random.default_rng(4)
+        reqs = _requests(rng, 8, bmin=10, bmax=16)
+        results: dict[int, list] = {}
+        errors: list = []
+
+        def run_client(cid):
+            try:
+                with serving.client() as c:
+                    for i in range(cid, len(reqs), 2):
+                        p, n = reqs[i]
+                        results[i] = c.generate(p, n, timeout=120).tolist()
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=run_client, args=(cid,))
+                   for cid in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        assert not errors, errors
+        for i, (p, n) in enumerate(reqs):
+            assert results[i] == _oracle(p, n), f"request {i} diverged"
+        m = serving.metrics()
+        assert m["failed"] == 0 and m["requeued"] >= 1, m
+        assert serving.scheduler.dead_replicas() == {1}
+        # the replays re-handed-off: more handoffs than completions
+        assert m["handoffs"] > m["completed"] - m["requeued"]
+    finally:
+        serving.shutdown(timeout=120)

@@ -71,8 +71,22 @@ def _dispatch_timed(job, num_workers, worker_env):
     return wall, dict(job.last_summary or {})
 
 
+#: transport pins per A/B mode, applied to BOTH endpoints (worker_env for
+#: the node QueueServers, os.environ for the driver's QueueClients).
+#: ``crosshost_bulk`` is the cross-host-shaped dispatch row: shm's probe
+#: can never succeed between real hosts, so pinning it off yields exactly
+#: the tier a remote driver negotiates — the chunked bulk transport;
+#: ``socket`` additionally kills bulk, the per-message pickle floor.
+_AB_MODES = {
+    "shm": {},
+    "crosshost_bulk": {"TFOS_TPU_NO_SHM": "1"},
+    "socket": {"TFOS_TPU_NO_SHM": "1", "TFOS_TPU_NO_BULK": "1"},
+}
+
+
 def bench_ab(shards, rows, cols, num_workers):
-    """records/s: shm transport vs TFOS_TPU_NO_SHM=1 socket fallback."""
+    """records/s across the three negotiated transport tiers: shm,
+    cross-host-simulated bulk, per-message pickle socket."""
     from tensorflowonspark_tpu.batch import BatchJob, ShardManifest
 
     rng = np.random.default_rng(0)
@@ -82,24 +96,23 @@ def bench_ab(shards, rows, cols, num_workers):
     total = shards * rows
     out = {}
     oracle = None
-    for mode in ("shm", "socket"):
+    for mode, pins in _AB_MODES.items():
         out_dir = tempfile.mkdtemp(prefix=f"tfos_bench_batch_{mode}_")
-        env = {"JAX_PLATFORMS": "cpu"}
-        if mode == "socket":
-            env["TFOS_TPU_NO_SHM"] = "1"
-            os.environ["TFOS_TPU_NO_SHM"] = "1"  # driver-side clients too
+        env = {"JAX_PLATFORMS": "cpu", **pins}
+        os.environ.update(pins)          # driver-side clients too
         try:
             job = BatchJob(manifest, out_dir, predict_rowsum,
                            batch_size=rows, prefetch=2)
             wall, summary = _dispatch_timed(job, num_workers, env)
         finally:
-            os.environ.pop("TFOS_TPU_NO_SHM", None)
+            for k in pins:
+                os.environ.pop(k, None)
         assert summary.get("scored") == shards, summary
         results = job.results()
         if oracle is None:
             oracle = results
         elif results != oracle:
-            raise AssertionError("shm and socket outputs differ")
+            raise AssertionError(f"{mode} output differs from the oracle")
         out[mode] = {"wall_secs": round(wall, 4), "records": total,
                      "records_per_sec": round(total / wall, 1),
                      "mb_per_sec": round(
@@ -108,6 +121,9 @@ def bench_ab(shards, rows, cols, num_workers):
         print(f"[ab] {mode}: {out[mode]}")
     out["speedup"] = round(out["shm"]["records_per_sec"]
                            / out["socket"]["records_per_sec"], 3)
+    out["bulk_speedup_vs_socket"] = round(
+        out["crosshost_bulk"]["records_per_sec"]
+        / out["socket"]["records_per_sec"], 3)
     return out
 
 
@@ -170,7 +186,7 @@ def validate_artifact(doc: dict) -> list[str]:
     probs = []
     if doc.get("benchmark") != "batch":
         probs.append("benchmark != 'batch'")
-    for mode in ("shm", "socket"):
+    for mode in _AB_MODES:
         row = doc.get("ab", {}).get(mode)
         if not isinstance(row, dict):
             probs.append(f"ab.{mode} missing")
@@ -178,8 +194,9 @@ def validate_artifact(doc: dict) -> list[str]:
         for k in ("wall_secs", "records", "records_per_sec"):
             if not isinstance(row.get(k), (int, float)):
                 probs.append(f"ab.{mode}.{k} not numeric")
-    if not isinstance(doc.get("ab", {}).get("speedup"), (int, float)):
-        probs.append("ab.speedup not numeric")
+    for k in ("speedup", "bulk_speedup_vs_socket"):
+        if not isinstance(doc.get("ab", {}).get(k), (int, float)):
+            probs.append(f"ab.{k} not numeric")
     res = doc.get("resume")
     if not isinstance(res, dict):
         probs.append("resume missing")

@@ -5,7 +5,10 @@
 #
 #   scripts/ci.sh               # analysis gate, then tier-1 tests
 #   scripts/ci.sh --check       # analysis gate only (fast, no jax)
-#   scripts/ci.sh --bench-smoke # analysis gate + bench_batch.py on a tiny
+#   scripts/ci.sh --bench-smoke # analysis gate + bench_dataplane.py --smoke
+#                               # (cross-host bulk transport A/B: schema,
+#                               # byte-identical, kill-switch fallback
+#                               # gates) + bench_batch.py on a tiny
 #                               # 4-shard manifest (artifact schema + the
 #                               # zero-reprocess/oracle resume gates) +
 #                               # bench_serving.py --sharded --smoke (a
@@ -38,6 +41,18 @@ if [ "${1:-}" = "--check" ]; then
 fi
 
 if [ "${1:-}" = "--bench-smoke" ]; then
+    echo "== bench smoke (data plane / bulk transport) =="
+    # loopback-simulated cross-host A/B: bulk transport vs per-message
+    # pickle with shm pinned off.  Hard gates: artifact schema,
+    # byte-identical round-trips, kill-switch fallback; the 1.5x speed
+    # gate is advisory at smoke sizes.  Writes dataplane_smoke.json
+    # (never the committed full artifact).
+    JAX_PLATFORMS=cpu python scripts/bench_dataplane.py --smoke
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "dataplane bench smoke FAILED (rc=$rc)" >&2
+        exit $rc
+    fi
     echo "== bench smoke (batch plane) =="
     # bench_batch.py --smoke validates its own artifact schema and fails
     # on the resume-correctness gates (zero reprocess, oracle-identical)

@@ -211,14 +211,16 @@ class HostAgent(MessageSocket):
                 pass
             env = dict(msg.get("env") or {})
             env["TFOS_WORKER_LOG"] = log_path  # fd-level capture, see _worker_entry
-            # host-level shm opt-out propagates to workers AND overrides a
-            # driver-supplied value: the agent's operator knows this host's
-            # /dev/shm situation (size, tenancy) better than the remote
-            # driver does
+            # host-level transport opt-outs propagate to workers AND
+            # override a driver-supplied value: the agent's operator knows
+            # this host's /dev/shm situation (size, tenancy) and NIC/memory
+            # budget better than the remote driver does
             from tensorflowonspark_tpu import shm as _shm
+            from tensorflowonspark_tpu import transport as _transport
 
-            if _shm.DISABLE_ENV in os.environ:
-                env[_shm.DISABLE_ENV] = os.environ[_shm.DISABLE_ENV]
+            for disable_env in (_shm.DISABLE_ENV, _transport.DISABLE_ENV):
+                if disable_env in os.environ:
+                    env[disable_env] = os.environ[disable_env]
             ctx = mp.get_context("spawn")  # fork is unsafe after jax/XLA init
             p = ctx.Process(
                 target=_worker_entry,

@@ -242,6 +242,7 @@ class TPUCluster:
             queues=DEFAULT_QUEUES, backend=None, worker_env: dict | None = None,
             working_dir: str | None = None, queue_depth: int = 64,
             default_fs: str = "", queue_shm: bool | None = None,
+            queue_bulk: bool | None = None,
             tensorboard_logdir: str | None = None, monitor: bool = True,
             hang_timeout: float = 120.0, step_timeout: float | None = None,
             heartbeat_interval: float = 1.0) -> "TPUCluster":
@@ -316,9 +317,11 @@ class TPUCluster:
             "queue_mode": "remote",
             "queue_depth": queue_depth,
             # None = auto: each feeder↔node connection negotiates the
-            # zero-copy shm transport when it proves same-host (shm.py);
-            # False pins every connection to the socket protocol.
+            # zero-copy shm transport when it proves same-host (shm.py),
+            # falling back to the chunked bulk transport (transport.py)
+            # cross-host; False pins the tier off for every connection.
             "queue_shm": queue_shm,
+            "queue_bulk": queue_bulk,
             "reservation_timeout": reservation_timeout,
             "tensorboard": tensorboard,
             "tensorboard_logdir": tensorboard_logdir,
@@ -467,7 +470,8 @@ class TPUCluster:
             info = next(n for n in self.cluster_info if n["executor_id"] == executor_id)
             self._clients[executor_id] = QueueClient(
                 info["addr"], info["authkey"],
-                shm=self.cluster_meta.get("queue_shm"))
+                shm=self.cluster_meta.get("queue_shm"),
+                bulk=self.cluster_meta.get("queue_bulk"))
         return self._clients[executor_id]
 
     def train(self, data, num_epochs: int = 1, qname: str = "input",
@@ -557,7 +561,8 @@ class TPUCluster:
             try:
                 target = nodes[node_idx]
                 client = QueueClient(target["addr"], target["authkey"],
-                                     shm=self.cluster_meta.get("queue_shm"))
+                                     shm=self.cluster_meta.get("queue_shm"),
+                                     bulk=self.cluster_meta.get("queue_bulk"))
                 try:
                     for pidx, part in parts:
                         # Interleave feeding with result collection: with

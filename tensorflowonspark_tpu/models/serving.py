@@ -427,6 +427,36 @@ class ContinuousBatcher:
                 prefix_cache=self._pages.prefix_cache)
         self.params = params
 
+    def set_role(self, role: str | None) -> None:
+        """Specialize an idle batcher for a disaggregated pool role —
+        the promote-with-role path of a warm standby joining a
+        prefill/decode tier (a standby's engine is built role-less so
+        ONE pool can back both specializations).  ``"prefill"`` flips
+        :attr:`prefill_only` on, under the same constraints the
+        constructor enforces (paged KV, no decode-time amortization
+        knobs); ``"decode"``/``None`` flips it off (adoption readiness is
+        checked by ``adopt_session`` itself).  Only legal while no
+        request is live: a seated request's posture must never change
+        under it."""
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(f"unknown role {role!r} "
+                             "(want 'prefill', 'decode' or None)")
+        if self.load()["total"] or self._reserved:
+            raise RuntimeError(
+                f"cannot set_role({role!r}) with live requests "
+                f"(load={self.load()})")
+        if role == "prefill":
+            if self._pages is None:
+                raise ValueError(
+                    "prefill role needs paged KV (kv_page_tokens): the "
+                    "KV-page handoff a prefill pool emits is "
+                    "page-granular")
+            if self.spec_k is not None or self.decode_block_steps is not None:
+                raise ValueError(
+                    "prefill role conflicts with speculative_k/"
+                    "decode_block_steps (decode-time knobs)")
+        self.prefill_only = role == "prefill"
+
     def _emit_token(self, rid: int, tok: int) -> None:
         cb = self._on_token.get(rid)
         if cb is not None:

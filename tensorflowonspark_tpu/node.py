@@ -236,7 +236,8 @@ def run(fn, tf_args, cluster_meta: dict, queues=DEFAULT_QUEUES):
             mgr = QueueServer(authkey=cluster_meta["authkey"], qnames=queues,
                               mode=cluster_meta.get("queue_mode", "remote"),
                               maxsize=cluster_meta.get("queue_depth", 64),
-                              shm=cluster_meta.get("queue_shm"))
+                              shm=cluster_meta.get("queue_shm"),
+                              bulk=cluster_meta.get("queue_bulk"))
             addr = mgr.start()
 
             # 1b. liveness: publish heartbeat/step/phase into this node's kv

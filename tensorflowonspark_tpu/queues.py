@@ -26,14 +26,20 @@ the wire format):
 Auth: an ``authkey`` hello on connect, mirroring the reference's
 ``multiprocessing`` authkey handshake.
 
-Same-host zero-copy mode (``shm.py``): right after the authkey hello the
+Transport negotiation (the three-tier hello, preference order
+**shm > bulk > per-message pickle**): right after the authkey hello the
 client offers a shared-memory probe; if the server proves it can read it
 (the two processes genuinely share ``/dev/shm``), the connection switches
 to :class:`~tensorflowonspark_tpu.shm.ShmChannel` framing — large ndarray
 payloads are written once into a shm segment ring and received as
 zero-copy numpy views, with the socket retained as the control channel.
-Cross-host peers, probe failures, and ``TFOS_TPU_NO_SHM=1`` keep the plain
-socket protocol; either way the op surface below is unchanged.
+A peer the probe does NOT reach (the cross-host case) next offers the
+chunked **bulk transport** (``transport.py``): scatter/gather chunk
+frames into pooled receive slabs, with negotiated chunk size and CRC
+mode (:class:`~tensorflowonspark_tpu.transport.BulkChannel`).  Probe
+failures + ``TFOS_TPU_NO_SHM=1`` skip tier one, a refused/failed
+``bulk_hello`` + ``TFOS_TPU_NO_BULK=1`` skip tier two, and either way
+the op surface below is unchanged — fallback is transparent.
 """
 
 from __future__ import annotations
@@ -44,6 +50,7 @@ import socket
 import threading
 
 from tensorflowonspark_tpu import shm as _shm
+from tensorflowonspark_tpu import transport as _transport
 from tensorflowonspark_tpu.reservation import (FrameFormatError,
                                                MessageSocket, _peer_name)
 
@@ -60,7 +67,8 @@ class QueueServer(MessageSocket):
     """
 
     def __init__(self, authkey: bytes, qnames=DEFAULT_QUEUES, mode: str = "local",
-                 maxsize: int = 64, shm: bool | None = None):
+                 maxsize: int = 64, shm: bool | None = None,
+                 bulk: bool | None = None):
         self.authkey = bytes(authkey)
         self.mode = mode
         self.queues = {name: _queue.Queue(maxsize=maxsize) for name in qnames}
@@ -71,6 +79,9 @@ class QueueServer(MessageSocket):
         # None = auto (accept shm when the env allows it); False = refuse
         self.shm = _shm.shm_resolve(shm)
         self.shm_conns = 0  # connections that negotiated the shm transport
+        # same tri-state for the cross-host bulk tier (transport.py)
+        self.bulk = _transport.bulk_resolve(bulk)
+        self.bulk_conns = 0  # connections that negotiated bulk framing
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> tuple[str, int]:
@@ -108,7 +119,7 @@ class QueueServer(MessageSocket):
             threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        chan: _shm.ShmChannel | None = None
+        chan = None   # ShmChannel | BulkChannel once negotiated
         try:
             # Mutual HMAC challenge-response (reservation.MessageSocket):
             # the key never crosses the wire and an unauthenticated peer
@@ -123,10 +134,28 @@ class QueueServer(MessageSocket):
                     # by a probe segment we must read back (shm.verify_probe)
                     ok = (chan is None and self.shm
                           and _shm.verify_probe(msg.get("seg"), msg.get("tok")))
-                    self.send(conn, ("SHM", bool(ok)))
                     if ok:
+                        # count BEFORE the reply: once the client sees
+                        # ("SHM", True) the negotiation is observable,
+                        # so the counter must already reflect it
                         chan = _shm.ShmChannel(self, conn)
                         self.shm_conns += 1
+                    self.send(conn, ("SHM", bool(ok)))
+                    continue
+                if isinstance(msg, dict) and msg.get("op") == "bulk_hello":
+                    # cross-host tier two: chunked bulk framing.  shm won
+                    # already (chan set) or the server refuses bulk ->
+                    # the client stays on the per-message pickle path.
+                    params = (_transport.accept_payload(msg)
+                              if chan is None and self.bulk else None)
+                    if params is not None:
+                        # count before the reply (see shm_hello above)
+                        chan = _transport.BulkChannel(
+                            self, conn, chunk_bytes=params["chunk"],
+                            peer_max=params.pop("peer_max"),
+                            crc_mode=params["crc"])
+                        self.bulk_conns += 1
+                    self.send(conn, ("BULK", params is not None, params))
                     continue
                 reply = chan.send if chan is not None else \
                     (lambda obj: self.send(conn, obj))
@@ -135,6 +164,11 @@ class QueueServer(MessageSocket):
                 except KeyError as e:
                     reply(("ERR", f"unknown queue {e}"))
         except FrameFormatError as e:
+            logger.error("dropping peer %s: %s", _peer_name(conn), e)
+        except _transport.BulkIntegrityError as e:
+            # transport.py's contract: a failed bulk stream is connection
+            # death, but it must be LOGGED — corruption on the wire is
+            # not a normal disconnect
             logger.error("dropping peer %s: %s", _peer_name(conn), e)
         except (EOFError, OSError, ValueError):
             pass
@@ -221,7 +255,7 @@ class QueueClient(MessageSocket):
     """
 
     def __init__(self, addr: tuple[str, int], authkey: bytes, timeout: float = 600.0,
-                 shm: bool | None = None):
+                 shm: bool | None = None, bulk: bool | None = None):
         self.addr = tuple(addr)
         self.authkey = bytes(authkey)
         self._default_timeout = timeout
@@ -235,9 +269,14 @@ class QueueClient(MessageSocket):
         except (PermissionError, EOFError, OSError) as e:
             # a bad key shows up as the server silently closing on us
             raise ConnectionError(f"queue server rejected connection: {e!r}")
-        self._chan: _shm.ShmChannel | None = None
+        # ShmChannel | BulkChannel | None — the three-tier hello, best
+        # transport first: shm when the probe proves a shared host, the
+        # chunked bulk framing otherwise, per-message pickle as the floor
+        self._chan = None
         if _shm.shm_resolve(shm):
             self._negotiate_shm()
+        if self._chan is None and _transport.bulk_resolve(bulk):
+            self._negotiate_bulk()
 
     def _negotiate_shm(self) -> None:
         """Offer the zero-copy transport as part of the connect hello; any
@@ -257,10 +296,39 @@ class QueueClient(MessageSocket):
         if resp == ("SHM", True):
             self._chan = _shm.ShmChannel(self, self._sock)
 
+    def _negotiate_bulk(self) -> None:
+        """Offer the chunked bulk transport.  A clean REFUSAL — server
+        with the tier disabled (``BULK False``), old peer replying ERR
+        to the unknown op — is a silent downgrade to the per-message
+        pickle protocol: both sides answered the hello, the stream stays
+        in sync.  An I/O error or malformed acceptance mid-exchange is
+        NOT safe to downgrade on: the server may already have switched
+        this connection to bulk framing (or its reply may still be in
+        flight), so continuing on the socket would desync every later
+        frame — the error propagates and kills the connection loudly,
+        mirroring ``_negotiate_shm``."""
+        self.send(self._sock, _transport.hello_payload())
+        resp = self.receive(self._sock)
+        if (isinstance(resp, tuple) and len(resp) == 3
+                and resp[0] == "BULK" and resp[1]):
+            try:
+                self._chan = _transport.BulkChannel(
+                    self, self._sock, chunk_bytes=resp[2]["chunk"],
+                    peer_max=resp[2]["max"], crc_mode=resp[2]["crc"])
+            except (KeyError, TypeError) as e:
+                raise ConnectionError(
+                    f"queue server sent a malformed bulk acceptance "
+                    f"{resp[2]!r}: {e!r}")
+
     @property
     def shm_active(self) -> bool:
-        """True when this connection negotiated the zero-copy transport."""
-        return self._chan is not None
+        """True when this connection negotiated the zero-copy shm tier."""
+        return isinstance(self._chan, _shm.ShmChannel)
+
+    @property
+    def bulk_active(self) -> bool:
+        """True when this connection negotiated the bulk transport tier."""
+        return isinstance(self._chan, _transport.BulkChannel)
 
     def _request(self, msg, op_timeout: float | None = None):
         with self._lock:

@@ -177,12 +177,18 @@ class MessageSocket:
         cls._recv_exact_into(sock, memoryview(ba))
         return bytes(ba) if n < BUFSIZE else ba  # small frames: hashable
 
-    def split_oob(self, msg) -> tuple[bytes, list]:
+    def split_oob(self, msg, oob_min: int | None = None,
+                  max_buffers: int | None = None) -> tuple[bytes, list]:
         """Pickle ``msg`` with the large-contiguous-buffer split applied:
         returns ``(pickle5_stream, oob_buffers)``.  Shared by the socket
-        framing below and the shm transport (``shm.ShmChannel``), which
-        routes the same buffers into shared memory instead."""
+        framing below, the shm transport (``shm.ShmChannel``), which
+        routes the same buffers into shared memory, and the bulk
+        transport (``transport.BulkChannel``), which lowers ``oob_min``
+        because its scatter/gather chunk frames amortize the per-buffer
+        syscall cost that sets this class's 64 KB default."""
         bufs: list = []
+        floor = self.OOB_MIN_BYTES if oob_min is None else int(oob_min)
+        cap = self.MAX_OOB_BUFFERS if max_buffers is None else int(max_buffers)
 
         def keep_large(pb):
             # pickle semantics: a TRUE return serializes the buffer
@@ -191,21 +197,28 @@ class MessageSocket:
                 v = pb.raw()
             except BufferError:          # non-contiguous
                 return True
-            if (v.nbytes < self.OOB_MIN_BYTES
-                    or len(bufs) >= self.MAX_OOB_BUFFERS):
+            if v.nbytes < floor or len(bufs) >= cap:
                 return True
             bufs.append(v)
             return False
 
         return pickle.dumps(msg, protocol=5, buffer_callback=keep_large), bufs
 
-    def send(self, sock: socket.socket, msg) -> None:
+    def frame_bytes(self, msg) -> list:
+        """The exact byte segments :meth:`send` would write for ``msg``,
+        returned instead of sent — the bulk transport routes whole frames
+        through its single-writer path so envelope frames can never
+        interleave with a pipelined chunk stream."""
         data, bufs = self.split_oob(msg)
         header = struct.pack(">BBII", self.FRAME_MAGIC, self.FRAME_VERSION,
                              len(data), len(bufs))
         if bufs:
             header += struct.pack(f">{len(bufs)}Q",
                                   *(v.nbytes for v in bufs))
+        return [header, data, *bufs]
+
+    def send(self, sock: socket.socket, msg) -> None:
+        header, data, *bufs = self.frame_bytes(msg)
         if len(data) < BUFSIZE:
             sock.sendall(header + data)
         else:

@@ -352,8 +352,12 @@ class ServingCluster:
         {...}}`` each pool gets its own independent autoscaler —
         TTFT-p95/queue pressure drives prefill, handoff-queue depth
         drives decode.  Composes with ``mesh=`` (every pool gang is a
-        device-mesh gang); ``warm_standbys`` is not yet supported with
-        disagg (standbys are role-less until promotion).
+        device-mesh gang) and with ``warm_standbys``: standbys are built
+        ROLE-LESS (one spare fleet backs both specializations) and
+        specialize at promotion — the promote control message carries
+        the target pool's role, the standby flips its engine
+        (``ContinuousBatcher.set_role``) and registers into that pool
+        (promote-with-role).
 
         ``warm_standbys`` keeps N fully-initialized spare replica gangs
         (process up, mesh built, serve step compiled, params UNLOADED,
@@ -412,15 +416,26 @@ class ServingCluster:
                     f"disagg pools sum to "
                     f"{disagg['prefill'] + disagg['decode']} gangs but "
                     f"num_replicas={num_replicas} — pass their sum")
-            if warm_standbys:
-                raise ValueError(
-                    "warm_standbys is not yet supported with disagg "
-                    "(a standby is role-less until promotion)")
             if (batcher_kwargs or {}).get("kv_page_tokens") is None:
                 raise ValueError(
                     "disagg needs paged KV: set batcher_kwargs="
                     "{'kv_page_tokens': ...} — the prefill→decode "
                     "handoff is a KV-page transfer")
+            if warm_standbys:
+                # a standby's engine is built from the BASE kwargs and
+                # must be able to set_role() into EITHER pool at
+                # promotion; decode-only knobs in the base would make
+                # every prefill promotion crash the standby AFTER the
+                # driver registered it — fail here, at boot, instead
+                bad = [k for k in ("speculative_k", "decode_block_steps")
+                       if (batcher_kwargs or {}).get(k) is not None]
+                if bad:
+                    raise ValueError(
+                        f"disagg with warm_standbys: {bad} must live in "
+                        "disagg['decode_kwargs'], not the base "
+                        "batcher_kwargs — a role-less standby built "
+                        "with them cannot specialize into a prefill "
+                        "pool at promotion")
             args["serve_disagg"] = disagg
             gsz = 1 if gang is None else gang.gang_size
             roles = boot_roles(disagg, gsz)
@@ -456,6 +471,12 @@ class ServingCluster:
             tier.disagg = disagg
             tier._replace_preempted = bool(replace_preempted)
             tier._replace_failed = bool(replace_failed)
+            if warm_standbys or replace_failed or replace_preempted:
+                # this tier HEALS lost gangs: when a pool's last acceptor
+                # dies, dispatch holds its requeued work briefly (until
+                # the heal's expect_replica announcement, or this bound)
+                # instead of shedding it sub-second as no_replica
+                scheduler.heal_grace = 30.0
             tier._drain_timeout = float(drain_timeout)
             tier._serve_args = args
             tier._standby_clone = bool(standby_clone)
@@ -594,33 +615,48 @@ class ServingCluster:
         pool FIRST (promotion: control message + weight clone, capacity
         restored in well under a cold boot) and cold-spawning only the
         remainder through :meth:`add_replicas`.  The autoscaler's
-        scale-up path calls this.  A disaggregated pool (``role=``)
-        always cold-spawns into its pool — standbys are role-less.
-        Returns the new replicas' leader executor ids."""
-        if role is not None:
-            return self.add_replicas(int(n), timeout=timeout, role=role)
+        scale-up path calls this.  On a disaggregated tier ``role``
+        (mandatory there) is carried in the promote message — the
+        standby specializes its engine at promotion and registers into
+        the named pool (promote-with-role; standbys are built role-less
+        so ONE pool backs both specializations).  Returns the new
+        replicas' leader executor ids."""
         added: list[int] = []
         for _ in range(int(n)):
-            eid = self.promote_standby(source)
+            eid = self.promote_standby(source, role=role)
             if eid is None:
                 break
             added.append(eid)
         remaining = int(n) - len(added)
         if remaining:
-            added.extend(self.add_replicas(remaining, timeout=timeout))
+            added.extend(self.add_replicas(remaining, timeout=timeout,
+                                           role=role))
         return added
 
-    def promote_standby(self, source: str = "scale_up") -> int | None:
+    def promote_standby(self, source: str = "scale_up",
+                        role: str | None = None) -> int | None:
         """Promote one warm standby into a routable replica: pop it from
         the pool (atomic — a concurrent failure + scale decision can
         never double-promote the same standby), send it the promote
         control message naming a live CLONE PEER (or None → it restores
         through the model builder), register it with the scheduler, and
-        backfill the pool in the background.  Returns the promoted
-        leader's executor id, or None when the pool is empty/absent
-        (callers fall back to a cold spawn)."""
+        backfill the pool in the background.  On a disaggregated tier
+        ``role`` is mandatory (per-role pool accounting: the scheduler
+        registers the newcomer into the named prefill/decode pool, and
+        the promote message tells the standby which specialization to
+        arm).  Returns the promoted leader's executor id, or None when
+        the pool is empty/absent (callers fall back to a cold spawn)."""
         pool = self.standbys
         if pool is None or self._shutdown_done:
+            return None
+        if (role is not None) != (self.disagg is not None):
+            # mismatched call (role on a unified tier / no role on a
+            # disagg tier): fall back to the cold path, whose
+            # add_replicas raises the explicit error for real misuse —
+            # a heal thread must never die on this
+            logger.warning("promote_standby(role=%r) on a tier with "
+                           "disagg=%r: skipping warm pool", role,
+                           self.disagg)
             return None
         got = pool.acquire()
         if got is None:
@@ -637,7 +673,8 @@ class ServingCluster:
         # on its plane until the post-promote serve loop drains them)
         try:
             self.scheduler.add_replica(entry["info"],
-                                       members=entry["members"])
+                                       members=entry["members"],
+                                       role=role)
         except Exception:
             # scheduler stopping / registration guard: the caller
             # cold-spawns instead; the pool backfills
@@ -646,14 +683,14 @@ class ServingCluster:
             with self._promotions_lock:
                 self._promotions.pop(eid, None)
             self.scheduler.emit_event("promote_failed", replica=eid,
-                                      source=source)
+                                      source=source, role=role)
             pool.backfill_async()
             return None
         try:
             self.cluster._client_for(eid).put(
                 REQUEST_QUEUE,
                 {"op": "standby", "event": "promote", "source": source,
-                 "peer": peer}, timeout=10)
+                 "peer": peer, "role": role}, timeout=10)
         except Exception:
             # the standby died under us: roll the registration back as
             # a planned departure (anything already routed re-queues
@@ -668,12 +705,15 @@ class ServingCluster:
             return None
         with self._promotions_lock:
             self._promoted[source] = self._promoted.get(source, 0) + 1
+            if role is not None:
+                key = f"role:{role}"      # per-role pool accounting
+                self._promoted[key] = self._promoted.get(key, 0) + 1
         self._m_promotions.inc(source=source)
         self.scheduler.emit_event(
-            "standby_promoted", replica=eid, source=source,
+            "standby_promoted", replica=eid, source=source, role=role,
             peer=None if peer is None else int(peer["executor_id"]))
-        logger.info("promoted warm standby %d (source=%s, clone peer %s)",
-                    eid, source,
+        logger.info("promoted warm standby %d (source=%s%s, clone peer %s)",
+                    eid, source, "" if role is None else f", role={role}",
                     "none" if peer is None else peer["executor_id"])
 
         def _backfill_after_ready():
@@ -847,20 +887,28 @@ class ServingCluster:
                                   source=source)
         # capture the lost replica's pool NOW: the replacement must
         # re-arm the SAME specialization (a decode gang replaced by a
-        # prefill gang would starve the other pool)
+        # prefill gang would starve the other pool).  The expectation
+        # makes dispatch QUEUE that pool's work for the heal window —
+        # when the dead gang was a pool's LAST, its requeued handoffs/
+        # prompts must wait for the replacement, not shed as no_replica.
         role = self.scheduler.replica_role(eid)
+        self.scheduler.expect_replica(role)
 
         def _go():
-            if self._shutdown_done:
-                return
-            if role is None:
-                promoted = self.promote_standby(promote_source)
+            try:
+                if self._shutdown_done:
+                    return
+                # promote-with-role: a lost prefill/decode gang heals
+                # from the (role-less) warm pool too — the promote
+                # message carries the dead gang's role and the standby
+                # specializes on arrival
+                promoted = self.promote_standby(promote_source, role=role)
                 if promoted is not None:
                     self.scheduler.emit_event(
                         "replica_replaced", replica=eid,
-                        replacement=promoted, source=source, mode="warm")
+                        replacement=promoted, source=source, mode="warm",
+                        role=role)
                     return
-            try:
                 new = self.add_replicas(1, role=role)
                 self.scheduler.emit_event(
                     "replica_replaced", replica=eid, replacement=new[0],
@@ -870,6 +918,8 @@ class ServingCluster:
                                  "failed", eid)
                 self.scheduler.emit_event("replace_failed", replica=eid,
                                           source=source)
+            finally:
+                self.scheduler.expect_done(role)
 
         threading.Thread(target=_go, name=f"serve-replace-{eid}",
                          daemon=True).start()

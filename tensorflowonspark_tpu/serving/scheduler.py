@@ -415,6 +415,19 @@ class ReplicaScheduler:
         #: prompts — their prefill compute is already spent)
         self._pending_handoff: collections.deque = collections.deque()
         self.handoffs = 0
+        #: in-flight replacements by pool (role, or None for unified):
+        #: while a heal is pending, dispatch QUEUES that pool's work
+        #: instead of fail-fasting on "no survivor" (expect_replica)
+        self._expected_roles: dict = {}
+        #: seconds dispatch keeps a pool's work queued after its LAST
+        #: acceptor dies, bridging death-detection (the recv loop's
+        #: requeue fires sub-second) to the tier's heal announcing
+        #: itself via :meth:`expect_replica` (the monitor classifies the
+        #: crash on its poll cadence).  0 = shed immediately (tiers with
+        #: no heal path keep the typed fail-fast); tiers that configure
+        #: heals (warm standbys / replace_failed) set this.
+        self.heal_grace = 0.0
+        self._pool_lost_at: dict = {}
         self._requests: dict[int, ServeRequest] = {}
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -739,6 +752,47 @@ class ReplicaScheduler:
                     if rep.alive and rep.draining}
 
     # -- elastic membership ------------------------------------------------
+    def expect_replica(self, role: str | None = None) -> None:
+        """Announce an in-flight replacement for ``role``'s pool (warm
+        promotion or cold spawn; ``None`` = unified tier).  Until the
+        matching :meth:`expect_done`, the dispatch loop QUEUES work for
+        that pool instead of fail-fasting on "no survivor" — a heal
+        window must not shed the very requests it exists to save.
+        Deadlines and client timeouts still bound the wait."""
+        with self._work:
+            self._expected_roles[role] = \
+                self._expected_roles.get(role, 0) + 1
+
+    def expect_done(self, role: str | None = None) -> None:
+        """The announced replacement registered — or the heal gave up;
+        either way dispatch resumes its normal no-survivor handling."""
+        with self._work:
+            n = self._expected_roles.get(role, 0) - 1
+            if n > 0:
+                self._expected_roles[role] = n
+            else:
+                self._expected_roles.pop(role, None)
+            self._work.notify_all()
+
+    def _expecting(self, kind: str) -> bool:
+        # under the lock; dispatch kind -> the pool that serves it
+        role = "decode" if kind == "adopt" else "prefill"
+        return bool(self._expected_roles.get(role)
+                    or self._expected_roles.get(None))
+
+    def _heal_grace_active(self, kind: str) -> bool:
+        """True while a just-lost pool's work should stay queued awaiting
+        the heal's ``expect_replica`` — bounded by ``heal_grace`` so a
+        heal that never comes still fails typed (lock held by caller).
+        The clock is anchored at the DEATH that emptied the pool
+        (``_mark_dead``), not at the first dispatch attempt — a request
+        arriving minutes after a heal already gave up must fail fast,
+        not stall a full grace window."""
+        if self.heal_grace <= 0:
+            return False
+        t0 = self._pool_lost_at.get(kind)
+        return t0 is not None and (time.monotonic() - t0) < self.heal_grace
+
     def add_replica(self, info: dict, members: tuple = (),
                     role: str | None = None) -> None:
         """Register a freshly reserved replica worker and start routing
@@ -772,6 +826,12 @@ class ReplicaScheduler:
                            weight=self._weight, role=role)
             self.replicas[eid] = rep
             self._has_roles = self._has_roles or role is not None
+            # a fresh acceptor resets the lost-pool clock for every
+            # dispatch kind it serves (unified replicas serve both)
+            if role in (None, "decode"):
+                self._pool_lost_at.pop("adopt", None)
+            if role in (None, "prefill"):
+                self._pool_lost_at.pop("gen", None)
             for e in (eid, *members):
                 self._gang_leader[e] = eid
             self._m_scale.inc(change="added")
@@ -993,6 +1053,16 @@ class ReplicaScheduler:
                 # their prefill compute is already spent, and seating
                 # them frees prefill-pool pages
                 handoff = bool(self._pending_handoff)
+                if handoff and self._pending and not any(
+                        r.alive and r.accepts("adopt")
+                        for r in self.replicas.values()) \
+                        and (self._expecting("adopt")
+                             or self._heal_grace_active("adopt")):
+                    # the decode pool is dead but HEALING: its handoffs
+                    # stay queued, and must not head-of-line block
+                    # prompts a live prefill gang could overlap with
+                    # the heal
+                    handoff = False
                 req = (self._pending_handoff.popleft() if handoff
                        else self._pending.popleft())
                 if req.finished:
@@ -1001,26 +1071,30 @@ class ReplicaScheduler:
                         and time.monotonic() > req.deadline:
                     self._expire(req)
                     continue
-                if not any(rep.alive for rep in self.replicas.values()):
-                    self._finish_err(req, "no_replica", "no replica alive")
-                    continue
                 rep = self._pick_replica("adopt" if handoff else "gen")
                 if rep is None:
-                    if handoff and not any(
-                            r.alive and r.accepts("adopt")
-                            for r in self.replicas.values()):
-                        self._finish_err(
-                            req, "no_replica",
-                            "no decode gang survives to adopt the "
-                            "handed-off session")
-                        continue
-                    if not handoff and self._has_roles and not any(
-                            r.alive and r.accepts("gen")
-                            for r in self.replicas.values()):
-                        self._finish_err(
-                            req, "no_replica",
-                            "no prefill-capable replica survives to run "
-                            "the prompt")
+                    kind = "adopt" if handoff else "gen"
+                    has_acceptor = any(r.alive and r.accepts(kind)
+                                       for r in self.replicas.values())
+                    # no survivor serves this work: fail typed — UNLESS
+                    # a heal is in flight (expect_replica) or recent
+                    # enough that its announcement may still be coming
+                    # (heal_grace), in which case the work stays queued
+                    if not has_acceptor and not self._expecting(kind) \
+                            and not self._heal_grace_active(kind):
+                        if handoff:
+                            self._finish_err(
+                                req, "no_replica",
+                                "no decode gang survives to adopt the "
+                                "handed-off session")
+                        elif self._has_roles:
+                            self._finish_err(
+                                req, "no_replica",
+                                "no prefill-capable replica survives to "
+                                "run the prompt")
+                        else:
+                            self._finish_err(req, "no_replica",
+                                             "no replica alive")
                         continue
                     # the pool is saturated: wait for capacity
                     if handoff:
@@ -1241,10 +1315,23 @@ class ReplicaScheduler:
         rep.outstanding.clear()
         self._close_clients(rep)
         survivors = any(r.alive for r in self.replicas.values())
+        # anchor the lost-pool clock for every dispatch kind this death
+        # left without an acceptor: the heal-grace window runs from HERE
+        # (a fresh acceptor pops the clock in add_replica)
+        now = time.monotonic()
+        for kind in ("gen", "adopt"):
+            if not any(r.alive and r.accepts(kind)
+                       for r in self.replicas.values()):
+                self._pool_lost_at.setdefault(kind, now)
+        # while a heal is announced (or recent enough that its
+        # announcement may still be coming), stranded/pending work is
+        # HELD instead of shed — the heal window must not lose the very
+        # requests it exists to save
+        hold_gen = self._expecting("gen") or self._heal_grace_active("gen")
         for req in stranded:
             if req.finished:
                 continue
-            if not survivors:
+            if not survivors and not hold_gen:
                 self._finish_err(req, "no_replica",
                                  f"replica {eid} died and no replica "
                                  "survives to replay the request")
@@ -1271,10 +1358,13 @@ class ReplicaScheduler:
                 self._emit("request_requeued", rid=req.rid, trace=req.trace,
                            from_replica=eid, delivered=len(req.tokens))
         if not survivors:
-            for req in list(self._pending):
-                self._finish_err(req, "no_replica", "no replica alive")
-            self._pending.clear()
-            for req in list(self._pending_handoff):
-                self._finish_err(req, "no_replica", "no replica alive")
-            self._pending_handoff.clear()
+            if not hold_gen:
+                for req in list(self._pending):
+                    self._finish_err(req, "no_replica", "no replica alive")
+                self._pending.clear()
+            if not (self._expecting("adopt")
+                    or self._heal_grace_active("adopt")):
+                for req in list(self._pending_handoff):
+                    self._finish_err(req, "no_replica", "no replica alive")
+                self._pending_handoff.clear()
         self._work.notify_all()

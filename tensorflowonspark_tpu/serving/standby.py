@@ -162,6 +162,26 @@ def _standby_leader(args, ctx, spec) -> None:
                             ctx.executor_id)
                 return
             params, prefix_pages = got
+            role = promote.get("role")
+            if role is not None:
+                # promote-with-role (disaggregated tier): specialize the
+                # pre-warmed engine for the pool this standby joins.  The
+                # standby was built from the tier's BASE batcher kwargs —
+                # per-role overlays (e.g. prefill_chunk) need a batcher
+                # rebuild, which would re-pay the compiles the pool
+                # exists to hoist, so the promoted gang serves with the
+                # base engine and a notice is logged.
+                overlay = (args.get("serve_disagg") or {}).get(
+                    f"{role}_kwargs")
+                if overlay:
+                    logger.warning(
+                        "standby %d promoted into the %s pool: the "
+                        "tier's %s_kwargs overlay %r does not apply to "
+                        "a pre-warmed engine (serving with base batcher "
+                        "config)", ctx.executor_id, role, role, overlay)
+                batcher.set_role(role)
+                logger.info("standby %d specialized for the %s pool",
+                            ctx.executor_id, role)
             if shard_fn is not None:
                 params = shard_fn(cfg, params, mesh)
             else:
@@ -193,13 +213,14 @@ def _standby_leader(args, ctx, spec) -> None:
                                      ctx.executor_id)
             mgr.queue_put(RESPONSE_QUEUE,
                           {"rid": None, "event": "standby_ready",
-                           "load": 0, "source": promote.get("source")})
+                           "load": 0, "source": promote.get("source"),
+                           **({} if role is None else {"role": role})})
             logger.info("standby %d promoted (source=%s): serving",
                         ctx.executor_id, promote.get("source"))
             run_serve_loop(args, ctx, batcher,
                            step_hook=None if barrier is None
                            else barrier.step,
-                           label="promoted-standby")
+                           label="promoted-standby", role=role)
         finally:
             if barrier is not None:
                 barrier.stop()

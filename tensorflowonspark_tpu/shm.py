@@ -410,7 +410,7 @@ class ShmChannel:
         rel = self._drain_releases()
         data, bufs = self._ms.split_oob(msg)  # the ONE pickle pass
         if bufs:
-            offs, total = _layout(bufs)
+            offs, total = aligned_layout(bufs)
             if self._ring is None:
                 self._ring = SegmentRing(self._ring_slots, self._slot_bytes)
             seg = self._ring.alloc(total)
@@ -471,11 +471,20 @@ class ShmChannel:
         self._map.close()
 
 
-def _layout(bufs: list[memoryview]) -> tuple[list[int], int]:
-    """Cache-line-aligned offsets for packing ``bufs`` into one segment."""
+def aligned_layout_lens(lens: list[int]) -> tuple[list[int], int]:
+    """Cache-line-aligned offsets + padded total from buffer LENGTHS —
+    the ONE packing-layout implementation.  Both transports share it (a
+    shm segment here; a sender's wire stream and the matching receive
+    slab in ``transport.py``), so sender and receiver offsets can never
+    diverge and reconstructed arrays stay ``_ALIGN``-byte aligned."""
     offs = []
     pos = 0
-    for v in bufs:
+    for n in lens:
         offs.append(pos)
-        pos += (v.nbytes + _ALIGN - 1) & ~(_ALIGN - 1)
+        pos += (int(n) + _ALIGN - 1) & ~(_ALIGN - 1)
     return offs, pos
+
+
+def aligned_layout(bufs: list[memoryview]) -> tuple[list[int], int]:
+    """Sender-side form of :func:`aligned_layout_lens` over memoryviews."""
+    return aligned_layout_lens([v.nbytes for v in bufs])

@@ -1268,6 +1268,52 @@ def test_prefill_only_validation_and_direct_finish():
                                   _oracle(cfg, params, prompt, 1))
 
 
+def test_set_role_specializes_idle_engine_both_ways():
+    """Promote-with-role (warm standby joining a disagg pool): a
+    role-less engine flips to prefill posture and exports a session
+    exactly as a constructor-built prefill pool would, then flips back
+    to decode posture and adopts it — the two specializations one warm
+    pool must be able to back."""
+    cfg, params = _make()
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, cfg.vocab_size, (10,)).astype(np.int32)
+    b = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8)
+    assert not b.prefill_only
+    b.set_role("prefill")
+    assert b.prefill_only
+    b.submit(prompt, 5)
+    sessions = _drive_handoff(b)
+    assert len(sessions) == 1 and b.decode_dispatches == 0
+    b.set_role("decode")
+    assert not b.prefill_only
+    drid = b.adopt_session(sessions[0][1])
+    np.testing.assert_array_equal(b.run()[drid],
+                                  _oracle(cfg, params, prompt, 5))
+    assert b.prefill_dispatches == 1     # the pre-handoff prefill only
+
+
+def test_set_role_validation():
+    cfg, params = _make()
+    b = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8)
+    with pytest.raises(ValueError, match="unknown role"):
+        b.set_role("both")
+    # prefill posture keeps the constructor's constraints
+    unpaged = ContinuousBatcher(cfg, params, max_batch=2)
+    with pytest.raises(ValueError, match="paged KV"):
+        unpaged.set_role("prefill")
+    spec = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8,
+                             speculative_k=2)
+    with pytest.raises(ValueError, match="decode-time"):
+        spec.set_role("prefill")
+    # a live request pins the posture
+    b.submit(np.asarray([1, 2, 3], np.int32), 3)
+    with pytest.raises(RuntimeError, match="live requests"):
+        b.set_role("prefill")
+    b.run()
+    b.set_role("prefill")                # drained: legal again
+    assert b.prefill_only
+
+
 def test_handoff_composes_with_chunked_prefill():
     """A long prompt streamed through the prefill pool's chunked
     admission exports the identical session a whole-prompt prefill

@@ -1964,6 +1964,157 @@ def test_trace_id_survives_handoff_and_post_handoff_requeue(tmp_path):
     assert "request_handoff" in tracing.format_timeline(timeline)
 
 
+class _DisaggPoolWorld(_DisaggWorld):
+    """``_DisaggWorld`` + the cluster surface StandbyPool/ServingCluster
+    need, with driver control messages RECORDED — the promote message
+    must carry the target pool's role."""
+
+    def __init__(self, n_prefill, n_decode, **kw):
+        super().__init__(n_prefill, n_decode, **kw)
+        self.control: list = []
+
+    def add_workers(self, n, map_fun=None, tf_args=None, timeout=None):
+        return [self.add_replica() for _ in range(n)]
+
+    def _client_for(self, eid):
+        world = self
+
+        class _Ctl:
+            def put(self, qname, item, timeout=None):
+                world.control.append((eid, item))
+
+        return _Ctl()
+
+    def retire_worker(self, eid):
+        pass
+
+
+def _disagg_standby_tier(world, scheduler, pool_size, disagg):
+    from tensorflowonspark_tpu.serving import ServingCluster, StandbyPool
+
+    tier = ServingCluster(world, scheduler, monitor=None, frontend=None,
+                          address=("127.0.0.1", 0))
+    tier.disagg = dict(disagg)
+    scheduler.on_replica_ready = tier._on_standby_ready
+    tier.standbys = StandbyPool(tier, pool_size)
+    tier.standbys.fill()
+    return tier
+
+
+def test_promote_with_role_joins_decode_pool_and_serves():
+    """Satellite (ROADMAP item 2 leftover): a role-less warm standby is
+    promoted INTO a killed decode gang's pool — the promote control
+    message carries ``role="decode"``, the scheduler registers the
+    newcomer into the decode pool, per-role accounting records it, and
+    the healed pipeline serves prefill→handoff→adopt exact."""
+    from tensorflowonspark_tpu.health import ClusterFailure
+
+    world = _DisaggPoolWorld(1, 1)
+    s = _disagg_scheduler(world).start()
+    tier = _disagg_standby_tier(world, s, pool_size=1,
+                                disagg={"prefill": 1, "decode": 1})
+    try:
+        assert tier.standbys.stats() == {"standbys": 1, "ready": [2]}
+        world.kill(1)                                  # the decode gang
+        s.on_cluster_failure(ClusterFailure("crash", "crash: worker 1",
+                                            (1,)))
+        tier._spawn_replacement(1, source="failure",
+                                promote_source="failure")
+        deadline = time.monotonic() + 10
+        while (2 not in s.alive_replicas() or not world.control) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert 2 in s.alive_replicas(), "standby was never promoted"
+        assert world.control, "promote control message never sent"
+        assert s.replica_role(2) == "decode", \
+            "the newcomer must join the DEAD gang's pool"
+        [(ctl_eid, promote)] = world.control
+        assert ctl_eid == 2
+        assert promote["op"] == "standby" and promote["event"] == "promote"
+        assert promote["role"] == "decode", \
+            "the promote message must carry the target pool's role"
+        # the healed pipeline spans the boundary: prompt -> prefill 0 ->
+        # handoff -> adopted by the promoted decode gang 2
+        for k in range(3):
+            p = np.asarray([5 + k, 2], np.int32)
+            toks, err = _collect(s.submit(p, 6))
+            assert err is None and toks == _fake_tokens(p, 6)
+        m = s.metrics()
+        assert m["handoffs"] >= 3
+        assert m["replicas"][2]["role"] == "decode"
+        # per-role pool accounting
+        assert tier.metrics()["standby"]["promotions"] == {
+            "failure": 1, "role:decode": 1}
+    finally:
+        tier.standbys.stop()
+        s.stop()
+
+
+def test_expectation_holds_handoff_queue_through_the_heal_window():
+    """When the dead decode gang was its pool's LAST, the requeued
+    handoffs must WAIT for the in-flight replacement (expect_replica)
+    instead of shedding as no_replica — and still fail typed once the
+    heal gives up (expect_done with no replacement registered)."""
+    world = _DisaggWorld(1, 1, token_delay=0.05)
+    s = _disagg_scheduler(world).start()
+    try:
+        p = np.asarray([3, 1], np.int32)
+        req = s.submit(p, 8)
+        deadline = time.monotonic() + 10
+        while len(req.tokens) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        s.expect_replica("decode")       # the heal announces itself
+        world.kill(1)                    # ...then the only decode dies
+        deadline = time.monotonic() + 10
+        while s.metrics()["requeued"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.3)                  # dispatch must NOT shed it
+        assert not req.finished, \
+            "a held pool's work was shed during the heal window"
+        info = world.add_replica()       # the replacement lands
+        s.add_replica(info, role="decode")
+        s.expect_done("decode")
+        toks, err = _collect(req, timeout=15)
+        assert err is None and toks == _fake_tokens(p, 8)
+        # a SECOND death with no expectation restores the typed shed
+        req2 = s.submit(p, 6)
+        deadline = time.monotonic() + 10
+        while req2.replica is None and time.monotonic() < deadline:
+            time.sleep(0.005)
+        world.kill(2)
+        toks, err = _collect(req2, timeout=15)
+        assert err is not None and err[1] == "no_replica", err
+    finally:
+        s.stop()
+
+
+def test_promote_role_mismatch_skips_warm_pool_never_crashes():
+    """A mismatched promote call (role on a unified tier, no role on a
+    disagg tier) SKIPS the warm pool — returning None so the heal thread
+    falls back to the cold path's explicit error — and never consumes a
+    standby."""
+    world = _PoolWorld(1)
+    s = _scheduler(world).start()
+    tier = _standby_tier(world, s, pool_size=1)
+    try:
+        assert tier.promote_standby("failure", role="decode") is None
+        assert tier.standbys.stats()["standbys"] == 1, \
+            "a skipped promotion must not consume the standby"
+    finally:
+        tier.standbys.stop()
+        s.stop()
+    world2 = _DisaggPoolWorld(1, 1)
+    s2 = _disagg_scheduler(world2).start()
+    tier2 = _disagg_standby_tier(world2, s2, pool_size=1,
+                                 disagg={"prefill": 1, "decode": 1})
+    try:
+        assert tier2.promote_standby("scale_up") is None
+        assert tier2.standbys.stats()["standbys"] == 1
+    finally:
+        tier2.standbys.stop()
+        s2.stop()
+
+
 class _FakeDisaggServing(_FakeServing):
     """Two-pool facade: per-role replica sets + both backlog queues, so
     the per-pool autoscalers can be driven deterministically."""
@@ -2190,3 +2341,71 @@ def test_disagg_decode_gang_kill_post_handoff_stays_exact(tmp_path,
         assert m["handoffs"] > m["completed"] - m["requeued"]
     finally:
         serving.shutdown(timeout=120)
+
+
+@pytest.mark.integration
+def test_disagg_standby_promotes_into_killed_decode_gang(tmp_path,
+                                                         worker_env):
+    """Satellite acceptance (disagg x warm_standbys): chaos SIGKILLs the
+    only decode gang while it streams adopted sessions; the heal
+    PROMOTES the role-less warm standby INTO the decode pool
+    (promote-with-role: control message carries role="decode", the
+    engine specializes via set_role, the scheduler registers it into the
+    pool) — every accepted request completes oracle-exact across the
+    heal and the per-role accounting tells the story."""
+    env = dict(worker_env, TFOS_CHAOS="kill node=1 at_step=3")
+    serving = _run_serving(
+        tmp_path, env, num_replicas=2,
+        disagg={"prefill": 1, "decode": 1},
+        batcher_kwargs={"kv_page_tokens": 8},
+        warm_standbys=1)
+    try:
+        assert serving.wait_standbys(timeout=180), "standby never warmed"
+        assert serving.standbys.stats() == {"standbys": 1, "ready": [2]}
+        rng = np.random.default_rng(8)
+        reqs = _requests(rng, 8, bmin=10, bmax=16)
+        results: dict[int, list] = {}
+        errors: list = []
+
+        def run_client(cid):
+            try:
+                with serving.client() as c:
+                    for i in range(cid, len(reqs), 2):
+                        p, n = reqs[i]
+                        results[i] = c.generate(p, n, timeout=240).tolist()
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=run_client, args=(cid,))
+                   for cid in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        assert not errors, errors
+        for i, (p, n) in enumerate(reqs):
+            assert results[i] == _oracle(p, n), f"request {i} diverged"
+        # the standby joined the DEAD gang's pool
+        deadline = time.monotonic() + 90
+        while 2 not in serving.scheduler.alive_replicas() \
+                and time.monotonic() < deadline:
+            time.sleep(0.25)
+        assert 2 in serving.scheduler.alive_replicas(), \
+            "standby was never promoted"
+        assert serving.scheduler.replica_role(2) == "decode"
+        assert serving.scheduler.dead_replicas() == {1}
+        m = serving.metrics()
+        assert m["failed"] == 0 and m["completed"] == m["accepted"], m
+        assert m["requeued"] >= 1, "the killed decode work must replay"
+        assert m["standby"]["promotions"] == {"failure": 1,
+                                              "role:decode": 1}
+        assert m["replicas"][2]["role"] == "decode"
+        promoted = [e for e in _serving_events(tmp_path)
+                    if e["kind"] == "standby_promoted"]
+        assert promoted and promoted[0]["role"] == "decode"
+        replaced = [e for e in _serving_events(tmp_path)
+                    if e["kind"] == "replica_replaced"]
+        assert replaced and replaced[0]["mode"] == "warm" \
+            and replaced[0]["role"] == "decode"
+    finally:
+        serving.shutdown(timeout=180)

@@ -1,0 +1,581 @@
+"""Cross-host bulk transport tests (``transport.py`` + the ``queues.py``
+three-tier hello).  All fast-tier: CPU only, loopback sockets.
+
+The negotiation-downgrade tests mirror ``tests/test_shm.py`` shape for
+shape: every path out of the bulk tier (handshake failure, env kill
+switch, refusing endpoint, oversized payload, shm winning on a shared
+host) must land on a working per-message pickle connection — degraded
+throughput, never correctness.
+
+The two counter-pinned tests at the bottom are the acceptance proof that
+the standby weight clone and the disagg KV-session handoff actually RIDE
+the bulk tier when shm is unavailable (the cross-host case, simulated by
+pinning shm off), and that a corrupted page payload is rejected by
+``adopt_session``'s content hashes without poisoning the engine.
+"""
+
+import gc
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import shm as shm_mod
+from tensorflowonspark_tpu import transport as tp
+from tensorflowonspark_tpu.queues import QueueClient, QueueServer
+from tensorflowonspark_tpu.reservation import MessageSocket
+
+AUTH = b"k" * 16
+
+#: sample-sized buffers — above transport.BULK_OOB_MIN (4 KB) but below
+#: MessageSocket.OOB_MIN_BYTES (64 KB), so the per-message tier carries
+#: them in-band: exactly the shape the bulk tier exists to fix
+SAMPLE = 2048  # f64 = 16 KB
+
+
+def _chunk(n=48, seed=0):
+    return [np.arange(SAMPLE, dtype=np.float64) + seed + i
+            for i in range(n)]
+
+
+def _assert_chunk_equal(got, n=48, seed=0):
+    assert len(got) == n
+    for i, a in enumerate(got):
+        np.testing.assert_array_equal(
+            a, np.arange(SAMPLE, dtype=np.float64) + seed + i)
+
+
+@pytest.fixture()
+def server():
+    s = QueueServer(authkey=AUTH, mode="local", maxsize=8, shm=False)
+    s.start()
+    yield s
+    s.stop()
+
+
+# ------------------------------------------------- negotiation + roundtrip
+
+def test_negotiation_and_roundtrip_integrity(server):
+    c = QueueClient(server.addr, AUTH, shm=False)
+    assert c.bulk_active, "shm-less client must negotiate the bulk tier"
+    assert not c.shm_active
+    c.put("input", _chunk())
+    _assert_chunk_equal(server.queue_get("input", timeout=5))
+    assert server.bulk_conns == 1
+    assert c._chan.stats["bulk_msgs"] >= 1
+    assert c._chan.stats["fallbacks"] == 0
+    # nested containers and mixed dtypes survive the scatter/gather path
+    big = np.arange(SAMPLE * 4, dtype=np.float32).reshape(64, -1)
+    c.put("input", {"x": big, "meta": {"label": 7},
+                    "small": np.arange(16, dtype=np.int32)})
+    got = server.queue_get("input", timeout=5)
+    np.testing.assert_array_equal(got["x"], big)
+    assert got["meta"]["label"] == 7
+    np.testing.assert_array_equal(got["small"],
+                                  np.arange(16, dtype=np.int32))
+    got["x"][0, 0] = -1.0  # received views must stay writable
+    c.close()
+
+
+def test_shm_preferred_over_bulk_on_same_host():
+    """Tier one outranks tier two: a client that CAN prove shared memory
+    must negotiate shm even when both endpoints would accept bulk."""
+    s = QueueServer(authkey=AUTH, mode="local")
+    s.start()
+    try:
+        c = QueueClient(s.addr, AUTH)
+        assert c.shm_active and not c.bulk_active
+        assert s.shm_conns == 1 and s.bulk_conns == 0
+        c.put("input", _chunk(4))
+        _assert_chunk_equal(s.queue_get("input", timeout=5), 4)
+        c.close()
+    finally:
+        s.stop()
+
+
+def test_env_kill_switch_pins_pickle_path(server, monkeypatch):
+    monkeypatch.setenv(tp.DISABLE_ENV, "1")
+    c = QueueClient(server.addr, AUTH, shm=False)
+    assert not c.bulk_active and not c.shm_active
+    c.put("input", _chunk(4))
+    _assert_chunk_equal(server.queue_get("input", timeout=5), 4)
+    assert server.bulk_conns == 0
+    c.close()
+
+
+def test_server_param_disable_downgrades_client():
+    s = QueueServer(authkey=AUTH, mode="local", shm=False, bulk=False)
+    s.start()
+    try:
+        c = QueueClient(s.addr, AUTH, shm=False)  # offers, server refuses
+        assert not c.bulk_active
+        c.put("input", _chunk(4))
+        _assert_chunk_equal(s.queue_get("input", timeout=5), 4)
+        c.close()
+    finally:
+        s.stop()
+
+
+def test_client_param_disable(server):
+    c = QueueClient(server.addr, AUTH, shm=False, bulk=False)
+    assert not c.bulk_active
+    c.put("input", [1, 2])
+    assert server.queue_get("input", timeout=5) == [1, 2]
+    c.close()
+
+
+def test_handshake_failure_downgrades_old_peer(server, monkeypatch):
+    """An old server that doesn't speak ``bulk_hello`` replies ERR for
+    the unknown op — the client must silently land on the pickle path."""
+    monkeypatch.setattr(
+        tp, "hello_payload",
+        lambda: {"op": "bulk_hello_vNEXT", "ver": 99})
+    c = QueueClient(server.addr, AUTH, shm=False)
+    assert not c.bulk_active
+    c.put("input", _chunk(4))
+    _assert_chunk_equal(server.queue_get("input", timeout=5), 4)
+    c.close()
+
+
+def test_handshake_version_mismatch_downgrades(server, monkeypatch):
+    """A frame-version the server doesn't recognize is a refusal
+    (``BULK False``), not an error."""
+    good = tp.hello_payload()
+    monkeypatch.setattr(tp, "hello_payload",
+                        lambda: dict(good, ver=99))
+    c = QueueClient(server.addr, AUTH, shm=False)
+    assert not c.bulk_active
+    assert server.bulk_conns == 0
+    c.put("input", _chunk(4))
+    _assert_chunk_equal(server.queue_get("input", timeout=5), 4)
+    c.close()
+
+
+def test_oversized_payload_falls_back(server, monkeypatch):
+    """A payload larger than the peer's advertised slab travels inline
+    (pickle-5 OOB socket framing) on the SAME connection; the next
+    fitting payload rides bulk again."""
+    monkeypatch.setenv(tp.SLAB_MB_ENV, "1")
+    c = QueueClient(server.addr, AUTH, shm=False)
+    assert c.bulk_active
+    big = np.random.rand(1 << 18)              # 2 MB > the 1 MB slab
+    c.put("input", big)
+    np.testing.assert_array_equal(server.queue_get("input", timeout=5), big)
+    assert c._chan.stats["fallbacks"] == 1
+    assert c._chan.stats["bulk_msgs"] == 0
+    c.put("input", _chunk(32))                 # 512 KB: fits again
+    _assert_chunk_equal(server.queue_get("input", timeout=5), 32)
+    assert c._chan.stats["bulk_msgs"] == 1
+    c.close()
+
+
+def test_small_control_messages_stay_inline(server):
+    """Sub-threshold payloads (every control message) skip bulk framing
+    without counting as fallbacks — small is the design, not a failure."""
+    c = QueueClient(server.addr, AUTH, shm=False)
+    assert c.bulk_active
+    c.put("input", {"op": "marker", "tiny": np.arange(8)})
+    got = server.queue_get("input", timeout=5)
+    assert got["op"] == "marker"
+    assert c._chan.stats["bulk_msgs"] == 0
+    assert c._chan.stats["inline_msgs"] >= 1
+    assert c._chan.stats["fallbacks"] == 0
+    c.close()
+
+
+def test_datafeed_next_chunk_over_bulk(server):
+    from tensorflowonspark_tpu.datafeed import DataFeed
+    from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition
+
+    c = QueueClient(server.addr, AUTH, shm=False)
+    assert c.bulk_active
+    c.put("input", _chunk(32, seed=1))
+    c.put("input", EndPartition())
+    c.put("input", _chunk(32, seed=2))
+    c.put("input", EndOfFeed())
+    feed = DataFeed(server)
+    assert feed.next_chunk(timeout=5)[0][0] == 1.0
+    assert feed.next_chunk(timeout=5)[0][0] == 2.0  # marker skipped
+    assert feed.next_chunk(timeout=5) is None
+    assert feed.should_stop()
+    assert c._chan.stats["bulk_msgs"] == 2
+    c.close()
+
+
+# ------------------------------------------------------- slab pool units
+
+def test_slab_pool_exhaustion_one_shot_then_recycles():
+    pool = tp.SlabPool(slabs=1, slab_bytes=1 << 16)
+    a = pool.acquire(1 << 12)
+    assert pool.free_slabs == 0
+    b = pool.acquire(1 << 12)          # exhausted: one-shot slab
+    assert pool.pool_misses == 1
+    views_a = a.views([0], [64])
+    b.discard()
+    assert pool.free_slabs == 0        # one-shot slab never pools
+    del views_a
+    gc.collect()                        # the lease's last view died
+    assert pool.free_slabs == 1
+    c = pool.acquire(1 << 12)
+    assert pool.pool_misses == 1        # recycled, no new miss
+    c.discard()
+    pool.close()
+
+
+def test_slab_views_anchor_until_last_derived_array_dies():
+    """numpy base collapse: an array DERIVED from a received view keeps
+    the slab leased after the view itself is gone (the shm-ring lease
+    design, applied to pooled process memory)."""
+    pool = tp.SlabPool(slabs=1, slab_bytes=1 << 16)
+    lease = pool.acquire(1 << 12)
+    [v] = lease.views([0], [1024])
+    arr = np.frombuffer(v, np.uint8)[10:20]
+    del v
+    gc.collect()
+    assert pool.free_slabs == 0, "derived array must keep the lease"
+    del arr
+    gc.collect()
+    assert pool.free_slabs == 1
+    pool.close()
+
+
+def test_full_pool_of_small_slabs_upgrades_for_bigger_streams():
+    """When the pool filled with small demand-sized slabs and the stream
+    size then grows, the pool evicts a small free slab and allocates a
+    bigger one in its place — it must not fall into the one-shot path
+    forever."""
+    pool = tp.SlabPool(slabs=2, slab_bytes=8 << 20)
+    small = [pool.acquire(100), pool.acquire(100)]   # two MIN_SLAB slabs
+    for lease in small:
+        lease.discard()                               # both free again
+    big = pool.acquire(4 << 20)                       # bigger than both
+    assert pool.pool_misses == 0, "free small slab should be replaced"
+    [v] = big.views([0], [4 << 20])
+    assert len(v) == 4 << 20
+    del v
+    gc.collect()
+    # the upgraded slab pools and is reused for the next big stream
+    again = pool.acquire(4 << 20)
+    assert pool.pool_misses == 0
+    again.discard()
+    pool.close()
+
+
+def test_oversized_acquire_is_a_one_shot_slab():
+    pool = tp.SlabPool(slabs=2, slab_bytes=1 << 12)
+    lease = pool.acquire(1 << 14)      # larger than any pooled slab
+    assert pool.pool_misses == 1
+    [v] = lease.views([0], [1 << 14])
+    assert len(v) == 1 << 14
+    pool.close()
+
+
+def test_aligned_layout_lens_matches_sender_layout():
+    rng = np.random.default_rng(0)
+    bufs = [memoryview(bytes(int(n)))
+            for n in rng.integers(1, 5000, size=32)]
+    send_offs, send_total = shm_mod.aligned_layout(list(bufs))
+    recv_offs, recv_total = tp.aligned_layout_lens(
+        [len(b) for b in bufs])
+    assert send_offs == recv_offs and send_total == recv_total
+    assert all(o % 64 == 0 for o in recv_offs)
+
+
+# ------------------------------------------------- hello payload policy
+
+def test_accept_payload_validation():
+    good = tp.hello_payload()
+    acc = tp.accept_payload(good)
+    assert acc is not None and acc["chunk"] == good["chunk"]
+    assert tp.accept_payload(dict(good, ver=2)) is None
+    assert tp.accept_payload(dict(good, chunk=1024)) is None  # < 4 KB floor
+    assert tp.accept_payload(dict(good, chunk="nope")) is None
+    assert tp.accept_payload({}) is None
+    # chunk negotiation: the smaller proposal wins
+    small = tp.accept_payload(dict(good, chunk=8192))
+    assert small["chunk"] == 8192
+
+
+def test_resolve_crc_env_wins_and_typos_stay_safe(monkeypatch):
+    monkeypatch.delenv(tp.CRC_ENV, raising=False)
+    assert tp.resolve_crc() == "fast"
+    assert tp.resolve_crc("full") == "full"
+    monkeypatch.setenv(tp.CRC_ENV, "off")
+    assert tp.resolve_crc("full") == "off"       # env outranks the peer
+    monkeypatch.setenv(tp.CRC_ENV, "fulll")      # typo: stay verified
+    assert tp.resolve_crc() == "fast"
+
+
+def test_bulk_resolve_tristate(monkeypatch):
+    monkeypatch.delenv(tp.DISABLE_ENV, raising=False)
+    assert tp.bulk_resolve(None) and tp.bulk_resolve(True)
+    assert not tp.bulk_resolve(False)
+    monkeypatch.setenv(tp.DISABLE_ENV, "1")
+    assert not tp.bulk_resolve(None) and not tp.bulk_resolve(True)
+
+
+# ------------------------------------------- frame integrity (wire level)
+
+class _CaptureSock:
+    """Sender-side fake: records the exact wire byte stream."""
+
+    def __init__(self):
+        self.buf = bytearray()
+
+    def sendmsg(self, iov):
+        n = 0
+        for v in iov:
+            self.buf += bytes(v)
+            n += len(v)
+        return n
+
+
+class _FeedSock:
+    """Receiver-side fake: serves a byte stream to ``recv_into``; EOF
+    (socket closed) once drained."""
+
+    def __init__(self, data):
+        self.data = memoryview(bytes(data))
+        self.pos = 0
+
+    def recv_into(self, view):
+        n = min(len(view), len(self.data) - self.pos)
+        view[:n] = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return n
+
+
+def _captured_stream(msg, crc_mode="full"):
+    """The full wire image of one bulk message + the offset of the first
+    chunk frame (right after the MessageSocket envelope frame)."""
+    ms = MessageSocket()
+    cap = _CaptureSock()
+    tx = tp.BulkChannel(ms, cap, crc_mode=crc_mode, pipeline=False)
+    tx.min_payload = 1024
+    tx.send(msg)
+    assert tx.bulk_msgs == 1, "test payload must take the bulk path"
+    # envelope frame: [1B magic][1B ver][4B plen][4B nbuf] + pickle (the
+    # bulk descriptor never carries MessageSocket-level OOB buffers)
+    magic, ver, plen, nbuf = struct.unpack(">BBII", cap.buf[:10])
+    assert nbuf == 0
+    return cap.buf, 10 + plen
+
+
+def _receive_stream(buf, crc_mode="full"):
+    ms = MessageSocket()
+    rx = tp.BulkChannel(ms, _FeedSock(buf), crc_mode=crc_mode,
+                        pipeline=False)
+    try:
+        return rx.receive()
+    finally:
+        rx.close()
+
+
+def _payload():
+    return {"arrs": [np.arange(SAMPLE, dtype=np.float64) + i
+                     for i in range(12)]}
+
+
+def test_wire_roundtrip_through_fake_sockets():
+    buf, _ = _captured_stream(_payload())
+    got = _receive_stream(buf)
+    _assert_chunk_equal(got["arrs"], 12)
+
+
+def test_corrupt_payload_byte_rejected_full_crc():
+    buf, chunk0 = _captured_stream(_payload(), crc_mode="full")
+    bad = bytearray(buf)
+    bad[-50] ^= 0xFF                     # payload byte of the last chunk
+    with pytest.raises(tp.BulkIntegrityError, match="CRC mismatch"):
+        _receive_stream(bad, crc_mode="full")
+
+
+def test_corrupt_prefix_byte_rejected_fast_crc():
+    """``fast`` mode checksums each chunk's first 4 KB — a flip there
+    (desync, mis-offset scatter, stale slab) must still be caught."""
+    buf, chunk0 = _captured_stream(_payload(), crc_mode="fast")
+    bad = bytearray(buf)
+    bad[chunk0 + tp._HDR.size + 100] ^= 0xFF
+    with pytest.raises(tp.BulkIntegrityError, match="CRC mismatch"):
+        _receive_stream(bad, crc_mode="fast")
+
+
+def test_corrupt_header_magic_rejected():
+    buf, chunk0 = _captured_stream(_payload())
+    bad = bytearray(buf)
+    bad[chunk0] ^= 0xFF                  # chunk frame magic byte
+    with pytest.raises(tp.BulkIntegrityError, match="magic"):
+        _receive_stream(bad)
+
+
+def test_sequence_gap_rejected():
+    buf, chunk0 = _captured_stream(_payload())
+    bad = bytearray(buf)
+    # _HDR = [1B magic][1B ver][2B flags][4B sid][4B seq]... -> seq @ +8
+    struct.pack_into(">I", bad, chunk0 + 8, 7)
+    with pytest.raises(tp.BulkIntegrityError, match="sequence gap"):
+        _receive_stream(bad)
+
+
+def test_digest_mismatch_rejected():
+    buf, _ = _captured_stream(_payload())
+    bad = bytearray(buf)
+    bad[-1] ^= 0xFF                      # digest frame's crc field
+    with pytest.raises(tp.BulkIntegrityError, match="digest"):
+        _receive_stream(bad)
+
+
+def test_truncated_stream_is_connection_death():
+    buf, _ = _captured_stream(_payload())
+    with pytest.raises(EOFError):
+        _receive_stream(buf[:-30])
+
+
+def test_crc_off_skips_payload_verification():
+    """``off`` disables payload CRCs by contract (headers still checked):
+    a mid-chunk flip is NOT a transport error — end-to-end content
+    checks (the KV handoff's page hashes) own that layer."""
+    buf, chunk0 = _captured_stream(_payload(), crc_mode="off")
+    bad = bytearray(buf)
+    bad[-50] ^= 0xFF
+    got = _receive_stream(bad, crc_mode="off")
+    assert len(got["arrs"]) == 12        # delivered, corrupted
+    flat = np.concatenate(got["arrs"])
+    ref = np.concatenate(_payload()["arrs"])
+    assert not np.array_equal(flat, ref)
+
+
+def test_failed_stream_discards_lease_and_pool_recovers():
+    """An integrity failure mid-stream returns the slab to the pool —
+    a few poisoned messages must not leak the receive buffers."""
+    ms = MessageSocket()
+    buf, chunk0 = _captured_stream(_payload())
+    bad = bytearray(buf)
+    bad[chunk0] ^= 0xFF
+    rx = tp.BulkChannel(ms, _FeedSock(bad), pipeline=False, slabs=1)
+    with pytest.raises(tp.BulkIntegrityError):
+        rx.receive()
+    assert rx._pool.free_slabs == 1 and rx._pool.pool_misses == 0
+    rx.close()
+
+
+# ---------------------- acceptance: clone + handoff ride the bulk tier
+
+def _tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab_size=61, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64,
+                    max_position_embeddings=48, dtype=jnp.float32,
+                    pos_encoding="rope")
+    params = GPT(cfg).init(jax.random.key(0),
+                           jnp.ones((1, 4), jnp.int32))["params"]
+    return cfg, params
+
+
+def _bulk_rx_bytes():
+    from tensorflowonspark_tpu import metrics as _metrics
+
+    return _metrics.get_registry().counter(
+        "tfos_transport_bytes_total",
+        "Bulk-transport payload bytes by tier and direction.",
+        labelnames=("tier", "dir")).value(tier="bulk", dir="rx")
+
+
+def test_kv_session_handoff_rides_bulk_and_rejects_corruption(
+        server, monkeypatch):
+    """Satellite: the disagg KV-page handoff on a simulated cross-host
+    hop (shm unavailable -> bulk negotiated, pinned via the transport
+    counters), with ``adopt_session``'s content hashes still rejecting a
+    corrupted page WITHOUT poisoning the adopting engine."""
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import (ContinuousBatcher,
+                                              greedy_generate)
+
+    monkeypatch.setenv(tp.MIN_KB_ENV, "1")   # tiny-model sessions qualify
+    cfg, params = _tiny_model()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, (36,)).astype(np.int32)
+    budget = 6
+
+    pre = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8,
+                            prefill_only=True)
+    pre.submit(prompt, budget)
+    sessions = []
+    for _ in range(20):
+        pre.step()
+        sessions.extend(pre.take_sessions())
+        if not pre.load()["total"]:
+            break
+    [(_, sess)] = sessions
+
+    # the cross-host hop: the session crosses a shm-less queue connection
+    before = _bulk_rx_bytes()
+    c = QueueClient(server.addr, AUTH, shm=False)
+    assert c.bulk_active and not c.shm_active
+    c.put("input", ("handoff", 0, sess))
+    _, _, sess_rx = server.queue_get("input", timeout=10)
+    c.put("input", ("handoff", 1, sess))
+    _, _, sess_corrupt = server.queue_get("input", timeout=10)
+    assert c._chan.stats["bulk_msgs"] == 2, \
+        "the KV-page handoff must ride the bulk tier when shm is off"
+    assert _bulk_rx_bytes() - before >= 2 * sum(
+        np.asarray(a).nbytes for a in sess["kv"])
+    c.close()
+
+    # a page corrupted past the transport layer (CRC-sampled regions
+    # clean) is the adopting engine's to reject, by content hash
+    kv0 = np.asarray(sess_corrupt["kv"][0])
+    kv0[tuple(0 for _ in kv0.shape)] += 1.0
+    dec = ContinuousBatcher(cfg, params, max_batch=2, kv_page_tokens=8)
+    with pytest.raises(ValueError, match="content hash mismatch"):
+        dec.adopt_session(sess_corrupt)
+    # the engine is NOT poisoned: the intact received session adopts and
+    # decodes oracle-exact, zero re-prefill
+    drid = dec.adopt_session(sess_rx)
+    results = dec.run()
+    assert dec.prefill_dispatches == 0
+    oracle = np.asarray(greedy_generate(
+        cfg, params, jnp.asarray(prompt)[None, :], budget))[0, len(prompt):]
+    np.testing.assert_array_equal(results[drid], oracle)
+
+
+def test_weight_clone_rides_bulk_when_shm_unavailable(server, monkeypatch):
+    """Acceptance: ``serve_clone_request``'s params transfer negotiates
+    the bulk tier when shm is pinned off (the cross-host standby heal),
+    pinned via the transport counters, tree-exact on arrival."""
+    import jax
+
+    from tensorflowonspark_tpu.models import ContinuousBatcher
+    from tensorflowonspark_tpu.serving.replica import serve_clone_request
+
+    monkeypatch.setenv(shm_mod.DISABLE_ENV, "1")   # shm unavailable
+    monkeypatch.setenv(tp.MIN_KB_ENV, "8")         # tiny params qualify
+    cfg, params = _tiny_model()
+    batcher = ContinuousBatcher(cfg, params, max_batch=2)
+
+    class _Ctx:
+        executor_id = 0
+
+    before = _bulk_rx_bytes()
+    conns_before = server.bulk_conns
+    serve_clone_request(
+        batcher, {"reply_addr": server.addr, "reply_authkey": AUTH},
+        _Ctx(), export_pages=False)
+    msg = server.queue_get("input", timeout=30)
+    assert msg["op"] == "standby" and msg["event"] == "params"
+    assert server.bulk_conns == conns_before + 1, \
+        "the weight clone must negotiate the bulk tier when shm is off"
+    flat_sent = jax.tree.leaves(jax.tree.map(np.asarray, params))
+    flat_got = jax.tree.leaves(msg["params"])
+    assert len(flat_sent) == len(flat_got)
+    for a, b in zip(flat_sent, flat_got):
+        np.testing.assert_array_equal(a, b)
+    big = sum(a.nbytes for a in flat_sent if a.nbytes >= tp.BULK_OOB_MIN)
+    assert _bulk_rx_bytes() - before >= big

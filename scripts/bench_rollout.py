@@ -1,0 +1,622 @@
+"""Multi-model serving + live-rollout benchmark — self-gating artifact.
+
+Boots real serving tiers (``serving.ServingCluster`` over
+``LocalProcessBackend`` replicas, tiny seeded GPTs so the numbers
+measure the control plane, not the model) and pins the PR's claims as
+hard gates; the script FAILS ITSELF on any miss:
+
+- ``multi_model``: two models (distinct seeds → distinct params) hosted
+  on one tier, one gang each, driven by concurrent per-model open-loop
+  load — vs a single-model baseline tier of the SAME total gang count
+  under the same total load.  Gates: every output oracle-exact against
+  ITS model's solo ``greedy_generate`` (routing isolation — one wrong
+  route would emit the other model's tokens), zero lost, and N-model
+  steady throughput within a bounded delta of the single-model baseline
+  (``tput_ratio >= 0.6`` — the control plane must not tax hosting).
+- ``hot_swap``: a 2-gang model rolled from v1 to v2 (different seed)
+  MID-LOAD via the drain-verb hot swap.  Gates: zero requests lost or
+  requeued (the swap is planned, not a failover), every output exactly
+  one of {v1 oracle, v2 oracle} (locked-vs-solo, per version), at least
+  one v2-exact output (the swap really happened), and a post-swap probe
+  v2-exact on both gangs.
+- ``canary_rollback``: a rollout to a version whose offline eval PASSED
+  but whose live behavior regresses (an injected per-step delay — the
+  shape an offline eval cannot see).  Gates: the controller auto-rolls
+  back on the latency gate, the version is marked ``rolled_back``,
+  every accepted request completed (the incumbent never stopped
+  serving), and a post-rollback probe is v1-exact on every gang.
+- ``standby_rearm``: two models + ONE shared warm standby; a chaos
+  SIGKILL takes model b's only gang.  Gates: the heal PROMOTES the
+  standby re-armed FOR MODEL B (promote message carries b's builder
+  payload; per-model promotion accounting records it), post-heal b
+  output is b-oracle-exact, model a never hiccups, zero accepted
+  requests lost.
+
+Writes ``bench_artifacts/rollout_serving.json`` (``--smoke``: tiny
+sizes, scenarios ``multi_model`` + ``canary_rollback`` only, writes
+``rollout_serving_smoke.json`` so the committed full artifact is never
+clobbered; wired into ``scripts/ci.sh --bench-smoke``).
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+from bench_serving import HIDDEN, VOCAB, bench_model_builder  # noqa: E402
+
+
+def version_delta(seed):
+    """A deterministic ADAPTER delta that provably changes greedy
+    output: a seeded bias shift before the head.  The bench's models/
+    versions differ by adapter over ONE shared base — the merged-LoRA
+    deployment shape, and the only reliable differentiator here (the
+    toy GPT's init ignores the builder seed on this jax, so seed-based
+    "versions" would share identical weights and make every exactness
+    gate vacuous)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return {"ln_f/bias": rng.normal(scale=1.0,
+                                    size=(HIDDEN,)).astype(np.float32)}
+
+
+def _oracle(delta_seed, reqs):
+    """Solo greedy decode of every request under the base params plus
+    the version's adapter delta (None = the bare base) — the
+    locked-vs-solo reference per model version."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import greedy_generate
+    from tensorflowonspark_tpu.serving import apply_adapter
+
+    cfg, params = bench_model_builder({})
+    if delta_seed is not None:
+        params = apply_adapter(params, version_delta(delta_seed))
+    return [np.asarray(greedy_generate(
+        cfg, params, jnp.asarray(p)[None, :], n))[0, len(p):].tolist()
+        for p, n in reqs]
+
+
+def _make_reqs(rng, n, lo=3, hi=10, blo=6, bhi=13):
+    import numpy as np  # noqa: F401
+
+    return [(rng.integers(0, VOCAB, (int(rng.integers(lo, hi)),))
+             .astype("int32"), int(rng.integers(blo, bhi)))
+            for _ in range(n)]
+
+
+def _run_load(serving, reqs, rate, rng, model=None):
+    """Open-loop Poisson arrivals, one streaming client per request."""
+    from tensorflowonspark_tpu.serving import ServingError
+
+    records = [None] * len(reqs)
+    threads = []
+
+    def one(i, prompt, budget):
+        rec = {"ok": False, "tokens": 0, "out": None, "model": model}
+        try:
+            with serving.client() as c:
+                toks = []
+                for delta in c.generate_stream(prompt, budget,
+                                               timeout=600, model=model):
+                    toks.extend(delta)
+                rec["tokens"] = len(toks)
+                rec["out"] = toks
+                rec["ok"] = True
+        except ServingError as e:
+            rec["error"] = f"{type(e).__name__}: {e}"
+        records[i] = rec
+
+    for i, (p, n) in enumerate(reqs):
+        t = threading.Thread(target=one, args=(i, p, n), daemon=True)
+        t.start()
+        threads.append(t)
+        time.sleep(rng.exponential(1.0 / rate))
+    for t in threads:
+        t.join(600)
+    return records
+
+
+def _check_complete(records, label):
+    lost = [i for i, r in enumerate(records)
+            if r is None or (not r["ok"] and "error" not in r)]
+    if lost:
+        raise RuntimeError(f"{label}: requests lost without a typed "
+                           f"error: {lost}")
+    failed = [r for r in records if r and not r["ok"]]
+    if failed:
+        raise RuntimeError(f"{label}: accepted requests failed: "
+                           f"{failed[:3]}")
+
+
+def _warm(serving, reqs, n, model=None):
+    def go():
+        with serving.client() as c:
+            c.generate(reqs[0][0], 2, timeout=600, model=model)
+
+    ts = [threading.Thread(target=go) for _ in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(600)
+
+
+def _registry(versions):
+    """``{version: {"delta": seed | None, "serve_args": {...}}}`` → a
+    registry hosting model "m": delta-less versions are the FULL base
+    builder, delta'd ones ADAPTER versions over it (each eval-passed:
+    the bench gates live behavior, not the offline gate, which tests
+    cover)."""
+    from tensorflowonspark_tpu.serving import ModelRegistry
+
+    reg = ModelRegistry()
+    for ver, spec in versions.items():
+        dseed = spec.get("delta")
+        if dseed is None:
+            reg.register("m", ver, bench_model_builder,
+                         serve_args=spec.get("serve_args"))
+        else:
+            reg.register("m", ver, base=bench_model_builder,
+                         adapter=version_delta(dseed),
+                         serve_args=spec.get("serve_args"))
+        reg.record_eval("m", ver, {"offline": "pass"}, passed=True)
+    return reg
+
+
+# ------------------------------------------------------------ scenarios
+
+def multi_model_scenario(n_per_model, rate, smoke=False, seed=0):
+    """One tier, two models, one gang each — vs a single-model 2-gang
+    baseline under the same total load."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.serving import ModelRegistry, ServingCluster
+
+    rng = np.random.default_rng(seed)
+    reqs_a = _make_reqs(rng, n_per_model)
+    reqs_b = _make_reqs(rng, n_per_model)
+    oracle_a = _oracle(None, reqs_a)
+    oracle_b = _oracle(7, reqs_b)
+
+    # baseline: 2 gangs, ONE model, the same total offered load
+    # admission depth pinned equal on both tiers: the scenario measures
+    # dispatch throughput + routing isolation, not shed policy (the
+    # multi tier boots with ONE founding gang, so its default bound
+    # would be half the baseline's)
+    base = ServingCluster.run(bench_model_builder, 2,
+                              max_queue_depth=256,
+                              worker_env={"JAX_PLATFORMS": "cpu"},
+                              reservation_timeout=120)
+    try:
+        _warm(base, reqs_a, 2)
+        t0 = time.monotonic()
+        recs = _run_load(base, reqs_a + reqs_b, 2 * rate, rng)
+        base_wall = time.monotonic() - t0
+        _check_complete(recs, "baseline")
+        base_tokens = sum(r["tokens"] for r in recs)
+    finally:
+        base.shutdown(timeout=300)
+
+    reg = ModelRegistry()
+    reg.register("a", "v1", bench_model_builder)
+    reg.register("b", "v1", base=bench_model_builder,
+                 adapter=version_delta(7))
+    reg.record_eval("b", "v1", {}, passed=True)
+    serving = ServingCluster.run(None, 1, registry=reg, model=("a", "v1"),
+                                 max_queue_depth=256,
+                                 worker_env={"JAX_PLATFORMS": "cpu"},
+                                 reservation_timeout=120)
+    try:
+        serving.deploy_model("b", "v1", replicas=1)
+        _warm(serving, reqs_a, 1, model="a")
+        _warm(serving, reqs_b, 1, model="b")
+        recs_a = [None] * len(reqs_a)
+        recs_b = [None] * len(reqs_b)
+        t0 = time.monotonic()
+
+        def load(model, reqs, out):
+            out[:] = _run_load(serving, reqs, rate,
+                               np.random.default_rng(seed + 1),
+                               model=model)
+
+        ta = threading.Thread(target=load, args=("a", reqs_a, recs_a))
+        tb = threading.Thread(target=load, args=("b", reqs_b, recs_b))
+        ta.start()
+        tb.start()
+        ta.join(600)
+        tb.join(600)
+        wall = time.monotonic() - t0
+        _check_complete(recs_a, "multi_model[a]")
+        _check_complete(recs_b, "multi_model[b]")
+        # GATE: routing isolation — every output exact vs ITS model
+        for recs, oracle, mid in ((recs_a, oracle_a, "a"),
+                                  (recs_b, oracle_b, "b")):
+            for i, (r, want) in enumerate(zip(recs, oracle)):
+                if r["out"] != want:
+                    raise RuntimeError(
+                        f"multi_model: model {mid} request {i} diverged "
+                        f"from its oracle — routing isolation broken")
+        sched = serving.metrics()
+        tokens = sum(r["tokens"] for r in recs_a + recs_b)
+    finally:
+        serving.shutdown(timeout=300)
+
+    base_tput = base_tokens / base_wall
+    multi_tput = tokens / wall
+    ratio = multi_tput / base_tput
+    floor = 0.4 if smoke else 0.6
+    if ratio < floor:
+        raise RuntimeError(
+            f"multi_model: hosting 2 models cost too much throughput "
+            f"({multi_tput:.1f} vs baseline {base_tput:.1f} tok/s = "
+            f"{ratio:.2f}x < {floor}x)")
+    return {
+        "scenario": "multi_model",
+        "requests_per_model": n_per_model,
+        "oracle_exact_per_model": True,
+        "baseline_tokens_per_s": round(base_tput, 2),
+        "multi_model_tokens_per_s": round(multi_tput, 2),
+        "tput_ratio_vs_single_model": round(ratio, 3),
+        "tput_ratio_floor": floor,
+        "models": sched["models"],
+        "per_model_requests": {
+            "a": {"completed": len(recs_a)}, "b": {"completed": len(recs_b)}},
+    }
+
+
+def hot_swap_scenario(n_requests, rate, seed=0):
+    """Roll a 2-gang model v1→v2 via the drain-verb hot swap under a
+    CLOSED-loop load that provably spans the whole rollout (pinger
+    threads cycling a probe pool with both versions' oracles
+    precomputed): every output must match exactly one version's oracle,
+    nothing may fail or requeue, at least one request must be v2-served
+    mid-rollout (the promotion-evidence gate enforces this too), and
+    post-swap probes must be v2-exact on both gangs."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.serving import RolloutPolicy, ServingCluster
+
+    rng = np.random.default_rng(seed)
+    probes = _make_reqs(rng, 8, blo=6, bhi=10)
+    oracle_v1 = _oracle(None, probes)
+    oracle_v2 = _oracle(3, probes)
+
+    reg = _registry({"v1": {}, "v2": {"delta": 3}})
+    serving = ServingCluster.run(None, 2, registry=reg, model=("m", "v1"),
+                                 worker_env={"JAX_PLATFORMS": "cpu"},
+                                 reservation_timeout=120)
+    try:
+        _warm(serving, probes, 2, model="m")
+        m0 = serving.scheduler.metrics()
+        stop = threading.Event()
+        ledger = {"v1": 0, "v2": 0, "other": 0, "errors": []}
+        llock = threading.Lock()
+
+        def pinger(tid):
+            k = tid
+            while not stop.is_set():
+                j = k % len(probes)
+                p, n = probes[j]
+                k += 4
+                try:
+                    with serving.client() as c:
+                        got = c.generate(p, n, timeout=120,
+                                         model="m").tolist()
+                except Exception as e:
+                    with llock:
+                        ledger["errors"].append(f"{type(e).__name__}: {e}")
+                    continue
+                with llock:
+                    if got == oracle_v1[j]:
+                        ledger["v1"] += 1
+                    elif got == oracle_v2[j]:
+                        ledger["v2"] += 1
+                    else:
+                        ledger["other"] += 1
+
+        threads = [threading.Thread(target=pinger, args=(t,), daemon=True)
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        _settle(serving, "m", "v1")
+        # the rollout IS the hot swap: canary one gang, then 100%
+        ctl = serving.rollout("m", "v2", policy=RolloutPolicy(
+            steps=(50, 100), bake_secs=2.0, min_samples=1,
+            max_e2e_ratio=None, max_error_rate=0.5))
+        swap_state = ctl.state
+        stop.set()
+        for t in threads:
+            t.join(120)
+        m1 = serving.scheduler.metrics()
+        requeued = m1["requeued"] - m0["requeued"]
+        failed = m1["failed"] - m0["failed"]
+        if swap_state != "promoted":
+            raise RuntimeError(f"hot_swap: rollout ended {swap_state} "
+                               f"({ctl.detail}, ledger={ledger})")
+        if requeued or failed or ledger["errors"]:
+            raise RuntimeError(
+                f"hot_swap: the planned swap cost failovers "
+                f"(requeued={requeued} failed={failed} "
+                f"errors={ledger['errors'][:3]}) — zero-loss gate")
+        if ledger["other"]:
+            raise RuntimeError(
+                f"hot_swap: {ledger['other']} request(s) match NEITHER "
+                "version's oracle — the swap window leaked mixed weights")
+        if ledger["v2"] < 1:
+            raise RuntimeError("hot_swap: no request was served by v2 — "
+                               "the swap never took traffic")
+        # post-swap probes: BOTH gangs serve v2 now
+        post = _make_reqs(np.random.default_rng(seed + 9), 4)
+        want = _oracle(3, post)
+        got = _run_load(serving, post, 50.0, rng, model="m")
+        _check_complete(got, "hot_swap probes")
+        if any(r["out"] != w for r, w in zip(got, want)):
+            raise RuntimeError("hot_swap: post-swap probe not v2-exact")
+        versions = serving.scheduler.model_versions("m")
+    finally:
+        serving.shutdown(timeout=300)
+    if set(versions) != {"v2"}:
+        raise RuntimeError(f"hot_swap: fleet ended on {versions}, "
+                           "expected every gang on v2")
+    return {
+        "scenario": "hot_swap",
+        "requests_completed": ledger["v1"] + ledger["v2"],
+        "requeued": requeued, "failed": failed,
+        "served_by_v1_exact": ledger["v1"],
+        "served_by_v2_exact": ledger["v2"],
+        "post_swap_probe_v2_exact": True,
+        "zero_loss": True,
+    }
+
+
+def _settle(serving, model, version, bound=0.6, timeout=180):
+    """Wait until a clean 2 s window of the incumbent's traffic decodes
+    fast: the first load waves pay prompt-bucket/group XLA compiles
+    whose multi-second completions would pollute a rollout's pre-canary
+    latency baseline (warm-up compiles stay OUT of measured windows)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        b0 = serving.scheduler.model_version_stats(model)
+        time.sleep(2.0)
+        w = (serving.scheduler.model_version_stats(model, base=b0)
+             .get(version) or {})
+        if (w.get("e2e") or {}).get("count", 0) >= 4 \
+                and w["e2e"]["p95_secs"] < bound:
+            return
+
+
+def canary_rollback_scenario(n_requests, rate, smoke=False, seed=0):
+    """A live regression the offline eval could not see: v2 carries an
+    injected per-step delay; the rollout gate catches it and rolls
+    back automatically.  The load is CLOSED-loop for the rollout's
+    whole life (N worker threads cycling a probe pool), so the gate is
+    guaranteed canary samples in every bake window."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.serving import RolloutPolicy, ServingCluster
+
+    rng = np.random.default_rng(seed)
+    probes = _make_reqs(rng, 8, blo=6, bhi=9)
+    oracle_v1 = _oracle(None, probes)
+
+    # v2: SAME params + a 120 ms/step delay — outputs stay v1-exact,
+    # so the exactness ledger also covers canary-served requests; only
+    # latency regresses
+    reg = _registry({"v1": {},
+                     "v2": {"serve_args":
+                            {"serve_step_delay": 0.12}}})
+    serving = ServingCluster.run(None, 2, registry=reg, model=("m", "v1"),
+                                 worker_env={"JAX_PLATFORMS": "cpu"},
+                                 reservation_timeout=120)
+    try:
+        _warm(serving, probes, 2, model="m")
+        stop = threading.Event()
+        ledger = {"ok": 0, "mismatch": 0, "errors": []}
+        llock = threading.Lock()
+
+        def pinger(tid):
+            k = tid
+            while not stop.is_set():
+                p, n = probes[k % len(probes)]
+                want = oracle_v1[k % len(probes)]
+                k += 6
+                try:
+                    with serving.client() as c:
+                        got = c.generate(p, n, timeout=120,
+                                         model="m").tolist()
+                except Exception as e:
+                    with llock:
+                        ledger["errors"].append(f"{type(e).__name__}: {e}")
+                    continue
+                with llock:
+                    ledger["ok" if got == want else "mismatch"] += 1
+
+        threads = [threading.Thread(target=pinger, args=(t,), daemon=True)
+                   for t in range(6)]
+        for t in threads:
+            t.start()
+        _settle(serving, "m", "v1")
+        ctl = serving.rollout("m", "v2", policy=RolloutPolicy(
+            steps=(50, 100), bake_secs=4.0,
+            min_samples=2 if smoke else 4,
+            max_e2e_ratio=1.6, max_error_rate=0.05))
+        stop.set()
+        for t in threads:
+            t.join(120)
+        if ctl.state != "rolled_back":
+            raise RuntimeError(
+                f"canary_rollback: the injected regression was NOT "
+                f"caught (state={ctl.state}, detail={ctl.detail}, "
+                f"steps={ctl.steps_taken}, ledger={ledger})")
+        if reg.version("m", "v2").state != "rolled_back":
+            raise RuntimeError("canary_rollback: registry state not "
+                               "rolled_back")
+        if ledger["errors"]:
+            raise RuntimeError(
+                f"canary_rollback: {len(ledger['errors'])} request(s) "
+                f"failed across the rollout (zero-loss gate): "
+                f"{ledger['errors'][:3]}")
+        if ledger["mismatch"]:
+            raise RuntimeError(
+                f"canary_rollback: {ledger['mismatch']} request(s) "
+                "diverged from the v1 oracle")
+        if ledger["ok"] < 4:
+            raise RuntimeError(
+                f"canary_rollback: only {ledger['ok']} requests "
+                "completed — the load never exercised the canary")
+        # the old version never stopped serving: post-rollback probes
+        # are v1-exact on every gang
+        post = _make_reqs(np.random.default_rng(seed + 5), 4)
+        want = _oracle(None, post)
+        got = _run_load(serving, post, 50.0, rng, model="m")
+        _check_complete(got, "rollback probes")
+        if any(r["out"] != w for r, w in zip(got, want)):
+            raise RuntimeError("canary_rollback: post-rollback probe "
+                               "not v1-exact")
+        versions = serving.scheduler.model_versions("m")
+        if set(versions) != {"v1"}:
+            raise RuntimeError(
+                f"canary_rollback: fleet ended on {versions}, expected "
+                "every gang back on v1")
+        events = [e for e in (ctl.steps_taken or []) if not e["ok"]]
+    finally:
+        serving.shutdown(timeout=300)
+    return {
+        "scenario": "canary_rollback",
+        "requests_completed": ledger["ok"],
+        "state": "rolled_back",
+        "gate_reason": ctl.detail.get("reason"),
+        "gate_detail": {k: v for k, v in ctl.detail.items()
+                        if k in ("canary", "stable")},
+        "failed_step": events[0]["percent"] if events else None,
+        "all_completed_v1_exact": True,
+        "old_version_still_serving": True,
+    }
+
+
+def standby_rearm_scenario(seed=0):
+    """Two models + ONE shared warm standby; killing model b's only
+    gang must promote the standby RE-ARMED for model b."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.serving import ModelRegistry, ServingCluster
+
+    rng = np.random.default_rng(seed)
+    reqs_b = _make_reqs(rng, 8, blo=10, bhi=14)
+    oracle_b = _oracle(7, reqs_b)
+
+    reg = ModelRegistry()
+    reg.register("a", "v1", bench_model_builder)
+    reg.register("b", "v1", base=bench_model_builder,
+                 adapter=version_delta(7))
+    reg.record_eval("b", "v1", {}, passed=True)
+    # boot: gang 0 = model a; standby fills next (eid 1); model b
+    # deploys after (eid 2) — the chaos plan kills eid 2 mid-decode
+    serving = ServingCluster.run(
+        None, 1, registry=reg, model=("a", "v1"), warm_standbys=1,
+        worker_env={"JAX_PLATFORMS": "cpu",
+                    "TFOS_CHAOS": "kill node=2 at_step=6"},
+        reservation_timeout=180)
+    try:
+        if not serving.wait_standbys(timeout=300):
+            raise RuntimeError("standby never reached warm phase")
+        b_eids = serving.deploy_model("b", "v1", replicas=1)
+        _warm(serving, reqs_b, 1, model="a")
+        # model b's traffic drives the chaos step counter; the kill
+        # lands mid-stream and the heal must promote WITH model b
+        records = _run_load(serving, reqs_b, 4.0, rng, model="b")
+        _check_complete(records, "standby_rearm[b]")
+        for i, r in enumerate(records):
+            if r["out"] != oracle_b[i]:
+                raise RuntimeError(
+                    f"standby_rearm: model b request {i} not oracle-"
+                    "exact across the promotion heal")
+        deadline = time.monotonic() + 60
+        m = serving.metrics()
+        while time.monotonic() < deadline:
+            m = serving.metrics()
+            if m["standby"]["promotions"].get("model:b"):
+                break
+            time.sleep(0.5)
+        promos = m["standby"]["promotions"]
+        if not promos.get("failure") or not promos.get("model:b"):
+            raise RuntimeError(
+                f"standby_rearm: no model-b promotion recorded "
+                f"(promotions={promos})")
+        # model a is untouched and still serving
+        probe = _make_reqs(np.random.default_rng(seed + 3), 2)
+        want_a = _oracle(None, probe)
+        got = _run_load(serving, probe, 50.0, rng, model="a")
+        _check_complete(got, "standby_rearm[a]")
+        if any(r["out"] != w for r, w in zip(got, want_a)):
+            raise RuntimeError("standby_rearm: model a probe diverged")
+        b_hosting = serving.scheduler.model_versions("b")
+        if not b_hosting.get("v1"):
+            raise RuntimeError("standby_rearm: model b has no hosting "
+                               "gang after the heal")
+        requeued = serving.scheduler.metrics()["requeued"]
+    finally:
+        serving.shutdown(timeout=300)
+    return {
+        "scenario": "standby_rearm",
+        "killed_gang": b_eids[0],
+        "requests_b": len(reqs_b),
+        "b_oracle_exact_across_heal": True,
+        "a_unaffected": True,
+        "promotions": promos,
+        "requeued": requeued,
+        "zero_loss": True,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=24,
+                    help="requests per model/scenario (full mode)")
+    ap.add_argument("--rate", type=float, default=6.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny sizes, multi_model + canary_rollback "
+                         "only; writes rollout_serving_smoke.json")
+    args = ap.parse_args()
+
+    rows = []
+    if args.smoke:
+        rows.append(multi_model_scenario(6, args.rate, smoke=True))
+        rows.append(canary_rollback_scenario(10, args.rate, smoke=True))
+    else:
+        rows.append(multi_model_scenario(args.requests // 2, args.rate))
+        rows.append(hot_swap_scenario(args.requests, args.rate))
+        rows.append(canary_rollback_scenario(args.requests, args.rate))
+        rows.append(standby_rearm_scenario())
+
+    artifact = {
+        "benchmark": "rollout_serving",
+        "smoke": bool(args.smoke),
+        "config": {"requests": args.requests, "rate": args.rate,
+                   "model": {"vocab": VOCAB, "platform": "cpu"}},
+        "rows": rows,
+    }
+    out_dir = os.path.join(REPO, "bench_artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    name = ("rollout_serving_smoke.json" if args.smoke
+            else "rollout_serving.json")
+    path = os.path.join(out_dir, name)
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"\nwrote {path}")
+    for row in rows:
+        print(json.dumps(row, indent=1))
+
+
+if __name__ == "__main__":
+    main()

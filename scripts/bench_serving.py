@@ -1539,8 +1539,40 @@ def main():
                          "artifact schema validation (the ci.sh "
                          "--bench-smoke gates; prefix speed gates are "
                          "advisory in smoke)")
+    ap.add_argument("--multi-model", action="store_true",
+                    help="run the multi-model dispatch row instead: two "
+                         "models hosted on one tier (per-model oracle-"
+                         "exact routing + throughput vs a single-model "
+                         "baseline); writes bench_artifacts/"
+                         "serving_multimodel.json.  The full rollout "
+                         "suite (hot swap / canary rollback / standby "
+                         "re-arm) lives in scripts/bench_rollout.py")
     args = ap.parse_args()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.multi_model:
+        # the scenario (and its gates) live beside the other rollout
+        # rows; this flag just gives the serving bench its dispatch row
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from bench_rollout import multi_model_scenario
+
+        row = multi_model_scenario(max(4, args.requests // 4), args.rate,
+                                   smoke=args.smoke)
+        artifact = {"benchmark": "serving_multimodel",
+                    "smoke": bool(args.smoke),
+                    "config": {"requests": args.requests,
+                               "rate": args.rate},
+                    "rows": [row]}
+        # --smoke writes its own file, never the committed full artifact
+        out = os.path.join(REPO, "bench_artifacts",
+                           "serving_multimodel_smoke.json" if args.smoke
+                           else "serving_multimodel.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"wrote {out}")
+        print(json.dumps(row, indent=1))
+        return
 
     if args.disagg:
         if args.smoke:

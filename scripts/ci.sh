@@ -103,6 +103,17 @@ if [ "${1:-}" = "--bench-smoke" ]; then
         echo "warm-standby heal bench smoke FAILED (rc=$rc)" >&2
         exit $rc
     fi
+    echo "== bench smoke (multi-model rollout) =="
+    # 2 models on one tier (per-model oracle-exact routing + throughput
+    # floor) and a forced canary regression auto-rolled back by the
+    # metrics gate; writes rollout_serving_smoke.json (never the
+    # committed full artifact)
+    JAX_PLATFORMS=cpu python scripts/bench_rollout.py --smoke
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "rollout bench smoke FAILED (rc=$rc)" >&2
+        exit $rc
+    fi
     exit 0
 fi
 

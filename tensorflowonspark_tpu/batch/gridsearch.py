@@ -89,3 +89,13 @@ class GridSearch:
         """One trial's merged output records, manifest order."""
         return read_results(self.output_dir, self.trial_manifest(trial_id),
                             decode=decode)
+
+    def score(self, scorer, decode: bool = False) -> dict:
+        """``{trial_id: scorer(results)}`` over every trial's merged
+        output — the offline-eval surface the serving registry's
+        promotion gate consumes
+        (:meth:`~tensorflowonspark_tpu.serving.rollout.ModelRegistry.
+        evaluate_grid` scores one trial; this scores them all, e.g. to
+        pick the winning candidate before registering it)."""
+        return {tid: scorer(self.trial_results(tid, decode=decode))
+                for tid in self.trials}

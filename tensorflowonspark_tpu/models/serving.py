@@ -268,6 +268,11 @@ class ContinuousBatcher:
         # prefill runs single-row, where per-row == scalar semantics; one
         # cfg keeps the two paths' traces structurally identical
         self.params = params
+        #: the compiled executables are keyed on this tree's structure +
+        #: leaf shapes/dtypes; load_params validates every later tree
+        #: against it (a hot-swapped or cloned version with a different
+        #: architecture must bounce, not silently crash a dispatch)
+        self._params_struct = self._struct_of(params)
         self.max_batch = int(max_batch)
         self.eos_id = eos_id
         self.model = GPT(self.cfg, decode=True)
@@ -404,12 +409,25 @@ class ContinuousBatcher:
                 f"(load={self.load()})")
         self.params = None
 
+    @staticmethod
+    def _struct_of(params) -> tuple:
+        """``(treedef, [(shape, dtype)])`` signature of a parameter
+        tree — what the compiled executables are keyed on."""
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        return (treedef,
+                [(tuple(np.shape(x)), str(getattr(x, "dtype", "?")))
+                 for x in leaves])
+
     def load_params(self, params) -> None:
         """(Re)arm the batcher with a parameter tree of the SAME
-        structure/shapes it compiled against — a peer-cloned or
-        checkpoint-restored replica state.  The compiled dispatches are
-        reused as-is, so the cost is the weight transfer, not a
-        recompile.  Dense-row KV state from before the swap is dead
+        structure/shapes it compiled against — a peer-cloned,
+        checkpoint-restored, or hot-swapped model version.  The
+        compiled dispatches are reused as-is, so the cost is the weight
+        transfer, not a recompile; a tree whose structure or leaf
+        shapes/dtypes differ from the compiled ones raises
+        ``ValueError`` (the multi-model hot-swap path turns this into a
+        typed ``model_swap_failed`` instead of a poisoned dispatch).
+        Dense-row KV state from before the swap is dead
         (every admission prefills its own rows from scratch), and the
         paged pool's PREFIX INDEX is rebuilt empty — cached pages hold
         KV computed under the OLD weights, and a post-swap prefix hit
@@ -417,6 +435,22 @@ class ContinuousBatcher:
         tree differs (e.g. a later-checkpoint restore)."""
         if params is None:
             raise ValueError("load_params needs a parameter tree")
+        treedef, leaves = self._struct_of(params)
+        want_def, want_leaves = self._params_struct
+        if treedef != want_def:
+            raise ValueError(
+                "load_params: parameter tree structure differs from the "
+                "one this batcher compiled against (another "
+                "architecture/version?) — rebuild the batcher instead")
+        bad = [i for i, (got, want) in enumerate(zip(leaves, want_leaves))
+               if got != want]
+        if bad:
+            raise ValueError(
+                f"load_params: {len(bad)} leaf(s) differ in shape/dtype "
+                f"from the compiled tree (first: leaf {bad[0]} got "
+                f"{leaves[bad[0]]}, want {want_leaves[bad[0]]}) — an "
+                "incompatible model version cannot reuse these "
+                "executables")
         if self._pages is not None:
             # idle by the unload_params contract: every page is free or
             # parked in the (now-stale) prefix cache — a fresh pool of

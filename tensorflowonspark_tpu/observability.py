@@ -275,6 +275,33 @@ class LatencyHistogram:
         snap = sorted(self._samples)
         return self._rank(snap, q) if snap else None
 
+    def window_summary(self, since_count: int) -> dict:
+        """:meth:`summary` restricted to the samples recorded AFTER the
+        first ``since_count`` — the canary-gate window: a baseline
+        snapshot's total ``count`` feeds back in, so the gate compares
+        bake-window latencies and is never biased by history the other
+        side doesn't share (warm-up compiles in the incumbent's
+        cumulative percentiles were exactly that bias).  Exact while
+        the reservoir has not wrapped (total <= cap — the gate-scale
+        case); after a wrap the retained recent ring is the best
+        available approximation of the window."""
+        total = len(self)
+        n = max(0, total - max(0, int(since_count)))
+        snap = list(self._samples)
+        if n and total <= len(snap):
+            # no wrap yet: the list is still in append order
+            snap = snap[total - n:]
+        if n == 0 or not snap:
+            return {"count": 0, "mean_secs": None, "p50_secs": None,
+                    "p95_secs": None, "p99_secs": None, "max_secs": None}
+        snap.sort()
+        return {"count": n,
+                "mean_secs": sum(snap) / len(snap),
+                "p50_secs": self._rank(snap, 50),
+                "p95_secs": self._rank(snap, 95),
+                "p99_secs": self._rank(snap, 99),
+                "max_secs": snap[-1]}
+
     def summary(self) -> dict:
         """``{count, mean_secs, p50_secs, p95_secs, p99_secs, max_secs}``
         (None-valued stats when no sample was recorded).  ``count`` is

@@ -23,9 +23,13 @@ map_fun, step barriers), ``disagg`` (disaggregated prefill/decode pools:
 role arithmetic + the pool map_fun; sessions move as KV-page transfers),
 ``standby`` (warm-standby gangs: pre-compiled spare replicas + the
 driver pool that heal paths promote instead of cold-spawning — cloning
-prefix-cache pages alongside weights), ``frontend`` (TCP edge +
+prefix-cache pages alongside weights, re-armed per model at promotion),
+``rollout`` (multi-model hosting: ``ModelRegistry`` catalog with the
+GridSearch offline-eval gate, and ``RolloutController`` — canary traffic
+shifting with metrics-gated auto-rollback), ``frontend`` (TCP edge +
 ``ServingCluster`` composition: ``add_replicas``/``retire_replica``/
-``scale_up``/drain-and-replace, whole-gang, per-pool autoscaling),
+``scale_up``/``deploy_model``/``swap_replica_model``/``rollout``/
+drain-and-replace, whole-gang, per-pool autoscaling),
 ``autoscaler`` (metrics-driven membership control, device-weighted,
 role-filterable, promotes standbys first), ``client`` (``ServeClient``).
 Architecture, backpressure semantics, the failure model, and the
@@ -40,6 +44,12 @@ from tensorflowonspark_tpu.serving.disagg import \
 from tensorflowonspark_tpu.serving.frontend import (ServeFrontend,  # noqa: F401
                                                     ServingCluster)
 from tensorflowonspark_tpu.serving.replica import serve_replica  # noqa: F401
+from tensorflowonspark_tpu.serving.rollout import (ModelRegistry,  # noqa: F401
+                                                   ModelVersion,
+                                                   RolloutController,
+                                                   RolloutError,
+                                                   RolloutPolicy,
+                                                   apply_adapter)
 from tensorflowonspark_tpu.serving.sharded import (GangShardLost,  # noqa: F401
                                                    GangSpec,
                                                    serve_sharded_replica)

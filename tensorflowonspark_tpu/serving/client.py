@@ -48,7 +48,7 @@ from tensorflowonspark_tpu.serving.scheduler import (DeadlineExceeded,
 logger = logging.getLogger(__name__)
 
 _REJECT_REASONS = ("queue_full", "tenant_throttled", "shutdown",
-                   "no_replica")
+                   "no_replica", "role_mismatch", "unknown_model")
 
 
 def _raise_typed(reason: str, message: str):
@@ -72,12 +72,15 @@ class ServeClient(MessageSocket):
 
     def __init__(self, addr: tuple[str, int], authkey: bytes,
                  timeout: float = 600.0, tenant: str | None = None,
-                 priority: str | None = None):
+                 priority: str | None = None, model: str | None = None):
         self.addr = tuple(addr)
         self._authkey = bytes(authkey)
         self._timeout = float(timeout)
         self.tenant = tenant
         self.priority = priority
+        #: default ``model`` for every request (multi-model tiers;
+        #: per-call override) — None = the tier's default model
+        self.model = model
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
         self._connect()
@@ -96,7 +99,7 @@ class ServeClient(MessageSocket):
 
     # -- requests ----------------------------------------------------------
     def _gen_msg(self, prompt, max_new_tokens, temperature, top_p, seed,
-                 stream, timeout, trace, tenant, priority):
+                 stream, timeout, trace, tenant, priority, model=None):
         return {"op": "generate",
                 "prompt": np.asarray(prompt, np.int32).reshape(-1),
                 "max_new_tokens": int(max_new_tokens),
@@ -105,7 +108,8 @@ class ServeClient(MessageSocket):
                 "timeout": timeout, "trace": trace,
                 "tenant": tenant if tenant is not None else self.tenant,
                 "priority": (priority if priority is not None
-                             else self.priority)}
+                             else self.priority),
+                "model": model if model is not None else self.model}
 
     def _request_first(self, msg):
         """Send ``msg`` and return its FIRST response frame, reconnecting
@@ -137,19 +141,23 @@ class ServeClient(MessageSocket):
                  temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
                  timeout: float | None = None, trace: str | None = None,
                  tenant: str | None = None,
-                 priority: str | None = None) -> np.ndarray:
+                 priority: str | None = None,
+                 model: str | None = None) -> np.ndarray:
         """Generate to completion; returns the token array (prompt
         excluded).  ``timeout`` is the end-to-end deadline (queue wait
         included); greedy (default) output is exact vs a solo
         ``greedy_generate`` run.  ``trace`` propagates a caller-chosen
         trace id through the tier's telemetry (``tracing.new_trace_id()``;
-        the frontend mints one otherwise).  ``tenant``/``priority``
-        override the client-level defaults for this request."""
+        the frontend mints one otherwise).  ``tenant``/``priority``/
+        ``model`` override the client-level defaults for this request
+        (``model`` selects the hosted model on a multi-model tier —
+        an unhosted name raises typed
+        ``RequestRejected(reason="unknown_model")``)."""
         with self._lock:
             frame = self._request_first(self._gen_msg(
                 prompt, max_new_tokens, temperature, top_p, seed,
                 stream=False, timeout=timeout, trace=trace,
-                tenant=tenant, priority=priority))
+                tenant=tenant, priority=priority, model=model))
             while True:
                 kind = frame[0]
                 if kind == "DONE":
@@ -163,7 +171,8 @@ class ServeClient(MessageSocket):
                         temperature: float = 0.0, top_p: float = 1.0,
                         seed: int = 0, timeout: float | None = None,
                         trace: str | None = None, tenant: str | None = None,
-                        priority: str | None = None):
+                        priority: str | None = None,
+                        model: str | None = None):
         """Yield token deltas (lists of ints) as the replica commits them;
         exact concatenation == :meth:`generate`'s output.  Consume the
         iterator fully (or ``close()`` the client): abandoning it
@@ -172,7 +181,7 @@ class ServeClient(MessageSocket):
             frame = self._request_first(self._gen_msg(
                 prompt, max_new_tokens, temperature, top_p, seed,
                 stream=True, timeout=timeout, trace=trace,
-                tenant=tenant, priority=priority))
+                tenant=tenant, priority=priority, model=model))
             try:
                 while True:
                     kind = frame[0]

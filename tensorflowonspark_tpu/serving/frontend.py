@@ -188,7 +188,8 @@ class ServeFrontend(MessageSocket):
                 seed=int(msg.get("seed", 0)), timeout=timeout,
                 trace=msg.get("trace"),
                 tenant=str(msg.get("tenant") or "default"),
-                priority=msg.get("priority"))
+                priority=msg.get("priority"),
+                model=msg.get("model"))
         except (RequestRejected, ServingError) as e:
             self.send(conn, ("ERR", getattr(e, "reason", "rejected"), str(e)))
             return
@@ -247,6 +248,16 @@ class ServingCluster:
         #: per-pool autoscalers of a disaggregated tier (one per role,
         #: independent signals/bounds/cooldowns); empty otherwise
         self.autoscalers: list = []
+        #: the :class:`~tensorflowonspark_tpu.serving.rollout.
+        #: ModelRegistry` of a multi-model tier (``run(registry=)``),
+        #: else None — ``deploy_model``/``swap_replica_model``/
+        #: ``rollout`` resolve version payloads through it
+        self.registry = None
+        #: the founding ``(model_id, version)`` label (``run(model=)``):
+        #: model-less spawns on a multi-model tier (the autoscaler's
+        #: ``scale_up(n)``) inherit it — an UNLABELED replica would
+        #: match every model's routing while serving only these weights
+        self._default_model: tuple | None = None
         #: the normalized ``disagg=`` spec when this tier runs
         #: specialized prefill/decode pools, else None
         self.disagg = None
@@ -302,6 +313,7 @@ class ServingCluster:
             gang_size: int | None = None, shard_params=None,
             warm_standbys: int = 0, standby_clone: bool = True,
             compile_cache=None, disagg: dict | None = None,
+            model: tuple | None = None, registry=None,
             **cluster_kwargs) -> "ServingCluster":
         """Boot ``num_replicas`` serving workers and the driver-side tier.
 
@@ -384,6 +396,33 @@ class ServingCluster:
             "serve_eos_id": eos_id,
             "serve_batcher_kwargs": dict(batcher_kwargs or {}),
         })
+        if model is not None:
+            # multi-model tier (docs/serving.md "Multi-model serving &
+            # live rollout"): the founding replicas are labeled with the
+            # (model_id, version) they serve; with a registry the
+            # version's registered builder + serve_args overlay applies
+            # (an explicit model_builder wins), and the incumbent needs
+            # no eval gate — it IS the baseline later versions gate
+            # against
+            model = (str(model[0]), str(model[1]))
+            if registry is not None:
+                if model_builder is not None:
+                    # ONE source of truth: every later payload path
+                    # (deploy/heal/promote/swap) ships the REGISTRY
+                    # entry's builder — a second, different founding
+                    # builder here would resurface on the first heal or
+                    # rollback as silently different weights under the
+                    # same label
+                    raise ValueError(
+                        "ambiguous founding builder: the registered "
+                        f"{model[0]}@{model[1]} entry is the builder of "
+                        "record — pass model_builder=None (register "
+                        "your builder in the entry instead)")
+                args.update(registry.version(*model).serve_args())
+        if args.get("serve_model_builder") is None:
+            raise ValueError(
+                "no model builder: pass model_builder=, or registry= + "
+                "model= naming a registered version")
         if compile_cache is not None:
             args["serve_compile_cache"] = compile_cache
         if warm_standbys < 0:
@@ -453,7 +492,7 @@ class ServingCluster:
                 tenants=tenants,
                 gang_size=1 if gang is None else gang.gang_size,
                 capacity_weight=1 if gang is None else gang.devices,
-                roles=roles)
+                roles=roles, model=model)
             if monitor:
                 mon = ClusterMonitor(
                     cluster, hang_timeout=hang_timeout,
@@ -469,6 +508,10 @@ class ServingCluster:
             tier = cls(cluster, scheduler, mon, frontend, address)
             tier.gang_spec = gang
             tier.disagg = disagg
+            tier.registry = registry
+            tier._default_model = model
+            if registry is not None and model is not None:
+                registry.mark(*model, "serving")
             tier._replace_preempted = bool(replace_preempted)
             tier._replace_failed = bool(replace_failed)
             if warm_standbys or replace_failed or replace_preempted:
@@ -565,7 +608,8 @@ class ServingCluster:
 
     # ----------------------------------------------------- live membership
     def add_replicas(self, n: int = 1, timeout: float | None = None,
-                     role: str | None = None) -> list[int]:
+                     role: str | None = None,
+                     model: tuple | None = None) -> list[int]:
         """Grow the tier by ``n`` replicas, live: the cluster re-opens
         its reservation path and spawns fresh serving workers (same
         model builder/args the tier booted with), the scheduler
@@ -575,8 +619,11 @@ class ServingCluster:
         workers, one routable endpoint).  A disaggregated tier grows
         one POOL at a time: ``role`` ("prefill" | "decode") pins the
         newcomers' specialization (mandatory — eid arithmetic cannot
-        classify late joiners).  Returns the new replicas' leader
-        executor ids."""
+        classify late joiners).  ``model`` spawns the newcomers with
+        that registered ``(model_id, version)``'s builder/args and
+        labels them for model-routed dispatch (multi-model tiers;
+        re-armed heals pass the dead gang's own model).  Returns the
+        new replicas' leader executor ids."""
         if self._shutdown_done:
             raise RuntimeError("serving tier is shut down")
         if (role is not None) != (self.disagg is not None):
@@ -584,14 +631,41 @@ class ServingCluster:
                 "add_replicas(role=) and a disagg tier go together: "
                 f"role={role!r} on a tier with disagg={self.disagg!r}")
         gsz = 1 if self.gang_spec is None else self.gang_spec.gang_size
+        if model is None:
+            # a model-less spawn on a labeled tier (the autoscaler's
+            # scale path) serves the FOUNDING builder — label it so, or
+            # the unlabeled newcomer would match EVERY model's routing
+            # while holding only the founding weights
+            model = self._default_model
+        tf_args = None
+        if model is not None:
+            model = (str(model[0]), str(model[1]))
+            if model != self._default_model:
+                if self.registry is None:
+                    # no registry = no builder for another model: the
+                    # newcomer would carry the FOUNDING weights under
+                    # this label and serve the wrong model silently
+                    raise ValueError(
+                        f"add_replicas(model={model!r}) needs a "
+                        "ModelRegistry (ServingCluster.run(registry=)) "
+                        "— without one the spawn would serve the "
+                        "founding weights under this label")
+                tf_args = dict(self._serve_args)
+                tf_args.update(
+                    self.registry.version(*model).serve_args())
+            # founding version: the stored boot payload IS its builder/
+            # args (run()'s explicit model_builder wins over a registry
+            # entry there, and must keep winning on heals/scale-ups)
         spawn_kwargs = {}
         if role is not None:
             from tensorflowonspark_tpu.serving.disagg import \
                 serve_disagg_replica
 
             spawn_kwargs = {"map_fun": serve_disagg_replica,
-                            "tf_args": dict(self._serve_args,
+                            "tf_args": dict(tf_args or self._serve_args,
                                             serve_role=role)}
+        elif tf_args is not None:
+            spawn_kwargs = {"tf_args": tf_args}
         with self._membership_lock:
             added = self.cluster.add_workers(n * gsz, timeout=timeout,
                                              **spawn_kwargs)
@@ -601,16 +675,29 @@ class ServingCluster:
                 self.scheduler.add_replica(
                     block[0],
                     members=tuple(int(b["executor_id"])
-                                  for b in block[1:]), role=role)
+                                  for b in block[1:]), role=role,
+                    model=model)
                 leaders.append(int(block[0]["executor_id"]))
-        logger.info("serving tier grew by %d replica(s): %s%s%s", n,
+        if role == "decode" and self.gang_spec is None:
+            # prefix-page donation (docs/serving.md): a fresh decode
+            # gang starts with an EMPTY prefix index — pre-warm it from
+            # a prefill pool's cache so its first adopts hit instead of
+            # importing page data the fleet already holds
+            for eid in leaders:
+                threading.Thread(target=self.donate_prefix_pages,
+                                 args=(eid,),
+                                 name=f"prefix-donate-{eid}",
+                                 daemon=True).start()
+        logger.info("serving tier grew by %d replica(s): %s%s%s%s", n,
                     leaders, f" (gangs of {gsz})" if gsz > 1 else "",
-                    f" (role {role})" if role else "")
+                    f" (role {role})" if role else "",
+                    f" (model {model[0]}@{model[1]})" if model else "")
         return leaders
 
     def scale_up(self, n: int = 1, timeout: float | None = None,
                  source: str = "scale_up",
-                 role: str | None = None) -> list[int]:
+                 role: str | None = None,
+                 model: tuple | None = None) -> list[int]:
         """Grow the tier by ``n`` replicas, consuming the warm-standby
         pool FIRST (promotion: control message + weight clone, capacity
         restored in well under a cold boot) and cold-spawning only the
@@ -623,18 +710,19 @@ class ServingCluster:
         replicas' leader executor ids."""
         added: list[int] = []
         for _ in range(int(n)):
-            eid = self.promote_standby(source, role=role)
+            eid = self.promote_standby(source, role=role, model=model)
             if eid is None:
                 break
             added.append(eid)
         remaining = int(n) - len(added)
         if remaining:
             added.extend(self.add_replicas(remaining, timeout=timeout,
-                                           role=role))
+                                           role=role, model=model))
         return added
 
     def promote_standby(self, source: str = "scale_up",
-                        role: str | None = None) -> int | None:
+                        role: str | None = None,
+                        model: tuple | None = None) -> int | None:
         """Promote one warm standby into a routable replica: pop it from
         the pool (atomic — a concurrent failure + scale decision can
         never double-promote the same standby), send it the promote
@@ -644,8 +732,13 @@ class ServingCluster:
         ``role`` is mandatory (per-role pool accounting: the scheduler
         registers the newcomer into the named prefill/decode pool, and
         the promote message tells the standby which specialization to
-        arm).  Returns the promoted leader's executor id, or None when
-        the pool is empty/absent (callers fall back to a cold spawn)."""
+        arm).  On a multi-model tier ``model`` RE-ARMS the standby for
+        that ``(model_id, version)``: one shared spare pool backs every
+        hosted model, the promote message carries the version's builder
+        payload, and the clone peer is restricted to replicas serving
+        that exact version.  Returns the promoted leader's executor id,
+        or None when the pool is empty/absent (callers fall back to a
+        cold spawn)."""
         pool = self.standbys
         if pool is None or self._shutdown_done:
             return None
@@ -658,11 +751,22 @@ class ServingCluster:
                            "disagg=%r: skipping warm pool", role,
                            self.disagg)
             return None
+        if model is None:
+            # like add_replicas: a model-less promotion on a labeled
+            # tier re-arms the FOUNDING version (the promoted standby
+            # restores through the founding builder)
+            model = self._default_model
+        payload: dict = {}
+        if model is not None:
+            model = (str(model[0]), str(model[1]))
+            payload = {"model": model[0], "version": model[1]}
+            if self.registry is not None:
+                payload.update(self.registry.version(*model).swap_payload())
         got = pool.acquire()
         if got is None:
             return None
         eid, entry = got
-        peer = (self.scheduler.peer_replica_info()
+        peer = (self.scheduler.peer_replica_info(model=model)
                 if self._standby_clone else None)
         ready = threading.Event()
         with self._promotions_lock:
@@ -674,7 +778,7 @@ class ServingCluster:
         try:
             self.scheduler.add_replica(entry["info"],
                                        members=entry["members"],
-                                       role=role)
+                                       role=role, model=model)
         except Exception:
             # scheduler stopping / registration guard: the caller
             # cold-spawns instead; the pool backfills
@@ -690,7 +794,7 @@ class ServingCluster:
             self.cluster._client_for(eid).put(
                 REQUEST_QUEUE,
                 {"op": "standby", "event": "promote", "source": source,
-                 "peer": peer, "role": role}, timeout=10)
+                 "peer": peer, "role": role, **payload}, timeout=10)
         except Exception:
             # the standby died under us: roll the registration back as
             # a planned departure (anything already routed re-queues
@@ -708,13 +812,30 @@ class ServingCluster:
             if role is not None:
                 key = f"role:{role}"      # per-role pool accounting
                 self._promoted[key] = self._promoted.get(key, 0) + 1
+            if model is not None:
+                key = f"model:{model[0]}"  # per-model pool accounting:
+                # the shared spare fleet's re-arm ledger
+                self._promoted[key] = self._promoted.get(key, 0) + 1
         self._m_promotions.inc(source=source)
         self.scheduler.emit_event(
             "standby_promoted", replica=eid, source=source, role=role,
+            model=None if model is None else model[0],
+            version=None if model is None else model[1],
             peer=None if peer is None else int(peer["executor_id"]))
-        logger.info("promoted warm standby %d (source=%s%s, clone peer %s)",
+        logger.info("promoted warm standby %d (source=%s%s%s, "
+                    "clone peer %s)",
                     eid, source, "" if role is None else f", role={role}",
+                    "" if model is None
+                    else f", model={model[0]}@{model[1]}",
                     "none" if peer is None else peer["executor_id"])
+        if role == "decode" and self.gang_spec is None:
+            # prefix-page donation: pre-warm the promoted decode gang's
+            # prefix index from a prefill pool (the peer clone may have
+            # shipped a unified peer's pages; a prefill pool holds the
+            # hottest prompt prefixes)
+            threading.Thread(target=self.donate_prefix_pages, args=(eid,),
+                             name=f"prefix-donate-{eid}",
+                             daemon=True).start()
 
         def _backfill_after_ready():
             # restock AFTER the promotion restores capacity (or a grace
@@ -793,6 +914,184 @@ class ServingCluster:
                 self.cluster._client_for(eid).put(REQUEST_QUEUE,
                                                   EndOfFeed(), timeout=5)
             self.cluster.retire_worker(eid)
+
+    # ------------------- multi-model hosting & live rollout (docs/
+    # serving.md "Multi-model serving & live rollout")
+    def deploy_model(self, model_id: str, version: str, *,
+                     replicas: int = 1, role: str | None = None,
+                     require_eval: bool = True,
+                     timeout: float | None = None) -> list[int]:
+        """Host an additional registered model on this live tier: spawn
+        ``replicas`` fresh gangs built from the version's registry args
+        and route ``model=model_id`` traffic to them.  ``require_eval``
+        (default) enforces the offline-eval gate
+        (:meth:`~tensorflowonspark_tpu.serving.rollout.ModelRegistry.
+        promotable`) — a version that never passed its GridSearch eval
+        does not reach traffic."""
+        if self.registry is None:
+            raise RuntimeError("deploy_model needs a ModelRegistry "
+                               "(ServingCluster.run(registry=))")
+        if self._default_model is None:
+            # an UNLABELED founding fleet matches every model's routing
+            # (accepts_model), so hosting a second model beside it would
+            # let the founding weights serve the new model's traffic
+            raise RuntimeError(
+                "deploy_model needs a model-labeled tier: boot with "
+                "ServingCluster.run(model=(id, version), registry=...) "
+                "so the founding gangs are labeled too")
+        entry = self.registry.version(model_id, version)
+        if require_eval and not self.registry.promotable(model_id,
+                                                         version):
+            raise RuntimeError(
+                f"{model_id}@{version} has not passed its offline eval "
+                "(ModelRegistry.evaluate_grid) — deploy_model("
+                "require_eval=False) overrides")
+        leaders = self.add_replicas(replicas, timeout=timeout, role=role,
+                                    model=entry.key)
+        self.registry.mark(model_id, version, "serving")
+        self.scheduler.emit_event("model_deployed", model=str(model_id),
+                                  version=str(version), replicas=leaders)
+        return leaders
+
+    def swap_replica_model(self, executor_id: int, model_id: str,
+                           version: str,
+                           timeout: float | None = None) -> None:
+        """HOT-SWAP one replica gang to another registered version via
+        the drain verbs — zero requests lost: stop routing to the gang
+        (``mark_draining``), wait out its in-flight streams, ship the
+        version payload over the queue/bulk plane (builder/adapter, or
+        a peer clone when another gang already serves the version), let
+        the replica rebuild params into its already-compiled batcher
+        (``ContinuousBatcher.load_params`` — compiles are NOT re-paid),
+        then resume routing under the new ``(model_id, version)`` label.
+        Raises on drain timeout, swap failure, or a death mid-swap; a
+        failed swap leaves the replica serving its OLD version.  On an
+        ACK TIMEOUT a best-effort cancel drops a swap the replica has
+        not yet applied; one already applied acks late, and the
+        scheduler relabels on that ack — the routing label always
+        tracks the version actually served."""
+        if self.registry is None:
+            raise RuntimeError("swap_replica_model needs a ModelRegistry "
+                               "(ServingCluster.run(registry=))")
+        if self._default_model is None:
+            # same hole deploy_model guards: relabeling one gang beside
+            # an UNLABELED founding fleet would let the founding weights
+            # serve the new model's traffic (unlabeled matches anything)
+            raise RuntimeError(
+                "swap_replica_model needs a model-labeled tier: boot "
+                "with ServingCluster.run(model=(id, version), "
+                "registry=...) so the founding gangs are labeled too")
+        if self.gang_spec is not None:
+            raise ValueError(
+                "in-place model swap supports single-process replicas; "
+                "mesh-sharded gangs swap by retire_replica + "
+                "deploy_model (the shard layout must be rebuilt)")
+        entry = self.registry.version(model_id, version)
+        eid = self.scheduler.resolve_gang(int(executor_id))
+        dt = self._drain_timeout if timeout is None else float(timeout)
+        if not self.scheduler.mark_draining(eid, reason="model_swap"):
+            raise RuntimeError(f"replica {eid} is not routable "
+                               "(unknown/dead/already draining)")
+        ok, err = False, ""
+        try:
+            if not self.scheduler.drain_replica(eid, timeout=dt):
+                err = f"replica {eid} did not drain within {dt:.0f}s"
+            else:
+                token = f"swap-{eid}-{time.monotonic_ns()}"
+                waiter = self.scheduler.expect_swap(eid, token=token)
+                peer = self.scheduler.peer_replica_info(
+                    exclude={eid}, model=entry.key)
+                # the registry entry is the builder of record for
+                # EVERY version (run() rejects a conflicting explicit
+                # model_builder), so the payload always carries it —
+                # no worker-args fallback guessing
+                payload = entry.swap_payload()
+                self.cluster._client_for(eid).put(
+                    REQUEST_QUEUE,
+                    {"op": "model", "event": "swap",
+                     "model": str(model_id), "version": str(version),
+                     "peer": peer, "swap_token": token,
+                     **payload}, timeout=10)
+                # the swap builds/clones + loads a parameter tree: allow
+                # it a model-build's worth of time on top of the drain
+                ok, err = self.scheduler.wait_swap(waiter, dt + 120.0)
+        finally:
+            if not ok:
+                # best-effort cancel: a swap the replica has not applied
+                # yet is dropped; an applied one acks late and the
+                # scheduler relabels (see the worker's cancel handler)
+                with contextlib.suppress(Exception):
+                    self.cluster._client_for(eid).put(
+                        REQUEST_QUEUE,
+                        {"op": "model", "event": "cancel"}, timeout=5)
+                # the replica still serves its old version (or died, in
+                # which case resume is a no-op and death handling owns
+                # the gang)
+                self.scheduler.resume_replica(eid)
+        if not ok:
+            raise RuntimeError(f"model swap of replica {eid} to "
+                               f"{model_id}@{version} failed: {err}")
+        self.registry.mark(model_id, version, "serving")
+
+    def rollout(self, model_id: str, version: str, policy=None,
+                block: bool = True):
+        """Run a live canary rollout of ``model_id`` to ``version``
+        (docs/serving.md): canary one gang, shift traffic by the
+        policy's percent steps, auto-roll back on a metrics regression.
+        ``block=True`` runs synchronously and returns the terminal
+        :class:`~tensorflowonspark_tpu.serving.rollout.
+        RolloutController` (``.state`` is ``promoted`` /
+        ``rolled_back``); ``block=False`` starts it on a background
+        thread (``.wait()`` joins)."""
+        from tensorflowonspark_tpu.serving.rollout import RolloutController
+
+        ctl = RolloutController(self, model_id, version, policy=policy)
+        if block:
+            ctl.run()
+            return ctl
+        return ctl.start()
+
+    def donate_prefix_pages(self, to_replica: int,
+                            from_replica: int | None = None) -> bool:
+        """Prefix-page donation across pools (docs/serving.md): ask a
+        prefill gang to ship its shared prefix-cache pages
+        (``ContinuousBatcher.export_prefix_cache``, content-hashed)
+        straight to ``to_replica``'s queue plane, where the decode
+        gang imports them (``import_prefix_cache``) — so a decode-side
+        prefix miss consults what a prefill pool already computed
+        instead of importing page data the fleet already holds.  The
+        donor defaults to the least-loaded prefill gang serving the
+        SAME (model, version).  Returns False when no eligible donor
+        exists or the tier runs mesh-sharded gangs (host pages would
+        need a resharding pass)."""
+        if self.gang_spec is not None or self._shutdown_done:
+            return False
+        eid = self.scheduler.resolve_gang(int(to_replica))
+        info = self.scheduler.replica_info(eid)
+        if info is None:
+            return False
+        donor = from_replica
+        if donor is None:
+            donor = self.scheduler.prefix_donor(
+                exclude={eid},
+                model=self.scheduler.replica_model_version(eid))
+        if donor is None:
+            return False
+        try:
+            self.cluster._client_for(int(donor)).put(
+                REQUEST_QUEUE,
+                {"op": "prefix", "event": "export",
+                 "reply_addr": tuple(info["addr"]),
+                 "reply_authkey": info["authkey"]}, timeout=10)
+        except Exception:  # tfos: ignore[broad-except] — a donation is
+            # an optimization; a dead/unreachable donor must not fail
+            # the membership path that triggered it
+            logger.exception("prefix-page donation %s -> %s failed",
+                             donor, eid)
+            return False
+        self.scheduler.emit_event("prefix_donation", donor=int(donor),
+                                  to=eid)
+        return True
 
     # ------------------------------------------------ preemption handling
     def _on_phase(self, eid: int, phase: str) -> None:
@@ -892,6 +1191,11 @@ class ServingCluster:
         # when the dead gang was a pool's LAST, its requeued handoffs/
         # prompts must wait for the replacement, not shed as no_replica.
         role = self.scheduler.replica_role(eid)
+        # ... and its MODEL: on a multi-model tier the replacement must
+        # serve the dead gang's own (model_id, version) — a shared spare
+        # fleet re-armed per model at promotion, a cold spawn built from
+        # the version's registry args
+        model = self.scheduler.replica_model_version(eid)
         self.scheduler.expect_replica(role)
 
         def _go():
@@ -902,17 +1206,20 @@ class ServingCluster:
                 # from the (role-less) warm pool too — the promote
                 # message carries the dead gang's role and the standby
                 # specializes on arrival
-                promoted = self.promote_standby(promote_source, role=role)
+                promoted = self.promote_standby(promote_source, role=role,
+                                                model=model)
                 if promoted is not None:
                     self.scheduler.emit_event(
                         "replica_replaced", replica=eid,
                         replacement=promoted, source=source, mode="warm",
-                        role=role)
+                        role=role,
+                        model=None if model is None else model[0])
                     return
-                new = self.add_replicas(1, role=role)
+                new = self.add_replicas(1, role=role, model=model)
                 self.scheduler.emit_event(
                     "replica_replaced", replica=eid, replacement=new[0],
-                    source=source, mode="cold", role=role)
+                    source=source, mode="cold", role=role,
+                    model=None if model is None else model[0])
             except Exception:
                 logger.exception("replacement for lost replica %d "
                                  "failed", eid)
@@ -945,6 +1252,8 @@ class ServingCluster:
             m["standby"] = {**self.standbys.stats(),
                             "promotions": promotions,
                             "heal": self.heal.summary()}
+        if self.registry is not None:
+            m["registry"] = self.registry.summary()
         return m
 
     def metrics_text(self) -> str:

@@ -162,6 +162,91 @@ def serve_clone_request(batcher, item: dict, ctx,
     threading.Thread(target=_send, name="serve-clone", daemon=True).start()
 
 
+def resolve_version_params(args, item):
+    """Build a model-version payload's parameter tree (the hot-swap
+    message / the standby promote payload): the payload's ``builder`` —
+    or ``base_builder`` + ``adapter`` delta for adapter versions — run
+    over this worker's args with the version's ``serve_args`` overlaid
+    (so a builder keying on e.g. ``seed`` sees the version's value).
+    Returns ``(params, version_args)``; the caller loads the params and
+    keeps ``version_args`` as its live arg view."""
+    version_args = dict(args)
+    version_args.update(item.get("serve_args") or {})
+    base = item.get("base_builder")
+    if base is not None:
+        # ONE implementation of base+adapter resolution: map the
+        # payload onto the spawn-path arg keys and delegate
+        from tensorflowonspark_tpu.serving.rollout import \
+            build_registered_model
+
+        version_args["serve_base_builder"] = base
+        version_args["serve_adapter"] = item.get("adapter")
+        _, params = build_registered_model(version_args)
+    else:
+        builder = item.get("builder") or args["serve_model_builder"]
+        _, params = builder(version_args)
+    return params, version_args
+
+
+def _donation_counter():
+    """The one donation-counter family (both the export and import
+    sites record into it; a single definition cannot drift)."""
+    return _metrics.get_registry().counter(
+        "tfos_replica_prefix_donations_total",
+        "Cross-pool prefix-cache page donations by direction.",
+        labelnames=("direction",))
+
+
+def serve_prefix_donation(batcher, item, ctx) -> None:
+    """Source side of cross-pool prefix-page donation: snapshot this
+    (prefill) replica's shared prefix-cache pages and ship them straight
+    to the requesting decode gang's queue plane (zero-copy/bulk
+    negotiated like any tensor payload).  The gather runs HERE, on the
+    serve-loop thread — decode steps donate the cache buffer, so an
+    off-thread gather would read freed device memory; only the send is
+    off-thread."""
+    export = getattr(batcher, "export_prefix_cache", None)
+    pages = None
+    try:
+        if export is not None:
+            pages = export()
+    # tfos: ignore[broad-except] — a donation is an optimization; a
+    # failed snapshot must not kill the serving replica
+    except Exception:
+        logger.exception("replica %d: prefix-cache export for donation "
+                         "failed", ctx.executor_id)
+    if not pages:
+        logger.info("replica %d: nothing to donate (empty/dense prefix "
+                    "cache)", ctx.executor_id)
+        return
+    m_donations = _donation_counter()
+
+    def _send():
+        from tensorflowonspark_tpu.queues import QueueClient
+
+        try:
+            cli = QueueClient(tuple(item["reply_addr"]),
+                              item["reply_authkey"], timeout=60.0)
+            try:
+                cli.put(REQUEST_QUEUE,
+                        {"op": "prefix", "event": "pages", "export": pages,
+                         "src": ctx.executor_id}, timeout=60)
+            finally:
+                cli.close()
+            m_donations.inc(direction="exported")
+            logger.info("replica %d donated %d prefix page(s) to %s",
+                        ctx.executor_id, pages["pages"],
+                        item.get("reply_addr"))
+        # tfos: ignore[broad-except] — the recipient may have died; the
+        # donation just doesn't happen
+        except Exception:
+            logger.exception("replica %d: prefix-page donation failed",
+                             ctx.executor_id)
+
+    threading.Thread(target=_send, name="serve-prefix-donate",
+                     daemon=True).start()
+
+
 def serving_batcher_kwargs(args) -> dict:
     """The ``ContinuousBatcher`` kwargs for this worker's role:
     ``serve_batcher_kwargs`` overlaid with the role's entry from
@@ -197,7 +282,8 @@ def serve_replica(args, ctx) -> None:
 
 
 def run_serve_loop(args, ctx, batcher, *, step_hook=None,
-                   label: str = "replica", role: str | None = None) -> None:
+                   label: str = "replica", role: str | None = None,
+                   base_args: dict | None = None) -> None:
     """THE serving loop (module docstring): intake ⇄ step interleave over
     the node queue plane until ``EndOfFeed`` / a drained preemption.
 
@@ -217,7 +303,12 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
     it); ``"decode"`` accepts ``{"op": "adopt"}`` intake items and seats
     them via ``batcher.adopt_session`` — a corrupt/raced transfer's
     ``ValueError`` bounces back as a typed error without touching the
-    engine."""
+    engine.
+
+    ``base_args`` (a promoted standby passes its PRISTINE boot args
+    while ``args`` carries the promoted version's serve_args overlay)
+    is the base a later hot swap's version_args build from — so a
+    rollback away from the promoted version fully sheds its knobs."""
     mgr = ctx.mgr
     if mgr is None:
         raise RuntimeError("the serving loop needs the node queue server "
@@ -230,9 +321,14 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
     # + monitor poll), so a request dispatched into that window is still
     # served rather than stranded
     preempt_grace = float(args.get("serve_preempt_grace", 2.0))
+    #: artificial per-step latency (benches/chaos: a deterministic
+    #: "slow version" for rollout-gate testing); a model swap's
+    #: serve_args overlay can change it live
+    step_delay = float(args.get("serve_step_delay", 0.0))
 
     deltas: dict[int, list[int]] = {}   # batcher rid -> tokens this step
     carry = None   # gen request read during a full-slots control sweep
+    pending_swap = None   # a model hot-swap awaiting an idle batcher
 
     def on_token(brid: int, tok: int) -> None:
         deltas.setdefault(brid, []).append(int(tok))
@@ -326,8 +422,71 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
     def busy() -> bool:
         return batcher.load()["total"] > 0
 
-    logger.info("%s %d serving (max_batch=%d)", label, ctx.executor_id,
-                batcher.max_batch)
+    swap_base = base_args if base_args is not None else args
+
+    def apply_model_swap(item: dict, cur_delay: float):
+        """Apply a drained hot swap (docs/serving.md "Multi-model
+        serving"): params from a peer clone (the version already serves
+        elsewhere) or the payload's builder/adapter; the already-
+        compiled batcher re-arms via ``load_params`` (shape-validated —
+        an incompatible version bounces back typed, the OLD params keep
+        serving).  Returns the new per-step delay, ``cur_delay`` on a
+        failed swap, or None when an EndOfFeed interrupted the clone
+        wait (tier shutdown)."""
+        import jax
+
+        old_params = batcher.params
+        params = None
+        version_args = dict(swap_base)
+        version_args.update(item.get("serve_args") or {})
+        peer = item.get("peer")
+        if peer is not None:
+            from tensorflowonspark_tpu.serving.standby import (
+                _STOP, _clone_from_peer)
+
+            got = _clone_from_peer(ctx, mgr, peer, timeout=float(
+                args.get("serve_clone_timeout", 60.0)))
+            if got is _STOP:
+                return None
+            if got is not None:
+                params = got["params"]
+        try:
+            if params is None:
+                params, version_args = resolve_version_params(swap_base,
+                                                              item)
+            batcher.unload_params()
+            batcher.load_params(jax.device_put(params))
+        # tfos: ignore[broad-except] — a bad version payload must bounce
+        # back typed, not kill a serving replica; the old params are
+        # restored so the gang keeps serving its registered version
+        except Exception as e:
+            if batcher.params is None:
+                batcher.load_params(old_params)
+            logger.exception("replica %d: model swap to %s@%s failed",
+                             ctx.executor_id, item.get("model"),
+                             item.get("version"))
+            mgr.queue_put(RESPONSE_QUEUE,
+                          {"rid": None, "event": "model_swap_failed",
+                           "error": f"{type(e).__name__}: {e}",
+                           "swap_token": item.get("swap_token"),
+                           "load": 0, **role_extra})
+            return cur_delay
+        mgr.queue_put(RESPONSE_QUEUE,
+                      {"rid": None, "event": "model_swapped",
+                       "model": item.get("model"),
+                       "version": item.get("version"),
+                       "swap_token": item.get("swap_token"), "load": 0,
+                       **role_extra})
+        logger.info("replica %d hot-swapped to model %s@%s",
+                    ctx.executor_id, item.get("model"),
+                    item.get("version"))
+        return float(version_args.get("serve_step_delay", 0.0))
+
+    served_model = args.get("serve_model")
+    logger.info("%s %d serving (max_batch=%d%s)", label, ctx.executor_id,
+                batcher.max_batch,
+                "" if not served_model
+                else f", model {served_model[0]}@{served_model[1]}")
     draining = False
     drain_started = 0.0
     guard = PreemptionGuard()
@@ -343,6 +502,17 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                 tracer.event("replica_preempted", None,
                              replica=ctx.executor_id,
                              inflight=batcher.load()["total"])
+            if pending_swap is not None and not stopping \
+                    and carry is None and not busy():
+                # the driver drained this gang first, so the batcher is
+                # idle here; a swap racing early-routed work simply
+                # waits for the next idle step
+                item, pending_swap = pending_swap, None
+                got = apply_model_swap(item, step_delay)
+                if got is None:     # EndOfFeed landed mid-clone
+                    stopping = True
+                    break
+                step_delay = got
             queue_idle = False
             while not stopping:
                 free = batcher.has_free_slot()
@@ -382,6 +552,53 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                     serve_clone_request(
                         batcher, item, ctx,
                         export_pages=not args.get("serve_mesh"))
+                    continue
+                if isinstance(item, dict) and item.get("op") == "model":
+                    ev = item.get("event")
+                    if ev == "swap":
+                        # a hot swap: applied at the loop top once the
+                        # batcher is idle (the driver drained first, so
+                        # normally it already is)
+                        pending_swap = item
+                    elif ev == "cancel":
+                        # the driver's swap call gave up (ack timeout):
+                        # drop a swap not yet applied.  One already
+                        # applied (or mid-apply) acks late instead, and
+                        # the scheduler relabels on the late ack — the
+                        # routing label always tracks the served
+                        # version.
+                        pending_swap = None
+                    continue
+                if isinstance(item, dict) and item.get("op") == "prefix":
+                    ev = item.get("event")
+                    if ev == "export":
+                        # a decode gang asks for this pool's prefix
+                        # pages (cross-pool donation)
+                        serve_prefix_donation(batcher, item, ctx)
+                    elif ev == "pages":
+                        # a donated page set arrives: import as cached,
+                        # refcount-0, evictable pages — matchable by
+                        # the very next admission/adopt
+                        try:
+                            importer = getattr(batcher,
+                                               "import_prefix_cache",
+                                               None)
+                            n = (0 if importer is None
+                                 else importer(item.get("export")))
+                            if n:
+                                _donation_counter().inc(
+                                    n, direction="imported")
+                            logger.info(
+                                "replica %d imported %d donated prefix "
+                                "page(s) from %s", ctx.executor_id, n,
+                                item.get("src"))
+                        # tfos: ignore[broad-except] — a corrupt/
+                        # mismatched donation is rejected by the hash/
+                        # layout checks; the replica serves on
+                        except Exception:
+                            logger.exception(
+                                "replica %d: donated prefix-page import "
+                                "failed", ctx.executor_id)
                     continue
                 if isinstance(item, dict) and item.get("op") == "adopt":
                     # a handed-off session: seat it without re-prefilling.
@@ -433,6 +650,8 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                     break   # grace-window drain complete: exit cleanly
                 continue
             done = batcher.step()
+            if step_delay:
+                _time.sleep(step_delay)
             steps += 1
             # serving-phase heartbeat: arms the hang watchdog on the decode
             # loop and gives chaos its at_step trigger.  A draining replica

@@ -113,7 +113,9 @@ class RequestRejected(ServingError):
     only THIS tenant is over budget), ``shutdown`` (scheduler stopping),
     ``no_replica`` (every replica is dead), ``role_mismatch`` (a
     disaggregated tier with no routable prefill-capable replica —
-    refusing to queue a bare prompt on a decode-only gang)."""
+    refusing to queue a bare prompt on a decode-only gang),
+    ``unknown_model`` (the request names a ``model`` no replica of this
+    tier hosts — docs/serving.md "Multi-model serving")."""
 
     def __init__(self, reason: str, message: str):
         super().__init__(message)
@@ -230,16 +232,22 @@ class ServeRequest:
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature", "top_p",
                  "seed", "deadline", "events", "tokens", "attempts",
                  "replica", "skip", "created", "first_token_at", "finished",
-                 "trace", "tenant", "priority", "session")
+                 "trace", "tenant", "priority", "session", "model",
+                 "session_version")
 
     def __init__(self, rid: int, prompt, max_new_tokens: int,
                  temperature: float, top_p: float, seed: int,
                  deadline: float | None, trace: str | None = None,
-                 tenant: str = "default", priority: str = "normal"):
+                 tenant: str = "default", priority: str = "normal",
+                 model: str | None = None):
         self.rid = rid
         self.trace = trace or tracing.new_trace_id()
         self.tenant = tenant
         self.priority = priority
+        #: resolved hosting model id (multi-model tiers; None on a
+        #: single-model tier) — routing only considers replicas whose
+        #: registered model matches
+        self.model = model
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -255,8 +263,12 @@ class ServeRequest:
         self.first_token_at: float | None = None
         self.finished = False
         #: the KV-page session a prefill gang handed back, held only
-        #: between the ``handoff`` response and its adopt dispatch
+        #: between the ``handoff`` response and its adopt dispatch —
+        #: ``session_version`` pins the VERSION whose weights computed
+        #: it (adopt dispatch must match: KV decoded under other
+        #: weights would silently emit wrong tokens)
         self.session: dict | None = None
+        self.session_version: str | None = None
 
     def message(self) -> dict:
         """The wire message the replica loop consumes (``trace`` rides
@@ -264,7 +276,8 @@ class ServeRequest:
         return {"op": "gen", "rid": self.rid, "prompt": self.prompt,
                 "max_new_tokens": self.max_new_tokens,
                 "temperature": self.temperature, "top_p": self.top_p,
-                "seed": self.seed, "trace": self.trace}
+                "seed": self.seed, "trace": self.trace,
+                "model": self.model}
 
 
 class _Replica:
@@ -275,7 +288,7 @@ class _Replica:
 
     def __init__(self, info: dict, max_inflight: int,
                  members: tuple = (), weight: int = 1,
-                 role: str | None = None):
+                 role: str | None = None, model: tuple | None = None):
         self.info = info
         self.eid = int(info["executor_id"])
         self.max_inflight = int(max_inflight)
@@ -286,6 +299,13 @@ class _Replica:
         #: handed-off sessions and steps them), or None (unified — the
         #: historical replica, serves the whole request)
         self.role = role
+        #: multi-model tier: the ``(model_id, version)`` this replica
+        #: serves (docs/serving.md "Multi-model serving").  None = the
+        #: historical unlabeled replica, which serves any request.
+        self.model: str | None = None
+        self.version: str | None = None
+        if model is not None:
+            self.model, self.version = str(model[0]), str(model[1])
         self.outstanding: dict[int, ServeRequest] = {}
         self.reported_load = 0   # last ContinuousBatcher.load()["total"]
         #: last self-reported allocatable KV pages (paged-KV replicas;
@@ -310,6 +330,12 @@ class _Replica:
             return self.role == "decode"
         return self.role in (None, "prefill")
 
+    def accepts_model(self, model: str | None) -> bool:
+        """Whether this replica may serve a request for ``model`` — an
+        unlabeled request or replica matches anything (single-model
+        tiers keep the historical behavior exactly)."""
+        return model is None or self.model is None or self.model == model
+
 
 class ReplicaScheduler:
     """Routes generate requests over a cluster of ContinuousBatcher
@@ -321,7 +347,8 @@ class ReplicaScheduler:
                  client_factory=None, event_log=None,
                  tenants: dict | None = None, gang_size: int = 1,
                  capacity_weight: int | None = None,
-                 roles: dict | None = None):
+                 roles: dict | None = None,
+                 model: tuple | None = None):
         self.cluster = cluster
         feedable = sorted(
             (n for n in cluster.cluster_info
@@ -375,9 +402,13 @@ class ReplicaScheduler:
                     f"(roles cover {sorted(roles)})")
             self.replicas[ids[0]] = _Replica(
                 block[0], max_inflight, members=tuple(ids[1:]),
-                weight=self._weight, role=roles.get(ids[0]))
+                weight=self._weight, role=roles.get(ids[0]),
+                model=model)
             for e in ids:
                 self._gang_leader[e] = ids[0]
+        #: default model id (multi-model tiers): requests that name no
+        #: ``model`` resolve to the founding replicas' label
+        self.default_model = None if model is None else str(model[0])
         #: bounded admission queue: queued + in-flight across the tier
         self.max_queue_depth = int(
             max_queue_depth if max_queue_depth is not None
@@ -428,6 +459,19 @@ class ReplicaScheduler:
         #: heals (warm standbys / replace_failed) set this.
         self.heal_grace = 0.0
         self._pool_lost_at: dict = {}
+        #: model id -> monotonic time its LAST hosting replica died —
+        #: the per-model heal-grace clock (a multi-model tier healing
+        #: one model's gang must queue, not shed, that model's traffic)
+        self._model_lost_at: dict[str, float] = {}
+        #: model id -> {"shares": [(version, pct)], "credit": {version:
+        #: float}} — smooth weighted round-robin state (set_traffic_
+        #: split): exact proportions over any window, evenly interleaved
+        self._traffic: dict[str, dict] = {}
+        #: per-(model, version) live stats — the rollout gate's feedback
+        #: signal (completed/failed counts + ttft/e2e histograms)
+        self._mv_stats: dict[tuple, dict] = {}
+        #: eid -> waiter record for an in-flight model hot swap
+        self._swap_waiters: dict[int, dict] = {}
         self._requests: dict[int, ServeRequest] = {}
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -449,10 +493,14 @@ class ReplicaScheduler:
         # mirror live state are set by the collect hook at snapshot time
         # so the hot path never touches them
         reg = _metrics.get_registry()
+        # the ``model`` label keeps two hosted models' series apart
+        # (bounded cardinality: label values come from the registered
+        # model set; single-model tiers collapse to model="default")
         self._m_requests = reg.counter(
             "tfos_serving_requests_total",
             "Serving requests by outcome (accepted/completed/shed/"
-            "expired/abandoned/failed/requeued).", labelnames=("outcome",))
+            "expired/abandoned/failed/requeued) and hosted model.",
+            labelnames=("outcome", "model"))
         # label values come from the CONFIGURED tenant set (unknown names
         # collapse to "default"), so cardinality is operator-bounded
         self._m_tenant = reg.counter(
@@ -464,9 +512,13 @@ class ReplicaScheduler:
             "Replica membership changes (added/draining/retired/dead).",
             labelnames=("change",))
         self._m_ttft = reg.histogram(
-            "tfos_serving_ttft_seconds", "Admission to first token.")
+            "tfos_serving_ttft_seconds",
+            "Admission to first token, per hosted model.",
+            labelnames=("model",))
         self._m_e2e = reg.histogram(
-            "tfos_serving_e2e_seconds", "Admission to completion.")
+            "tfos_serving_e2e_seconds",
+            "Admission to completion, per hosted model.",
+            labelnames=("model",))
         self._g_depth = reg.gauge(
             "tfos_serving_queue_depth_count",
             "Requests queued in the scheduler, not yet dispatched.")
@@ -536,6 +588,10 @@ class ReplicaScheduler:
                 if not req.finished:
                     self._finish_err(req, "shutdown",
                                      "scheduler stopped before completion")
+            for rec in self._swap_waiters.values():
+                rec["error"] = "scheduler stopped mid-swap"
+                rec["event"].set()
+            self._swap_waiters.clear()
         for t in list(self._threads):  # add_replica appends recv threads
             if t is not threading.current_thread():
                 t.join(timeout=5.0)
@@ -578,14 +634,18 @@ class ReplicaScheduler:
                top_p: float = 1.0, seed: int = 0,
                timeout: float | None = None,
                trace: str | None = None, tenant: str = "default",
-               priority: str | None = None) -> ServeRequest:
+               priority: str | None = None,
+               model: str | None = None) -> ServeRequest:
         """Admit one request (typed rejections; see module docstring).
         ``trace`` propagates a caller-supplied trace id; one is minted
         otherwise — every event for this request carries it.  ``tenant``
         selects the admission policy (unknown names fall back to the
         ``default`` tenant); ``priority`` overrides the tenant's class
         but can only DEMOTE — a tenant configured ``low`` cannot smuggle
-        requests into the high band."""
+        requests into the high band.  ``model`` routes the request to
+        the replicas hosting that model on a multi-model tier (None =
+        the tier's default model); an unhosted model is rejected typed
+        ``unknown_model``."""
         with self._lock:
             if self._stop.is_set():
                 raise RequestRejected("shutdown", "serving tier is stopping")
@@ -600,6 +660,8 @@ class ReplicaScheduler:
                     "role_mismatch",
                     "no prefill-capable replica is routable: refusing to "
                     "queue a bare prompt on a decode-only gang")
+            model = self._resolve_model(model)
+            mdl = model or "default"
             ten = self.tenants.get(tenant) or self.tenants["default"]
             if priority is not None and priority not in PRIORITIES:
                 raise ValueError(f"unknown priority {priority!r} "
@@ -615,7 +677,7 @@ class ReplicaScheduler:
             if depth >= self.max_queue_depth:
                 ten.shed += 1
                 self.shed += 1
-                self._m_requests.inc(outcome="shed")
+                self._m_requests.inc(outcome="shed", model=mdl)
                 self._m_tenant.inc(tenant=ten.name, outcome="queue_full")
                 raise RequestRejected(
                     "queue_full",
@@ -624,7 +686,7 @@ class ReplicaScheduler:
             if ten.bucket is not None and not ten.bucket.try_take():
                 ten.shed += 1
                 self.shed += 1
-                self._m_requests.inc(outcome="shed")
+                self._m_requests.inc(outcome="shed", model=mdl)
                 self._m_tenant.inc(tenant=ten.name,
                                    outcome="tenant_throttled")
                 self._emit("request_shed", tenant=ten.name,
@@ -639,15 +701,16 @@ class ReplicaScheduler:
                 rid, prompt, max_new_tokens, temperature, top_p, seed,
                 deadline=None if timeout is None
                 else time.monotonic() + float(timeout), trace=trace,
-                tenant=ten.name, priority=eff_priority)
+                tenant=ten.name, priority=eff_priority, model=model)
             self._requests[rid] = req
             self._pending.append(req)
             self.accepted += 1
             ten.accepted += 1
-            self._m_requests.inc(outcome="accepted")
+            self._m_requests.inc(outcome="accepted", model=mdl)
             self._m_tenant.inc(tenant=ten.name, outcome="accepted")
             self._emit("request_admitted", rid=rid, trace=req.trace,
-                       depth=depth, tenant=ten.name, priority=eff_priority)
+                       depth=depth, tenant=ten.name, priority=eff_priority,
+                       model=model)
             self._work.notify()
         return req
 
@@ -672,10 +735,12 @@ class ReplicaScheduler:
                     self._work.notify_all()
             if reason == "expired":
                 self.expired += 1
-                self._m_requests.inc(outcome="expired")
+                self._m_requests.inc(outcome="expired",
+                                     model=req.model or "default")
             else:
                 self.abandoned += 1
-                self._m_requests.inc(outcome="abandoned")
+                self._m_requests.inc(outcome="abandoned",
+                                     model=req.model or "default")
             self._emit("request_failed", rid=req.rid, trace=req.trace,
                        reason=reason)
 
@@ -710,20 +775,235 @@ class ReplicaScheduler:
                 return (int(executor_id),)
             return (leader, *rep.members)
 
-    def peer_replica_info(self, exclude=()) -> dict | None:
+    def peer_replica_info(self, exclude=(),
+                          model: tuple | None = None) -> dict | None:
         """Reservation info of the least-loaded alive, non-draining
         replica — the clone SOURCE a promoted warm standby pulls weights
         from; None when no healthy peer exists (the promotion then falls
-        back to checkpoint restore via the model builder)."""
+        back to checkpoint restore via the model builder).  ``model``
+        restricts the peer to replicas serving that exact ``(model_id,
+        version)`` — weights cloned across versions would silently serve
+        the wrong model under the new label."""
         with self._lock:
             best = None
             for eid, rep in self.replicas.items():
                 if not rep.alive or rep.draining or eid in exclude:
                     continue
+                if model is not None and (rep.model, rep.version) \
+                        != (str(model[0]), str(model[1])):
+                    continue
                 if best is None \
                         or len(rep.outstanding) < len(best.outstanding):
                     best = rep
             return None if best is None else dict(best.info)
+
+    def _resolve_model(self, model) -> str | None:
+        """Admission-time model resolution (lock held): None falls back
+        to the tier's default model; a named model must be hosted by at
+        least one ALIVE replica (draining included — it still finishes
+        work) or be inside its heal-grace window (a dead-but-healing
+        model's traffic queues rather than shedding).  A model whose
+        last gang died with no heal coming rejects typed — admitting it
+        would burn queue depth and tenant tokens on requests that can
+        only ever fail ``no_replica``."""
+        if model is None:
+            return self.default_model
+        model = str(model)
+        hosted = {rep.model for rep in self.replicas.values()
+                  if rep.model is not None and rep.alive}
+        if model not in hosted and not self._model_heal_active(model):
+            raise RequestRejected(
+                "unknown_model",
+                f"model {model!r} is not (or no longer) hosted by this "
+                f"tier (hosted: {sorted(hosted) or 'none'})")
+        return model
+
+    def _model_heal_active(self, model: str | None) -> bool:
+        """True while a just-lost model's last hosting gang may still be
+        healing (lock held by caller) — the per-model twin of
+        :meth:`_heal_grace_active`, cleared when a fresh replica of the
+        model registers."""
+        if model is None or self.heal_grace <= 0:
+            return False
+        t0 = self._model_lost_at.get(model)
+        return t0 is not None and (time.monotonic() - t0) < self.heal_grace
+
+    # -- multi-model hosting (docs/serving.md "Multi-model serving") ------
+    def model_versions(self, model_id: str) -> dict[str, list[int]]:
+        """``{version: [leader eids]}`` of the ALIVE replicas hosting
+        ``model_id`` (draining included — they still finish work)."""
+        with self._lock:
+            out: dict[str, list[int]] = {}
+            for eid, rep in self.replicas.items():
+                if rep.alive and not rep.retired \
+                        and rep.model == str(model_id):
+                    out.setdefault(rep.version or "", []).append(eid)
+            return {v: sorted(e) for v, e in out.items()}
+
+    def replicas_of(self, model_id: str,
+                    version: str | None = None) -> list[int]:
+        """Routable (alive, non-draining) leader eids hosting
+        ``model_id`` (optionally one version)."""
+        with self._lock:
+            return sorted(
+                eid for eid, rep in self.replicas.items()
+                if rep.alive and not rep.draining
+                and rep.model == str(model_id)
+                and (version is None or (rep.version or "")
+                     == str(version)))
+
+    def replica_model_version(self, eid: int) -> tuple | None:
+        """The ``(model_id, version)`` replica ``eid`` registered with
+        (None for unlabeled/unknown) — replacement spawns re-arm the
+        SAME model."""
+        with self._lock:
+            rep = self.replicas.get(int(eid))
+            if rep is None or rep.model is None:
+                return None
+            return (rep.model, rep.version)
+
+    def replica_info(self, eid: int) -> dict | None:
+        """The reservation info dict of replica ``eid`` (None when
+        unknown) — the address a prefix-page donation replies to."""
+        with self._lock:
+            rep = self.replicas.get(int(eid))
+            return None if rep is None else dict(rep.info)
+
+    def prefix_donor(self, exclude=(),
+                     model: tuple | None = None) -> int | None:
+        """The least-outstanding alive PREFILL gang eligible to donate
+        its prefix-cache pages (docs/serving.md "Prefix-page donation"):
+        prefill pools hold the hottest prompt prefixes, and donated
+        pages must come from a replica serving the SAME (model, version)
+        — KV computed under other weights would decode wrong tokens."""
+        with self._lock:
+            best = None
+            for eid, rep in self.replicas.items():
+                if not rep.alive or rep.draining or eid in exclude \
+                        or rep.role != "prefill":
+                    continue
+                if model is not None and (rep.model, rep.version) \
+                        != (str(model[0]), str(model[1])):
+                    continue
+                if best is None \
+                        or len(rep.outstanding) < len(best.outstanding):
+                    best = rep
+            return None if best is None else best.eid
+
+    def model_version_stats(self, model_id: str,
+                            base: dict | None = None) -> dict:
+        """Per-version live snapshot for one model — completed/failed
+        counts (cumulative) plus ttft/e2e percentile summaries, the
+        rollout gate's feedback signal.  With ``base`` (a PRIOR return
+        value of this method), the latency summaries cover only the
+        samples recorded since the base — windowed percentiles, so a
+        canary gate compares the bake window on BOTH sides instead of a
+        fresh canary histogram vs the incumbent's warm-up-polluted
+        history (``RolloutController._bake_and_gate``)."""
+        model_id = str(model_id)
+        with self._lock:
+            for rep in self.replicas.values():
+                if rep.model == model_id:
+                    self._mv(rep)           # materialize hosted versions
+            out = {}
+            for (mid, ver), mv in self._mv_stats.items():
+                if mid != model_id:
+                    continue
+                b = (base or {}).get(ver) or {}
+                out[ver] = {
+                    "completed": mv["completed"],
+                    "failed": mv["failed"],
+                    "ttft": mv["ttft"].summary() if base is None
+                    else mv["ttft"].window_summary(
+                        (b.get("ttft") or {}).get("count", 0)),
+                    "e2e": mv["e2e"].summary() if base is None
+                    else mv["e2e"].window_summary(
+                        (b.get("e2e") or {}).get("count", 0)),
+                }
+            return out
+
+    def _mv(self, rep) -> dict | None:
+        """The (model, version) stats bucket for ``rep``'s label (lock
+        held by caller); None for unlabeled replicas."""
+        if rep is None or rep.model is None:
+            return None
+        key = (rep.model, rep.version or "")
+        mv = self._mv_stats.get(key)
+        if mv is None:
+            mv = self._mv_stats[key] = {
+                "completed": 0, "failed": 0,
+                "ttft": observability.LatencyHistogram(),
+                "e2e": observability.LatencyHistogram()}
+        return mv
+
+    def set_traffic_split(self, model_id: str, split: dict) -> None:
+        """Declarative per-model version split: ``{version: percent}``
+        (positive percents summing to 100).  Dispatch runs smooth
+        weighted round-robin over the versions — deterministic AND
+        evenly interleaved, so a 10% canary sees every ~10th dispatched
+        request (exact proportions over any 100-dispatch window), not a
+        coin flip and not the first 10 of each 100 — falling back
+        across the model's other versions when the target has no spare
+        capacity (availability over split fidelity).
+        :meth:`clear_traffic_split` restores pure least-outstanding
+        routing."""
+        model_id = str(model_id)
+        items = [(str(v), float(p)) for v, p in dict(split).items()]
+        if not items or any(p <= 0 for _, p in items) \
+                or abs(sum(p for _, p in items) - 100.0) > 1e-6:
+            raise ValueError(f"traffic split must be positive percents "
+                             f"summing to 100, got {split!r}")
+        with self._work:
+            self._traffic[model_id] = {
+                "shares": items, "credit": {v: 0.0 for v, _ in items}}
+            self._emit("traffic_split", model=model_id,
+                       split={v: p for v, p in items})
+            self._work.notify_all()
+
+    def clear_traffic_split(self, model_id: str) -> None:
+        with self._work:
+            if self._traffic.pop(str(model_id), None) is not None:
+                self._emit("traffic_split", model=str(model_id),
+                           split=None)
+                self._work.notify_all()
+
+    def resume_replica(self, eid: int) -> bool:
+        """Clear a replica's draining flag and resume routing to it —
+        the model-swap path un-drains after a completed (or failed,
+        still-serving-the-old-version) swap.  Retired/dead replicas
+        never resume."""
+        with self._work:
+            rep = self.replicas.get(int(eid))
+            if rep is None or not rep.alive or rep.retired:
+                return False
+            rep.draining = False
+            self._work.notify_all()
+            return True
+
+    def expect_swap(self, eid: int, token: str | None = None) -> dict:
+        """Register a waiter for replica ``eid``'s next hot-swap ack
+        (``model_swapped`` / ``model_swap_failed`` on its response
+        channel); a death mid-swap or scheduler stop releases the waiter
+        with an error.  ``token`` (echoed by the worker as
+        ``swap_token``) pins the waiter to ONE swap message: a late ack
+        from a PREVIOUS timed-out swap relabels the replica but cannot
+        release a retry's waiter.  Pair with :meth:`wait_swap`."""
+        rec = {"event": threading.Event(), "ok": False, "error": None,
+               "eid": int(eid), "token": token}
+        with self._lock:
+            self._swap_waiters[int(eid)] = rec
+        return rec
+
+    def wait_swap(self, rec: dict, timeout: float) -> tuple[bool, str]:
+        rec["event"].wait(timeout)
+        if not rec["event"].is_set():
+            # unregister THIS waiter: a stale entry would let the
+            # timed-out swap's late ack release a later retry's waiter
+            with self._lock:
+                if self._swap_waiters.get(rec["eid"]) is rec:
+                    del self._swap_waiters[rec["eid"]]
+            return False, f"no swap ack within {timeout:.0f}s"
+        return bool(rec["ok"]), rec.get("error") or ""
 
     def dead_replicas(self) -> set[int]:
         """Every executor id lost to FAILURE — for a dead gang that is
@@ -794,14 +1074,17 @@ class ReplicaScheduler:
         return t0 is not None and (time.monotonic() - t0) < self.heal_grace
 
     def add_replica(self, info: dict, members: tuple = (),
-                    role: str | None = None) -> None:
+                    role: str | None = None,
+                    model: tuple | None = None) -> None:
         """Register a freshly reserved replica worker and start routing
         to it (live scale-up / preemption replacement).  ``info`` is the
         node's reservation dict, exactly as ``cluster_info`` carries it;
         ``members`` the shard workers of a gang replica (their deaths
         resolve to this endpoint, like the founding gangs').  In a
         role-aware (disaggregated) tier ``role`` is mandatory — an
-        unspecialized replica cannot join specialized pools."""
+        unspecialized replica cannot join specialized pools.  ``model``
+        labels the newcomer with the ``(model_id, version)`` it serves
+        (multi-model tiers; deploys and re-armed heals pass it)."""
         eid = int(info["executor_id"])
         members = tuple(int(m) for m in members)
         if len(members) != self.gang_size - 1:
@@ -823,7 +1106,7 @@ class ReplicaScheduler:
             if existing is not None and existing.alive:
                 raise ValueError(f"replica {eid} already registered")
             rep = _Replica(info, self._max_inflight, members=members,
-                           weight=self._weight, role=role)
+                           weight=self._weight, role=role, model=model)
             self.replicas[eid] = rep
             self._has_roles = self._has_roles or role is not None
             # a fresh acceptor resets the lost-pool clock for every
@@ -832,12 +1115,15 @@ class ReplicaScheduler:
                 self._pool_lost_at.pop("adopt", None)
             if role in (None, "prefill"):
                 self._pool_lost_at.pop("gen", None)
+            if rep.model is not None:
+                # the model is hosted again: its heal-grace clock stops
+                self._model_lost_at.pop(rep.model, None)
             for e in (eid, *members):
                 self._gang_leader[e] = eid
             self._m_scale.inc(change="added")
             self._emit("replica_added", replica=eid,
                        members=list(members), weight=rep.weight,
-                       role=role,
+                       role=role, model=rep.model, version=rep.version,
                        alive=sum(1 for r in self.replicas.values()
                                  if r.alive))
             self._work.notify_all()
@@ -900,10 +1186,12 @@ class ReplicaScheduler:
                 if req.finished:
                     continue
                 self.requeued += 1
-                self._m_requests.inc(outcome="requeued")
+                self._m_requests.inc(outcome="requeued",
+                                     model=req.model or "default")
                 req.attempts = max(0, req.attempts - 1)
                 req.replica = None
                 req.session = None
+                req.session_version = None
                 req.skip = len(req.tokens)
                 self._pending.appendleft(req)
                 self._emit("request_requeued", rid=req.rid, trace=req.trace,
@@ -962,9 +1250,23 @@ class ReplicaScheduler:
                           "free_pages": rep.reported_free_pages,
                           "weight": rep.weight,
                           "role": rep.role,
+                          "model": rep.model,
+                          "version": rep.version,
                           "members": list(rep.members),
                           "served": rep.served}
                     for eid, rep in self.replicas.items()},
+                # multi-model hosting view: per-(model, version) request
+                # counts + the replicas serving each (the rollout gate
+                # reads the richer model_version_stats())
+                "models": {
+                    mid: {ver: {"completed": mv["completed"],
+                                "failed": mv["failed"]}
+                          for (m, ver), mv in self._mv_stats.items()
+                          if m == mid}
+                    for mid in {m for m, _ in self._mv_stats}},
+                "traffic": {
+                    mid: {v: p for v, p in split["shares"]}
+                    for mid, split in self._traffic.items()},
                 "tenants": {
                     name: {"accepted": t.accepted, "shed": t.shed,
                            "priority": t.priority,
@@ -1019,7 +1321,9 @@ class ReplicaScheduler:
                     cli.close()
         rep.send_cli = rep.recv_cli = None
 
-    def _pick_replica(self, kind: str = "gen") -> _Replica | None:
+    def _pick_replica(self, kind: str = "gen",
+                      model: str | None = None,
+                      version: str | None = None) -> _Replica | None:
         """Least-outstanding alive replica with spare in-flight capacity
         (ties by last self-reported batcher load, then by KV-page
         pressure — MORE free pages wins, so long prompts stop landing
@@ -1027,20 +1331,150 @@ class ReplicaScheduler:
         the decode gang with the most page headroom); None when
         saturated.  Draining replicas take no new work.  ``kind``
         selects the pool in a role-aware tier: ``"gen"`` considers
-        unified/prefill replicas, ``"adopt"`` decode gangs only."""
-        best = None
-        best_key = None
+        unified/prefill replicas, ``"adopt"`` decode gangs only.
+        ``model`` restricts to replicas hosting that model and
+        ``version`` (adopt dispatches: the version whose weights
+        computed the handed-off KV) to that exact version; an active
+        traffic split additionally targets the version smooth-weighted-
+        round-robin picks next (deterministic, evenly interleaved
+        canary proportions), falling back to the model's other versions
+        when the target has no spare capacity."""
+        split = (self._traffic.get(model)
+                 if model is not None and kind == "gen" else None)
+        target = None
+        if split:
+            # tentative SWRR pick — committed only on a real dispatch
+            credit = split["credit"]
+            target = max(split["shares"],
+                         key=lambda vp: credit[vp[0]] + vp[1])[0]
+        best = best_key = None
+        best_t = best_t_key = None
         for rep in self.replicas.values():
             if not rep.alive or rep.draining or not rep.accepts(kind) \
+                    or not rep.accepts_model(model) \
+                    or (version is not None and rep.version != version) \
                     or len(rep.outstanding) >= rep.max_inflight:
                 continue
             key = (len(rep.outstanding), rep.reported_load,
                    -rep.reported_free_pages)
             if best is None or key < best_key:
                 best, best_key = rep, key
-        return best
+            if target is not None and (rep.version or "") == target \
+                    and (best_t is None or key < best_t_key):
+                best_t, best_t_key = rep, key
+        chosen = best_t if best_t is not None else best
+        if chosen is not None and split:
+            # commit the SWRR step, charging the version that actually
+            # serves (a saturated target's unspent credit accumulates,
+            # so it catches up as soon as capacity frees)
+            credit = split["credit"]
+            for v, p in split["shares"]:
+                # clamp at one full round: normal SWRR never exceeds
+                # it, and a version with NO routable replica (dead
+                # canary awaiting its heal) cannot bank unbounded
+                # credit that would burst all traffic onto it the
+                # moment capacity returns
+                credit[v] = min(credit[v] + p, 100.0)
+            charged = (chosen.version
+                       if chosen.version in credit else target)
+            credit[charged] -= 100.0
+        return chosen
 
     # -- dispatch ----------------------------------------------------------
+    def _scan_queue(self, queue_, kind: str):
+        """First dispatchable request in ``queue_`` (lock held): scans
+        PAST work whose model/pool is merely saturated or healing —
+        one saturated model must never head-of-line block another's
+        traffic — while expiring deadline-passed requests and failing
+        (typed) work with no surviving acceptor and no heal in flight.
+        FIFO within a (priority, model) class is preserved: every
+        request of a class sees the same candidate set, so the head
+        dispatches first.  A class found saturated is probed ONCE per
+        scan (``stuck`` memo) — a deep backlog costs O(classes x
+        replicas) per scan under the lock, not O(pending x replicas).
+        Returns ``(req, rep)`` or None."""
+        stuck: set = set()
+        for req in list(queue_):
+            if req.finished:
+                with contextlib.suppress(ValueError):
+                    queue_.remove(req)
+                continue
+            if req.deadline is not None \
+                    and time.monotonic() > req.deadline:
+                with contextlib.suppress(ValueError):
+                    queue_.remove(req)
+                self._expire(req)
+                continue
+            pin = req.session_version if kind == "adopt" else None
+            if (req.model, pin) in stuck:
+                continue        # this class already probed saturated
+            rep = self._pick_replica(kind, model=req.model, version=pin)
+            if rep is not None:
+                with contextlib.suppress(ValueError):
+                    queue_.remove(req)
+                return req, rep
+            # no capacity right now: does ANY acceptor for this work
+            # survive?  Fail typed if not — UNLESS a heal is in flight
+            # (expect_replica) or recent enough that its announcement
+            # may still be coming (heal_grace / the model's own clock),
+            # in which case the work stays queued
+            if not any(r.alive and r.accepts(kind)
+                       and r.accepts_model(req.model)
+                       and (pin is None or r.version == pin)
+                       for r in self.replicas.values()) \
+                    and not self._expecting(kind) \
+                    and not self._heal_grace_active(kind) \
+                    and not self._model_heal_active(req.model):
+                with contextlib.suppress(ValueError):
+                    queue_.remove(req)
+                if kind == "adopt":
+                    self._finish_err(
+                        req, "no_replica",
+                        "no decode gang survives to adopt the "
+                        "handed-off session"
+                        + (f" (version {pin})" if pin else ""))
+                elif req.model is not None and any(
+                        r.alive for r in self.replicas.values()):
+                    self._finish_err(
+                        req, "no_replica",
+                        f"no replica hosting model {req.model!r} "
+                        "survives to run the request")
+                elif self._has_roles:
+                    self._finish_err(
+                        req, "no_replica",
+                        "no prefill-capable replica survives to "
+                        "run the prompt")
+                else:
+                    self._finish_err(req, "no_replica",
+                                     "no replica alive")
+                continue
+            # saturated (or healing): stays queued; later requests of
+            # the same class face the identical candidate set
+            stuck.add((req.model, pin))
+        return None
+
+    def _next_dispatch(self):
+        """The next (req, rep, is_handoff) to dispatch, or None when
+        everything queued is waiting on capacity or a heal (lock held).
+        Handed-off sessions go first — their prefill compute is already
+        spent, and seating them frees prefill-pool pages — unless the
+        decode pool is dead-but-healing, in which case prompts a live
+        prefill gang could overlap with the heal are not blocked."""
+        decode_dead_healing = self._pending and not any(
+            r.alive and r.accepts("adopt")
+            for r in self.replicas.values()) \
+            and (self._expecting("adopt")
+                 or self._heal_grace_active("adopt"))
+        if self._pending_handoff and not decode_dead_healing:
+            got = self._scan_queue(self._pending_handoff, "adopt")
+            if got is not None:
+                return (*got, True)
+        if self._pending:
+            got = self._scan_queue(self._pending, "gen")
+            if got is not None:
+                return (*got, False)
+        return None
+
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
             with self._work:
@@ -1049,60 +1483,13 @@ class ReplicaScheduler:
                     self._work.wait(0.2)
                 if self._stop.is_set():
                     return
-                # handed-off sessions dispatch ahead of new prompts:
-                # their prefill compute is already spent, and seating
-                # them frees prefill-pool pages
-                handoff = bool(self._pending_handoff)
-                if handoff and self._pending and not any(
-                        r.alive and r.accepts("adopt")
-                        for r in self.replicas.values()) \
-                        and (self._expecting("adopt")
-                             or self._heal_grace_active("adopt")):
-                    # the decode pool is dead but HEALING: its handoffs
-                    # stay queued, and must not head-of-line block
-                    # prompts a live prefill gang could overlap with
-                    # the heal
-                    handoff = False
-                req = (self._pending_handoff.popleft() if handoff
-                       else self._pending.popleft())
-                if req.finished:
-                    continue
-                if req.deadline is not None \
-                        and time.monotonic() > req.deadline:
-                    self._expire(req)
-                    continue
-                rep = self._pick_replica("adopt" if handoff else "gen")
-                if rep is None:
-                    kind = "adopt" if handoff else "gen"
-                    has_acceptor = any(r.alive and r.accepts(kind)
-                                       for r in self.replicas.values())
-                    # no survivor serves this work: fail typed — UNLESS
-                    # a heal is in flight (expect_replica) or recent
-                    # enough that its announcement may still be coming
-                    # (heal_grace), in which case the work stays queued
-                    if not has_acceptor and not self._expecting(kind) \
-                            and not self._heal_grace_active(kind):
-                        if handoff:
-                            self._finish_err(
-                                req, "no_replica",
-                                "no decode gang survives to adopt the "
-                                "handed-off session")
-                        elif self._has_roles:
-                            self._finish_err(
-                                req, "no_replica",
-                                "no prefill-capable replica survives to "
-                                "run the prompt")
-                        else:
-                            self._finish_err(req, "no_replica",
-                                             "no replica alive")
-                        continue
-                    # the pool is saturated: wait for capacity
-                    if handoff:
-                        self._pending_handoff.appendleft(req)
-                    else:
-                        self._pending.appendleft(req)
+                got = self._next_dispatch()
+                if got is None:
+                    # every queued piece of work is waiting on capacity
+                    # or a heal window
                     self._work.wait(0.05)
                     continue
+                req, rep, handoff = got
                 req.replica = rep.eid
                 rep.outstanding[req.rid] = req
                 if handoff:
@@ -1136,7 +1523,8 @@ class ReplicaScheduler:
     def _expire(self, req: ServeRequest) -> None:
         """Fail ``req`` with a deadline error (lock held by caller)."""
         self.expired += 1
-        self._m_requests.inc(outcome="expired")
+        self._m_requests.inc(outcome="expired",
+                             model=req.model or "default")
         req.finished = True
         self._requests.pop(req.rid, None)
         self._emit("request_failed", rid=req.rid, trace=req.trace,
@@ -1148,7 +1536,15 @@ class ReplicaScheduler:
     def _finish_err(self, req: ServeRequest, reason: str, msg: str) -> None:
         """Fail ``req`` with a typed error (lock held by caller)."""
         self.failed += 1
-        self._m_requests.inc(outcome="failed")
+        self._m_requests.inc(outcome="failed",
+                             model=req.model or "default")
+        # per-version failure attribution: the replica last serving the
+        # request (the rollout gate's error-rate signal); unattributable
+        # failures (never routed) only count at the model level
+        mv = self._mv(self.replicas.get(req.replica)
+                      if req.replica is not None else None)
+        if mv is not None:
+            mv["failed"] += 1
         req.finished = True
         self._requests.pop(req.rid, None)
         self._emit("request_failed", rid=req.rid, trace=req.trace,
@@ -1192,6 +1588,45 @@ class ReplicaScheduler:
                     rep.eid, role, rep.role)
                 self._emit("role_mismatch", replica=rep.eid,
                            reported=role, registered=rep.role)
+            if event == "model_swapped":
+                # the replica finished its hot swap: update its label,
+                # resume routing, release the tier's waiter
+                model, version = msg.get("model"), msg.get("version")
+                rep.model = None if model is None else str(model)
+                rep.version = None if version is None else str(version)
+                if rep.model is not None:
+                    self._model_lost_at.pop(rep.model, None)
+                rec = self._swap_waiters.get(rep.eid)
+                if rec is None or rec["token"] in (
+                        None, msg.get("swap_token")):
+                    # the ack belongs to the active swap (or no swap is
+                    # in flight): resume routing.  A LATE ack racing a
+                    # retry's drain still relabels above, but must not
+                    # clear the drain the retry owns.
+                    rep.draining = False
+                if rec is not None and rec["token"] in (
+                        None, msg.get("swap_token")):
+                    self._swap_waiters.pop(rep.eid, None)
+                    rec["ok"] = True
+                    rec["event"].set()
+                self._emit("model_swapped", replica=rep.eid, model=model,
+                           version=version)
+                self._work.notify_all()
+                return
+            if event == "model_swap_failed":
+                # the replica kept (or restored) its OLD params — it is
+                # still routable; the tier's swap call raises
+                rec = self._swap_waiters.get(rep.eid)
+                err = str(msg.get("error", "swap failed"))
+                if rec is not None and rec["token"] in (
+                        None, msg.get("swap_token")):
+                    self._swap_waiters.pop(rep.eid, None)
+                    rec["error"] = err
+                    rec["event"].set()
+                logger.error("replica %d model swap failed: %s",
+                             rep.eid, err)
+                self._emit("model_swap_failed", replica=rep.eid, error=err)
+                return
             if event == "standby_ready":
                 # a promoted standby finished loading weights: capacity
                 # is restored — let the tier close its heal measurement
@@ -1221,8 +1656,10 @@ class ReplicaScheduler:
                 session = msg.get("session") or {}
                 req.replica = None
                 req.session = session
+                req.session_version = rep.version
                 self.handoffs += 1
-                self._m_requests.inc(outcome="handoff")
+                self._m_requests.inc(outcome="handoff",
+                                     model=req.model or "default")
                 self._pending_handoff.append(req)
                 self._emit(
                     "request_handoff", rid=rid, trace=req.trace,
@@ -1244,7 +1681,10 @@ class ReplicaScheduler:
                     req.first_token_at = time.monotonic()
                     ttft = req.first_token_at - req.created
                     self.ttft.record(ttft)
-                    self._m_ttft.record(ttft)
+                    self._m_ttft.record(ttft, model=req.model or "default")
+                    mv = self._mv(rep)
+                    if mv is not None:
+                        mv["ttft"].record(ttft)
                     self._emit("request_first_token", rid=rid,
                                trace=req.trace, replica=rep.eid,
                                ttft_secs=round(ttft, 6))
@@ -1256,10 +1696,15 @@ class ReplicaScheduler:
                 req.finished = True
                 self._requests.pop(rid, None)
                 self.completed += 1
-                self._m_requests.inc(outcome="completed")
+                self._m_requests.inc(outcome="completed",
+                                     model=req.model or "default")
                 e2e = time.monotonic() - req.created
                 self.e2e.record(e2e)
-                self._m_e2e.record(e2e)
+                self._m_e2e.record(e2e, model=req.model or "default")
+                mv = self._mv(rep)
+                if mv is not None:
+                    mv["completed"] += 1
+                    mv["e2e"].record(e2e)
                 self._emit("request_done", rid=rid, trace=req.trace,
                            replica=rep.eid, tokens=len(req.tokens),
                            e2e_secs=round(e2e, 6))
@@ -1314,6 +1759,12 @@ class ReplicaScheduler:
         stranded = list(rep.outstanding.values())
         rep.outstanding.clear()
         self._close_clients(rep)
+        # a death mid-hot-swap releases the tier's waiter with an error
+        # (the swap call fails; normal death handling replaces the gang)
+        rec = self._swap_waiters.pop(eid, None)
+        if rec is not None:
+            rec["error"] = f"replica died mid-swap: {reason}"
+            rec["event"].set()
         survivors = any(r.alive for r in self.replicas.values())
         # anchor the lost-pool clock for every dispatch kind this death
         # left without an acceptor: the heal-grace window runs from HERE
@@ -1323,6 +1774,12 @@ class ReplicaScheduler:
             if not any(r.alive and r.accepts(kind)
                        for r in self.replicas.values()):
                 self._pool_lost_at.setdefault(kind, now)
+        # and per model: the heal window for a multi-model tier that
+        # just lost a model's LAST hosting gang
+        if rep.model is not None and not any(
+                r.alive and r.model == rep.model
+                for r in self.replicas.values()):
+            self._model_lost_at.setdefault(rep.model, now)
         # while a heal is announced (or recent enough that its
         # announcement may still be coming), stranded/pending work is
         # HELD instead of shed — the heal window must not lose the very
@@ -1331,7 +1788,8 @@ class ReplicaScheduler:
         for req in stranded:
             if req.finished:
                 continue
-            if not survivors and not hold_gen:
+            if not survivors and not hold_gen \
+                    and not self._model_heal_active(req.model):
                 self._finish_err(req, "no_replica",
                                  f"replica {eid} died and no replica "
                                  "survives to replay the request")
@@ -1350,9 +1808,11 @@ class ReplicaScheduler:
                 # counter dedups everything already delivered — the
                 # requeue-once budget spans the whole pipeline
                 self.requeued += 1
-                self._m_requests.inc(outcome="requeued")
+                self._m_requests.inc(outcome="requeued",
+                                     model=req.model or "default")
                 req.replica = None
                 req.session = None
+                req.session_version = None
                 req.skip = len(req.tokens)
                 self._pending.appendleft(req)
                 self._emit("request_requeued", rid=req.rid, trace=req.trace,
@@ -1360,8 +1820,11 @@ class ReplicaScheduler:
         if not survivors:
             if not hold_gen:
                 for req in list(self._pending):
+                    if self._model_heal_active(req.model):
+                        continue        # held for the model's heal window
                     self._finish_err(req, "no_replica", "no replica alive")
-                self._pending.clear()
+                    with contextlib.suppress(ValueError):
+                        self._pending.remove(req)
             if not (self._expecting("adopt")
                     or self._heal_grace_active("adopt")):
                 for req in list(self._pending_handoff):

@@ -215,12 +215,22 @@ def _standby_leader(args, ctx, spec) -> None:
                           {"rid": None, "event": "standby_ready",
                            "load": 0, "source": promote.get("source"),
                            **({} if role is None else {"role": role})})
-            logger.info("standby %d promoted (source=%s): serving",
-                        ctx.executor_id, promote.get("source"))
-            run_serve_loop(args, ctx, batcher,
+            logger.info("standby %d promoted (source=%s%s): serving",
+                        ctx.executor_id, promote.get("source"),
+                        "" if promote.get("model") is None
+                        else f", model={promote['model']}"
+                             f"@{promote.get('version')}")
+            # the promoted model's serve_args overlay (e.g. a seed, a
+            # bench's step delay) applies to the serve LOOP; the
+            # pristine boot args stay the base for later hot swaps, so
+            # a rollback away from this version fully sheds its knobs
+            loop_args = (dict(args, **promote["serve_args"])
+                         if promote.get("serve_args") else args)
+            run_serve_loop(loop_args, ctx, batcher,
                            step_hook=None if barrier is None
                            else barrier.step,
-                           label="promoted-standby", role=role)
+                           label="promoted-standby", role=role,
+                           base_args=args)
         finally:
             if barrier is not None:
                 barrier.stop()
@@ -290,7 +300,14 @@ def _acquire_params(args, ctx, mgr, promote: dict, cfg):
     ONLY rides the clone path: builder-restored weights may differ from
     any peer's, and prefix K/V computed under other weights would
     silently decode wrong tokens.  ``_STOP`` when an ``EndOfFeed``
-    interrupted the clone wait (tier shutdown / concurrent retire)."""
+    interrupted the clone wait (tier shutdown / concurrent retire).
+
+    A promote message carrying a MODEL-VERSION payload (``model``/
+    ``builder``/``base_builder``/``adapter``/``serve_args`` — the
+    shared spare pool re-armed per model, docs/serving.md) builds
+    through THAT payload on the fallback path; the driver already
+    restricted ``peer`` to replicas serving the same version, so the
+    clone path is version-correct by construction."""
     peer = promote.get("peer")
     if peer is not None:
         got = _clone_from_peer(
@@ -303,6 +320,13 @@ def _acquire_params(args, ctx, mgr, promote: dict, cfg):
         logger.warning("standby %d: peer clone from %s failed/timed out; "
                        "falling back to the model builder",
                        ctx.executor_id, peer.get("executor_id"))
+    if promote.get("model") is not None or promote.get("builder") \
+            or promote.get("base_builder"):
+        from tensorflowonspark_tpu.serving.replica import \
+            resolve_version_params
+
+        params, _ = resolve_version_params(args, promote)
+        return params, None
     _cfg, params = args["serve_model_builder"](args)
     return params, None
 

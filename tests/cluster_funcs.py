@@ -659,3 +659,58 @@ def serving_sharded_gpt_builder(args):
     params = GPT(cfg).init(jax.random.key(int(args.get("seed", 0))),
                            jnp.ones((1, 4), jnp.int32))["params"]
     return cfg, params
+
+
+def rollout_parity_cfg():
+    """The estimator→serve parity test's tiny GPT config — ONE
+    definition shared by the trainer, the batch-eval workers, the
+    serving replicas, and the driver-side oracle."""
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import GPTConfig
+
+    return GPTConfig(vocab_size=31, hidden_size=16, num_layers=1,
+                     num_heads=2, intermediate_size=32,
+                     max_position_embeddings=32, dtype=jnp.float32,
+                     pos_encoding="rope")
+
+
+def rollout_parity_builder(args):
+    """Model builder restoring the estimator-trained checkpoint from
+    ``args["model_dir"]`` (top level so spawn pickles it by reference)
+    — the registry entry behind the estimator → eval → promote → serve
+    parity path.  A target-less orbax restore returns flax
+    ``Partitioned`` kernels as ``{"value": array}`` boxes; serving
+    applies raw arrays, so unbox them."""
+    from tensorflowonspark_tpu.checkpoint import CheckpointManager
+
+    def unbox(tree):
+        if isinstance(tree, dict):
+            if set(tree) == {"value"}:
+                return unbox(tree["value"])
+            return {k: unbox(v) for k, v in tree.items()}
+        return tree
+
+    with CheckpointManager(args["model_dir"]) as ckpt:
+        state = ckpt.restore()
+    params = state["params"] if isinstance(state, dict) else state.params
+    return rollout_parity_cfg(), unbox(params)
+
+
+def rollout_parity_predict(model, records, trial_params):
+    """Batch-plane predict fn for the parity test's GridSearch eval:
+    greedy-decode each prompt record under the restored params."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import greedy_generate
+
+    cfg, params = model
+    out = []
+    for rec in records:
+        p = np.asarray(rec, np.int32).reshape(-1)
+        toks = np.asarray(greedy_generate(
+            cfg, params, jnp.asarray(p)[None, :],
+            int(trial_params.get("budget", 4))))[0, p.size:]
+        out.append(toks.astype(np.int32).tobytes())
+    return out

@@ -485,3 +485,106 @@ def _triple():
         return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
 
     return init_fn, loss_fn, optax.sgd(0.1)
+
+
+@_isolated
+def test_estimator_to_serve_parity(tmp_path):
+    """Estimator → serve parity, end to end on one stack (ROADMAP item
+    5's last pipeline gap): train a tiny GPT through ``Estimator``/
+    ``train_and_evaluate`` (checkpoint under ``model_dir``), run the
+    batch plane's ``GridSearch`` as the OFFLINE EVAL whose verdict gates
+    promotion (``ModelRegistry.evaluate_grid``), then serve the
+    promoted version on a real ``ServingCluster`` — with the served
+    output greedy-exact vs a solo ``greedy_generate`` oracle over the
+    SAME restored checkpoint."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.batch.gridsearch import GridSearch
+    from tensorflowonspark_tpu.batch.manifest import ShardManifest
+    from tensorflowonspark_tpu.models import GPT, greedy_generate
+    from tensorflowonspark_tpu.serving import ModelRegistry, ServingCluster
+    from tests.cluster_funcs import (rollout_parity_builder,
+                                     rollout_parity_cfg,
+                                     rollout_parity_predict)
+
+    cfg = rollout_parity_cfg()
+    model_dir = str(tmp_path / "ckpt")
+    rng = np.random.default_rng(0)
+    # batch rows divisible by any local device count (the default
+    # DataParallelStrategy shards the batch over all devices)
+    data = rng.integers(1, cfg.vocab_size, (16, 9)).astype(np.int32)
+
+    def init_fn():
+        return GPT(cfg).init(jax.random.key(0),
+                             jnp.ones((1, 4), jnp.int32))["params"]
+
+    def loss_fn(params, batch):
+        x = batch["x"]
+        logits = GPT(cfg).apply({"params": params}, x[:, :-1])
+        logp = jax.nn.log_softmax(logits)
+        picked = jnp.take_along_axis(logp, x[:, 1:, None], axis=-1)
+        return -jnp.mean(picked)
+
+    def input_fn():
+        for i in range(0, len(data), 8):
+            yield {"x": data[i:i + 8]}
+
+    with Estimator(init_fn, loss_fn, optax.adam(1e-2), model_dir,
+                   save_every_steps=2, handle_preemption=False,
+                   summary_dir="") as est:
+        final = train_and_evaluate(
+            est, TrainSpec(input_fn=input_fn, max_steps=4),
+            EvalSpec(input_fn=input_fn, steps=1, throttle_steps=4))
+        assert final["global_step"] == 4
+
+    # the driver-side oracle decodes under the SAME restored checkpoint
+    _cfg, params = rollout_parity_builder({"model_dir": model_dir})
+    prompts = [data[i, :5] for i in range(4)]
+    budget = 4
+    oracle = [np.asarray(greedy_generate(
+        cfg, params, jnp.asarray(p)[None, :], budget))[0, p.size:].tolist()
+        for p in prompts]
+
+    # offline eval: the batch plane's GridSearch over the checkpoint
+    reg = ModelRegistry()
+    reg.register("parity", "v1", rollout_parity_builder)
+    assert not reg.promotable("parity", "v1")
+    gs = GridSearch(
+        ShardManifest.from_arrays([np.stack(prompts[:2]),
+                                   np.stack(prompts[2:])]),
+        str(tmp_path / "eval"), rollout_parity_predict,
+        param_grid=[{"budget": budget}],
+        model_builder=rollout_parity_builder,
+        predict_args={"model_dir": model_dir}, batch_size=2)
+    gs.run(num_workers=1, max_restarts=0,
+           worker_env={"JAX_PLATFORMS": "cpu"},
+           working_dir=str(tmp_path / "wd"),
+           reservation_timeout=120, shutdown_timeout=120)
+
+    def scorer(results):
+        got = [np.frombuffer(b, np.int32).tolist() for b in results]
+        exact = sum(g == o for g, o in zip(got, oracle))
+        return ({"exact": exact, "n": len(got)},
+                len(got) == len(oracle) and exact == len(oracle))
+
+    assert reg.evaluate_grid("parity", "v1", gs, "t0", scorer)
+    assert reg.promotable("parity", "v1")
+    assert reg.version("parity", "v1").eval_metrics == {"exact": 4, "n": 4}
+
+    # serve the promoted version on one cluster; the registry entry's
+    # builder restores the estimator checkpoint in the replica process
+    serving = ServingCluster.run(
+        None, 1, registry=reg, model=("parity", "v1"),
+        replica_args={"model_dir": model_dir},
+        worker_env={"JAX_PLATFORMS": "cpu"}, reservation_timeout=120)
+    try:
+        with serving.client() as c:
+            got = c.generate(prompts[0], budget, model="parity")
+        assert got.tolist() == oracle[0], \
+            "served output diverged from the trained checkpoint's oracle"
+        m = serving.metrics()
+        assert m["registry"]["parity"]["v1"]["state"] == "serving"
+        assert m["replicas"][0]["model"] == "parity"
+    finally:
+        serving.shutdown(timeout=300)

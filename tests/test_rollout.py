@@ -1,0 +1,822 @@
+"""Multi-model serving + live rollout (``serving/rollout.py``).
+
+Three layers:
+
+- **registry units** — ``ModelRegistry``/``ModelVersion`` cataloging,
+  the adapter-delta math, and the offline-eval promotion gate;
+- **scheduler units** — model-labeled routing, deterministic traffic
+  splits, the unknown-model typed rejection, the per-model heal grace,
+  and the drain-verb hot-swap protocol, all over deterministic
+  in-process fake replicas (the ``test_serving_cluster`` idiom);
+- **controller units** — a real ``RolloutController`` over the real
+  scheduler + fakes: a clean canary promotes, an error-spewing canary
+  is caught by the metrics gate and auto-rolled back with the incumbent
+  still serving.
+
+Engine-level pieces (``load_params`` shape validation, cross-pool
+prefix-page donation) ride at the bottom; the full estimator → eval →
+promote → serve parity path lives in ``tests/test_estimator.py``
+(isolated, like the rest of that suite).
+"""
+
+import queue as _queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.serving import (ModelRegistry, ReplicaScheduler,
+                                           RequestRejected,
+                                           RolloutController, RolloutError,
+                                           RolloutPolicy, ServingCluster,
+                                           apply_adapter)
+
+# --------------------------------------------------------------- fakes
+
+
+class _FakeBackend:
+    def __init__(self, n):
+        self.codes = {i: None for i in range(n)}
+
+    def exitcodes(self):
+        return dict(self.codes)
+
+    def failed(self):
+        return [i for i, c in self.codes.items() if c not in (0, None)]
+
+
+def _fake_tokens(prompt, n, salt=0):
+    """Deterministic 'decode', salted per model version — a pure
+    function of (request, version), like the real batcher + params."""
+    base = int(np.sum(np.asarray(prompt, np.int64))) + 13 * int(salt)
+    return [(base + 7 * k) % 101 for k in range(n)]
+
+
+class _ModelWorld:
+    """N fake replicas speaking the serve queue protocol, each with a
+    mutable per-replica behavior (``salt`` = which version's tokens it
+    emits, ``fail`` = answer every gen with a typed error — the forced
+    canary regression).  Handles the ``op="model"`` hot-swap message:
+    applies the payload's ``serve_args`` behavior and acks
+    ``model_swapped`` (or ``model_swap_failed`` when the payload says
+    so), exactly like a drained real replica."""
+
+    def __init__(self, n, token_delay=0.0):
+        self.backend = _FakeBackend(n)
+        self.cluster_info = [
+            {"executor_id": i, "job_name": "worker",
+             "addr": ("127.0.0.1", 0), "authkey": b"x"} for i in range(n)]
+        self.cluster_meta = {"queue_shm": False}
+        self.working_dir = None
+        self.token_delay = token_delay
+        self.behavior = {i: {"salt": 0, "fail": False} for i in range(n)}
+        self.inq = {i: _queue.Queue() for i in range(n)}
+        self.outq = {i: _queue.Queue() for i in range(n)}
+        self.control: list = []
+        self._dead: set[int] = set()
+        self.threads = [threading.Thread(target=self._run, args=(i,),
+                                         daemon=True) for i in range(n)]
+        for t in self.threads:
+            t.start()
+
+    def _run(self, i):
+        while i not in self._dead:
+            try:
+                item = self.inq[i].get(timeout=0.02)
+            except _queue.Empty:
+                continue
+            if not isinstance(item, dict):
+                continue
+            if item.get("op") == "model" and item.get("event") == "swap":
+                sa = item.get("serve_args") or {}
+                if sa.get("swap_fail"):
+                    self.outq[i].put({"rid": None,
+                                      "event": "model_swap_failed",
+                                      "error": "injected swap failure",
+                                      "swap_token":
+                                          item.get("swap_token")})
+                    continue
+                self.behavior[i] = {"salt": int(sa.get("salt", 0)),
+                                    "fail": bool(sa.get("fail"))}
+                self.outq[i].put({"rid": None, "event": "model_swapped",
+                                  "model": item.get("model"),
+                                  "version": item.get("version"),
+                                  "swap_token": item.get("swap_token"),
+                                  "load": 0})
+                continue
+            if item.get("op") != "gen":
+                continue
+            rid, p = item["rid"], item["prompt"]
+            beh = dict(self.behavior[i])
+            if beh["fail"]:
+                self.outq[i].put({"rid": rid, "event": "error",
+                                  "error": "injected regression",
+                                  "load": 0})
+                continue
+            toks = _fake_tokens(p, item["max_new_tokens"], beh["salt"])
+            for tok in toks:
+                if i in self._dead:
+                    return
+                if self.token_delay:
+                    time.sleep(self.token_delay)
+                self.outq[i].put({"rid": rid, "event": "tok",
+                                  "tokens": [tok], "load": 1})
+            self.outq[i].put({"rid": rid, "event": "done", "load": 0})
+
+    def kill(self, i):
+        self._dead.add(i)
+        self.backend.codes[i] = -9
+
+    def add_replica(self):
+        i = len(self.cluster_info)
+        info = {"executor_id": i, "job_name": "worker",
+                "addr": ("127.0.0.1", 0), "authkey": b"x"}
+        self.cluster_info.append(info)
+        self.backend.codes[i] = None
+        self.behavior[i] = {"salt": 0, "fail": False}
+        self.inq[i] = _queue.Queue()
+        self.outq[i] = _queue.Queue()
+        t = threading.Thread(target=self._run, args=(i,), daemon=True)
+        self.threads.append(t)
+        t.start()
+        return info
+
+    def add_workers(self, n, map_fun=None, tf_args=None, timeout=None):
+        # a spawned gang applies the version's serve_args like a real
+        # worker's builder would
+        infos = [self.add_replica() for _ in range(n)]
+        sa = dict(tf_args or {})
+        for info in infos:
+            self.behavior[int(info["executor_id"])] = {
+                "salt": int(sa.get("salt", 0)),
+                "fail": bool(sa.get("fail"))}
+        return infos
+
+    def retire_worker(self, eid):
+        pass
+
+    def _client_for(self, eid):
+        world = self
+
+        class _Ctl:
+            def put(self, qname, item, timeout=None):
+                world.control.append((eid, item))
+                world.inq[eid].put(item)
+
+        return _Ctl()
+
+    def client(self, info):
+        eid, world = info["executor_id"], self
+
+        class _C:
+            def put(self, qname, item, timeout=None):
+                if eid in world._dead:
+                    raise ConnectionError("replica dead")
+                world.inq[eid].put(item)
+
+            def get(self, qname, timeout=0.5):
+                if eid in world._dead:
+                    raise ConnectionError("replica dead")
+                try:
+                    return world.outq[eid].get(timeout=timeout)
+                except _queue.Empty:
+                    raise TimeoutError
+
+            def close(self):
+                pass
+
+        return _C()
+
+
+def _scheduler(world, **kw):
+    kw.setdefault("slots_per_replica", 2)
+    kw.setdefault("poll_interval", 0.05)
+    return ReplicaScheduler(world, client_factory=world.client, **kw)
+
+
+def _collect(req, timeout=10.0):
+    toks, deadline = [], time.monotonic() + timeout
+    while True:
+        ev = req.events.get(timeout=max(0.01, deadline - time.monotonic()))
+        if ev[0] == "tok":
+            toks.extend(ev[1])
+        elif ev[0] == "done":
+            return toks, None
+        else:
+            return toks, ev
+
+
+def _builder(args):  # a stand-in "model builder" for registry entries
+    return None, {"w": np.zeros((2,), np.float32)}
+
+
+def _tier(world, scheduler, registry=None):
+    """A driver-side ServingCluster over fakes (the _standby_tier
+    idiom): no frontend/monitor, real scheduler, real rollout paths.
+    Mirrors ``run()``'s founding label so the labeled-tier guards see
+    the same state a booted tier would."""
+    tier = ServingCluster(world, scheduler, monitor=None, frontend=None,
+                          address=("127.0.0.1", 0))
+    tier.registry = registry
+    if scheduler.default_model is not None:
+        for rep in scheduler.replicas.values():
+            if rep.model == scheduler.default_model:
+                tier._default_model = (rep.model, rep.version)
+                break
+    return tier
+
+
+# ------------------------------------------------------- registry units
+
+def test_registry_register_lookup_and_validation():
+    reg = ModelRegistry()
+    v1 = reg.register("chat", "v1", _builder)
+    assert reg.models() == ["chat"] and reg.versions("chat") == ["v1"]
+    assert reg.version("chat", "v1") is v1
+    assert v1.state == "registered" and not reg.promotable("chat", "v1")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.register("chat", "v1", _builder)
+    with pytest.raises(ValueError, match="exactly one"):
+        reg.register("chat", "v2")
+    with pytest.raises(ValueError, match="exactly one"):
+        reg.register("chat", "v2", _builder, base=_builder)
+    with pytest.raises(ValueError, match="adapter= needs base="):
+        reg.register("chat", "v2", _builder, adapter={"w": np.ones(2)})
+    with pytest.raises(KeyError, match="unknown version"):
+        reg.version("chat", "v9")
+    # adapter over a registered full base, by key
+    v2 = reg.register("chat", "v2", base=("chat", "v1"),
+                      adapter={"w": np.ones((2,), np.float32)})
+    assert v2.base_builder is _builder
+    assert v2.describe()["kind"] == "adapter"
+    # adapter-over-adapter is rejected
+    with pytest.raises(ValueError, match="adapter-over-adapter"):
+        reg.register("chat", "v3", base=("chat", "v2"))
+    with pytest.raises(ValueError, match="unknown state"):
+        reg.mark("chat", "v1", "bogus")
+
+
+def test_registry_eval_gate_and_serve_args():
+    reg = ModelRegistry()
+    reg.register("m", "v2", _builder, serve_args={"seed": 3})
+    assert not reg.promotable("m", "v2")
+    passed = reg.evaluate("m", "v2",
+                          scorer=lambda rs: ({"n": len(rs)}, len(rs) == 2),
+                          results=["a", "b"])
+    assert passed and reg.promotable("m", "v2")
+    entry = reg.version("m", "v2")
+    assert entry.state == "evaluated"
+    assert entry.eval_metrics == {"n": 2}
+    sa = entry.serve_args()
+    assert sa["serve_model"] == ("m", "v2") and sa["seed"] == 3
+    assert sa["serve_model_builder"] is _builder
+    assert entry.swap_payload()["builder"] is _builder
+    # a failed eval leaves the version unpromotable
+    reg.register("m", "v3", _builder)
+    assert not reg.evaluate("m", "v3",
+                            scorer=lambda rs: ({}, False), results=[])
+    assert not reg.promotable("m", "v3")
+
+
+def test_apply_adapter_paths_and_errors():
+    params = {"a": {"kernel": np.ones((2, 2), np.float32)},
+              "b": np.full((3,), 2.0, np.float32)}
+    out = apply_adapter(params, {"a/kernel": np.full((2, 2), 0.5),
+                                 "b": np.ones((3,))})
+    np.testing.assert_allclose(np.asarray(out["a"]["kernel"]), 1.5)
+    np.testing.assert_allclose(np.asarray(out["b"]), 3.0)
+    # the base is untouched (adapters share it across versions)
+    np.testing.assert_allclose(np.asarray(params["a"]["kernel"]), 1.0)
+    with pytest.raises(ValueError, match="unknown parameter path"):
+        apply_adapter(params, {"a/missing": np.ones((2, 2))})
+    with pytest.raises(ValueError, match="shape"):
+        apply_adapter(params, {"b": np.ones((4,))})
+
+
+def test_rollout_policy_validation():
+    RolloutPolicy(steps=(25, 100), bake_secs=0.0)
+    with pytest.raises(ValueError, match="ending at"):
+        RolloutPolicy(steps=(10, 50))
+    with pytest.raises(ValueError, match="increasing"):
+        RolloutPolicy(steps=(50, 10, 100))
+    with pytest.raises(ValueError, match="bake_secs"):
+        RolloutPolicy(bake_secs=-1)
+    with pytest.raises(ValueError, match="max_e2e_ratio"):
+        RolloutPolicy(max_e2e_ratio=0)
+
+
+# ------------------------------------------------- model routing units
+
+def test_model_routing_isolates_models_and_rejects_unknown():
+    """Two hosted models on one scheduler: requests route only to their
+    model's replicas (version-salted fake output proves it), stats keep
+    per-model series apart, and an unhosted model is rejected typed."""
+    world = _ModelWorld(2)
+    s = _scheduler(world, model=("a", "v1")).start()
+    try:
+        # a fresh replica joins as model b (the deploy path's shape);
+        # one founding a-gang retires (fake recv threads are per-eid,
+        # so reusing a retired eid would race its draining reader)
+        s.retire_replica(1)
+        info = world.add_replica()
+        world.behavior[int(info["executor_id"])] = {"salt": 5,
+                                                    "fail": False}
+        s.add_replica(info, model=("b", "v1"))
+        for k in range(3):
+            p = np.asarray([k + 1, 2], np.int32)
+            toks, err = _collect(s.submit(p, 4, model="a"))
+            assert err is None and toks == _fake_tokens(p, 4, 0)
+            toks, err = _collect(s.submit(p, 4, model="b"))
+            assert err is None and toks == _fake_tokens(p, 4, 5)
+        # unnamed requests resolve to the tier's default model
+        p = np.asarray([9], np.int32)
+        toks, err = _collect(s.submit(p, 3))
+        assert err is None and toks == _fake_tokens(p, 3, 0)
+        m = s.metrics()
+        assert m["replicas"][0]["model"] == "a"
+        assert m["replicas"][2]["model"] == "b"
+        assert m["models"]["a"]["v1"]["completed"] == 4
+        assert m["models"]["b"]["v1"]["completed"] == 3
+        assert s.model_versions("a") == {"v1": [0]}
+        assert s.model_versions("b") == {"v1": [2]}
+        with pytest.raises(RequestRejected) as ei:
+            s.submit(p, 2, model="zebra")
+        assert ei.value.reason == "unknown_model"
+        # per-model metric series stay apart (the satellite's point)
+        from tensorflowonspark_tpu import metrics as tpu_metrics
+
+        snap = tpu_metrics.get_registry().snapshot()
+        ttft_models = {lbl["model"] for lbl, _ in
+                       snap["tfos_serving_ttft_seconds"]["samples"]}
+        assert {"a", "b"} <= ttft_models
+    finally:
+        s.stop()
+
+
+def test_traffic_split_is_deterministic_and_clearable():
+    """A 50/50 then 10/90 split lands EXACT proportions over the
+    dispatch-counter bucket cycle, and clearing the split restores pure
+    least-outstanding routing."""
+    world = _ModelWorld(2)
+    s = _scheduler(world, model=("m", "v1")).start()
+    try:
+        s.retire_replica(1)
+        info = world.add_replica()
+        world.behavior[int(info["executor_id"])] = {"salt": 1,
+                                                    "fail": False}
+        s.add_replica(info, model=("m", "v2"))
+        with pytest.raises(ValueError, match="summing to 100"):
+            s.set_traffic_split("m", {"v1": 30, "v2": 30})
+        s.set_traffic_split("m", {"v2": 50, "v1": 50})
+        outs = []
+        for k in range(10):
+            p = np.asarray([k + 1], np.int32)
+            toks, err = _collect(s.submit(p, 3, model="m"))
+            assert err is None
+            outs.append(toks == _fake_tokens(p, 3, 1))  # served by v2?
+        assert sum(outs) == 5, f"50/50 split served {sum(outs)}/10 on v2"
+        assert s.metrics()["traffic"] == {"m": {"v2": 50.0, "v1": 50.0}}
+        s.set_traffic_split("m", {"v2": 10, "v1": 90})
+        outs = []
+        for k in range(20):
+            p = np.asarray([40 + k], np.int32)
+            toks, err = _collect(s.submit(p, 3, model="m"))
+            assert err is None
+            outs.append(toks == _fake_tokens(p, 3, 1))
+        assert sum(outs) == 2, f"10/90 split served {sum(outs)}/20 on v2"
+        s.clear_traffic_split("m")
+        assert s.metrics()["traffic"] == {}
+    finally:
+        s.stop()
+
+
+def test_saturated_model_never_blocks_the_other():
+    """Head-of-line isolation: model a's only replica is busy with a
+    slow stream and its queue holds a waiting request; model b's
+    request must still dispatch immediately."""
+    world = _ModelWorld(2, token_delay=0.15)
+    s = _scheduler(world, slots_per_replica=1, overcommit=1,
+                   model=("a", "v1")).start()
+    try:
+        s.retire_replica(1)
+        info = world.add_replica()
+        s.add_replica(info, model=("b", "v1"))
+        blocker = s.submit(np.asarray([1], np.int32), 8, model="a")
+        waiting = s.submit(np.asarray([2], np.int32), 2, model="a")
+        t0 = time.monotonic()
+        p = np.asarray([3], np.int32)
+        toks, err = _collect(s.submit(p, 2, model="b"))
+        fast = time.monotonic() - t0
+        assert err is None and toks == _fake_tokens(p, 2, 0)
+        assert fast < 1.0, f"model b waited {fast:.2f}s behind model a"
+        for req in (blocker, waiting):
+            _, err = _collect(req, timeout=15)
+            assert err is None
+    finally:
+        s.stop()
+
+
+def test_model_heal_grace_holds_then_fresh_replica_serves():
+    """The per-model heal window: model b's only replica dies on a tier
+    with heal paths — b's queued/new traffic is HELD (not shed) until a
+    replacement registers, then completes exactly."""
+    world = _ModelWorld(2, token_delay=0.05)
+    s = _scheduler(world, model=("a", "v1")).start()
+    s.heal_grace = 10.0
+    try:
+        s.retire_replica(1)
+        first_b = world.add_replica()
+        b_eid = int(first_b["executor_id"])
+        s.add_replica(first_b, model=("b", "v1"))
+        world.kill(b_eid)
+        deadline = time.monotonic() + 5
+        while b_eid not in s.dead_replicas() \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        # model b is dead-but-healing: admission accepts and queues
+        p = np.asarray([7, 7], np.int32)
+        req = s.submit(p, 3, model="b")
+        time.sleep(0.3)
+        assert not req.finished, "held request was shed during the heal"
+        info = world.add_replica()
+        s.add_replica(info, model=("b", "v1"))
+        toks, err = _collect(req, timeout=10)
+        assert err is None and toks == _fake_tokens(p, 3, 0)
+        # model a kept serving throughout
+        toks, err = _collect(s.submit(p, 2, model="a"))
+        assert err is None
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------------ hot-swap units
+
+def _swap_registry():
+    reg = ModelRegistry()
+    reg.register("m", "v1", _builder, serve_args={"salt": 0})
+    reg.register("m", "v2", _builder, serve_args={"salt": 9})
+    reg.record_eval("m", "v2", {"ok": 1}, passed=True)
+    return reg
+
+
+def test_hot_swap_drains_swaps_and_resumes():
+    """The drain-verb hot swap end-to-end over fakes: routing stops,
+    the payload ships, the replica acks, the label flips, routing
+    resumes — and post-swap output is the NEW version's."""
+    world = _ModelWorld(2)
+    reg = _swap_registry()
+    s = _scheduler(world, model=("m", "v1")).start()
+    tier = _tier(world, s, registry=reg)
+    try:
+        tier.swap_replica_model(1, "m", "v2")
+        m = s.metrics()["replicas"]
+        assert m[1]["version"] == "v2" and not m[1]["draining"]
+        assert s.model_versions("m") == {"v1": [0], "v2": [1]}
+        # the swap message carried the registered payload
+        [(eid, msg)] = [(e, i) for e, i in world.control
+                        if i.get("op") == "model"]
+        assert eid == 1 and msg["version"] == "v2"
+        assert msg["serve_args"] == {"salt": 9}
+        # v2 traffic lands on the swapped gang with v2 output
+        s.set_traffic_split("m", {"v2": 100})
+        p = np.asarray([4, 4], np.int32)
+        toks, err = _collect(s.submit(p, 4, model="m"))
+        assert err is None and toks == _fake_tokens(p, 4, 9)
+    finally:
+        s.stop()
+
+
+def test_hot_swap_failure_keeps_old_version_routable():
+    world = _ModelWorld(1)
+    reg = _swap_registry()
+    reg.register("m", "bad", _builder, serve_args={"swap_fail": True})
+    reg.record_eval("m", "bad", {}, passed=True)
+    s = _scheduler(world, model=("m", "v1")).start()
+    tier = _tier(world, s, registry=reg)
+    try:
+        with pytest.raises(RuntimeError, match="injected swap failure"):
+            tier.swap_replica_model(0, "m", "bad")
+        rep = s.metrics()["replicas"][0]
+        assert rep["version"] == "v1" and not rep["draining"]
+        p = np.asarray([2], np.int32)
+        toks, err = _collect(s.submit(p, 3, model="m"))
+        assert err is None and toks == _fake_tokens(p, 3, 0)
+    finally:
+        s.stop()
+
+
+def test_model_less_scale_up_inherits_founding_label():
+    """A model-less scale_up on a multi-model tier (the autoscaler's
+    call shape) must NOT register an unlabeled replica — unlabeled
+    matches every model's routing while serving only the founding
+    weights.  The newcomer inherits the founding (model, version)."""
+    world = _ModelWorld(1)
+    reg = _swap_registry()
+    s = _scheduler(world, model=("m", "v1")).start()
+    tier = _tier(world, s, registry=reg)
+    tier._default_model = ("m", "v1")
+    try:
+        [eid] = tier.scale_up(1)
+        rep = s.metrics()["replicas"][eid]
+        assert rep["model"] == "m" and rep["version"] == "v1", rep
+        p = np.asarray([2, 2], np.int32)
+        toks, err = _collect(s.submit(p, 3, model="m"))
+        assert err is None and toks == _fake_tokens(p, 3, 0)
+    finally:
+        s.stop()
+
+
+def test_late_swap_ack_relabels_replica():
+    """A swap ack arriving after the driver's waiter gave up still
+    updates the routing label — the label always tracks the version
+    actually served (the timeout path's cancel is best-effort)."""
+    world = _ModelWorld(1)
+    s = _scheduler(world, model=("m", "v1")).start()
+    try:
+        s._handle_response(s.replicas[0],
+                           {"rid": None, "event": "model_swapped",
+                            "model": "m", "version": "v9", "load": 0})
+        rep = s.metrics()["replicas"][0]
+        assert rep["version"] == "v9" and not rep["draining"]
+    finally:
+        s.stop()
+
+
+def test_dead_model_rejects_typed_without_heal():
+    """With no heal coming (heal_grace 0), a model whose last gang died
+    rejects at ADMISSION (typed unknown_model) instead of accepting
+    requests that can only fail no_replica."""
+    world = _ModelWorld(2)
+    s = _scheduler(world, model=("a", "v1")).start()
+    try:
+        s.retire_replica(1)
+        info = world.add_replica()
+        b_eid = int(info["executor_id"])
+        s.add_replica(info, model=("b", "v1"))
+        world.kill(b_eid)
+        deadline = time.monotonic() + 5
+        while b_eid not in s.dead_replicas() \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        with pytest.raises(RequestRejected) as ei:
+            s.submit(np.asarray([1], np.int32), 2, model="b")
+        assert ei.value.reason == "unknown_model"
+        assert "no longer" in str(ei.value)
+        # model a is untouched
+        _, err = _collect(s.submit(np.asarray([1], np.int32), 2,
+                                   model="a"))
+        assert err is None
+    finally:
+        s.stop()
+
+
+# ----------------------------------------------------- controller units
+
+def test_rollout_refuses_unevaluated_version():
+    world = _ModelWorld(1)
+    reg = ModelRegistry()
+    reg.register("m", "v1", _builder)
+    reg.register("m", "v2", _builder)
+    s = _scheduler(world, model=("m", "v1")).start()
+    tier = _tier(world, s, registry=reg)
+    try:
+        with pytest.raises(RolloutError, match="offline eval"):
+            tier.rollout("m", "v2")
+    finally:
+        s.stop()
+
+
+def test_rollout_promotes_clean_canary():
+    """A healthy canary walks every traffic step and promotes: both
+    gangs end on v2, the split is cleared, the registry records
+    serving/retired."""
+    world = _ModelWorld(2)
+    reg = _swap_registry()
+    s = _scheduler(world, model=("m", "v1")).start()
+    tier = _tier(world, s, registry=reg)
+    try:
+        # background load keeps the gate fed with canary samples
+        stop = threading.Event()
+
+        def load():
+            k = 0
+            while not stop.is_set():
+                k += 1
+                try:
+                    _collect(s.submit(np.asarray([k % 11 + 1], np.int32),
+                                      3, model="m"), timeout=5)
+                except Exception:
+                    return
+                time.sleep(0.01)
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        ctl = tier.rollout("m", "v2", policy=RolloutPolicy(
+            steps=(50, 100), bake_secs=0.4, min_samples=3,
+            max_e2e_ratio=None))
+        stop.set()
+        t.join(5)
+        assert ctl.state == "promoted", ctl.detail
+        assert s.model_versions("m") == {"v2": [0, 1]}
+        assert s.metrics()["traffic"] == {}
+        assert reg.version("m", "v2").state == "serving"
+        assert reg.version("m", "v1").state == "retired"
+        # the fleet serves v2 output now
+        p = np.asarray([6], np.int32)
+        toks, err = _collect(s.submit(p, 3, model="m"))
+        assert err is None and toks == _fake_tokens(p, 3, 9)
+    finally:
+        s.stop()
+
+
+def test_rollout_rolls_back_on_canary_error_rate():
+    """Acceptance: an injected canary regression (every request errors)
+    trips the metrics gate — traffic snaps back to v1, the canary gang
+    swaps back, v2 is marked rolled_back, and the incumbent never
+    stopped serving."""
+    world = _ModelWorld(2)
+    reg = _swap_registry()
+    reg.register("m", "v3", _builder, serve_args={"salt": 0, "fail": True})
+    reg.record_eval("m", "v3", {"offline": "cannot see latency"},
+                    passed=True)
+    s = _scheduler(world, model=("m", "v1")).start()
+    tier = _tier(world, s, registry=reg)
+    try:
+        stop = threading.Event()
+        outcomes = {"ok": 0, "err": 0}
+
+        def load():
+            k = 0
+            while not stop.is_set():
+                k += 1
+                try:
+                    _, err = _collect(
+                        s.submit(np.asarray([k % 11 + 1], np.int32), 3,
+                                 model="m"), timeout=5)
+                    outcomes["err" if err else "ok"] += 1
+                except Exception:
+                    return
+                time.sleep(0.01)
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        ctl = tier.rollout("m", "v3", policy=RolloutPolicy(
+            steps=(50, 100), bake_secs=0.5, min_samples=1,
+            max_error_rate=0.2, max_e2e_ratio=None))
+        stop.set()
+        t.join(5)
+        assert ctl.state == "rolled_back", ctl.detail
+        assert "error rate" in ctl.detail["reason"]
+        assert reg.version("m", "v3").state == "rolled_back"
+        # the canary gang swapped BACK to v1; the whole fleet serves v1
+        assert s.model_versions("m") == {"v1": [0, 1]}
+        assert s.metrics()["traffic"] == {}
+        p = np.asarray([8, 1], np.int32)
+        toks, err = _collect(s.submit(p, 4, model="m"))
+        assert err is None and toks == _fake_tokens(p, 4, 0)
+        assert outcomes["ok"] > 0, "the incumbent stopped serving"
+    finally:
+        s.stop()
+
+
+def test_deploy_model_requires_labeled_tier():
+    """Hosting a second model beside an UNLABELED founding fleet would
+    let the founding weights serve the new model's traffic (unlabeled
+    replicas match every model) — deploy_model refuses up front."""
+    world = _ModelWorld(1)
+    reg = _swap_registry()
+    s = _scheduler(world).start()            # no model label
+    tier = _tier(world, s, registry=reg)
+    try:
+        with pytest.raises(RuntimeError, match="model-labeled tier"):
+            tier.deploy_model("m", "v2")
+    finally:
+        s.stop()
+
+
+def test_promote_phase_swap_failure_clears_split():
+    """A finishing-swap failure after the steps baked clean must not
+    strand the {new: 100} split: routing falls back to capacity across
+    the mixed fleet and the rollout reports failed."""
+    world = _ModelWorld(2)
+    reg = _swap_registry()
+    s = _scheduler(world, model=("m", "v1")).start()
+    tier = _tier(world, s, registry=reg)
+    try:
+        calls = []
+        real = tier.swap_replica_model
+
+        def flaky(eid, mid, ver, timeout=None):
+            calls.append(eid)
+            if len(calls) >= 2:              # the finishing swap
+                raise RuntimeError("injected finishing-swap failure")
+            return real(eid, mid, ver, timeout=timeout)
+
+        tier.swap_replica_model = flaky
+        # min_samples=0: the promotion-evidence gate must not trip —
+        # this test targets the FINISHING loop's failure cleanup
+        ctl = RolloutController(tier, "m", "v2", policy=RolloutPolicy(
+            steps=(100,), bake_secs=0.05, min_samples=0))
+        with pytest.raises(RuntimeError, match="injected"):
+            ctl.run()
+        assert ctl.state == "failed"
+        assert s.metrics()["traffic"] == {}, \
+            "the failed promote leaked a live traffic split"
+        # the mixed fleet still serves (each gang its own version)
+        p = np.asarray([5], np.int32)
+        toks, err = _collect(s.submit(p, 3, model="m"))
+        assert err is None and toks in (_fake_tokens(p, 3, 0),
+                                        _fake_tokens(p, 3, 9))
+    finally:
+        s.stop()
+
+
+def test_rollout_needs_single_incumbent():
+    world = _ModelWorld(2)
+    reg = _swap_registry()
+    s = _scheduler(world, model=("m", "v1")).start()
+    tier = _tier(world, s, registry=reg)
+    try:
+        tier.swap_replica_model(1, "m", "v2")
+        reg.register("m", "v4", _builder)
+        reg.record_eval("m", "v4", {}, passed=True)
+        with pytest.raises(RolloutError, match="exactly one incumbent"):
+            tier.rollout("m", "v4")
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------- engine-level pieces
+
+def _tiny_paged_batcher(prefill_only=False, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import GPT, GPTConfig
+    from tensorflowonspark_tpu.models.serving import ContinuousBatcher
+
+    cfg = GPTConfig(vocab_size=31, hidden_size=16, num_layers=1,
+                    num_heads=2, intermediate_size=32,
+                    max_position_embeddings=32, dtype=jnp.float32,
+                    pos_encoding="rope")
+    params = GPT(cfg).init(jax.random.key(seed),
+                           jnp.ones((1, 4), jnp.int32))["params"]
+    return ContinuousBatcher(cfg, params, max_batch=2,
+                             kv_page_tokens=4, prefill_only=prefill_only)
+
+
+def test_load_params_validates_tree_shapes():
+    """A hot-swapped/cloned tree must match the compiled structure —
+    shape or structure drift raises instead of poisoning a dispatch."""
+    import jax
+
+    b = _tiny_paged_batcher()
+    good = jax.tree.map(lambda x: np.asarray(x), b.params)
+    b.unload_params()
+    bad = {k: v for k, v in good.items()}
+    bad["extra"] = np.zeros((1,), np.float32)
+    with pytest.raises(ValueError, match="structure differs"):
+        b.load_params(bad)
+    wrong = jax.tree.map(
+        lambda x: np.zeros(tuple(np.shape(x)) + (1,), np.float32), good)
+    with pytest.raises(ValueError, match="shape/dtype"):
+        b.load_params(wrong)
+    b.load_params(good)          # the faithful tree re-arms it
+    rid = b.submit(np.asarray([1, 2, 3], np.int32), 2)
+    while b.result(rid) is None:
+        b.step()
+
+
+def test_prefix_donation_prewarms_decode_pool():
+    """Cross-pool prefix-page donation (ROADMAP item-2 leftover): a
+    prefill pool's exported prefix index, imported by a decode batcher,
+    turns the decode side's session adopt into a prefix HIT — the
+    donated pages are matched instead of importing the session's page
+    data."""
+    prefill = _tiny_paged_batcher(prefill_only=True)
+    decode = _tiny_paged_batcher()
+    prompt = np.asarray([3, 1, 4, 1, 5, 9, 2, 6], np.int32)  # 2 pages
+    # the prefill pool computes the prompt once; its index holds the
+    # full prompt pages after release
+    rid = prefill.submit(prompt, 4)
+    prefill.step()
+    sessions = prefill.take_sessions()
+    assert len(sessions) == 1
+    export = prefill.export_prefix_cache()
+    assert export is not None and export["pages"] >= 2
+    assert decode.import_prefix_cache(export) >= 2
+    # a second prefill of the same prompt hands off again; the decode
+    # side adopts it with its donated pages matching
+    rid2 = prefill.submit(prompt, 4)
+    prefill.step()
+    [(_, session)] = prefill.take_sessions()
+    before = decode._pages.stats()["hit"]
+    brid = decode.adopt_session(session)
+    decode.step()                 # seats the adoption
+    assert decode._pages.stats()["hit"] == before + 1, \
+        "the donated pages did not match the adopted session's prefix"
+    # and the adopted stream completes
+    while decode.result(brid) is None:
+        decode.step()
+    assert decode.sessions_adopted == 1

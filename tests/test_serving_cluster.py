@@ -316,15 +316,18 @@ def test_scheduler_registry_series_update(tmp_path):
     world = _FakeWorld(2)
     s = _scheduler(world).start()
     reg = tpu_metrics.get_registry()
-    c = reg.counter("tfos_serving_requests_total", labelnames=("outcome",))
-    accepted0 = c.value(outcome="accepted")
-    completed0 = c.value(outcome="completed")
+    c = reg.counter("tfos_serving_requests_total",
+                    labelnames=("outcome", "model"))
+    accepted0 = c.value(outcome="accepted", model="default")
+    completed0 = c.value(outcome="completed", model="default")
     try:
         req = s.submit(np.asarray([1, 2], np.int32), 4)
         _, err = _collect(req)
         assert err is None
-        assert c.value(outcome="accepted") == accepted0 + 1
-        assert c.value(outcome="completed") == completed0 + 1
+        # single-model tiers collapse to the model="default" series
+        assert c.value(outcome="accepted", model="default") == accepted0 + 1
+        assert c.value(outcome="completed",
+                       model="default") == completed0 + 1
         snap = reg.snapshot()    # runs the collect hook
         outst = {tuple(sorted(lbl.items())): v for lbl, v in
                  snap["tfos_serving_replica_outstanding_count"]["samples"]}
@@ -2028,11 +2031,23 @@ def test_promote_with_role_joins_decode_pool_and_serves():
         assert world.control, "promote control message never sent"
         assert s.replica_role(2) == "decode", \
             "the newcomer must join the DEAD gang's pool"
-        [(ctl_eid, promote)] = world.control
+        [(ctl_eid, promote)] = [(e, m) for e, m in world.control
+                                if m.get("op") == "standby"]
         assert ctl_eid == 2
         assert promote["op"] == "standby" and promote["event"] == "promote"
         assert promote["role"] == "decode", \
             "the promote message must carry the target pool's role"
+        # a decode-pool promotion also triggers a prefix-page donation
+        # request to a prefill gang (background thread — wait for it)
+        deadline = time.monotonic() + 5
+        while not any(m.get("op") == "prefix" for _, m in world.control) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        donations = [(e, m) for e, m in world.control
+                     if m.get("op") == "prefix"]
+        assert donations and donations[0][0] == 0, \
+            "the donation export must go to the prefill gang"
+        assert donations[0][1]["event"] == "export"
         # the healed pipeline spans the boundary: prompt -> prefill 0 ->
         # handoff -> adopted by the promoted decode gang 2
         for k in range(3):

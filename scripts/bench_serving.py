@@ -113,6 +113,23 @@ def bench_model_builder(args):
     return cfg, params
 
 
+def bench_draft_builder(args):
+    """Draft model for the speculation rows: the SAME seeded tiny GPT as
+    the target (top level so spawn pickles it by reference).  A
+    same-weights draft makes the bench measure the dispatch-amortization
+    CEILING — every proposal the window can see agrees with the target,
+    so acceptance is bounded only by window truncation and per-row
+    budget clipping, and the tok/s delta is purely dispatches-per-token.
+    A real tier's smaller draft trades some acceptance for a cheaper
+    propose; correctness is identical either way (verify-gated)."""
+    return bench_model_builder(args)
+
+
+#: speculation-row knobs: window >= prompt + budget, so the draft's
+#: truncated context never diverges from the full history (the
+#: acceptance ceiling); window + k must fit the draft's MAXLEN
+SPEC_K, SPEC_WINDOW = 6, 48
+
 SHARDED_VOCAB = 64   # vocab/heads/ffn must divide by the gang tp
 
 
@@ -1489,6 +1506,271 @@ def ramp_scenario(n_requests, base_rate, slots, replace_step, seed=0,
     }
 
 
+def spec_ab_scenario(smoke: bool, seed=0) -> dict:
+    """Draft-speculation A/B, in-process: a greedy repetitive-completion
+    workload (tiled-motif prompts whose continuation locks into a
+    cycle — the regime prompt-lookup and drafting both target) through
+    a plain per-token batcher and a draft-armed speculative one, both
+    oracle-checked token-for-token against solo ``greedy_generate``.
+
+    What the timer isolates: the DECODE DRAIN.  The decode loop is
+    KV-cached single-token dispatches, so it is dispatch-bound, not
+    compute-bound (the tp=1-vs-tp=2 tie in sharded_serving.json) — the
+    plain arm pays one dispatch per token while the spec arm pays one
+    draft-propose + one fused verify per k+1 tokens.  Admission/prefill
+    (identical work in both arms, and not what speculation changes) is
+    paid by an untimed first ``step()``; executables are pre-paid by an
+    untimed identical warm wave.  Full mode uses long prompts in a
+    512-position model with a short draft window (the trailing-window
+    propose stays faithful because RoPE attention is relative and the
+    continuation is cyclic); smoke shrinks to the 64-position bench
+    model with a full-history window and keeps the gates directional.
+    In-process on purpose: the tier's queue plane would add constant
+    per-token overhead to BOTH arms and dilute the dispatch count this
+    bench isolates."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import (GPT, GPTConfig,
+                                              greedy_generate)
+    from tensorflowonspark_tpu.models.serving import (ContinuousBatcher,
+                                                      DraftModel)
+
+    slots = 8                 # one admission wave: every request admits
+    rng = np.random.default_rng(seed)
+    if smoke:
+        n_requests, plen, budget = 8, 8, SPEC_WINDOW - 8
+        k, window = SPEC_K, SPEC_WINDOW
+        cfg, params = bench_model_builder({"seed": seed})
+        reqs = [rng.integers(0, VOCAB, (plen,)).astype(np.int32)
+                for _ in range(n_requests)]
+    else:
+        # short window: the cyclic continuation makes a trailing-4-token
+        # draft context faithful, and the k-step draft scan's cost is
+        # linear in window — the cheapest honest draft for this regime
+        n_requests, plen, budget, k, window = 8, 320, 96, 12, 4
+        cfg = GPTConfig(vocab_size=VOCAB, hidden_size=HIDDEN,
+                        num_layers=LAYERS, num_heads=HEADS,
+                        intermediate_size=2 * HIDDEN,
+                        max_position_embeddings=512, dtype=jnp.float32,
+                        pos_encoding="rope")
+        params = GPT(cfg).init(jax.random.key(seed),
+                               jnp.ones((1, 4), jnp.int32))["params"]
+        reqs = [np.tile(rng.integers(0, VOCAB, (16,)).astype(np.int32),
+                        plen // 16) for _ in range(n_requests)]
+    oracle = [np.asarray(greedy_generate(
+        cfg, params, jnp.asarray(p)[None, :], budget))[0, plen:].tolist()
+        for p in reqs]
+
+    def run_arm(spec: bool) -> dict:
+        if spec:
+            b = ContinuousBatcher(cfg, params, max_batch=slots,
+                                  speculative_k=k)
+            b.set_draft(DraftModel(cfg, params, window=window))
+        else:
+            b = ContinuousBatcher(cfg, params, max_batch=slots)
+        # pay the executables outside the measured window with one
+        # identical warm wave (prefill group + decode/verify/propose)
+        warm = [b.submit(p, budget) for p in reqs]
+        while b.load()["total"]:
+            b.step()
+        for rid in warm:
+            b.result(rid, pop=True)
+        # best of 3 measured waves: the per-wave wall is tens of ms, so
+        # a single scheduler hiccup could otherwise decide the gate
+        best, exact = None, True
+        for _ in range(3):
+            rids = {b.submit(p, budget): i for i, p in enumerate(reqs)}
+            b.step()          # untimed: admission + prefill dispatch
+            tok0 = sum(len(s.tokens) for s in b.slots if s is not None)
+            d0, s0 = b.decode_dispatches, b.decode_steps
+            t0 = time.monotonic()
+            while b.load()["total"]:
+                b.step()
+            wall = time.monotonic() - t0
+            outs = {i: list(b.result(rid, pop=True))
+                    for rid, i in rids.items()}
+            exact = exact and all(outs[i] == oracle[i]
+                                  for i in range(n_requests))
+            tokens = sum(len(v) for v in outs.values()) - tok0
+            wave = {"wall_secs": round(wall, 3), "decode_tokens": tokens,
+                    "tok_per_s": round(tokens / wall, 1),
+                    "decode_dispatches": b.decode_dispatches - d0,
+                    "decode_steps": b.decode_steps - s0}
+            if best is None or wave["tok_per_s"] > best["tok_per_s"]:
+                best = wave
+        row = {**best, "oracle_exact": exact}
+        if spec:
+            row.update({
+                "draft_dispatches": b.draft_dispatches,
+                "proposed": b.spec_proposed, "accepted": b.spec_accepted,
+                "acceptance": round(b.spec_accepted
+                                    / max(1, b.spec_proposed), 3)})
+        return row
+
+    plain = run_arm(False)
+    spec = run_arm(True)
+    return {"scenario": "spec_ab", "k": k, "window": window,
+            "requests": n_requests, "prompt_tokens": plen,
+            "budget": budget, "plain": plain, "spec": spec,
+            "speedup": round(spec["tok_per_s"] / plain["tok_per_s"], 3),
+            "oracle_exact": plain["oracle_exact"]
+            and spec["oracle_exact"]}
+
+
+def aot_warmup_scenario(seed=0) -> dict:
+    """AOT warm-up A/B: the standby bucket x group sweep
+    (``standby._warm_batcher``) against an EMPTY AOT cache directory
+    (every site pays trace + lower + XLA compile) and again, fresh
+    batcher, against the now-populated one (every site is a
+    ``deserialize_and_load``) — the standby ``standby_warmup`` phase
+    duration with and without a pre-baked cache.  The load arm must
+    compile exactly 0 executables (the ``tfos_warmcache.py`` contract)."""
+    import tempfile
+
+    from tensorflowonspark_tpu.models.serving import (ContinuousBatcher,
+                                                      DraftModel)
+    from tensorflowonspark_tpu.serving.aot import AOTExecutableCache
+    from tensorflowonspark_tpu.serving.standby import _warm_batcher
+
+    cfg, params = bench_model_builder({"seed": seed})
+    cache_dir = tempfile.mkdtemp(prefix="tfos_aot_bench_")
+
+    def arm():
+        cache = AOTExecutableCache(cache_dir)
+        b = ContinuousBatcher(cfg, params, max_batch=4,
+                              speculative_k=SPEC_K, aot_cache=cache)
+        b.set_draft(DraftModel(cfg, params, window=32))
+        t0 = time.monotonic()
+        _warm_batcher(b)
+        return round(time.monotonic() - t0, 3), cache.stats()
+
+    compile_secs, s_compile = arm()
+    load_secs, s_load = arm()
+    return {"scenario": "aot_warmup", "cache_dir": cache_dir,
+            "compile_arm": {"wall_secs": compile_secs, **s_compile},
+            "load_arm": {"wall_secs": load_secs, **s_load},
+            "ratio": round(load_secs / compile_secs, 3)}
+
+
+def spec_heal_scenario(slots, kill_step, seed=0) -> dict:
+    """Zero-loss heal with speculation + AOT armed tier-wide: a real
+    2-replica tier (+1 warm standby) serving with the draft model and
+    the AOT cache, a chaos SIGKILL of replica 1 mid-stream, every
+    accepted request completing oracle-exact — speculation must survive
+    requeue-once failover AND the standby promotion re-arm (the
+    promoted engine proposes with the same draft, loads its executables
+    from the cache the dead replica populated)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import greedy_generate
+    from tensorflowonspark_tpu.serving import ServingCluster
+
+    n_requests, rate = 24, 10.0
+    rng = np.random.default_rng(seed)
+    reqs = [(rng.integers(0, VOCAB, (int(rng.integers(3, 10)),))
+             .astype(np.int32), int(rng.integers(8, 17)))
+            for _ in range(n_requests)]
+    serving = ServingCluster.run(
+        bench_model_builder, 2, max_batch=slots,
+        draft_model=bench_draft_builder, aot_cache=True,
+        replica_args={"serve_draft_window": SPEC_WINDOW,
+                      "serve_draft_k": SPEC_K},
+        warm_standbys=1,
+        worker_env={"JAX_PLATFORMS": "cpu",
+                    "TFOS_CHAOS": f"kill node=1 at_step={kill_step}"},
+        reservation_timeout=120)
+    try:
+        def _warm():
+            with serving.client() as c:
+                c.generate(reqs[0][0], 2, timeout=600)
+
+        warmers = [threading.Thread(target=_warm) for _ in range(2)]
+        for t in warmers:
+            t.start()
+        for t in warmers:
+            t.join(600)
+        t0 = time.monotonic()
+        records = _run_load(serving, reqs, rate, rng)
+        wall = time.monotonic() - t0
+        sched = serving.metrics()
+    finally:
+        serving.shutdown(timeout=300)
+
+    lost = [i for i, r in enumerate(records)
+            if r is None or (not r["ok"] and "error" not in r)]
+    failed = [r for r in records if r and not r["ok"]]
+    cfg, params = bench_model_builder({"seed": seed})
+    exact = True
+    for (p, n), r in zip(reqs, records):
+        if r and r["ok"]:
+            want = np.asarray(greedy_generate(
+                cfg, params, jnp.asarray(p)[None, :], n))[0, len(p):]
+            exact = exact and r["out"] == want.tolist()
+    tokens = sum(r["tokens"] for r in records if r and r["ok"])
+    specs = [rep.get("spec") for rep in sched["replicas"].values()
+             if rep.get("spec")]
+    return {"scenario": "spec_heal", "requests": n_requests,
+            "kill_plan": f"kill node=1 at_step={kill_step}",
+            "lost": len(lost), "failed": len(failed),
+            "oracle_exact": exact, "tokens_total": tokens,
+            "wall_secs": round(wall, 3),
+            "throughput_tokens_per_s": round(tokens / wall, 2),
+            # the scheduler-side acceptance piggyback, as routing sees it
+            "replica_spec": specs,
+            "requeued": sched["requeued"]}
+
+
+SPEC_AB_KEYS = {"scenario", "k", "window", "requests", "budget", "plain",
+                "spec", "speedup", "oracle_exact"}
+
+
+def validate_spec_artifact(out: dict) -> None:
+    """Self-gates for ``spec_serving.json`` (full) /
+    ``spec_serving_smoke.json`` (ci.sh --bench-smoke).  Oracle and
+    load-arm-compiles-0 are hard everywhere; the speedup >= 1.3x,
+    acceptance >= 50% and warm-up <= 0.5x gates apply to the full run
+    (smoke keeps them directional: acceptance > 0)."""
+    if out.get("benchmark") != "spec_serving":
+        raise RuntimeError("artifact gate: wrong benchmark name")
+    smoke = bool(out.get("config", {}).get("smoke"))
+    rows = {r["scenario"]: r for r in (out.get("rows") or [])}
+    ab = rows.get("spec_ab")
+    if ab is None or SPEC_AB_KEYS - set(ab):
+        raise RuntimeError("artifact gate: spec_ab row missing/short")
+    if not ab["oracle_exact"]:
+        raise RuntimeError("artifact gate: spec_ab outputs diverged from "
+                           "solo greedy (the speculation oracle)")
+    acc = ab["spec"]["acceptance"]
+    if acc <= 0:
+        raise RuntimeError("artifact gate: zero speculation acceptance — "
+                           "the draft path never engaged")
+    wu = rows.get("aot_warmup")
+    if wu is None:
+        raise RuntimeError("artifact gate: aot_warmup row missing")
+    if wu["load_arm"]["compiles"] != 0:
+        raise RuntimeError(
+            f"artifact gate: pre-baked warm-up compiled "
+            f"{wu['load_arm']['compiles']} executable(s); must load all")
+    if not smoke:
+        if acc < 0.5:
+            raise RuntimeError(f"artifact gate: acceptance {acc} < 0.5")
+        if ab["speedup"] < 1.3:
+            raise RuntimeError(f"artifact gate: speculation speedup "
+                               f"{ab['speedup']}x < 1.3x")
+        if wu["ratio"] > 0.5:
+            raise RuntimeError(f"artifact gate: AOT warm-up ratio "
+                               f"{wu['ratio']} > 0.5")
+        heal = rows.get("spec_heal")
+        if heal is None:
+            raise RuntimeError("artifact gate: full run needs spec_heal")
+        if heal["lost"] or heal["failed"] or not heal["oracle_exact"]:
+            raise RuntimeError("artifact gate: spec_heal violates the "
+                               "zero-loss/oracle gates")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=60)
@@ -1547,6 +1829,15 @@ def main():
                          "serving_multimodel.json.  The full rollout "
                          "suite (hot swap / canary rollback / standby "
                          "re-arm) lives in scripts/bench_rollout.py")
+    ap.add_argument("--spec", action="store_true",
+                    help="run the draft-speculation + AOT rows instead: "
+                         "in-process spec-on/off A/B (oracle-exact, "
+                         ">=1.3x + >=50%% acceptance gates), AOT warm-up "
+                         "A/B (pre-baked load arm must compile 0), and "
+                         "(full only) a chaos heal through a spec+AOT "
+                         "tier; writes bench_artifacts/spec_serving.json "
+                         "(--smoke: spec_serving_smoke.json, gates "
+                         "directional)")
     args = ap.parse_args()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -1572,6 +1863,28 @@ def main():
             json.dump(artifact, f, indent=1)
         print(f"wrote {out}")
         print(json.dumps(row, indent=1))
+        return
+
+    if args.spec:
+        rows = [spec_ab_scenario(smoke=args.smoke),
+                aot_warmup_scenario()]
+        if not args.smoke:
+            rows.append(spec_heal_scenario(args.slots, args.kill_step))
+        artifact = {"benchmark": "spec_serving",
+                    "config": {"smoke": bool(args.smoke), "k": SPEC_K,
+                               "window": SPEC_WINDOW,
+                               "slots": args.slots},
+                    "rows": rows}
+        validate_spec_artifact(artifact)
+        # --smoke writes its own file, never the committed full artifact
+        out = os.path.join(REPO, "bench_artifacts",
+                           "spec_serving_smoke.json" if args.smoke
+                           else "spec_serving.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"wrote {out}")
+        print(json.dumps(rows, indent=1))
         return
 
     if args.disagg:

@@ -17,6 +17,10 @@
 #                               # --prefix-heavy --smoke + --disagg --smoke
 #                               # (disaggregated pools: handoff/oracle/
 #                               # zero-prefill-on-decode gates) + --warm
+#                               # + --spec --smoke (draft speculation +
+#                               # AOT warm-up A/B) + tfos_warmcache.py
+#                               # --check-warm (pre-baked cache must
+#                               # compile 0 on the second sweep)
 #
 # The analysis gate (docs/analysis.md) runs all six project rules plus the
 # exports-drift check against the committed analysis_baseline.json ratchet
@@ -101,6 +105,28 @@ if [ "${1:-}" = "--bench-smoke" ]; then
     rc=$?
     if [ $rc -ne 0 ]; then
         echo "warm-standby heal bench smoke FAILED (rc=$rc)" >&2
+        exit $rc
+    fi
+    echo "== bench smoke (draft speculation + AOT) =="
+    # draft-propose/target-verify A/B (oracle-exact, acceptance>0) and
+    # the AOT warm-up A/B (pre-baked load arm must compile 0); writes
+    # spec_serving_smoke.json (never the committed full artifact)
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py --spec --smoke
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "spec serving bench smoke FAILED (rc=$rc)" >&2
+        exit $rc
+    fi
+    echo "== bench smoke (AOT pre-bake CLI) =="
+    # warm the cache twice into a throwaway dir: the second sweep must
+    # load every serve-step executable and compile exactly 0
+    _aotdir=$(mktemp -d)
+    JAX_PLATFORMS=cpu python scripts/tfos_warmcache.py \
+        --cache-dir "$_aotdir" --spec-k 4 --runs 2 --check-warm
+    rc=$?
+    rm -rf "$_aotdir"
+    if [ $rc -ne 0 ]; then
+        echo "warmcache smoke FAILED (rc=$rc)" >&2
         exit $rc
     fi
     echo "== bench smoke (multi-model rollout) =="

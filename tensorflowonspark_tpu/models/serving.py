@@ -130,6 +130,85 @@ def _select_tokens(logits, seeds, steps, temps, top_ps):
     return jax.vmap(pick)(logits, seeds, steps, temps, top_ps)
 
 
+class DraftModel:
+    """Pluggable draft provider for draft-model speculative decoding
+    (Leviathan et al.): a SMALL model whose jitted forward proposes up
+    to k greedy tokens per decode row, which the target's fused verify
+    dispatch then accepts/rejects (``ContinuousBatcher.set_draft``).
+
+    Cache-less by design: the decode loop is dispatch-bound, not
+    compute-bound (``bench_artifacts/sharded_serving.json``), so the
+    draft re-runs a full no-KV-cache forward over each row's trailing
+    ``window`` tokens inside ONE scanned k-step dispatch instead of
+    mirroring the target's paged-cache admission machinery.  The win is
+    2 dispatches (propose + verify) per up-to-(k+1) committed tokens;
+    the cost is O(k × window) tiny-model positions of redundant
+    compute, bounded by ``window`` regardless of context length.
+
+    Correctness never depends on the draft: proposals are only
+    committed where the target's own argmax agrees (the ``_verify_jit``
+    contract), so an untrained, truncated-context, or plain WRONG draft
+    costs acceptance, never exactness.  ``window + k`` must fit the
+    draft's ``max_position_embeddings`` (checked at ``set_draft``).
+
+    The batcher propagates its AOT executable cache into an armed
+    draft, so propose executables pre-bake/load exactly like the
+    target's serve steps.
+    """
+
+    def __init__(self, cfg: GPTConfig, params, window: int = 64):
+        if window < 1:
+            raise ValueError(f"draft window must be >= 1, got {window}")
+        self.cfg = cfg
+        self.params = params
+        self.window = int(window)
+        self.model = GPT(cfg)          # full forward — no decode cache
+        self.dispatches = 0
+        self._aot = None               # set by ContinuousBatcher.set_draft
+        self._jits: dict = {}
+
+    def _propose_jit(self, B: int, L: int, k: int):
+        key = (B, L, k)
+        if key in self._jits:
+            return self._jits[key]
+        model = self.model
+        rows = jnp.arange(B)
+
+        def propose_fn(params, buf, lens):
+            def body(carry, _):
+                buf, lens = carry
+                logits = model.apply({"params": params}, buf)  # [B, L, V]
+                nxt = jnp.take_along_axis(
+                    jnp.argmax(logits, axis=-1), (lens - 1)[:, None],
+                    axis=1)[:, 0]
+                buf = buf.at[rows, lens].set(nxt, mode="drop")
+                return (buf, lens + 1), nxt
+
+            (_, _), seq = jax.lax.scan(body, (buf, lens), None, length=k)
+            return seq.swapaxes(0, 1)                          # [B, k]
+
+        if self._aot is None:
+            fn = jax.jit(propose_fn)
+        else:
+            fn = self._aot.wrap(
+                ("draft_propose", repr((self.cfg, self.window)), key),
+                propose_fn)
+        self._jits[key] = fn
+        return fn
+
+    def propose(self, buf: np.ndarray, lens: np.ndarray,
+                k: int) -> np.ndarray:
+        """k greedy draft tokens per row: ``buf [B, window + k]`` holds
+        each row's right-zero-padded trailing history, ``lens [B]`` its
+        true length (>= 1).  One device dispatch for the whole batch;
+        rows the caller deems ineligible simply have their proposals
+        ignored (the verify mask ``d`` is what gates commitment)."""
+        B, L = buf.shape
+        self.dispatches += 1
+        return np.asarray(self._propose_jit(B, L, int(k))(
+            self.params, jnp.asarray(buf), jnp.asarray(lens)))
+
+
 class ContinuousBatcher:
     """Admit/step/retire decode requests over one compiled batch —
     greedy by default, per-request nucleus sampling via ``submit``'s
@@ -159,7 +238,8 @@ class ContinuousBatcher:
                  kv_page_tokens: int | None = None,
                  kv_pool_pages: int | None = None,
                  prefix_cache: bool = True,
-                 prefill_only: bool = False):
+                 prefill_only: bool = False,
+                 aot_cache=None):
         if cfg.rolling_kv_cache:
             raise ValueError("ContinuousBatcher requires a full-length "
                              "cache (rolling_kv_cache=False)")
@@ -184,8 +264,13 @@ class ContinuousBatcher:
             # drafting is host-side control flow per step; it cannot run
             # inside a scanned block — the two amortization strategies
             # are alternatives, not composable
-            raise ValueError("decode_block_steps and speculative_k are "
-                             "mutually exclusive")
+            raise ValueError(
+                "decode_block_steps and speculative_k are mutually "
+                "exclusive (a scanned block cannot host the per-step "
+                "draft/verify control flow) — for multi-token decode "
+                "dispatches keep speculative_k and arm a draft model "
+                "(set_draft / ServingCluster.run(draft_model=)) instead "
+                "of blocking")
         if prefill_only:
             if kv_page_tokens is None:
                 raise ValueError("prefill_only needs kv_page_tokens: the "
@@ -227,6 +312,19 @@ class ContinuousBatcher:
         #: per verify dispatch (tokens_per_dispatch > 1 is the win)
         self.spec_proposed = 0
         self.spec_accepted = 0
+        #: draft-MODEL speculation (:meth:`set_draft`): when armed, a
+        #: jitted small-model forward proposes the k tokens instead of
+        #: the prompt-lookup n-gram match — same verify, same
+        #: greedy-exact acceptance, but proposals exist for novel text
+        #: too.  None = prompt-lookup drafting (the historical default).
+        self._draft_model = None
+        #: draft-model propose dispatches (each covers every eligible
+        #: row; compare spec_accepted for the tokens-per-dispatch story)
+        self.draft_dispatches = 0
+        #: per-row accepted draft lengths, one entry per drafted row per
+        #: verify dispatch — drained by :meth:`take_spec_accept_lens`
+        #: into the replica's ``tfos_replica_spec_accept_len`` histogram
+        self._accept_lens: list[int] = []
         #: long-context admission: prompts longer than this are prefilled
         #: in fixed-size chunks through the SAME cached decode path (the
         #: cache index advances per chunk), bounding the transient
@@ -344,6 +442,17 @@ class ContinuousBatcher:
         #   ("zeros", rows) -> fresh side-cache allocator,
         #   ("scatter", rows) -> indexed row scatter jit
         self._prefill_jit: dict = {}
+        #: optional :class:`~tensorflowonspark_tpu.serving.aot.
+        #: AOTExecutableCache`: every compile site below routes through
+        #: :meth:`_jit`, so an armed batcher resolves its serve-step
+        #: executables as serialized-artifact LOADS (compile-and-store
+        #: on miss) — the standby warm-up / cold-replica lever.  The
+        #: context string disambiguates entries across models/knobs
+        #: sharing one cache directory.
+        self._aot = aot_cache
+        self._aot_ctx = None if aot_cache is None else repr(
+            (self.cfg, self.max_batch, self.spec_k, self.spec_ngram,
+             self.prefill_chunk, self.decode_block_steps))
 
         def step_greedy(params, cache, tokens):
             return _decode_one_greedy(self.model, params, cache, tokens)
@@ -354,8 +463,26 @@ class ContinuousBatcher:
 
         # two executables so all-greedy traffic (the common batch) never
         # pays the per-row sort/sample computation
-        self._step = jax.jit(step_greedy, donate_argnums=(1,))
-        self._step_sample = jax.jit(step_sample, donate_argnums=(1,))
+        self._step = self._jit(("step",), step_greedy, donate_argnums=(1,))
+        self._step_sample = self._jit(("step_sample",), step_sample,
+                                      donate_argnums=(1,))
+
+    def _jit(self, site, fn, donate_argnums=()):
+        """THE compile-site chokepoint: plain ``jax.jit`` without an AOT
+        cache, else the cache's load-or-compile wrapper keyed on (site,
+        this batcher's config context, arg avals).  Both are lazy and
+        call-compatible, so the executable registry stores either."""
+        if self._aot is None:
+            return jax.jit(fn, donate_argnums=donate_argnums)
+        return self._aot.wrap((site, self._aot_ctx), fn,
+                              donate_argnums=donate_argnums)
+
+    def aot_stats(self) -> dict | None:
+        """The AOT executable cache's ``{dir, loads, compiles, errors}``
+        counters, or None for an uncached batcher — benches and
+        ``scripts/tfos_warmcache.py`` gate on ``compiles == 0`` for a
+        fully pre-baked warm-up."""
+        return None if self._aot is None else self._aot.stats()
 
     def _scatter_rows(self, row_cache, slot_idx: list[int]) -> None:
         """Write a prefilled side cache's rows into the batch slots named
@@ -379,7 +506,8 @@ class ContinuousBatcher:
                                         0, axis)
                 return jax.tree_util.tree_map_with_path(put, cache, rows)
 
-            self._prefill_jit[key] = jax.jit(scatter_fn, donate_argnums=(0,))
+            self._prefill_jit[key] = self._jit(key, scatter_fn,
+                                               donate_argnums=(0,))
         self.cache = self._prefill_jit[key](
             self.cache, row_cache, jnp.asarray(slot_idx, jnp.int32))
 
@@ -491,6 +619,63 @@ class ContinuousBatcher:
                     "decode_block_steps (decode-time knobs)")
         self.prefill_only = role == "prefill"
 
+    # -- draft-model speculation ------------------------------------------
+    def set_draft(self, draft: "DraftModel | None") -> None:
+        """Arm (or clear, with ``None``) a :class:`DraftModel` as the
+        speculation proposer: eligible greedy rows get their k draft
+        tokens from ONE jitted draft forward instead of the host-side
+        prompt-lookup, and the existing fused verify commits the
+        agreeing prefix — same oracle, same counters, more accepted
+        tokens on workloads n-gram lookup can't predict.  Sampled rows
+        keep the draft-0 fallback (their token still comes from the
+        verify dispatch's own boundary logits).  Misconfiguration is
+        rejected here, up front and typed, not as a mid-serve shape
+        blowup.  Swappable while requests are live: correctness never
+        depends on WHICH draft proposed (hot-swap coherence)."""
+        if draft is None:
+            self._draft_model = None
+            return
+        if not isinstance(draft, DraftModel):
+            raise TypeError(
+                f"set_draft wants a DraftModel, got {type(draft).__name__}")
+        if self.prefill_only:
+            raise ValueError(
+                "draft_model conflicts with prefill_only: a prefill pool "
+                "never decodes, so it has no speculation to accelerate")
+        if self.spec_k is None:
+            raise ValueError(
+                "draft_model needs speculative_k: the draft proposes into "
+                "the k-token verify window (pass speculative_k= to the "
+                "batcher, or serve_draft_k through the serving tier)")
+        if draft.cfg.vocab_size != self.cfg.vocab_size:
+            raise ValueError(
+                f"draft/target vocab mismatch: draft vocab_size="
+                f"{draft.cfg.vocab_size} vs target "
+                f"{self.cfg.vocab_size} — draft proposals index the "
+                "target's token space, so the tokenizers must be "
+                "identical")
+        if draft.window + self.spec_k > draft.cfg.max_position_embeddings:
+            raise ValueError(
+                f"draft window {draft.window} + speculative_k "
+                f"{self.spec_k} exceeds the draft's "
+                f"max_position_embeddings "
+                f"({draft.cfg.max_position_embeddings}) — shrink the "
+                "window (serve_draft_window) or use a longer-context "
+                "draft")
+        if self._aot is not None and draft._aot is None:
+            # the draft's propose executables pre-bake/load through the
+            # same AOT cache as the target's serve steps
+            draft._aot = self._aot
+        self._draft_model = draft
+
+    def take_spec_accept_lens(self) -> list[int]:
+        """Drain the per-row accepted-draft-length samples recorded by
+        speculative verify dispatches since the last drain — the
+        ``tfos_replica_spec_accept_len`` histogram feed (host-side ints,
+        one per drafted row per dispatch)."""
+        out, self._accept_lens = self._accept_lens, []
+        return out
+
     def _emit_token(self, rid: int, tok: int) -> None:
         cb = self._on_token.get(rid)
         if cb is not None:
@@ -581,7 +766,7 @@ class ContinuousBatcher:
                 jax.tree_util.tree_map_with_path(walk, cache)
                 return out
 
-            self._prefill_jit[key] = jax.jit(export_fn)
+            self._prefill_jit[key] = self._jit(key, export_fn)
         ids = np.zeros((npad,), np.int32)
         ids[:n] = page_ids
         got = self._prefill_jit[key](self.cache, jnp.asarray(ids))
@@ -639,7 +824,8 @@ class ContinuousBatcher:
 
                 return jax.tree_util.tree_map_with_path(put, cache)
 
-            self._prefill_jit[key] = jax.jit(seat_fn, donate_argnums=(0,))
+            self._prefill_jit[key] = self._jit(key, seat_fn,
+                                               donate_argnums=(0,))
         ids = np.full((npad,), P, np.int32)   # sentinel pads drop
         ids[:n] = import_ids
         kv_pad = []
@@ -954,8 +1140,8 @@ class ContinuousBatcher:
         if key not in self._prefill_jit:
             template = jax.eval_shape(
                 lambda: init_cache(self.cfg, self.params, rows))
-            self._prefill_jit[key] = jax.jit(
-                lambda: jax.tree.map(
+            self._prefill_jit[key] = self._jit(
+                key, lambda: jax.tree.map(
                     lambda t: jnp.zeros(t.shape, t.dtype), template))
         return self._prefill_jit[key]()
 
@@ -967,8 +1153,8 @@ class ContinuousBatcher:
                     {"params": params, "cache": cache},
                     tokens_row, mutable=["cache"])
                 return vars_["cache"]
-            self._prefill_jit[("chunk", C)] = jax.jit(
-                chunk_fn, donate_argnums=(1,))
+            self._prefill_jit[("chunk", C)] = self._jit(
+                ("chunk", C), chunk_fn, donate_argnums=(1,))
         return self._prefill_jit[("chunk", C)]
 
     def _advance_inflight(self) -> list[int]:
@@ -1059,7 +1245,8 @@ class ContinuousBatcher:
                 first = _select_tokens(
                     last, seeds, jnp.zeros_like(true_len), temps, top_ps)
                 return first, rewind_cache(vars_["cache"], true_tot)
-            self._prefill_jit[key] = jax.jit(final_fn, donate_argnums=(1,))
+            self._prefill_jit[key] = self._jit(key, final_fn,
+                                               donate_argnums=(1,))
         self.prefill_dispatches += 1
         return self._prefill_jit[key](
             self.params, cache, padded,
@@ -1353,8 +1540,8 @@ class ContinuousBatcher:
                 return first, jax.tree_util.tree_map_with_path(
                     back, cache, vars_["cache"])
 
-            self._prefill_jit[key] = jax.jit(pfinal_fn,
-                                             donate_argnums=(1,))
+            self._prefill_jit[key] = self._jit(key, pfinal_fn,
+                                               donate_argnums=(1,))
         self.prefill_dispatches += 1
         firsts, self.cache = self._prefill_jit[key](
             self.params, self.cache, tokens, row_bt,
@@ -1397,7 +1584,8 @@ class ContinuousBatcher:
                     in ("index", "pos", "block_table") else r,
                     cache, vars_["cache"])
 
-            self._prefill_jit[key] = jax.jit(chunk_fn, donate_argnums=(1,))
+            self._prefill_jit[key] = self._jit(key, chunk_fn,
+                                               donate_argnums=(1,))
         return self._prefill_jit[key]
 
     def _advance_inflight_paged(self) -> list[int]:
@@ -1458,7 +1646,8 @@ class ContinuousBatcher:
                     return leaf
                 return jax.tree_util.tree_map_with_path(f, cache)
 
-            self._prefill_jit[key] = jax.jit(park_fn, donate_argnums=(0,))
+            self._prefill_jit[key] = self._jit(key, park_fn,
+                                               donate_argnums=(0,))
         self.cache = self._prefill_jit[key](self.cache,
                                             jnp.asarray(i, jnp.int32))
 
@@ -1491,18 +1680,25 @@ class ContinuousBatcher:
             self._poisoned = f"{type(e).__name__}: {e}"
             raise
 
+    def _history(self, s: "_Slot", prompt: np.ndarray,
+                 W: int) -> np.ndarray:
+        """Trailing ``W`` tokens of one slot's (prompt + generated)
+        history, host-side int32.  Slices BEFORE concatenating: the
+        window bound must hold for the copies too, or a 100k-token
+        context still pays O(history)/step."""
+        tail = np.asarray(s.tokens[-W:], np.int32)
+        need = W - tail.size
+        if need <= 0:
+            return tail
+        return np.concatenate([prompt[-need:].astype(np.int32), tail])
+
     def _draft(self, s: "_Slot", prompt: np.ndarray) -> np.ndarray:
         """Prompt-lookup draft for one slot: continuation of the most
         recent occurrence of the request's final ``spec_ngram`` tokens in
         its own (prompt + generated) history; empty when no match.  Host-
         side numpy — drafting is control flow, not device work."""
         g, k = self.spec_ngram, self.spec_k
-        # slice BEFORE concatenating: the window bound must hold for the
-        # copies too, or a 100k-token context still pays O(history)/step
-        W = self.spec_window
-        tail = np.asarray(s.tokens[-W:], np.int32)
-        need = W - tail.size
-        h = tail if need <= 0 else np.concatenate([prompt[-need:], tail])
+        h = self._history(s, prompt, self.spec_window)
         if h.size <= g:
             return h[:0]
         pat = h[-g:]
@@ -1552,26 +1748,52 @@ class ContinuousBatcher:
                 else leaf, vars_["cache"])
             return a, bonus, cache
 
-        self._prefill_jit["verify"] = jax.jit(verify_fn,
-                                              donate_argnums=(1,))
+        self._prefill_jit["verify"] = self._jit("verify", verify_fn,
+                                                donate_argnums=(1,))
         return self._prefill_jit["verify"]
 
     def _spec_step(self) -> list[int]:
-        """One speculative decode step for every active slot."""
+        """One speculative decode step for every active slot: propose
+        (draft model when armed, else host-side prompt lookup), then one
+        fused verify dispatch commits each row's agreeing prefix plus
+        the bonus token."""
         K = self.spec_k
         B = self.max_batch
+        dm = self._draft_model
         toks = np.zeros((B, K + 1), np.int32)
         d = np.zeros((B,), np.int32)
+        elig: list[int] = []
+        if dm is not None:
+            buf = np.zeros((B, dm.window + K), np.int32)
+            lens = np.ones((B,), np.int32)
         for i, s in enumerate(self.slots):
             if s is None:
                 continue
             toks[i, :] = s.tokens[-1]
             if s.temperature <= 0 and s.remaining > 1:
+                # sampled rows keep the draft-0 fallback: their token
+                # still comes from the verify dispatch's boundary logits
+                if dm is not None:
+                    h = self._history(s, self._prompts[s.request_id],
+                                      dm.window)
+                    buf[i, :h.size] = h
+                    lens[i] = h.size
+                    elig.append(i)
+                    continue
                 dr = self._draft(s, self._prompts[s.request_id])
                 di = min(dr.size, s.remaining - 1)
                 if di > 0:
                     toks[i, 1:1 + dr.size] = dr
                     d[i] = di
+        if dm is not None and elig:
+            # ONE scanned draft dispatch proposes K tokens for every
+            # eligible row; ineligible rows ride along masked (d=0)
+            props = dm.propose(buf, lens, K)
+            self.draft_dispatches += 1
+            for i in elig:
+                s = self.slots[i]
+                toks[i, 1:1 + K] = props[i]
+                d[i] = min(K, s.remaining - 1)
         if not d.any():
             # nothing drafted anywhere (all-sampled traffic, novel text,
             # or every slot at its last token): fall through to the plain
@@ -1593,6 +1815,10 @@ class ContinuousBatcher:
         a, bonus = np.asarray(a), np.asarray(bonus)
         self.spec_proposed += int(d.sum())
         self.spec_accepted += int(a.sum())
+        for i in np.flatnonzero(d):
+            self._accept_lens.append(int(a[i]))
+        if len(self._accept_lens) > 65536:   # unmetered batcher: bound it
+            del self._accept_lens[:-4096]
         done = []
         for i, s in enumerate(self.slots):
             if s is None:
@@ -1693,7 +1919,8 @@ class ContinuousBatcher:
                     body, (tokens, cache), None, length=K)
                 return seq.swapaxes(0, 1), cache
 
-        self._prefill_jit[key] = jax.jit(block_fn, donate_argnums=(1,))
+        self._prefill_jit[key] = self._jit(key, block_fn,
+                                           donate_argnums=(1,))
         return self._prefill_jit[key]
 
     def _block_step(self, K: int) -> list[int]:

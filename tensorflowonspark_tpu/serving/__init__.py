@@ -31,11 +31,17 @@ shifting with metrics-gated auto-rollback), ``frontend`` (TCP edge +
 ``scale_up``/``deploy_model``/``swap_replica_model``/``rollout``/
 drain-and-replace, whole-gang, per-pool autoscaling),
 ``autoscaler`` (metrics-driven membership control, device-weighted,
-role-filterable, promotes standbys first), ``client`` (``ServeClient``).
+role-filterable, promotes standbys first), ``client`` (``ServeClient``),
+``aot`` (``AOTExecutableCache``: serve-step executables serialized to
+disk, so warm-ups and cold starts load instead of compile — pre-baked
+by ``scripts/tfos_warmcache.py``).  Draft-model speculative decoding
+arms via ``ServingCluster.run(draft_model=...)``.
 Architecture, backpressure semantics, the failure model, and the
 scale-event taxonomy are in ``docs/serving.md``.
 """
 
+from tensorflowonspark_tpu.serving.aot import \
+    AOTExecutableCache  # noqa: F401
 from tensorflowonspark_tpu.serving.autoscaler import (Autoscaler,  # noqa: F401
                                                       AutoscalerConfig)
 from tensorflowonspark_tpu.serving.client import ServeClient  # noqa: F401

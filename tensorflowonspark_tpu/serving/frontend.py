@@ -312,7 +312,8 @@ class ServingCluster:
             drain_timeout: float = 60.0, mesh: dict | None = None,
             gang_size: int | None = None, shard_params=None,
             warm_standbys: int = 0, standby_clone: bool = True,
-            compile_cache=None, disagg: dict | None = None,
+            compile_cache=None, aot_cache=None, draft_model=None,
+            disagg: dict | None = None,
             model: tuple | None = None, registry=None,
             **cluster_kwargs) -> "ServingCluster":
         """Boot ``num_replicas`` serving workers and the driver-side tier.
@@ -386,6 +387,30 @@ class ServingCluster:
         promote regardless.  ``compile_cache`` overrides the
         fleet-shared persistent XLA compilation cache directory (default
         ``<working_dir>/jax_cache``; ``False`` disables it).
+
+        ``aot_cache`` arms the tier's AOT serialized-executable cache
+        (docs/performance.md "Decode speed"): every replica, gang
+        leader, and warm standby resolves its serve-step executables by
+        ``deserialize_and_load`` from ``<working_dir>/jax_cache_aot``
+        (``True``; a string overrides the directory — point it at a
+        ``scripts/tfos_warmcache.py`` pre-baked dir for compile-free
+        cold starts and standby warm-ups).
+
+        ``draft_model`` arms DRAFT-MODEL SPECULATIVE DECODING on every
+        decode-capable replica: a picklable ``builder(args) -> (cfg,
+        params)`` for the small draft, or a registered ``(model_id,
+        version)`` tuple (needs ``registry=``; adapter-or-full, like any
+        version).  Each decode step then runs one jitted draft forward
+        proposing ``serve_draft_k`` (replica_args; default 4) tokens per
+        eligible greedy row and one fused verify dispatch on the target
+        — output-exact by construction (the verify only commits tokens
+        the target's own argmax agrees with; sampled rows keep the
+        single-token path).  Tune via ``replica_args``:
+        ``serve_draft_window`` (draft context, default 64),
+        ``serve_draft_k``.  The draft vocab must match the target's
+        (validated at boot, typed).  Hot swaps re-resolve the draft from
+        the incoming version's ``serve_args`` — a version without draft
+        keys clears it.
         """
         from tensorflowonspark_tpu.serving.replica import serve_replica
 
@@ -425,6 +450,25 @@ class ServingCluster:
                 "model= naming a registered version")
         if compile_cache is not None:
             args["serve_compile_cache"] = compile_cache
+        if aot_cache is not None:
+            args["serve_aot_cache"] = aot_cache
+        if draft_model is not None:
+            if isinstance(draft_model, tuple):
+                if registry is None:
+                    raise ValueError(
+                        "draft_model=(model_id, version) needs registry= "
+                        "— or pass the draft's builder callable directly")
+                from tensorflowonspark_tpu.serving.rollout import \
+                    draft_overlay
+
+                args.update(draft_overlay(registry.version(*draft_model)))
+            elif callable(draft_model):
+                args["serve_draft_builder"] = draft_model
+            else:
+                raise ValueError(
+                    "draft_model must be a builder callable or a "
+                    "registered (model_id, version) tuple, got "
+                    f"{type(draft_model).__name__}")
         if warm_standbys < 0:
             raise ValueError(f"warm_standbys must be >= 0, "
                              f"got {warm_standbys}")

@@ -93,6 +93,74 @@ def enable_serving_compile_cache(args, ctx) -> None:
         else os.path.join(ctx.working_dir, "jax_cache"))
 
 
+def serving_aot_cache(args, ctx):
+    """The tier's AOT serialized-executable cache (``serving/aot.py``),
+    or None when not armed.  ``args["serve_aot_cache"]``: truthy enables
+    (``ServingCluster.run(aot_cache=...)``), a string overrides the
+    directory (default ``<working_dir>/jax_cache_aot`` — shared by every
+    replica, gang leader, standby, and the ``tfos_warmcache.py``
+    pre-bake CLI of one tier).  The gang's mesh spec is mixed into every
+    entry key so differently-sharded tiers never collide in one
+    directory."""
+    spec = args.get("serve_aot_cache")
+    if not spec:
+        return None
+    from tensorflowonspark_tpu.serving.aot import AOTExecutableCache
+
+    return AOTExecutableCache(
+        spec if isinstance(spec, str)
+        else os.path.join(ctx.working_dir, "jax_cache_aot"),
+        extra_key=repr(args.get("serve_mesh")))
+
+
+def build_draft_model(args):
+    """Build this arg view's draft model (``serve_draft_builder``, or
+    ``serve_draft_base_builder`` [+ ``serve_draft_adapter``] for a
+    registry adapter version), device-put, wrapped in a
+    :class:`~tensorflowonspark_tpu.models.serving.DraftModel` with the
+    configured ``serve_draft_window``; None when no draft is configured.
+    The draft is "just another model version": the same builder/adapter
+    resolution the hot-swap and standby-promote paths use."""
+    builder = args.get("serve_draft_builder")
+    base = args.get("serve_draft_base_builder")
+    if builder is None and base is None:
+        return None
+    import jax
+
+    from tensorflowonspark_tpu.models.serving import DraftModel
+
+    # the draft version's own serve_args overlay applies only while
+    # BUILDING the draft (rollout.draft_overlay stashes it here) — a
+    # draft's seed/knobs must never leak into the target's arg view
+    draft_args = dict(args)
+    draft_args.update(args.get("serve_draft_args") or {})
+    if builder is not None:
+        cfg, params = builder(draft_args)
+    else:
+        from tensorflowonspark_tpu.serving.rollout import \
+            build_registered_model
+
+        draft_args["serve_base_builder"] = base
+        draft_args["serve_adapter"] = args.get("serve_draft_adapter")
+        cfg, params = build_registered_model(draft_args)
+    return DraftModel(cfg, jax.device_put(params),
+                      window=int(args.get("serve_draft_window", 64)))
+
+
+def arm_draft(batcher, args) -> None:
+    """(Re)arm or clear the batcher's draft model from an arg view —
+    boot, standby promotion, and hot swap all funnel here so target and
+    draft can never go incoherent: a view without draft keys CLEARS any
+    armed draft (swap-away invalidation), one with them builds and
+    validates the new draft (typed errors from ``set_draft``, raised
+    before any params move)."""
+    draft = None
+    if not getattr(batcher, "prefill_only", False) \
+            and getattr(batcher, "spec_k", None) is not None:
+        draft = build_draft_model(args)
+    batcher.set_draft(draft)
+
+
 def serve_clone_request(batcher, item: dict, ctx,
                         export_pages: bool = True) -> None:
     """Source side of peer weight cloning: ship this replica's params to
@@ -261,6 +329,18 @@ def serving_batcher_kwargs(args) -> dict:
             (args.get("serve_disagg") or {}).get(f"{role}_kwargs") or {}))
     if role == "prefill":
         kwargs["prefill_only"] = True
+    if (args.get("serve_draft_builder")
+            or args.get("serve_draft_base_builder")) \
+            and role != "prefill" and not kwargs.get("prefill_only") \
+            and not (args.get("serve_disagg") and role is None) \
+            and "speculative_k" not in kwargs \
+            and "decode_block_steps" not in kwargs:
+        # a configured draft implies speculation: arm the verify window
+        # (serve_draft_k) unless the caller pinned either decode knob.
+        # Role-less workers of a disagg tier (warm standbys) stay
+        # unarmed — they may be promoted into a prefill pool, which
+        # set_role refuses under decode-time knobs.
+        kwargs["speculative_k"] = int(args.get("serve_draft_k", 4))
     return kwargs
 
 
@@ -277,7 +357,9 @@ def serve_replica(args, ctx) -> None:
         cfg, params,
         max_batch=int(args.get("serve_max_batch", 4)),
         eos_id=args.get("serve_eos_id"),
+        aot_cache=serving_aot_cache(args, ctx),
         **serving_batcher_kwargs(args))
+    arm_draft(batcher, args)
     run_serve_loop(args, ctx, batcher, role=args.get("serve_role"))
 
 
@@ -367,6 +449,12 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
         "tfos_replica_spec_tokens_total",
         "Speculative tokens by outcome (proposed/accepted).",
         labelnames=("outcome",))
+    h_accept = reg.histogram(
+        "tfos_replica_spec_accept_len_count",
+        "Accepted draft length per drafted row per verify dispatch — "
+        "the tokens-per-dispatch distribution behind the "
+        "proposed/accepted totals (each commit is accept_len + 1 bonus "
+        "token from one dispatch).")
     g_pages = reg.gauge(
         "tfos_replica_kv_pages_free_count",
         "Allocatable KV pages (free + evictable cached) in the paged "
@@ -379,10 +467,17 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
         "tfos_replica_sessions_total",
         "KV-page handoff sessions by direction (exported by a prefill "
         "pool / adopted by a decode pool).", labelnames=("direction",))
+    m_aot = reg.counter(
+        "tfos_replica_aot_resolves_total",
+        "AOT serve-step executable resolutions by outcome (load = disk "
+        "hit, compile = miss paid with a compile, error = corrupt "
+        "entry or failed write, each degraded to a compile).",
+        labelnames=("outcome",))
     last = {"decode_dispatches": 0, "prefill_dispatches": 0,
             "spec_proposed": 0, "spec_accepted": 0,
             "sessions_exported": 0, "sessions_adopted": 0,
-            "hit": 0, "miss": 0, "partial": 0}
+            "hit": 0, "miss": 0, "partial": 0,
+            "aot_loads": 0, "aot_compiles": 0, "aot_errors": 0}
 
     def publish_engine_counters() -> None:
         """Move the batcher's lifetime counters into the registry as
@@ -405,6 +500,19 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
             if cur > last[attr]:
                 m_sessions.inc(cur - last[attr], direction=direction)
                 last[attr] = cur
+        take_lens = getattr(batcher, "take_spec_accept_lens", None)
+        if take_lens is not None:
+            for n in take_lens():
+                h_accept.record(n)
+        aot = getattr(batcher, "_aot", None)
+        if aot is not None:
+            for attr, outcome in (("loads", "load"),
+                                  ("compiles", "compile"),
+                                  ("errors", "error")):
+                cur = getattr(aot, attr, 0)
+                if cur > last[f"aot_{attr}"]:
+                    m_aot.inc(cur - last[f"aot_{attr}"], outcome=outcome)
+                    last[f"aot_{attr}"] = cur
         prefix_stats = getattr(batcher, "prefix_stats", None)
         if prefix_stats is not None:
             stats = prefix_stats()
@@ -436,6 +544,7 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
         import jax
 
         old_params = batcher.params
+        old_draft = getattr(batcher, "_draft_model", None)
         params = None
         version_args = dict(swap_base)
         version_args.update(item.get("serve_args") or {})
@@ -454,6 +563,14 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
             if params is None:
                 params, version_args = resolve_version_params(swap_base,
                                                               item)
+            # draft coherence BEFORE the params move: the new version's
+            # draft arms (or a version without one clears the old draft)
+            # while the old target still serves — a bad draft payload
+            # bounces typed below with the old (params, draft) pair
+            # fully intact, and the swapped target can never decode
+            # against a stale draft (which would only cost acceptance,
+            # but would lie about the version's measured speedup)
+            arm_draft(batcher, version_args)
             batcher.unload_params()
             batcher.load_params(jax.device_put(params))
         # tfos: ignore[broad-except] — a bad version payload must bounce
@@ -462,6 +579,8 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
         except Exception as e:
             if batcher.params is None:
                 batcher.load_params(old_params)
+            if getattr(batcher, "_draft_model", old_draft) is not old_draft:
+                batcher.set_draft(old_draft)
             logger.exception("replica %d: model swap to %s@%s failed",
                              ctx.executor_id, item.get("model"),
                              item.get("version"))
@@ -668,6 +787,13 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
             ld = batcher.load()
             load = ld["total"]
             free_pages = int(ld.get("free_pages", 0))
+            # acceptance piggyback: cumulative proposed/accepted ride
+            # every response message of a speculating replica, so the
+            # scheduler's metrics()["replicas"] shows tokens-per-
+            # dispatch without log scraping
+            spec_extra = {} if getattr(batcher, "spec_k", None) is None \
+                else {"spec": {"proposed": batcher.spec_proposed,
+                               "accepted": batcher.spec_accepted}}
             m_steps.inc()
             g_load.set(load)
             g_pages.set(free_pages)
@@ -682,7 +808,8 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                 mgr.queue_put(RESPONSE_QUEUE,
                               {"rid": rid, "event": "tok",
                                "tokens": toks, "load": load,
-                               "free_pages": free_pages, **role_extra})
+                               "free_pages": free_pages, **spec_extra,
+                               **role_extra})
             deltas.clear()
             for brid in done:
                 batcher.result(brid, pop=True)  # tokens already streamed
@@ -693,7 +820,8 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                 m_served.inc()
                 mgr.queue_put(RESPONSE_QUEUE,
                               {"rid": rid, "event": "done", "load": load,
-                               "free_pages": free_pages, **role_extra})
+                               "free_pages": free_pages, **spec_extra,
+                               **role_extra})
                 served += 1
             if role == "prefill":
                 # prefill pool: flush each admitted request's exported

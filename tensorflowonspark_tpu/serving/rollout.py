@@ -177,6 +177,32 @@ class ModelVersion:
                 "metadata": dict(self.metadata)}
 
 
+def draft_overlay(version: ModelVersion) -> dict:
+    """Map a registered version onto the DRAFT-side arg keys
+    (``serve_draft_*``) — a speculation draft is "just another version":
+    the same builder-or-(base+adapter) payload every serving path ships,
+    renamed so the worker arms it as the proposer
+    (``serving.replica.build_draft_model``) instead of the target.  The
+    version's ``serve_draft_*`` extra args (e.g. ``serve_draft_window``,
+    ``serve_draft_k``) pass through directly; its remaining extra args
+    land in ``serve_draft_args``, overlaid onto the builder's arg view
+    only while BUILDING the draft (a draft version's ``seed`` must not
+    leak into the target's)."""
+    a = {k: v for k, v in version.extra_args.items()
+         if str(k).startswith("serve_draft_")}
+    rest = {k: v for k, v in version.extra_args.items()
+            if not str(k).startswith("serve_draft_")}
+    if rest:
+        a["serve_draft_args"] = rest
+    a["serve_draft_model"] = version.key
+    if version.base_builder is not None:
+        a["serve_draft_base_builder"] = version.base_builder
+        a["serve_draft_adapter"] = version.adapter
+    else:
+        a["serve_draft_builder"] = version.builder
+    return a
+
+
 class ModelRegistry:
     """Catalog of models/versions one serving tier hosts (module
     docstring).  Thread-safe; the tier, the rollout controller and user

@@ -311,6 +311,10 @@ class _Replica:
         #: last self-reported allocatable KV pages (paged-KV replicas;
         #: 0 for dense ones) — the memory-pressure routing tie-break
         self.reported_free_pages = 0
+        #: last self-reported cumulative speculation counters
+        #: ({"proposed": n, "accepted": n}) from a speculating replica's
+        #: response piggyback; None when the replica never speculates
+        self.reported_spec: dict | None = None
         self.alive = True
         self.draining = False    # no NEW routes; in-flight runs out
         self.retired = False     # left cleanly — never counts as dead
@@ -1248,6 +1252,16 @@ class ReplicaScheduler:
                           "outstanding": len(rep.outstanding),
                           "reported_load": rep.reported_load,
                           "free_pages": rep.reported_free_pages,
+                          # speculation acceptance piggyback (None for a
+                          # non-speculating replica): rate = accepted /
+                          # proposed, the tokens-per-dispatch signal
+                          "spec": None if rep.reported_spec is None
+                          else {**rep.reported_spec,
+                                "acceptance": (
+                                    rep.reported_spec["accepted"]
+                                    / rep.reported_spec["proposed"]
+                                    if rep.reported_spec["proposed"]
+                                    else None)},
                           "weight": rep.weight,
                           "role": rep.role,
                           "model": rep.model,
@@ -1578,6 +1592,11 @@ class ReplicaScheduler:
                 rep.reported_load = int(msg["load"])
             if "free_pages" in msg:
                 rep.reported_free_pages = int(msg["free_pages"])
+            spec = msg.get("spec")
+            if spec is not None:
+                rep.reported_spec = {
+                    "proposed": int(spec.get("proposed", 0)),
+                    "accepted": int(spec.get("accepted", 0))}
             role = msg.get("role")
             if role is not None and role != rep.role:
                 # a replica serving a different specialization than it

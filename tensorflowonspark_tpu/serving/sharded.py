@@ -319,8 +319,8 @@ def serve_sharded_replica(args, ctx) -> None:
         _member_loop(args, ctx, spec, leader_eid, rank)
         return
     # leader: jax/model imports stay inside the worker process
-    from tensorflowonspark_tpu.serving.replica import \
-        enable_serving_compile_cache
+    from tensorflowonspark_tpu.serving.replica import (
+        arm_draft, enable_serving_compile_cache, serving_aot_cache)
 
     enable_serving_compile_cache(args, ctx)
     from tensorflowonspark_tpu.models.serving import ContinuousBatcher
@@ -350,7 +350,12 @@ def serve_sharded_replica(args, ctx) -> None:
             cfg, params,
             max_batch=int(args.get("serve_max_batch", 4)),
             eos_id=args.get("serve_eos_id"),
+            aot_cache=serving_aot_cache(args, ctx),
             **serving_batcher_kwargs(args))
+        # inside the mesh context: the draft's params stay REPLICATED
+        # (a tiny model has nothing worth sharding) and its propose
+        # dispatches ride the same mesh as the target's verify
+        arm_draft(batcher, args)
         barrier = GangBarrier(
             members,
             boot_timeout=float(args.get("serve_gang_boot_timeout", 120.0)),

@@ -103,8 +103,8 @@ def serve_standby(args, ctx) -> None:
 
 def _standby_leader(args, ctx, spec) -> None:
     from tensorflowonspark_tpu.serving.replica import (
-        enable_serving_compile_cache, run_serve_loop,
-        serving_batcher_kwargs)
+        arm_draft, enable_serving_compile_cache, run_serve_loop,
+        serving_aot_cache, serving_batcher_kwargs)
 
     mgr = ctx.mgr
     if mgr is None:
@@ -140,7 +140,12 @@ def _standby_leader(args, ctx, spec) -> None:
             cfg, params,
             max_batch=int(args.get("serve_max_batch", 4)),
             eos_id=args.get("serve_eos_id"),
+            aot_cache=serving_aot_cache(args, ctx),
             **serving_batcher_kwargs(args))
+        # arm the tier's draft BEFORE the warm-up sweep, so the draft
+        # propose + fused verify executables are part of what the
+        # standby pre-pays (and what the AOT cache pre-bakes)
+        arm_draft(batcher, args)
         try:
             if barrier is not None:
                 barrier.hello()
@@ -226,6 +231,28 @@ def _standby_leader(args, ctx, spec) -> None:
             # a rollback away from this version fully sheds its knobs
             loop_args = (dict(args, **promote["serve_args"])
                          if promote.get("serve_args") else args)
+            if any(loop_args.get(k) != args.get(k)
+                   for k in ("serve_draft_builder",
+                             "serve_draft_base_builder",
+                             "serve_draft_adapter", "serve_draft_window",
+                             "serve_draft_k", "seed")):
+                try:
+                    # the PROMOTED version's overlay changed the draft
+                    # config: re-arm from its arg view (swap or clear) —
+                    # an unchanged overlay keeps the boot draft and its
+                    # warmed propose executables.  Best-effort: a
+                    # standby that already acked standby_ready must
+                    # serve, so a bad overlay draft costs speculation,
+                    # never the heal
+                    arm_draft(batcher, loop_args)
+                # tfos: ignore[broad-except] — see above; the target
+                # params are already live and correct without any draft
+                except Exception:
+                    logger.exception(
+                        "standby %d: draft re-arm on promotion failed; "
+                        "serving without speculation draft",
+                        ctx.executor_id)
+                    batcher.set_draft(None)
             run_serve_loop(loop_args, ctx, batcher,
                            step_hook=None if barrier is None
                            else barrier.step,
@@ -246,15 +273,24 @@ def _warm_batcher(batcher) -> None:
     window (exactly the cold cost the pool exists to hoist).  So sweep
     the small bucket x group grid the serve path actually uses; the
     greedy decode step compiles once on the first wave.  Further shapes
-    compile on demand — and hit the fleet's persistent cache."""
+    compile on demand — and hit the fleet's persistent cache.
+
+    With an AOT cache armed the sweep is load-or-compile: executables
+    pre-baked by ``scripts/tfos_warmcache.py`` (or a previous standby)
+    deserialize instead of compiling.  A speculating batcher sweeps with
+    budget 4, not 2 — the spec step only engages with >1 token remaining
+    (budget 2 commits its whole budget at admission + first verify-less
+    step), so a 2-token sweep would leave the draft-propose and fused
+    verify executables to compile inside the heal window."""
     import numpy as np
 
+    budget = 2 if getattr(batcher, "spec_k", None) is None else 4
     group_sizes = sorted({1, min(2, batcher.max_batch), batcher.max_batch})
     for plen in (3, 6, 9):            # pow2 prompt buckets 4 / 8 / 16
-        if plen + 2 > batcher.cfg.max_position_embeddings:
+        if plen + budget > batcher.cfg.max_position_embeddings:
             continue
         for rows in group_sizes:
-            rids = [batcher.submit(np.ones(plen, np.int32), 2)
+            rids = [batcher.submit(np.ones(plen, np.int32), budget)
                     for _ in range(rows)]
             pending = set(rids)
             for _ in range(256):

@@ -80,6 +80,21 @@ gated on the cold-spawn floor (promotion ready < 3 s — under any cold
 boot's jax import alone) + schema validation; writes
 ``bench_artifacts/elasticity_smoke.json`` so the committed full
 artifact is never clobbered by a smoke run.
+
+``--failover`` runs the DRIVER-KILL scenarios instead (docs/
+robustness.md "Control-plane failover"): a ``kill driver after_secs=F``
+chaos plan hard-crashes the control plane under continuous streaming
+clients armed with ``failover_wait=``, ``serving.failover.
+resume_driver`` replays the write-ahead journal onto the surviving
+replicas and rebinds the old port, and the run gates itself on ZERO
+accepted requests lost (drained journal owes nothing), every stream
+oracle-exact across the heal, at least one mid-flight requeue, and the
+heal latency (``tfos_serving_failover_seconds``); a second row crashes
+the driver MID-CANARY and gates that the resumed driver CONTINUES the
+rollout (``resume_rollouts``: only the un-gated steps re-execute, the
+surviving canary is re-used, the promotion completes).  Writes
+``bench_artifacts/failover.json`` (``--smoke``:
+``failover_smoke.json``, wired into ``scripts/ci.sh --bench-smoke``).
 """
 
 import argparse
@@ -1771,6 +1786,352 @@ def validate_spec_artifact(out: dict) -> None:
                                "zero-loss/oracle gates")
 
 
+# ------------------------------------------- driver failover scenarios
+
+def failover_scenario(smoke, seed=0):
+    """THE control-plane durability gate (docs/robustness.md
+    "Control-plane failover"): a ``kill driver after_secs=F`` chaos plan
+    hard-crashes the serving control plane under continuous streaming
+    load, ``resume_driver`` replays the fsync'd journal onto the
+    surviving replicas and rebinds the old port, and every client —
+    armed with ``failover_wait=`` — rides through.  Self-gating: zero
+    accepted requests lost (the drained journal has no unfinished
+    admissions), every completed stream byte-exact vs its solo greedy
+    oracle (requeued replays INCLUDED — no token lost, repeated, or
+    diverged), at least one request requeued (the kill landed
+    mid-flight), exactly one recorded resume."""
+    import contextlib
+    import tempfile
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import chaos
+    from tensorflowonspark_tpu.serving import ServingCluster, resume_driver
+    from tensorflowonspark_tpu.serving.journal import ControlPlaneJournal
+
+    after = 8.0 if smoke else 12.0
+    n_clients = 3 if smoke else 5
+    wd = tempfile.mkdtemp(prefix="tfos_failover_")
+    env0 = {k: os.environ.get(k) for k in ("TFOS_CHAOS", "TFOS_CHAOS_DIR")}
+    os.environ["TFOS_CHAOS"] = f"kill driver after_secs={after:g}"
+    os.environ["TFOS_CHAOS_DIR"] = wd
+    results, errors = [], []
+    stop, lock = threading.Event(), threading.Lock()
+    serving = serving2 = None
+    try:
+        serving = ServingCluster.run(
+            bench_model_builder, 2, max_batch=4,
+            worker_env={"JAX_PLATFORMS": "cpu"}, working_dir=wd,
+            reservation_timeout=120, max_queue_depth=256)
+        addr = serving.address
+
+        def loop_client(tid):
+            # back-to-back streams from one persistent connection: the
+            # kill is guaranteed to land mid-stream for somebody.  Small
+            # shape pool keeps the oracle's compile bill bounded.
+            crng = np.random.default_rng(seed + 100 + tid)
+            try:
+                with serving.client(failover_wait=120.0) as c:
+                    while not stop.is_set():
+                        plen = int(crng.choice([4, 6, 8]))
+                        p = crng.integers(0, VOCAB, (plen,)) \
+                            .astype(np.int32)
+                        n = int(crng.choice([24, 32]))
+                        toks = []
+                        for delta in c.generate_stream(p, n, timeout=600):
+                            toks.extend(delta)
+                        with lock:
+                            results.append((p.tolist(), n, toks))
+            except Exception as e:
+                with lock:
+                    errors.append(f"client {tid}: "
+                                  f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=loop_client, args=(t,))
+                   for t in range(n_clients)]
+        for t in threads:
+            t.start()
+        # the env-armed timer fires the crash; the sentinel tells us when
+        deadline = time.monotonic() + after + 120
+        while chaos.fired_at(wd, "driver") is None:
+            if time.monotonic() > deadline:
+                raise RuntimeError("failover: driver chaos never fired")
+            time.sleep(0.1)
+        crashed_at = chaos.fired_at(wd, "driver")
+        time.sleep(1.0)      # clients are now in their reconnect loops
+        serving2 = resume_driver(serving.cluster, address=addr,
+                                 max_batch=4, crashed_at=crashed_at)
+        heal_secs = max(0.0, time.time() - crashed_at)
+        requeued = serving2.scheduler.requeued
+        time.sleep(2.0 if smoke else 4.0)    # post-heal traffic window
+        stop.set()
+        for t in threads:
+            t.join(300)
+        alive = [t for t in threads if t.is_alive()]
+        if alive:
+            raise RuntimeError(f"failover: {len(alive)} client(s) hung")
+    finally:
+        stop.set()
+        if serving2 is not None:
+            serving2.shutdown(timeout=300)
+        elif serving is not None:
+            with contextlib.suppress(Exception):
+                serving.shutdown(timeout=60)
+            with contextlib.suppress(Exception):
+                serving.cluster._abort()
+        for k, v in env0.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    if errors:
+        raise RuntimeError(f"failover: client errors (zero-loss gate): "
+                           f"{errors[:3]}")
+    if len(results) < n_clients:
+        raise RuntimeError(f"failover: only {len(results)} stream(s) "
+                           f"completed across {n_clients} clients")
+    if requeued < 1:
+        raise RuntimeError("failover: nothing was requeued — the kill "
+                           "missed every in-flight request")
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import greedy_generate
+
+    cfg, params = bench_model_builder({"seed": 0})
+    oracle_cache, mismatches = {}, 0
+    for p, n, toks in results:
+        key = (tuple(p), n)
+        if key not in oracle_cache:
+            oracle_cache[key] = np.asarray(greedy_generate(
+                cfg, params, jnp.asarray(np.asarray(p, np.int32))[None, :],
+                n))[0, len(p):].tolist()
+        if toks != oracle_cache[key]:
+            mismatches += 1
+    if mismatches:
+        raise RuntimeError(f"failover: {mismatches} stream(s) diverged "
+                           "from the greedy oracle across the heal")
+    st = ControlPlaneJournal.replay(os.path.join(wd, "control_plane.jsonl"))
+    if st.unfinished:
+        raise RuntimeError(f"failover: journal still owes "
+                           f"{sorted(st.unfinished)} — accepted requests "
+                           "were lost")
+    if st.resumes != 1:
+        raise RuntimeError(f"failover: journal records {st.resumes} "
+                           "resume(s), want exactly 1")
+    return {
+        "scenario": "driver_kill",
+        "chaos": f"kill driver after_secs={after:g}",
+        "clients": n_clients,
+        "streams_completed": len(results),
+        "requeued_on_resume": requeued,
+        "heal_secs": round(heal_secs, 3),
+        "errors": len(errors),
+        "oracle_mismatches": mismatches,
+        "journal": {"admitted": len(st.admitted),
+                    "committed": len(st.committed),
+                    "unfinished": len(st.unfinished),
+                    "resumes": st.resumes},
+    }
+
+
+def registry_resume_scenario(smoke, seed=0):
+    """The registry-resume row: crash the driver MID-CANARY (step 25
+    gated, step 100 mid-bake) and show the restarted driver CONTINUES
+    the rollout — ``resume_rollouts`` re-executes only the remaining
+    steps onto the surviving canary replica (``rollout_canary`` event
+    with ``mode="resumed"``) and promotes, while riding-through pingers
+    stay oracle-exact against one of the two versions throughout."""
+    import contextlib
+    import tempfile
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from bench_rollout import _make_reqs, _oracle, _registry
+
+    from tensorflowonspark_tpu.observability import EventLog
+    from tensorflowonspark_tpu.serving import (RolloutPolicy,
+                                               ServingCluster,
+                                               resume_driver,
+                                               resume_rollouts)
+    from tensorflowonspark_tpu.serving.journal import ControlPlaneJournal
+
+    wd = tempfile.mkdtemp(prefix="tfos_failover_rollout_")
+    jpath = os.path.join(wd, "control_plane.jsonl")
+    rng = np.random.default_rng(seed)
+    probes = _make_reqs(rng, 6, blo=6, bhi=10)
+    oracle_v1 = _oracle(None, probes)
+    oracle_v2 = _oracle(3, probes)
+    pol = dict(bake_secs=1.5 if smoke else 3.0, min_samples=1,
+               max_e2e_ratio=None, max_error_rate=0.5)
+    ledger = {"v1": 0, "v2": 0, "other": 0}
+    errors = []
+    stop, llock = threading.Event(), threading.Lock()
+    serving = serving2 = None
+    try:
+        serving = ServingCluster.run(
+            None, 2, registry=_registry({"v1": {}, "v2": {"delta": 3}}),
+            model=("m", "v1"), max_batch=4,
+            worker_env={"JAX_PLATFORMS": "cpu"}, working_dir=wd,
+            reservation_timeout=120)
+        addr = serving.address
+
+        def pinger(tid):
+            k = tid
+            try:
+                with serving.client(failover_wait=120.0) as c:
+                    while not stop.is_set():
+                        j = k % len(probes)
+                        k += 2
+                        p, n = probes[j]
+                        got = c.generate(p, n, timeout=300,
+                                         model="m").tolist()
+                        with llock:
+                            if got == oracle_v1[j]:
+                                ledger["v1"] += 1
+                            elif got == oracle_v2[j]:
+                                ledger["v2"] += 1
+                            else:
+                                ledger["other"] += 1
+            except Exception as e:
+                with llock:
+                    errors.append(f"pinger {tid}: "
+                                  f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=pinger, args=(t,))
+                   for t in range(2)]
+        for t in threads:
+            t.start()
+        serving.rollout("m", "v2",
+                        policy=RolloutPolicy(steps=(25, 100), **pol),
+                        block=False)
+        # crash window: step 25 gated, step 100 (journaled as INTENT)
+        # mid-bake — the resume must re-execute 100 and nothing else
+        deadline = time.monotonic() + 300
+        while True:
+            r = ControlPlaneJournal.replay(jpath).rollouts.get("m")
+            if r and r.get("outcome"):
+                raise RuntimeError(f"registry_resume: rollout finished "
+                                   f"{r['outcome']} before the crash "
+                                   "window")
+            if r and 25 in r["done_steps"]:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError("registry_resume: step 25 never gated")
+            time.sleep(0.1)
+        time.sleep(0.4)
+        crashed_at = time.time()
+        serving.crash()
+        time.sleep(1.0)
+        # a restarted driver re-registers builders (code never journals)
+        serving2 = resume_driver(
+            serving.cluster, address=addr, max_batch=4, model=("m", "v1"),
+            registry=_registry({"v1": {}, "v2": {"delta": 3}}),
+            crashed_at=crashed_at)
+        remaining = serving2.resume_state.remaining_steps("m")
+        ctls = resume_rollouts(serving2,
+                               policy=RolloutPolicy(steps=(100,), **pol))
+        state2 = ctls[0].state if ctls else None
+        stop.set()
+        for t in threads:
+            t.join(300)
+        reg2 = serving2.registry
+        v2_state = reg2.version("m", "v2").state
+        v1_state = reg2.version("m", "v1").state
+        canary_modes = [e.get("mode") for e in EventLog.read(
+            os.path.join(wd, "serving_events.jsonl"))
+            if e.get("kind") == "rollout_canary"]
+    finally:
+        stop.set()
+        if serving2 is not None:
+            serving2.shutdown(timeout=300)
+        elif serving is not None:
+            with contextlib.suppress(Exception):
+                serving.shutdown(timeout=60)
+            with contextlib.suppress(Exception):
+                serving.cluster._abort()
+
+    if errors:
+        raise RuntimeError(f"registry_resume: pinger errors: {errors[:3]}")
+    if tuple(remaining) != (100,):
+        raise RuntimeError(f"registry_resume: remaining steps {remaining} "
+                           "!= (100,) — the resume did not narrow the plan")
+    if state2 != "promoted":
+        raise RuntimeError(f"registry_resume: resumed rollout ended "
+                           f"{state2!r}, want 'promoted'")
+    if "resumed" not in canary_modes:
+        raise RuntimeError(f"registry_resume: canary arm modes "
+                           f"{canary_modes} — the resumed controller "
+                           "re-armed instead of continuing the survivor")
+    if ledger["other"]:
+        raise RuntimeError(f"registry_resume: {ledger['other']} "
+                           "request(s) match NEITHER version's oracle")
+    if ledger["v2"] < 1:
+        raise RuntimeError("registry_resume: no request was ever served "
+                           "by v2")
+    if (v2_state, v1_state) != ("serving", "retired"):
+        raise RuntimeError(f"registry_resume: final registry states "
+                           f"v2={v2_state} v1={v1_state}")
+    st = ControlPlaneJournal.replay(jpath)
+    if st.open_rollouts() or \
+            st.rollouts["m"].get("outcome") != "promoted":
+        raise RuntimeError(f"registry_resume: journal rollout state "
+                           f"{st.rollouts.get('m')}")
+    if st.unfinished or st.resumes != 1:
+        raise RuntimeError(
+            f"registry_resume: journal owes {sorted(st.unfinished)}, "
+            f"resumes={st.resumes}")
+    return {
+        "scenario": "registry_resume",
+        "resumed_steps": [int(s) for s in remaining],
+        "rollout_state": state2,
+        "canary_modes": canary_modes,
+        "ledger": dict(ledger),
+        "errors": len(errors),
+        "registry": {"v2": v2_state, "v1": v1_state},
+        "journal": {"outcome": st.rollouts["m"].get("outcome"),
+                    "resumes": st.resumes,
+                    "unfinished": len(st.unfinished)},
+    }
+
+
+def validate_failover_artifact(out: dict) -> None:
+    """Schema + gate check for ``bench_artifacts/failover.json`` — the
+    scenarios gate themselves at run time; this re-checks the COMMITTED
+    numbers so a hand-edited or stale artifact fails CI."""
+    if out.get("benchmark") != "failover":
+        raise RuntimeError("artifact gate: wrong benchmark name")
+    rows = {r["scenario"]: r for r in out["rows"]}
+    dk = rows.get("driver_kill")
+    if dk is None:
+        raise RuntimeError("artifact gate: missing driver_kill row")
+    if dk["errors"] or dk["oracle_mismatches"]:
+        raise RuntimeError("artifact gate: driver_kill row carries "
+                           "client errors / oracle mismatches")
+    if dk["requeued_on_resume"] < 1:
+        raise RuntimeError("artifact gate: driver_kill requeued nothing")
+    if dk["journal"]["unfinished"] or dk["journal"]["resumes"] != 1:
+        raise RuntimeError("artifact gate: driver_kill journal not "
+                           "drained / wrong resume count")
+    if not isinstance(dk.get("heal_secs"), (int, float)) \
+            or dk["heal_secs"] < 0:
+        raise RuntimeError("artifact gate: driver_kill heal_secs missing")
+    rr = rows.get("registry_resume")
+    if rr is None:
+        raise RuntimeError("artifact gate: missing registry_resume row")
+    if rr["resumed_steps"] != [100] or rr["rollout_state"] != "promoted":
+        raise RuntimeError("artifact gate: registry_resume did not "
+                           "continue-and-promote")
+    if "resumed" not in rr["canary_modes"]:
+        raise RuntimeError("artifact gate: registry_resume re-armed the "
+                           "canary instead of continuing it")
+    if rr["ledger"]["other"] or rr["errors"] \
+            or rr["journal"]["outcome"] != "promoted":
+        raise RuntimeError("artifact gate: registry_resume rows violate "
+                           "the oracle/outcome gates")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=60)
@@ -1838,8 +2199,37 @@ def main():
                          "tier; writes bench_artifacts/spec_serving.json "
                          "(--smoke: spec_serving_smoke.json, gates "
                          "directional)")
+    ap.add_argument("--failover", action="store_true",
+                    help="run the DRIVER-KILL failover scenarios instead "
+                         "(docs/robustness.md): a chaos 'kill driver' "
+                         "mid-stream healed by journal replay "
+                         "(zero-loss + oracle-exact + requeued>=1 "
+                         "gates), and a mid-canary crash whose rollout "
+                         "the resumed driver CONTINUES; writes "
+                         "bench_artifacts/failover.json (--smoke: "
+                         "failover_smoke.json)")
     args = ap.parse_args()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.failover:
+        rows = [failover_scenario(smoke=args.smoke),
+                registry_resume_scenario(smoke=args.smoke)]
+        artifact = {"benchmark": "failover",
+                    "config": {"backend": "LocalProcessBackend",
+                               "platform": "cpu",
+                               "smoke": bool(args.smoke)},
+                    "rows": rows}
+        validate_failover_artifact(artifact)
+        # --smoke writes its own file, never the committed full artifact
+        out = os.path.join(REPO, "bench_artifacts",
+                           "failover_smoke.json" if args.smoke
+                           else "failover.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"wrote {out} (all gates passed)")
+        print(json.dumps(rows, indent=1))
+        return
 
     if args.multi_model:
         # the scenario (and its gates) live beside the other rollout

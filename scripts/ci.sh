@@ -20,7 +20,11 @@
 #                               # + --spec --smoke (draft speculation +
 #                               # AOT warm-up A/B) + tfos_warmcache.py
 #                               # --check-warm (pre-baked cache must
-#                               # compile 0 on the second sweep)
+#                               # compile 0 on the second sweep) +
+#                               # --failover --smoke (chaos driver kill
+#                               # healed by journal replay: zero-loss,
+#                               # oracle-exact, mid-canary rollout
+#                               # continuation gates)
 #
 # The analysis gate (docs/analysis.md) runs all six project rules plus the
 # exports-drift check against the committed analysis_baseline.json ratchet
@@ -138,6 +142,18 @@ if [ "${1:-}" = "--bench-smoke" ]; then
     rc=$?
     if [ $rc -ne 0 ]; then
         echo "rollout bench smoke FAILED (rc=$rc)" >&2
+        exit $rc
+    fi
+    echo "== bench smoke (driver failover) =="
+    # a chaos 'kill driver' hard-crashes the control plane mid-stream;
+    # resume_driver replays the write-ahead journal onto the surviving
+    # replicas: fails itself on the zero-loss, oracle-exact, requeue,
+    # and mid-canary rollout-continuation gates; writes
+    # failover_smoke.json (never the committed full artifact)
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py --failover --smoke
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "driver failover bench smoke FAILED (rc=$rc)" >&2
         exit $rc
     fi
     exit 0

@@ -11,13 +11,20 @@ through ``AgentBackend`` — the env rides ``worker_env``).
 Plan grammar (full reference: ``docs/robustness.md``)::
 
     TFOS_CHAOS = action [';' action]...
-    action     = verb SP assignments          # 'kill node=1 at_step=3'
+    action     = verb SP scope SP assignments # 'kill node=1 at_step=3'
+    scope      = 'node='<int> | 'driver'      # 'kill driver after_secs=2'
     assignments= key'='value [[',' | SP] key'='value]...
     verb       = 'kill' | 'term' | 'stall' | 'drop' | 'replace' | 'flap'
 
 Keys:
 
-- ``node=<int>`` (required) — executor id the action targets.
+- ``node=<int>`` (required unless the scope is ``driver``) — executor id
+  the action targets.  The bare token ``driver`` scopes the action to
+  the DRIVER process instead (``kill`` only, ``after_secs=`` only —
+  there are no worker steps on the driver): the serving tier arms it
+  (``ServingCluster.run``) and fires it as a hard control-plane crash,
+  the failover drill ``serving/failover.py`` heals from.  Same
+  once-per-job sentinel (``chaos.driver.<index>``).
 - ``at_step=<int>`` — fire when ``ctx.report_step()`` reaches this step.
 - ``after_secs=<float>`` — fire this long after the worker's harness
   starts (checked on the heartbeat tick) — for faults before step 1.
@@ -85,6 +92,11 @@ STATE_DIR_ENV = "TFOS_CHAOS_DIR"
 
 VERBS = ("kill", "term", "stall", "drop", "replace", "flap")
 
+#: ``ChaosAction.node`` value for driver-scope actions (``kill driver
+#: after_secs=F``) — no executor ever has this id, so worker agents
+#: filter them out for free; sentinels use the literal ``driver``
+DRIVER_NODE = -1
+
 _INT_KEYS = ("node", "at_step", "count")
 _FLOAT_KEYS = ("after_secs", "grace", "secs", "every")
 
@@ -110,12 +122,14 @@ class ChaosAction:
     index: int = 0  # position in the plan → sentinel-file identity
 
     def describe(self) -> str:
+        scope = ("driver" if self.node == DRIVER_NODE
+                 else f"node={self.node}")
         if self.verb == "flap":
-            return (f"flap node={self.node} every={self.every:g} "
+            return (f"flap {scope} every={self.every:g} "
                     f"count={self.count or 1}")
         trig = (f"at_step={self.at_step}" if self.at_step is not None
                 else f"after_secs={self.after_secs}")
-        return f"{self.verb} node={self.node} {trig}"
+        return f"{self.verb} {scope} {trig}"
 
 
 def parse_plan(spec: str) -> list[ChaosAction]:
@@ -130,6 +144,13 @@ def parse_plan(spec: str) -> list[ChaosAction]:
         kwargs: dict = {}
         for assign in parts[1:]:
             if "=" not in assign:
+                if assign.lower() == "driver":
+                    if "node" in kwargs:
+                        raise ChaosPlanError(
+                            f"chaos action {raw!r}: 'driver' and node= are "
+                            f"mutually exclusive scopes")
+                    kwargs["node"] = DRIVER_NODE
+                    continue
                 raise ChaosPlanError(f"expected key=value, got {assign!r} in {raw!r}")
             key, val = assign.split("=", 1)
             key = key.strip().lower()
@@ -147,7 +168,23 @@ def parse_plan(spec: str) -> list[ChaosAction]:
                     raise
                 raise ChaosPlanError(f"bad value for {key!r} in {raw!r}: {val!r}")
         if "node" not in kwargs:
-            raise ChaosPlanError(f"chaos action {raw!r} needs node=<int>")
+            raise ChaosPlanError(
+                f"chaos action {raw!r} needs a scope: node=<int> or driver")
+        if kwargs["node"] < 0 and kwargs["node"] != DRIVER_NODE:
+            raise ChaosPlanError(
+                f"chaos action {raw!r}: node must be >= 0")
+        if kwargs["node"] == DRIVER_NODE:
+            if verb != "kill":
+                raise ChaosPlanError(
+                    f"chaos action {raw!r}: only 'kill' supports the "
+                    f"driver scope")
+            if kwargs.get("at_step") is not None:
+                raise ChaosPlanError(
+                    f"chaos action {raw!r}: at_step= does not apply to "
+                    f"the driver (no worker steps); use after_secs=")
+            if kwargs.get("after_secs") is None:
+                raise ChaosPlanError(
+                    f"chaos action {raw!r} needs a trigger: after_secs=")
         if verb == "flap":
             if kwargs.get("every") is None:
                 raise ChaosPlanError(
@@ -308,9 +345,86 @@ class ChaosAgent:
                 logger.exception("chaos: drop failed")
 
 
-def fired_at(state_dir: str, node: int, index: int = 0) -> float | None:
+class DriverChaos:
+    """Driver-side arm of the plan: fires ``kill driver after_secs=F``.
+
+    The worker verbs self-apply inside the worker harness; a
+    driver-scope action has no harness, so the serving tier arms this
+    object in ``ServingCluster.run``.  Firing means invoking ``on_fire``
+    — the tier's hard control-plane crash
+    (:meth:`~tensorflowonspark_tpu.serving.frontend.ServingCluster.
+    crash`): frontend sockets drop, scheduler threads stop with no
+    drain/fail/cleanup of queued work, and only the fsync'd journal
+    survives — the in-process equivalent of SIGKILLing a standalone
+    driver process, minus taking the bench/test process with it.  Same
+    once-per-job sentinel discipline as the worker verbs
+    (``chaos.driver.<index>`` under ``TFOS_CHAOS_DIR``/``state_dir``,
+    holding the fired-at wall clock for failover-latency accounting).
+    """
+
+    def __init__(self, actions: list[ChaosAction], on_fire,
+                 state_dir: str | None = None):
+        self.actions = [a for a in actions if a.node == DRIVER_NODE]
+        self.on_fire = on_fire
+        self.state_dir = os.environ.get(STATE_DIR_ENV) or state_dir \
+            or tempfile.gettempdir()
+        self._timers: list[threading.Timer] = []
+        self._fired: set[int] = set()
+        for a in self.actions:
+            logger.warning("chaos armed on driver: %s", a.describe())
+
+    def start(self) -> "DriverChaos":
+        for a in self.actions:
+            t = threading.Timer(a.after_secs or 0.0, self._fire, args=(a,))
+            t.daemon = True
+            t.start()
+            self._timers.append(t)
+        return self
+
+    def stop(self) -> None:
+        for t in self._timers:
+            t.cancel()
+        self._timers.clear()
+
+    def _sentinel(self, action: ChaosAction) -> str:
+        return os.path.join(self.state_dir, f"chaos.driver.{action.index}")
+
+    def _fire(self, action: ChaosAction) -> None:
+        if action.index in self._fired:
+            return
+        self._fired.add(action.index)
+        sentinel = self._sentinel(action)
+        if os.path.exists(sentinel):  # already fired in a previous attempt
+            return
+        try:
+            with open(sentinel, "w") as f:
+                f.write(f"{time.time():.6f}")
+        except OSError:
+            logger.warning("chaos: cannot write sentinel %s; firing anyway",
+                           sentinel)
+        logger.warning("chaos FIRING on driver: %s", action.describe())
+        try:
+            self.on_fire(action)
+        except Exception:
+            logger.exception("chaos: driver kill handler failed")
+
+
+def driver_from_env(on_fire, state_dir: str | None = None) \
+        -> DriverChaos | None:
+    """Build the driver's chaos arm from ``$TFOS_CHAOS``; None when unset
+    or when no action carries the ``driver`` scope."""
+    spec = os.environ.get(PLAN_ENV)
+    if not spec:
+        return None
+    drv = DriverChaos(parse_plan(spec), on_fire, state_dir=state_dir)
+    return drv if drv.actions else None
+
+
+def fired_at(state_dir: str, node: "int | str", index: int = 0) \
+        -> float | None:
     """Read the fired-at wall time a sentinel recorded (bench/test helper);
-    None if that action has not fired."""
+    None if that action has not fired.  ``node="driver"`` reads a
+    driver-scope action's sentinel."""
     path = os.path.join(state_dir, f"chaos.{node}.{index}")
     try:
         with open(path) as f:

@@ -196,21 +196,26 @@ class EventLog:
         """Parse an event file back into records (bench/test helper).
 
         Tolerates malformed lines: a driver killed mid-``emit`` leaves a
-        truncated final line, and a post-mortem read that raised on it
-        would lose every GOOD record in the file.  Bad lines are skipped
-        with a warning instead."""
+        truncated final line — cut mid-payload, mid-UTF-8 sequence, or
+        before its newline — and a post-mortem read that raised on it
+        would lose every GOOD record in the file.  The file is read as
+        bytes and decoded per line (a text-mode iterator raises
+        ``UnicodeDecodeError`` on a torn multibyte tail and drops every
+        line after it); bad lines are skipped with a warning, intact
+        lines before AND after still come back."""
         out: list[dict] = []
-        with open(path) as f:
-            for lineno, line in enumerate(f, 1):
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    out.append(json.loads(line))
-                except json.JSONDecodeError:
-                    logger.warning(
-                        "skipping malformed event at %s:%d (truncated by a "
-                        "mid-write death?): %.80r", path, lineno, line)
+        with open(path, "rb") as f:
+            data = f.read()
+        for lineno, raw in enumerate(data.split(b"\n"), 1):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                out.append(json.loads(raw.decode("utf-8")))
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                logger.warning(
+                    "skipping malformed event at %s:%d (truncated by a "
+                    "mid-write death?): %.80r", path, lineno, raw)
         return out
 
 

@@ -31,11 +31,16 @@ shifting with metrics-gated auto-rollback), ``frontend`` (TCP edge +
 ``scale_up``/``deploy_model``/``swap_replica_model``/``rollout``/
 drain-and-replace, whole-gang, per-pool autoscaling),
 ``autoscaler`` (metrics-driven membership control, device-weighted,
-role-filterable, promotes standbys first), ``client`` (``ServeClient``),
-``aot`` (``AOTExecutableCache``: serve-step executables serialized to
+role-filterable, promotes standbys first), ``client`` (``ServeClient``;
+``failover_wait=`` rides through driver failovers), ``aot``
+(``AOTExecutableCache``: serve-step executables serialized to
 disk, so warm-ups and cold starts load instead of compile — pre-baked
-by ``scripts/tfos_warmcache.py``).  Draft-model speculative decoding
-arms via ``ServingCluster.run(draft_model=...)``.
+by ``scripts/tfos_warmcache.py``), ``journal`` (the write-ahead
+control-plane journal: every accept/route/commit/membership/registry/
+rollout transition fsync'd, the recovery source of truth), ``failover``
+(``resume_driver``/``resume_rollouts``: rebuild a zero-loss control
+plane over the surviving workers after a driver death).  Draft-model
+speculative decoding arms via ``ServingCluster.run(draft_model=...)``.
 Architecture, backpressure semantics, the failure model, and the
 scale-event taxonomy are in ``docs/serving.md``.
 """
@@ -44,11 +49,16 @@ from tensorflowonspark_tpu.serving.aot import \
     AOTExecutableCache  # noqa: F401
 from tensorflowonspark_tpu.serving.autoscaler import (Autoscaler,  # noqa: F401
                                                       AutoscalerConfig)
-from tensorflowonspark_tpu.serving.client import ServeClient  # noqa: F401
+from tensorflowonspark_tpu.serving.client import (FrontendUnavailable,  # noqa: F401
+                                                  ServeClient)
 from tensorflowonspark_tpu.serving.disagg import \
     serve_disagg_replica  # noqa: F401
+from tensorflowonspark_tpu.serving.failover import (resume_driver,  # noqa: F401
+                                                    resume_rollouts)
 from tensorflowonspark_tpu.serving.frontend import (ServeFrontend,  # noqa: F401
                                                     ServingCluster)
+from tensorflowonspark_tpu.serving.journal import (ControlPlaneJournal,  # noqa: F401
+                                                   JournalState)
 from tensorflowonspark_tpu.serving.replica import serve_replica  # noqa: F401
 from tensorflowonspark_tpu.serving.rollout import (ModelRegistry,  # noqa: F401
                                                    ModelVersion,

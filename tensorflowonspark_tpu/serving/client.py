@@ -27,6 +27,17 @@ untouched.
 per-call override) into the scheduler's per-tenant token-bucket
 admission — an over-budget tenant sees
 ``RequestRejected(reason="tenant_throttled")``.
+
+Driver failover ride-through (docs/robustness.md "Control-plane
+failover"): ``failover_wait=N`` arms the client to survive a DRIVER
+death mid-request.  The client then mints its own trace id (the journal
+records it at admission), and when the connection dies mid-stream it
+reconnects to the same address — with backoff, for up to ``N`` seconds
+while the standby driver replays the journal and rebinds the port —
+and sends a ``resume`` op naming the trace and how many tokens it
+already holds; the resumed frontend replays exactly the missing tail.
+:class:`FrontendUnavailable` is the typed exhaustion error (no frontend
+came back within the window).
 """
 
 from __future__ import annotations
@@ -51,6 +62,12 @@ _REJECT_REASONS = ("queue_full", "tenant_throttled", "shutdown",
                    "no_replica", "role_mismatch", "unknown_model")
 
 
+class FrontendUnavailable(ServingError):
+    """No serving frontend answered at the tier's address within the
+    client's ``failover_wait`` reconnect window — the driver is gone
+    and no standby resumed in time."""
+
+
 def _raise_typed(reason: str, message: str):
     if reason in _REJECT_REASONS:
         raise RequestRejected(reason, message)
@@ -72,12 +89,16 @@ class ServeClient(MessageSocket):
 
     def __init__(self, addr: tuple[str, int], authkey: bytes,
                  timeout: float = 600.0, tenant: str | None = None,
-                 priority: str | None = None, model: str | None = None):
+                 priority: str | None = None, model: str | None = None,
+                 failover_wait: float = 0.0):
         self.addr = tuple(addr)
         self._authkey = bytes(authkey)
         self._timeout = float(timeout)
         self.tenant = tenant
         self.priority = priority
+        #: seconds to ride through a driver failover (module docstring);
+        #: 0 = off, connection loss mid-request propagates as before
+        self.failover_wait = float(failover_wait)
         #: default ``model`` for every request (multi-model tiers;
         #: per-call override) — None = the tier's default model
         self.model = model
@@ -90,6 +111,16 @@ class ServeClient(MessageSocket):
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock.settimeout(self._timeout)
         self._sock.connect(self.addr)
+        if self._sock.getsockname() == self._sock.getpeername():
+            # loopback SELF-CONNECT: with no listener bound (a driver
+            # mid-failover) and the target port inside the ephemeral
+            # range, the kernel can give this socket the target as its
+            # OWN local port and TCP simultaneous-open "succeeds" against
+            # itself — the handshake would then hang AND the held port
+            # would block the resumed frontend's rebind
+            self.close()
+            raise ConnectionError(
+                f"self-connect to {self.addr} (no listener bound)")
         try:
             self.auth_respond(self._sock, self._authkey)
         except (PermissionError, EOFError, OSError) as e:
@@ -137,6 +168,58 @@ class ServeClient(MessageSocket):
             self.send(self._sock, msg)
             return self.receive(self._sock)
 
+    # -- driver-failover ride-through --------------------------------------
+    def _reconnect_failover(self) -> None:
+        """Reconnect to the tier address for up to ``failover_wait``
+        seconds (backoff doubling from RETRY_BACKOFF_SECS, capped at
+        2s) while a standby driver replays the journal and rebinds the
+        port.  Typed :class:`FrontendUnavailable` on exhaustion."""
+        deadline = time.monotonic() + self.failover_wait
+        backoff = self.RETRY_BACKOFF_SECS
+        with contextlib.suppress(OSError):
+            self._sock.close()
+        while True:
+            try:
+                self._connect()
+                return
+            except (OSError, ConnectionError) as e:
+                if time.monotonic() + backoff > deadline:
+                    raise FrontendUnavailable(
+                        f"serving frontend {self.addr} did not come back "
+                        f"within failover_wait={self.failover_wait:.0f}s: "
+                        f"{e!r}") from e
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+
+    def _resume_frame(self, trace, received: int, stream: bool, timeout):
+        """Reconnect and send the ``resume`` op; returns its first
+        response frame.  The resume exchange itself retries too — a
+        reconnect can land on a frontend that is still going down (or a
+        standby mid-boot), and that race must look like one more
+        connect failure, not a raw socket error."""
+        logger.warning(
+            "serve frontend %s: connection lost mid-request; riding "
+            "through driver failover (trace %s, %d token(s) held, "
+            "window %.0fs)", self.addr, trace, received,
+            self.failover_wait)
+        deadline = time.monotonic() + self.failover_wait
+        while True:
+            self._reconnect_failover()
+            try:
+                self.send(self._sock, {"op": "resume", "trace": trace,
+                                       "received": int(received),
+                                       "stream": bool(stream),
+                                       "timeout": timeout})
+                return self.receive(self._sock)
+            except (OSError, EOFError) as e:
+                if isinstance(e, TimeoutError):
+                    raise   # a slow resumed tier, not an absent one
+                if time.monotonic() > deadline:
+                    raise FrontendUnavailable(
+                        f"serving frontend {self.addr} kept dropping the "
+                        f"resume exchange past failover_wait="
+                        f"{self.failover_wait:.0f}s: {e!r}") from e
+
     def generate(self, prompt, max_new_tokens: int, *,
                  temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
                  timeout: float | None = None, trace: str | None = None,
@@ -153,19 +236,41 @@ class ServeClient(MessageSocket):
         (``model`` selects the hosted model on a multi-model tier —
         an unhosted name raises typed
         ``RequestRejected(reason="unknown_model")``)."""
+        failover = self.failover_wait > 0
+        if failover and trace is None:
+            from tensorflowonspark_tpu.tracing import new_trace_id
+
+            trace = new_trace_id()   # the resume op's lookup key
         with self._lock:
-            frame = self._request_first(self._gen_msg(
+            msg = self._gen_msg(
                 prompt, max_new_tokens, temperature, top_p, seed,
                 stream=False, timeout=timeout, trace=trace,
-                tenant=tenant, priority=priority, model=model))
+                tenant=tenant, priority=priority, model=model)
+            frame = None
             while True:
-                kind = frame[0]
-                if kind == "DONE":
-                    return np.asarray(frame[1], np.int32)
-                if kind == "ERR":
-                    _raise_typed(frame[1], frame[2])
-                # tolerate stray TOK frames (stream flag mismatch)
-                frame = self.receive(self._sock)
+                try:
+                    if frame is None:
+                        frame = self._request_first(msg)
+                    kind = frame[0]
+                    if kind == "DONE":
+                        return np.asarray(frame[1], np.int32)
+                    if kind == "ERR":
+                        if frame[1] == "unknown_request" and failover:
+                            # the resumed driver's journal never saw (or
+                            # already committed) this admission; nothing
+                            # was delivered to us, so replaying the
+                            # original generate is exact
+                            frame = None
+                            continue
+                        _raise_typed(frame[1], frame[2])
+                    # tolerate stray TOK frames (stream flag mismatch)
+                    frame = self.receive(self._sock)
+                except (OSError, EOFError) as e:
+                    # a TIMEOUT is a slow response, not a dead driver —
+                    # same rule as _request_first
+                    if not failover or isinstance(e, TimeoutError):
+                        raise
+                    frame = self._resume_frame(trace, 0, False, timeout)
 
     def generate_stream(self, prompt, max_new_tokens: int, *,
                         temperature: float = 0.0, top_p: float = 1.0,
@@ -176,22 +281,59 @@ class ServeClient(MessageSocket):
         """Yield token deltas (lists of ints) as the replica commits them;
         exact concatenation == :meth:`generate`'s output.  Consume the
         iterator fully (or ``close()`` the client): abandoning it
-        mid-stream closes the connection to avoid frame desync."""
+        mid-stream closes the connection to avoid frame desync.
+
+        With ``failover_wait`` armed, a connection death mid-stream
+        rides through a driver failover: the client reconnects and
+        resumes AT the token it stopped at — the concatenated yield is
+        exactly :meth:`generate`'s output, no token lost or repeated."""
+        failover = self.failover_wait > 0
+        if failover and trace is None:
+            from tensorflowonspark_tpu.tracing import new_trace_id
+
+            trace = new_trace_id()   # the resume op's lookup key
         with self._lock:
-            frame = self._request_first(self._gen_msg(
+            msg = self._gen_msg(
                 prompt, max_new_tokens, temperature, top_p, seed,
                 stream=True, timeout=timeout, trace=trace,
-                tenant=tenant, priority=priority, model=model))
+                tenant=tenant, priority=priority, model=model)
+            received = 0    # tokens already yielded = the resume cursor
+            frame = None
             try:
                 while True:
-                    kind = frame[0]
-                    if kind == "TOK":
-                        yield list(frame[1])
-                    elif kind == "DONE":
-                        return
-                    else:
-                        _raise_typed(frame[1], frame[2])
-                    frame = self.receive(self._sock)
+                    try:
+                        if frame is None:
+                            frame = self._request_first(msg)
+                        kind = frame[0]
+                        if kind == "TOK":
+                            toks = list(frame[1])
+                            received += len(toks)
+                            yield toks
+                        elif kind == "DONE":
+                            return
+                        else:
+                            if frame[1] == "unknown_request" and failover:
+                                if received == 0:
+                                    # nothing delivered yet: replaying
+                                    # the original generate is exact
+                                    # (see generate())
+                                    frame = None
+                                    continue
+                                # a half-delivered stream the resumed
+                                # driver cannot finish (journal commit
+                                # raced the crash): replay would repeat
+                                # tokens — typed loss instead
+                                raise ReplicaFailed(
+                                    f"stream lost to driver failover "
+                                    f"after {received} token(s): "
+                                    f"{frame[2]}")
+                            _raise_typed(frame[1], frame[2])
+                        frame = self.receive(self._sock)
+                    except (OSError, EOFError) as e:
+                        if not failover or isinstance(e, TimeoutError):
+                            raise
+                        frame = self._resume_frame(trace, received,
+                                                   True, timeout)
             except GeneratorExit:
                 # abandoned mid-stream: unread frames would desync the
                 # next request — retire the connection instead

@@ -41,7 +41,9 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import errno
 import logging
+import os
 import queue
 import socket
 import threading
@@ -77,20 +79,34 @@ class ServeFrontend(MessageSocket):
       for ``stream=False``, the total token count for streams — or
       ``("ERR", reason, message)``;
     - ``{"op": "stats"}`` → ``("OK", metrics_dict)``;
-    - ``{"op": "ping"}`` → ``"OK"``.
+    - ``{"op": "ping"}`` → ``"OK"``;
+    - ``{"op": "resume", "trace", "received", "stream", "timeout"}`` →
+      the tail of a replayed stream after a DRIVER failover
+      (docs/robustness.md "Control-plane failover"): the client names
+      the trace it was streaming and how many tokens it already holds,
+      and the resumed frontend replays the rest exactly.
     """
 
     def __init__(self, scheduler: ReplicaScheduler, authkey: bytes,
-                 mode: str = "local", default_timeout: float = 600.0):
+                 mode: str = "local", default_timeout: float = 600.0,
+                 port: int = 0):
         self.scheduler = scheduler
         self.authkey = bytes(authkey)
         self.mode = mode
         self.default_timeout = float(default_timeout)
+        self._port = int(port)
         self.done = threading.Event()
         self._listener: socket.socket | None = None
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
         self.connections = 0
+        #: trace -> replayed ServeRequest a driver failover re-queued
+        #: (``serving.failover.resume_driver`` wires these); claimed
+        #: one-shot by the first resume naming the trace
+        self.resumed: dict = {}
+        #: trace -> token count of requests whose commit landed just
+        #: before the crash — the client may only be missing DONE
+        self.resumed_done: dict = {}
         self._m_ops = tpu_metrics.get_registry().counter(
             "tfos_frontend_requests_total",
             "Frontend operations received, by op.", labelnames=("op",))
@@ -100,7 +116,22 @@ class ServeFrontend(MessageSocket):
         host = "127.0.0.1" if self.mode == "local" else "0.0.0.0"
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((host, 0))
+        # port != 0: a RESUMED driver rebinds the crashed frontend's
+        # address so riding-through clients reconnect where they were.
+        # SO_REUSEADDR only exempts TIME_WAIT — the crashed frontend's
+        # accepted conns linger in FIN_WAIT/CLOSE_WAIT for a moment, so
+        # the rebind retries while they drain (clients are in their own
+        # failover_wait backoff anyway)
+        deadline = time.monotonic() + 15.0
+        while True:
+            try:
+                self._listener.bind((host, self._port))
+                break
+            except OSError as e:
+                if (self._port == 0 or e.errno != errno.EADDRINUSE
+                        or time.monotonic() > deadline):
+                    raise
+                time.sleep(0.2)
         self._listener.listen(128)
         self.port = self._listener.getsockname()[1]
         threading.Thread(target=self._accept_loop, name="serve-frontend",
@@ -149,10 +180,13 @@ class ServeFrontend(MessageSocket):
                 op = msg.get("op") if isinstance(msg, dict) else None
                 # label only the known op set — a client-controlled label
                 # value must not mint unbounded counter series
-                self._m_ops.inc(op=op if op in ("generate", "stats", "ping")
+                self._m_ops.inc(op=op if op in ("generate", "stats",
+                                                "ping", "resume")
                                 else "other")
                 if op == "generate":
                     self._handle_generate(conn, msg)
+                elif op == "resume":
+                    self._handle_resume(conn, msg)
                 elif op == "stats":
                     self.send(conn, ("OK", self.scheduler.metrics()))
                 elif op == "ping":
@@ -196,6 +230,18 @@ class ServeFrontend(MessageSocket):
         except (ValueError, TypeError, KeyError) as e:
             self.send(conn, ("ERR", "bad_request", str(e)))
             return
+        self._pump_request(conn, req, stream)
+
+    def _pump_request(self, conn: socket.socket, req, stream: bool,
+                      skip: int = 0) -> None:
+        """Drain ``req``'s event queue onto ``conn`` until terminal.
+
+        ``skip`` suppresses the first N generated tokens — the RESUME
+        path's dedup cut: a replayed request's queue carries the whole
+        stream from token 0, and the reconnecting client already holds
+        ``skip`` of them.  The cut lives here, frontend-side, so the
+        scheduler's replay never races who reconnects when.
+        """
         try:
             while True:
                 remaining = (None if req.deadline is None
@@ -210,8 +256,13 @@ class ServeFrontend(MessageSocket):
                 except queue.Empty:
                     continue        # loop re-checks remaining (<= 0 now)
                 if ev[0] == "tok":
-                    if stream:
-                        self.send(conn, ("TOK", ev[1]))
+                    toks = ev[1]
+                    if skip:
+                        cut = min(skip, len(toks))
+                        skip -= cut
+                        toks = toks[cut:]
+                    if stream and toks:
+                        self.send(conn, ("TOK", toks))
                 elif ev[0] == "done":
                     self.send(conn, ("DONE",
                                      ev[1] if stream
@@ -225,6 +276,38 @@ class ServeFrontend(MessageSocket):
             # output for it is dropped instead of queuing forever
             self.scheduler.abandon(req, reason="disconnect")
             raise
+
+    def _handle_resume(self, conn: socket.socket, msg: dict) -> None:
+        """Re-attach a client that lost its stream to a driver crash
+        (docs/robustness.md "Control-plane failover").  The client names
+        its trace plus how many tokens it already holds; a replayed
+        request's queue carries the WHOLE stream from token 0, so the
+        dedup cut happens here in :meth:`_pump_request`."""
+        trace = msg.get("trace")
+        received = max(0, int(msg.get("received") or 0))
+        stream = bool(msg.get("stream"))
+        req = self.resumed.pop(trace, None) if trace else None
+        if req is None:
+            done = self.resumed_done.get(trace) if trace else None
+            if done is not None and stream and received >= int(done):
+                # the commit landed just before the kill: the client
+                # already holds every token, only DONE was lost
+                self.send(conn, ("DONE", int(done)))
+                return
+            # non-stream clients (received == 0) land here too even when
+            # committed: the journal holds token COUNTS, not values —
+            # the client's resume fallback re-submits the original
+            # generate, and determinism recomputes the same stream
+            self.send(conn, ("ERR", "unknown_request",
+                             f"no replayed request for trace {trace!r}"))
+            return
+        timeout = msg.get("timeout")
+        if timeout is None:
+            timeout = self.default_timeout
+        # the journal carries no wall-clock deadlines (they died with the
+        # old driver): re-bound the wait from re-attach time
+        req.deadline = time.monotonic() + float(timeout)
+        self._pump_request(conn, req, stream, skip=received)
 
 
 class ServingCluster:
@@ -273,6 +356,16 @@ class ServingCluster:
         #: the warm-standby pool (:class:`~tensorflowonspark_tpu.serving.
         #: standby.StandbyPool`) when ``run(warm_standbys=N)``, else None
         self.standbys = None
+        #: the tier's write-ahead :class:`~tensorflowonspark_tpu.serving.
+        #: journal.ControlPlaneJournal` when the cluster has a
+        #: working_dir (``<working_dir>/control_plane.jsonl``), else None
+        self.journal = None
+        #: armed driver-scope chaos (``TFOS_CHAOS="kill driver ..."``)
+        self._driver_chaos = None
+        #: the folded :class:`~tensorflowonspark_tpu.serving.journal.
+        #: JournalState` a resumed tier was rebuilt from
+        #: (``serving.failover.resume_driver``), else None
+        self.resume_state = None
         self._serve_args: dict = {}       # standby gangs re-use the args
         self._standby_clone = True
         self._replace_failed = False
@@ -528,15 +621,27 @@ class ServingCluster:
         cluster = TPUCluster.run(map_fun, args, num_workers,
                                  input_mode=InputMode.SPARK, monitor=False,
                                  **cluster_kwargs)
-        scheduler = mon = frontend = tier = None
+        scheduler = mon = frontend = tier = journal = None
         try:
+            wd = getattr(cluster, "working_dir", None)
+            if wd:
+                # the write-ahead control-plane journal: every accept/
+                # route/commit/membership/rollout transition fsync'd
+                # before it takes effect, so a driver death replays to
+                # a zero-loss resume (docs/robustness.md "Control-plane
+                # failover"); no working_dir = nowhere durable to put it
+                from tensorflowonspark_tpu.serving.journal import \
+                    ControlPlaneJournal
+
+                journal = ControlPlaneJournal(
+                    os.path.join(wd, "control_plane.jsonl"))
             scheduler = ReplicaScheduler(
                 cluster, slots_per_replica=max_batch, overcommit=overcommit,
                 max_queue_depth=max_queue_depth, requeue_limit=requeue_limit,
                 tenants=tenants,
                 gang_size=1 if gang is None else gang.gang_size,
                 capacity_weight=1 if gang is None else gang.devices,
-                roles=roles, model=model)
+                roles=roles, model=model, journal=journal)
             if monitor:
                 mon = ClusterMonitor(
                     cluster, hang_timeout=hang_timeout,
@@ -553,7 +658,13 @@ class ServingCluster:
             tier.gang_spec = gang
             tier.disagg = disagg
             tier.registry = registry
+            tier.journal = journal
             tier._default_model = model
+            if registry is not None and journal is not None:
+                # bind BEFORE the founding mark: the journal snapshot
+                # of pre-boot registrations/evals plus every later
+                # mutation is what a resumed driver re-folds
+                registry.bind_journal(journal)
             if registry is not None and model is not None:
                 registry.mark(*model, "serving")
             tier._replace_preempted = bool(replace_preempted)
@@ -620,6 +731,16 @@ class ServingCluster:
                 tier.metrics_address = (
                     (address[0], bound[1]) if bound[0] == "0.0.0.0"
                     else bound)
+            # driver-scope chaos (TFOS_CHAOS="kill driver after_secs=F"):
+            # armed LAST, once the tier is fully live — firing calls
+            # tier.crash(), the in-process equivalent of SIGKILLing a
+            # standalone driver (docs/robustness.md)
+            from tensorflowonspark_tpu import chaos as tfos_chaos
+
+            tier._driver_chaos = tfos_chaos.driver_from_env(
+                on_fire=lambda action: tier.crash(), state_dir=wd)
+            if tier._driver_chaos is not None:
+                tier._driver_chaos.start()
         except Exception:
             # a late failure (e.g. the metrics port is taken) must tear
             # down everything already live: the autoscaler's control
@@ -634,6 +755,9 @@ class ServingCluster:
                 if part is not None:
                     with contextlib.suppress(Exception):
                         part.stop()
+            if journal is not None:
+                with contextlib.suppress(Exception):
+                    journal.close()
             cluster._abort()
             raise
         return tier
@@ -1311,6 +1435,48 @@ class ServingCluster:
             self.monitor.node_metrics() if self.monitor is not None else {})
 
     # ------------------------------------------------------------- shutdown
+    def crash(self) -> None:
+        """Hard-kill the DRIVER half of the tier in place — the
+        in-process equivalent of SIGKILLing a standalone driver process
+        (the ``TFOS_CHAOS="kill driver ..."`` verb fires this).
+
+        No drain, no requeue, no typed shutdown errors, nothing further
+        journaled: frontend sockets drop mid-stream, scheduler threads
+        stop with pending/outstanding work left exactly where it was.
+        Workers, their queue servers, and everything in flight on them
+        keep running — the obligations live in the fsync'd journal, and
+        :func:`~tensorflowonspark_tpu.serving.failover.resume_driver`
+        rebuilds a control plane over the surviving data plane from it.
+        """
+        if self._shutdown_done:
+            return
+        self._shutdown_done = True        # membership paths stand down
+        jnl, self.journal = self.journal, None
+        logger.warning(
+            "driver CRASH: dropping the control plane in place (journal "
+            "%s survives)", "<none>" if jnl is None else jnl.path)
+        if self._driver_chaos is not None:
+            with contextlib.suppress(Exception):
+                self._driver_chaos.stop()
+        # driver-side control threads only — a dead process would take
+        # these with it, and none of them messages a worker
+        for scaler in ([self.autoscaler] if self.autoscaler is not None
+                       else []) + list(self.autoscalers):
+            with contextlib.suppress(Exception):
+                scaler.stop()
+        if self.metrics_http is not None:
+            with contextlib.suppress(Exception):
+                self.metrics_http.stop()
+            self.metrics_http = None
+        self.frontend.stop()
+        self.scheduler.crash()
+        if self.monitor is not None:
+            with contextlib.suppress(Exception):
+                self.monitor.stop()
+        if jnl is not None:
+            jnl.close()       # every record is already fsync'd; the fd
+            # just dies with the "process", like a real SIGKILL
+
     def shutdown(self, timeout: float = 600.0,
                  drain_timeout: float = 60.0) -> None:
         """Drain in-flight requests, stop the tier, shut the cluster down.
@@ -1346,8 +1512,17 @@ class ServingCluster:
             with contextlib.suppress(Exception):
                 self.metrics_http.stop()
             self.metrics_http = None
+        if self._driver_chaos is not None:
+            # a still-pending driver-kill timer must not fire into a
+            # cleanly shut down tier
+            with contextlib.suppress(Exception):
+                self._driver_chaos.stop()
         self.frontend.stop()
         self.scheduler.stop()
+        if self.journal is not None:
+            # after scheduler.stop(): nothing records past this point
+            self.journal.close()
+            self.journal = None
         if self.monitor is not None:
             self.monitor.stop()
         try:

@@ -211,6 +211,58 @@ class ModelRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._versions: dict[str, dict[str, ModelVersion]] = {}
+        self._journal = None
+
+    def bind_journal(self, journal) -> None:
+        """Attach the tier's write-ahead control-plane journal
+        (``serving/journal.py``): every registration, eval verdict and
+        state flip appends a record, so a resumed driver replays the
+        catalog's lifecycle state (:meth:`adopt`).  Binding SNAPSHOTS
+        the current catalog first — registrations and eval verdicts
+        made before the tier booted (the usual order) must replay too;
+        the records are idempotent under the journal fold, so re-binding
+        after a resume is harmless.  Builders are code and never
+        journal — a resume re-registers them."""
+        self._journal = journal
+        with self._lock:
+            entries = [e for vs in self._versions.values()
+                       for e in vs.values()]
+        for e in sorted(entries, key=lambda e: (e.model_id, e.version)):
+            self._jrecord("registry_register", model=e.model_id,
+                          version=e.version,
+                          flavor="adapter" if e.base_builder is not None
+                          else "full")
+            if e.eval_passed is not None:
+                self._jrecord("registry_eval", model=e.model_id,
+                              version=e.version, passed=bool(e.eval_passed),
+                              metrics=e.eval_metrics)
+            if e.state != "registered":
+                self._jrecord("registry_state", model=e.model_id,
+                              version=e.version, state=e.state)
+
+    def _jrecord(self, kind: str, **fields) -> None:
+        if self._journal is not None:
+            self._journal.record(kind, **fields)
+
+    def adopt(self, state) -> None:
+        """Restore version states + eval verdicts from a replayed
+        :class:`~tensorflowonspark_tpu.serving.journal.JournalState`
+        (``serving.failover.resume_driver``).  The caller re-registers
+        each version's builder first; journaled versions with no
+        matching registration are warned about and skipped."""
+        for (mid, ver), ent in sorted(state.registry.items()):
+            try:
+                entry = self.version(mid, ver)
+            except KeyError:
+                logger.warning(
+                    "journal names %s@%s but it is not re-registered in "
+                    "the resumed registry; skipping", mid, ver)
+                continue
+            if ent.get("eval_passed") is not None:
+                entry.eval_passed = bool(ent["eval_passed"])
+                entry.eval_metrics = ent.get("eval_metrics")
+            if ent.get("state") in STATES:
+                entry.state = ent["state"]
 
     # -- registration ------------------------------------------------------
     def register(self, model_id: str, version: str, builder=None, *,
@@ -262,6 +314,10 @@ class ModelRegistry:
             versions[entry.version] = entry
         logger.info("registered %s@%s (%s)", entry.model_id, entry.version,
                     "adapter" if base_builder is not None else "full")
+        self._jrecord("registry_register", model=entry.model_id,
+                      version=entry.version,
+                      flavor="adapter" if base_builder is not None
+                      else "full")
         return entry
 
     # -- lookup ------------------------------------------------------------
@@ -305,6 +361,9 @@ class ModelRegistry:
             entry.state = "evaluated"
         logger.info("offline eval for %s@%s: %s %s", model_id, version,
                     "PASSED" if passed else "FAILED", metrics)
+        self._jrecord("registry_eval", model=entry.model_id,
+                      version=entry.version, passed=bool(passed),
+                      metrics=entry.eval_metrics)
 
     def evaluate(self, model_id: str, version: str, scorer,
                  results) -> bool:
@@ -333,6 +392,8 @@ class ModelRegistry:
             raise ValueError(f"unknown state {state!r} (want one of "
                              f"{STATES})")
         self.version(model_id, version).state = state
+        self._jrecord("registry_state", model=str(model_id),
+                      version=str(version), state=state)
 
 
 # ------------------------------------------------------------- rollout
@@ -446,6 +507,10 @@ class RolloutController:
                                       model=self.model_id,
                                       version=self.version,
                                       error=str(e))
+            self.scheduler.journal_record("rollout_done",
+                                          model=self.model_id,
+                                          version=self.version,
+                                          outcome="failed")
             raise
         except Exception as e:  # tfos: ignore[broad-except] — a rollout
             # crash must leave a terminal state + event, not a silently
@@ -457,6 +522,10 @@ class RolloutController:
                                       model=self.model_id,
                                       version=self.version,
                                       error=str(e))
+            self.scheduler.journal_record("rollout_done",
+                                          model=self.model_id,
+                                          version=self.version,
+                                          outcome="failed")
             logger.exception("rollout %s@%s failed", self.model_id,
                              self.version)
             raise
@@ -494,6 +563,13 @@ class RolloutController:
                                   version=ver, incumbent=old,
                                   steps=list(pol.steps),
                                   bake_secs=pol.bake_secs)
+        # the journaled plan is THIS controller's steps — a resumed
+        # rollout (serving/failover.py) re-starts with only the
+        # remaining steps, so a second failover replays against the
+        # narrowed plan, not the original one
+        self.scheduler.journal_record("rollout_started", model=mid,
+                                      version=ver, incumbent=old,
+                                      steps=list(pol.steps))
         if len(self.scheduler.replicas_of(mid, version=old)) <= 1:
             # a single-gang incumbent disappears at canary arm — every
             # "percent" step then routes ALL of the model's traffic to
@@ -525,6 +601,11 @@ class RolloutController:
         self.state = "shifting"
         try:
             for pct in pol.steps:
+                # step INTENT lands before the shift: a driver killed
+                # between here and the gate re-executes this step on
+                # resume (re-setting a split is idempotent)
+                self.scheduler.journal_record("rollout_step", model=mid,
+                                              version=ver, percent=pct)
                 self.scheduler.set_traffic_split(
                     mid, {ver: pct, old: 100 - pct} if pct < 100
                     else {ver: 100})
@@ -538,6 +619,9 @@ class RolloutController:
                 if not ok:
                     self._rollback(canary_eid, old, detail)
                     return
+                self.scheduler.journal_record("rollout_step_done",
+                                              model=mid, version=ver,
+                                              percent=pct)
         except Exception:
             # a crash mid-shift must not strand a partial split
             with contextlib.suppress(Exception):
@@ -583,6 +667,8 @@ class RolloutController:
         self._m_rollouts.inc(outcome="promoted")
         self.scheduler.emit_event("rollout_promoted", model=mid,
                                   version=ver, retired=old)
+        self.scheduler.journal_record("rollout_done", model=mid,
+                                      version=ver, outcome="promoted")
         logger.info("rollout %s@%s promoted (%s retired)", mid, ver, old)
 
     def _arm_canary(self, old: str) -> int:
@@ -591,6 +677,15 @@ class RolloutController:
         gang — capacity constant), falling back to an in-place
         drain-swap of an incumbent gang when no pool exists."""
         mid, ver = self.model_id, self.version
+        existing = self.scheduler.replicas_of(mid, version=ver)
+        if existing:
+            # a RESUMED rollout (serving/failover.py): the canary gang
+            # already serves the new version — continue it, don't re-arm
+            # (re-swapping would drain a healthy canary for nothing)
+            self.scheduler.emit_event("rollout_canary", model=mid,
+                                      version=ver, replica=existing[0],
+                                      mode="resumed")
+            return existing[0]
         victims = self.scheduler.replicas_of(mid, version=old)
         if not victims:
             raise RolloutError(f"no {mid}@{old} gang to canary against")
@@ -712,3 +807,5 @@ class RolloutController:
         self.scheduler.emit_event("rollout_rolled_back", model=mid,
                                   version=ver, incumbent=old,
                                   reason=detail.get("reason"))
+        self.scheduler.journal_record("rollout_done", model=mid,
+                                      version=ver, outcome="rolled_back")

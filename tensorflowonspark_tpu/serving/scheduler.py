@@ -352,7 +352,8 @@ class ReplicaScheduler:
                  tenants: dict | None = None, gang_size: int = 1,
                  capacity_weight: int | None = None,
                  roles: dict | None = None,
-                 model: tuple | None = None):
+                 model: tuple | None = None,
+                 journal=None):
         self.cluster = cluster
         feedable = sorted(
             (n for n in cluster.cluster_info
@@ -444,6 +445,17 @@ class ReplicaScheduler:
                 os.path.join(cluster.working_dir, "serving_events.jsonl"),
                 echo=False)
         self.events = event_log
+        #: write-ahead control-plane journal (``serving/journal.py``):
+        #: the recovery source of truth a resumed driver replays — every
+        #: admission/route/commit/membership/split transition appends an
+        #: fsync'd record BEFORE (admissions) or as (the rest) it becomes
+        #: observable.  None keeps the historical non-durable behavior.
+        self.journal = journal
+        if journal is not None:
+            for jeid, jrep in sorted(self.replicas.items()):
+                journal.record("replica_added", replica=jeid,
+                               members=list(jrep.members), role=jrep.role,
+                               model=jrep.model, version=jrep.version)
         self._pending = _PendingQueue()
         #: sessions a prefill gang handed back, awaiting their adopt
         #: dispatch onto a decode gang (FIFO; dispatched ahead of new
@@ -619,6 +631,147 @@ class ReplicaScheduler:
             self.events = None
             self._own_events = False
 
+    def crash(self) -> None:
+        """Hard-stop the control plane WITHOUT the shutdown courtesies —
+        the in-process equivalent of SIGKILLing a standalone driver
+        (driver-scope chaos; docs/robustness.md "Control-plane
+        failover").  Queued and in-flight requests are NOT failed,
+        drained, or journaled, and the journal handle is dropped FIRST
+        so nothing the crash path does is ever recorded: what the
+        journal already holds is exactly what a real kill would leave
+        behind, and ``serving.failover.resume_driver`` replays it."""
+        self.journal = None      # a dying driver writes nothing more
+        with self._lock:
+            self._stop.set()
+            self._work.notify_all()
+            # release swap waiters so tier threads blocked in wait_swap
+            # observe the death instead of hanging a full timeout
+            for rec in self._swap_waiters.values():
+                rec["error"] = "driver crashed mid-swap"
+                rec["event"].set()
+            self._swap_waiters.clear()
+        for t in list(self._threads):
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
+        _metrics.get_registry().remove_collect_hook(self._collect_gauges)
+        for eid in self.replicas:
+            self._g_outstanding.remove(replica=str(eid))
+            self._g_load.remove(replica=str(eid))
+        self._g_depth.remove()
+        self._g_handoff_depth.remove()
+        self._g_alive.remove()
+        self._g_capacity.remove()
+        for rep in self.replicas.values():
+            self._close_clients(rep)
+        # pending/outstanding/_requests stay AS-IS: a killed process
+        # fails no one — the obligations live in the journal now
+        if self._own_events and self.events is not None:
+            self.events.close()
+            self.events = None
+            self._own_events = False
+
+    # -- driver failover (serving/failover.py) -----------------------------
+    def adopt(self, state) -> dict:
+        """Apply a replayed :class:`~tensorflowonspark_tpu.serving.
+        journal.JournalState` to this freshly constructed, NOT yet
+        started scheduler — the driver half of the PR-12 heal
+        discipline (``serving.failover.resume_driver``).
+
+        Journal-dead/retired gangs never route again, hot-swap labels
+        survive, traffic splits restore, and every accepted-but-
+        uncommitted admission re-queues as a NEW request under the
+        requeue-once discipline.  The replay deliberately mints FRESH
+        rids: a surviving replica may still be streaming the OLD rid,
+        and those stale messages must miss ``outstanding`` and drop
+        (the replica-death requeue's exact discipline) instead of
+        interleaving with the replay — a ``requeue {rid, as}`` alias
+        record ties the new rid back to the original admission, so
+        zero-loss accounting and a SECOND failover both resolve commits
+        through the chain.  Corrective ``replica_model``/dead/retired
+        records are re-journaled because this constructor just appended
+        founding ``replica_added`` lines with its default labels; a
+        second replay must not resurrect those.
+
+        Returns ``{"requeued": {trace: ServeRequest}, "done": {trace:
+        n_tokens}}`` — what the frontend needs to re-attach
+        reconnecting clients (mid-stream resumes, and streams whose
+        commit landed just before the kill)."""
+        with self._lock:
+            # never reuse a journaled rid: a fresh admission sharing an
+            # old rid would collide with its alias/commit history
+            top = max((int(r) for r in (*state.admitted, *state.aliases,
+                                        *state.committed)), default=-1)
+            self._ids = itertools.count(top + 1)
+            for eid, ent in sorted(state.replicas.items()):
+                rep = self.replicas.get(int(eid))
+                if rep is None:
+                    logger.warning(
+                        "journal replica %s has no reservation in the "
+                        "resumed cluster; skipping", eid)
+                    continue
+                if "model" in ent:
+                    rep.model = ent.get("model")
+                    rep.version = (None if ent.get("version") is None
+                                   else str(ent["version"]))
+                if ent.get("retired"):
+                    rep.alive = False
+                    rep.retired = True
+                elif ent.get("alive") is False:
+                    rep.alive = False
+                if self.journal is not None:
+                    self.journal.record("replica_model", replica=int(eid),
+                                        model=rep.model,
+                                        version=rep.version)
+                    if rep.retired:
+                        self.journal.record("replica_retired",
+                                            replica=int(eid))
+                    elif not rep.alive:
+                        self.journal.record("replica_dead",
+                                            replica=int(eid))
+            for model_id, split in state.traffic.items():
+                if split:
+                    items = [(str(v), float(p)) for v, p in split.items()]
+                    self._traffic[str(model_id)] = {
+                        "shares": items,
+                        "credit": {v: 0.0 for v, _ in items}}
+            done: dict[str, int] = {}
+            for orig, rec in state.committed.items():
+                trace = (state.admitted.get(orig) or {}).get("trace")
+                if trace and rec.get("outcome") == "done":
+                    done[trace] = int(rec.get("tokens") or 0)
+            requeued: dict[str, ServeRequest] = {}
+            for orig, rec in sorted(state.unfinished.items()):
+                rid = next(self._ids)
+                prio = rec.get("priority")
+                req = ServeRequest(
+                    rid, rec.get("prompt") or [],
+                    int(rec.get("max_new_tokens") or 1),
+                    float(rec.get("temperature") or 0.0),
+                    float(rec.get("top_p") or 1.0),
+                    int(rec.get("seed") or 0),
+                    # the wall-clock budget died with the old driver;
+                    # the frontend's resume path re-bounds the wait
+                    deadline=None,
+                    trace=rec.get("trace"),
+                    tenant=str(rec.get("tenant") or "default"),
+                    priority=(prio if prio in PRIORITIES else "normal"),
+                    model=rec.get("model"))
+                self._requests[rid] = req
+                self._pending.append(req)
+                self.requeued += 1
+                self._m_requests.inc(outcome="requeued",
+                                     model=req.model or "default")
+                if self.journal is not None:
+                    self.journal.record("requeue",
+                                        **{"rid": int(orig), "as": rid})
+                self._emit("request_requeued", rid=rid, trace=req.trace,
+                           from_replica=None, delivered=0,
+                           orig_rid=int(orig), failover=True)
+                if req.trace:
+                    requeued[req.trace] = req
+            self._work.notify_all()
+            return {"requeued": requeued, "done": done}
+
     def drain(self, timeout: float = 60.0) -> bool:
         """Wait for the queue and every replica's in-flight set to empty;
         False if ``timeout`` elapses first."""
@@ -706,6 +859,19 @@ class ReplicaScheduler:
                 deadline=None if timeout is None
                 else time.monotonic() + float(timeout), trace=trace,
                 tenant=ten.name, priority=eff_priority, model=model)
+            # WRITE-AHEAD: the zero-loss contract attaches at admission,
+            # so the accept is durable BEFORE it is observable anywhere
+            # (queue entry, counters, the caller's return) — a driver
+            # killed one instruction later still owes this request, and
+            # journal replay re-queues it
+            if self.journal is not None:
+                self.journal.record(
+                    "admit", rid=rid,
+                    prompt=[int(t) for t in req.prompt.tolist()],
+                    max_new_tokens=req.max_new_tokens,
+                    temperature=req.temperature, top_p=req.top_p,
+                    seed=req.seed, tenant=ten.name,
+                    priority=eff_priority, model=model, trace=req.trace)
             self._requests[rid] = req
             self._pending.append(req)
             self.accepted += 1
@@ -747,6 +913,9 @@ class ReplicaScheduler:
                                      model=req.model or "default")
             self._emit("request_failed", rid=req.rid, trace=req.trace,
                        reason=reason)
+            if self.journal is not None:
+                self.journal.record("commit", rid=req.rid, outcome=reason,
+                                    tokens=len(req.tokens))
 
     # -- failure intake ----------------------------------------------------
     def on_cluster_failure(self, failure) -> None:
@@ -962,6 +1131,9 @@ class ReplicaScheduler:
                 "shares": items, "credit": {v: 0.0 for v, _ in items}}
             self._emit("traffic_split", model=model_id,
                        split={v: p for v, p in items})
+            if self.journal is not None:
+                self.journal.record("traffic_split", model=model_id,
+                                    split={v: p for v, p in items})
             self._work.notify_all()
 
     def clear_traffic_split(self, model_id: str) -> None:
@@ -969,6 +1141,9 @@ class ReplicaScheduler:
             if self._traffic.pop(str(model_id), None) is not None:
                 self._emit("traffic_split", model=str(model_id),
                            split=None)
+                if self.journal is not None:
+                    self.journal.record("traffic_split",
+                                        model=str(model_id), split=None)
                 self._work.notify_all()
 
     def resume_replica(self, eid: int) -> bool:
@@ -1130,6 +1305,10 @@ class ReplicaScheduler:
                        role=role, model=rep.model, version=rep.version,
                        alive=sum(1 for r in self.replicas.values()
                                  if r.alive))
+            if self.journal is not None:
+                self.journal.record("replica_added", replica=eid,
+                                    members=list(members), role=role,
+                                    model=rep.model, version=rep.version)
             self._work.notify_all()
         t = threading.Thread(target=self._recv_loop, args=(rep,),
                              name=f"serve-recv-{eid}", daemon=True)
@@ -1186,6 +1365,8 @@ class ReplicaScheduler:
                        requeued=len(stranded),
                        alive=sum(1 for r in self.replicas.values()
                                  if r.alive))
+            if self.journal is not None:
+                self.journal.record("replica_retired", replica=eid)
             for req in stranded:
                 if req.finished:
                     continue
@@ -1295,6 +1476,13 @@ class ReplicaScheduler:
         events ride here so one log tells the whole membership story)."""
         with self._lock:
             self._emit(kind, **fields)
+
+    def journal_record(self, kind: str, **fields) -> None:
+        """None-safe write-ahead journal append — tier components whose
+        state must survive a driver failover (the registry, the rollout
+        controller's step intents) record through here."""
+        if self.journal is not None:
+            self.journal.record(kind, **fields)
 
     # -- internals ---------------------------------------------------------
     def _default_client(self, info: dict):
@@ -1506,6 +1694,9 @@ class ReplicaScheduler:
                 req, rep, handoff = got
                 req.replica = rep.eid
                 rep.outstanding[req.rid] = req
+                if self.journal is not None:
+                    self.journal.record("route", rid=req.rid,
+                                        replica=rep.eid)
                 if handoff:
                     # the adopt hop CONTINUES the same attempt — only gen
                     # dispatches charge the requeue-once failover budget,
@@ -1543,6 +1734,9 @@ class ReplicaScheduler:
         self._requests.pop(req.rid, None)
         self._emit("request_failed", rid=req.rid, trace=req.trace,
                    reason="deadline")
+        if self.journal is not None:
+            self.journal.record("commit", rid=req.rid, outcome="expired",
+                                tokens=len(req.tokens))
         req.events.put(("err", "deadline",
                         f"deadline exceeded after "
                         f"{time.monotonic() - req.created:.2f}s in queue"))
@@ -1563,6 +1757,9 @@ class ReplicaScheduler:
         self._requests.pop(req.rid, None)
         self._emit("request_failed", rid=req.rid, trace=req.trace,
                    reason=reason)
+        if self.journal is not None:
+            self.journal.record("commit", rid=req.rid, outcome="failed",
+                                reason=reason, tokens=len(req.tokens))
         req.events.put(("err", reason, msg))
 
     # -- replica responses -------------------------------------------------
@@ -1630,6 +1827,10 @@ class ReplicaScheduler:
                     rec["event"].set()
                 self._emit("model_swapped", replica=rep.eid, model=model,
                            version=version)
+                if self.journal is not None:
+                    self.journal.record("replica_model", replica=rep.eid,
+                                        model=rep.model,
+                                        version=rep.version)
                 self._work.notify_all()
                 return
             if event == "model_swap_failed":
@@ -1728,6 +1929,9 @@ class ReplicaScheduler:
                            replica=rep.eid, tokens=len(req.tokens),
                            e2e_secs=round(e2e, 6))
                 req.events.put(("done", len(req.tokens)))
+                if self.journal is not None:
+                    self.journal.record("commit", rid=rid, outcome="done",
+                                        tokens=len(req.tokens))
                 self._work.notify_all()
             elif event == "error":
                 rep.outstanding.pop(rid, None)
@@ -1775,6 +1979,8 @@ class ReplicaScheduler:
         self._emit("replica_dead", replica=eid, reason=reason,
                    shards=list((eid, *rep.members)),
                    inflight=len(rep.outstanding))
+        if self.journal is not None:
+            self.journal.record("replica_dead", replica=eid)
         stranded = list(rep.outstanding.values())
         rep.outstanding.clear()
         self._close_clients(rep)

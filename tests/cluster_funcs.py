@@ -589,6 +589,18 @@ def batch_predict_scale(model, records, trial_params):
     return [(row * scale).tobytes() for row in arr]
 
 
+def batch_predict_scale_paced(model, records, trial_params):
+    """``batch_predict_scale`` with a small per-shard delay: paces the
+    queue so a mid-job chaos kill reliably lands while work is still
+    outstanding (a free-running scorer lets one worker drain everything
+    before the victim's trigger step).  Output is byte-identical to the
+    unpaced scorer."""
+    import time
+
+    time.sleep(0.1)
+    return batch_predict_scale(model, records, trial_params)
+
+
 def batch_predict_len(model, records, trial_params):
     """Batch-plane scorer over tfrecord shards: echo each raw record's
     length (records arrive as bytes)."""

@@ -273,9 +273,14 @@ def test_batch_job_reassigns_dead_worker_without_restart(tmp_path):
     outstanding shards are requeued (classified by the serving-mode
     monitor or the collector's dead socket) and the job completes in ONE
     attempt; the corpse's exit is tolerated at shutdown."""
-    chunks = _chunks(8)
+    # paced scorer + enough shards that the queue can't drain before
+    # node 1 reaches its trigger step: free-running over 8 tiny chunks,
+    # a head start for node 0 occasionally finished the whole job
+    # before node 1 got anything outstanding to heal
+    # (handled_workers == [] flake)
+    chunks = _chunks(24)
     job = BatchJob(ShardManifest.from_arrays(chunks), str(tmp_path / "out"),
-                   funcs.batch_predict_scale, batch_size=1, prefetch=1)
+                   funcs.batch_predict_scale_paced, batch_size=1, prefetch=1)
     summary = job.run(
         num_workers=2, max_restarts=2, reassign_dead=True,
         backoff_base=0.2, working_dir=str(tmp_path / "wd"),

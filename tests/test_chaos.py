@@ -197,6 +197,79 @@ def test_action_fires_once_per_job(tmp_path):
     assert calls == [None]
 
 
+# ------------------------------------------------------- driver scope
+
+def test_parse_plan_driver_scope():
+    (a,) = parse_plan("kill driver after_secs=0.5")
+    assert a.verb == "kill" and a.node == chaos.DRIVER_NODE
+    assert a.after_secs == 0.5
+    assert a.describe() == "kill driver after_secs=0.5"
+    # a mixed plan: worker agents filter the driver action out for free
+    plan = parse_plan("kill node=1 at_step=3; kill driver after_secs=2")
+    assert [a.node for a in plan] == [1, chaos.DRIVER_NODE]
+
+
+@pytest.mark.parametrize("bad,tokens", [
+    # only kill supports the driver scope
+    ("stall driver secs=1 after_secs=1", ["'kill'", "driver"]),
+    # the driver has no worker steps: at_step= is meaningless
+    ("kill driver at_step=3", ["at_step", "after_secs="]),
+    # driver actions need their wall-clock trigger
+    ("kill driver", ["after_secs="]),
+    # 'driver' and node= are mutually exclusive scopes
+    ("kill node=2 driver after_secs=1", ["driver", "node="]),
+])
+def test_parse_plan_driver_rejections_are_single_line(bad, tokens):
+    with pytest.raises(ChaosPlanError) as ei:
+        parse_plan(bad)
+    msg = str(ei.value)
+    assert "\n" not in msg, f"multi-line chaos error: {msg!r}"
+    for token in tokens:
+        assert token in msg, f"error {msg!r} does not name {token!r}"
+
+
+def test_driver_chaos_fires_once_with_sentinel(tmp_path):
+    """DriverChaos fires its kill exactly once per job — the
+    ``chaos.driver.<index>`` sentinel disarms a re-armed plan (a
+    RESUMED driver re-runs the same env) and records the fired-at
+    wall clock that failover-latency accounting reads back."""
+    fired = []
+    drv = chaos.DriverChaos(parse_plan("kill driver after_secs=0.05"),
+                            on_fire=fired.append,
+                            state_dir=str(tmp_path))
+    drv.start()
+    deadline = time.monotonic() + 5.0
+    while not fired and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(fired) == 1 and fired[0].node == chaos.DRIVER_NODE
+    t0 = chaos.fired_at(str(tmp_path), "driver")
+    assert t0 is not None and abs(time.time() - t0) < 60
+    # a resumed driver arms the SAME plan again: sentinel disarms it
+    drv2 = chaos.DriverChaos(parse_plan("kill driver after_secs=0.01"),
+                             on_fire=fired.append,
+                             state_dir=str(tmp_path))
+    drv2.start()
+    time.sleep(0.3)
+    drv2.stop()
+    assert len(fired) == 1
+    assert chaos.fired_at(str(tmp_path), "driver") == t0
+
+
+def test_driver_from_env_filters_driver_actions(monkeypatch, tmp_path):
+    monkeypatch.setenv(chaos.PLAN_ENV, "kill node=0 after_secs=9")
+    assert chaos.driver_from_env(lambda a: None,
+                                 state_dir=str(tmp_path)) is None
+    monkeypatch.setenv(chaos.PLAN_ENV,
+                       "kill node=0 after_secs=9; kill driver after_secs=5")
+    drv = chaos.driver_from_env(lambda a: None, state_dir=str(tmp_path))
+    assert drv is not None and len(drv.actions) == 1
+    assert drv.actions[0].node == chaos.DRIVER_NODE
+    drv.stop()
+    monkeypatch.delenv(chaos.PLAN_ENV)
+    assert chaos.driver_from_env(lambda a: None,
+                                 state_dir=str(tmp_path)) is None
+
+
 # ------------------------------------------------- kill/restore scenarios
 
 pytestmark_integration = pytest.mark.integration

@@ -1,0 +1,439 @@
+"""Control-plane durability + driver failover (PR 18).
+
+Three layers:
+
+- **journal units** — ``ControlPlaneJournal``/``JournalState``: fsync'd
+  roundtrip, idempotent replay under duplicated lines, torn tails
+  (payload-cut AND mid-UTF-8 byte cut), unknown kinds one-warning,
+  requeue alias chains, rollout ``remaining_steps`` arithmetic.
+- **adopt units** — ``ReplicaScheduler.adopt`` (fresh rids, requeue
+  aliases, dead replicas, restored splits) and ``ModelRegistry``
+  journal binding/snapshot/adopt, against the fake-replica world.
+- **ride-through units** — ``ServeFrontend`` ``resume`` op +
+  ``ServeClient(failover_wait=)``: a mid-stream frontend restart
+  resumes at the exact token cursor; a frontend that never returns
+  raises typed ``FrontendUnavailable``.
+
+The full driver-kill heal (real 2-replica cluster, oracle-exact
+streams, zero loss) is the slow-marked integration test at the bottom
+— excluded from tier-1 like the other full-cluster chaos scenarios;
+the ``scripts/bench_serving.py --failover`` gate (ci.sh
+``--bench-smoke``) keeps it enforced in CI.
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.serving import (FrontendUnavailable,
+                                           ModelRegistry, ServeClient,
+                                           ServeFrontend)
+from tensorflowonspark_tpu.serving.journal import (ControlPlaneJournal,
+                                                   JournalState)
+
+from tests.test_serving_cluster import (_FakeWorld, _fake_tokens,
+                                        _scheduler)
+
+# --------------------------------------------------------- journal units
+
+
+def _admit(rid, trace=None, n=4, **kw):
+    rec = dict(kind="admit", t=1.0, rid=rid, prompt=[1, 2, 3],
+               max_new_tokens=n, temperature=0.0, top_p=1.0, seed=0,
+               tenant="default", priority="normal", model=None,
+               trace=trace)
+    rec.update(kw)
+    return rec
+
+
+def test_journal_roundtrip(tmp_path):
+    path = str(tmp_path / "cp.jsonl")
+    j = ControlPlaneJournal(path)
+    j.record("admit", rid=0, prompt=[1, 2], max_new_tokens=3, trace="t0")
+    j.record("route", rid=0, replica=1)
+    j.record("commit", rid=0, outcome="done", tokens=3)
+    j.record("replica_added", replica=1, role=None, model="m", version="v1")
+    j.close()
+    st = ControlPlaneJournal.replay(path)
+    assert list(st.admitted) == [0] and st.routed[0] == 1
+    assert st.committed[0] == {"outcome": "done", "tokens": 3}
+    assert not st.unfinished
+    assert st.replicas[1]["alive"] and st.replicas[1]["model"] == "m"
+
+
+def test_journal_record_survives_close_and_bad_payload(tmp_path, caplog):
+    """``record`` never raises: closed handle → dropped; a payload json
+    can't serialize → dropped with one warning (the serving path must
+    not die of its own durability)."""
+    j = ControlPlaneJournal(str(tmp_path / "cp.jsonl"))
+    with caplog.at_level(logging.WARNING):
+        j.record("admit", rid=0, prompt=object())     # not serializable
+        j.record("admit", rid=1, prompt=object())
+    assert sum("not JSON-serializable" in r.message
+               for r in caplog.records) == 1
+    j.close()
+    j.record("admit", rid=2, prompt=[1])              # no raise after close
+    assert ControlPlaneJournal.replay(j.path).admitted == {}
+
+
+def test_journal_replay_idempotent_under_duplicates():
+    recs = [
+        _admit(0, trace="a"), _admit(1, trace="b"),
+        dict(kind="route", rid=0, replica=2),
+        dict(kind="requeue", rid=1, **{"as": 7}),
+        dict(kind="commit", rid=7, outcome="done", tokens=4),
+        dict(kind="replica_added", replica=2),
+        dict(kind="replica_dead", replica=2),
+        dict(kind="traffic_split", model="m", split={"v2": 25.0}),
+        dict(kind="rollout_started", model="m", version="v2",
+             incumbent="v1", steps=[5, 25, 100]),
+        dict(kind="rollout_step", model="m", version="v2", percent=5),
+        dict(kind="rollout_step_done", model="m", version="v2", percent=5),
+    ]
+    once = JournalState.from_records(recs)
+    twice = JournalState.from_records([r for r in recs for _ in (0, 1)])
+    for st in (once, twice):
+        assert set(st.unfinished) == {0}
+        assert st.committed[1] == {"outcome": "done", "tokens": 4}
+        assert st.replicas[2]["alive"] is False
+        assert st.rollouts["m"]["done_steps"] == [5]
+    assert twice.traffic == once.traffic == {"m": {"v2": 25.0}}
+
+
+def test_journal_requeue_alias_chain_resolves_to_root():
+    """A commit under the SECOND failover's rid still discharges the
+    original admission — route/commit resolve through the alias chain."""
+    st = JournalState.from_records([
+        _admit(0, trace="a"),
+        dict(kind="requeue", rid=0, **{"as": 5}),      # failover 1
+        dict(kind="requeue", rid=5, **{"as": 9}),      # failover 2
+        dict(kind="route", rid=9, replica=3),
+        dict(kind="commit", rid=9, outcome="done", tokens=4),
+    ])
+    assert st._root(9) == 0 and st.routed == {0: 3}
+    assert set(st.committed) == {0} and not st.unfinished
+
+
+def test_journal_torn_tail_payload_cut(tmp_path):
+    """A crash mid-``write`` leaves a final line cut inside the JSON
+    payload; replay skips exactly that line."""
+    path = str(tmp_path / "cp.jsonl")
+    j = ControlPlaneJournal(path)
+    j.record("admit", rid=0, prompt=[1], max_new_tokens=2)
+    j.record("admit", rid=1, prompt=[2], max_new_tokens=2)
+    j.close()
+    with open(path, "ab") as f:       # torn: payload cut, no newline
+        f.write(b'{"t": 3.0, "kind": "commit", "rid": 1, "outc')
+    st = ControlPlaneJournal.replay(path)
+    assert set(st.admitted) == {0, 1} and not st.committed
+
+
+def test_journal_torn_tail_mid_utf8(tmp_path, caplog):
+    """The torn byte can land INSIDE a multi-byte UTF-8 sequence — the
+    decode error must skip the line, not take the replay down."""
+    path = str(tmp_path / "cp.jsonl")
+    j = ControlPlaneJournal(path)
+    j.record("admit", rid=0, prompt=[1], max_new_tokens=2)
+    j.close()
+    whole = json.dumps({"t": 3.0, "kind": "commit", "rid": 0,
+                        "outcome": "café"},
+                       ensure_ascii=False).encode("utf-8")
+    assert b"\xc3" in whole
+    with open(path, "ab") as f:       # cut between the é's two bytes
+        f.write(whole[:whole.index(b"\xc3") + 1])
+    with caplog.at_level(logging.WARNING):
+        st = ControlPlaneJournal.replay(path)
+    assert set(st.admitted) == {0} and not st.committed
+    assert any("torn/corrupt" in r.message for r in caplog.records)
+
+
+def test_journal_unknown_kinds_skip_with_one_warning(tmp_path, caplog):
+    path = str(tmp_path / "cp.jsonl")
+    with open(path, "w") as f:
+        for rec in (_admit(0), {"t": 2.0, "kind": "quantum_entangle"},
+                    {"t": 2.1, "kind": "quantum_entangle"},
+                    dict(kind="commit", t=2.2, rid=0, outcome="done",
+                         tokens=1)):
+            f.write(json.dumps(rec) + "\n")
+    with caplog.at_level(logging.WARNING):
+        st = ControlPlaneJournal.replay(path)
+    assert st.unknown_kinds == 2
+    assert sum("unknown record kind" in r.message
+               for r in caplog.records) == 1
+    assert set(st.committed) == {0}     # folding continued past them
+
+
+def test_remaining_steps_resume_arithmetic():
+    base = [dict(kind="rollout_started", model="m", version="v2",
+                 incumbent="v1", steps=[5, 25, 100]),
+            dict(kind="rollout_step", model="m", version="v2", percent=5),
+            dict(kind="rollout_step_done", model="m", version="v2",
+                 percent=5)]
+    # mid-canary: 5 gated; 25 intended but its gate never committed →
+    # 25 re-executes (idempotent split), then 100
+    st = JournalState.from_records(
+        base + [dict(kind="rollout_step", model="m", version="v2",
+                     percent=25)])
+    assert st.remaining_steps("m") == (25, 100)
+    assert st.open_rollouts()["m"]["intended"] == 25
+    # every step gated but the finishing promotion never committed →
+    # the bare (100,) finisher
+    st = JournalState.from_records(
+        base + [dict(kind="rollout_step_done", model="m", version="v2",
+                     percent=p) for p in (25, 100)])
+    assert st.remaining_steps("m") == (100,)
+    # terminal rollouts are not open; unknown models owe nothing
+    st = JournalState.from_records(
+        base + [dict(kind="rollout_done", model="m", version="v2",
+                     outcome="promoted")])
+    assert st.open_rollouts() == {} and st.remaining_steps("x") == ()
+
+
+# ----------------------------------------------------------- adopt units
+
+
+def test_scheduler_adopt_requeues_fresh_rids_and_completes(tmp_path):
+    """adopt(): fresh rids past the journal's max, ``requeue`` aliases
+    journaled, dead replicas never route, committed-done traces surface
+    for the frontend, and the requeued work then actually completes."""
+    path = str(tmp_path / "cp.jsonl")
+    state = JournalState.from_records([
+        _admit(0, trace="tr0", n=3, prompt=[2, 3]),
+        _admit(1, trace="tr1", n=3, prompt=[4]),
+        _admit(2, trace="tr2", n=3, prompt=[5, 6]),
+        dict(kind="route", rid=0, replica=0),
+        dict(kind="commit", rid=1, outcome="done", tokens=3),
+        dict(kind="replica_added", replica=0),
+        dict(kind="replica_added", replica=1),
+        dict(kind="replica_dead", replica=1),
+    ])
+    world = _FakeWorld(2)
+    s = _scheduler(world, journal=ControlPlaneJournal(path))
+    try:
+        adopted = s.adopt(state)
+        # committed stream resurfaces by trace; unfinished requeue
+        assert adopted["done"] == {"tr1": 3}
+        assert set(adopted["requeued"]) == {"tr0", "tr2"}
+        # fresh rids: nothing the journal ever named is reused
+        assert all(r.rid > 2 for r in adopted["requeued"].values())
+        assert s.requeued == 2 and not s.replicas[1].alive
+        # the aliases hit the journal, so a SECOND replay folds them
+        st2 = ControlPlaneJournal.replay(path)
+        assert {st2._root(r.rid) for r in adopted["requeued"].values()} \
+            == {0, 2}
+        s.start()
+        for trace, want_prompt in (("tr0", [2, 3]), ("tr2", [5, 6])):
+            req = adopted["requeued"][trace]
+            toks = []
+            while True:
+                ev = req.events.get(timeout=10)
+                if ev[0] == "tok":
+                    toks.extend(ev[1])
+                else:
+                    assert ev[0] == "done", ev
+                    break
+            assert toks == _fake_tokens(want_prompt, 3)
+    finally:
+        s.stop()
+
+
+def test_registry_journal_snapshot_and_adopt(tmp_path, caplog):
+    """bind_journal snapshots the pre-bind catalog (registrations made
+    before the tier booted must replay too); adopt restores eval
+    verdicts + states onto re-registered builders and warns-and-skips
+    versions nobody re-registered."""
+    path = str(tmp_path / "cp.jsonl")
+    reg = ModelRegistry()
+    reg.register("m", "v1", builder=lambda a: None)
+    reg.register("m", "v2", builder=lambda a: None)
+    v2 = reg.version("m", "v2")
+    v2.eval_passed, v2.eval_metrics = True, {"exact": 1.0}
+    v2.state = "canary"
+    j = ControlPlaneJournal(path)
+    reg.bind_journal(j)               # ← snapshot happens here
+    reg.mark("m", "v1", "serving")    # post-bind mutations journal live
+    j.close()
+    st = ControlPlaneJournal.replay(path)
+    assert st.registry[("m", "v2")]["state"] == "canary"
+    assert st.registry[("m", "v2")]["eval_passed"] is True
+    assert st.registry[("m", "v1")]["state"] == "serving"
+
+    reg2 = ModelRegistry()
+    reg2.register("m", "v2", builder=lambda a: None)  # v1 NOT re-registered
+    with caplog.at_level(logging.WARNING):
+        reg2.adopt(st)
+    got = reg2.version("m", "v2")
+    assert got.state == "canary" and got.eval_passed is True
+    assert got.eval_metrics == {"exact": 1.0}
+    assert any("not re-registered" in r.message for r in caplog.records)
+
+
+# ------------------------------------------------- ride-through units
+
+
+def test_client_stream_rides_through_frontend_restart():
+    """The PR-18 client contract at unit scale: kill the frontend (only)
+    mid-stream, stand a new one on the SAME port with the replayed
+    request wired into ``resumed``, and the concatenated yield is
+    byte-identical — the ``received`` cursor dedups the replay."""
+    world = _FakeWorld(1, token_delay=0.1)
+    s = _scheduler(world, slots_per_replica=2).start()
+    fe1 = ServeFrontend(s, authkey=b"s" * 16)
+    addr = fe1.start()
+    prompt, n, trace = np.asarray([3, 4], np.int32), 8, "tr-restart"
+    got, errs = [], []
+    resumed_while_streaming = threading.Event()
+
+    def consume():
+        try:
+            with ServeClient(addr, b"s" * 16, failover_wait=15.0) as c:
+                for delta in c.generate_stream(prompt, n, trace=trace,
+                                               timeout=30.0):
+                    got.extend(delta)
+                    resumed_while_streaming.set()
+        except Exception as e:       # surfaces in the main thread
+            errs.append(e)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    while not got and t.is_alive():
+        time.sleep(0.01)             # let a first delta land
+    fe1.stop()                       # ← "driver dies" (scheduler survives)
+    seen_at_kill = len(got)
+    # the failover replay: a FRESH request for the same admission (new
+    # rid — exactly what scheduler.adopt mints), wired pre-start
+    req2 = s.submit(prompt, n, trace=trace)
+    fe2 = ServeFrontend(s, authkey=b"s" * 16, port=addr[1])
+    fe2.resumed = {trace: req2}
+    try:
+        assert fe2.start()[1] == addr[1]   # same port, lingering conns ok
+        t.join(30)
+        assert not t.is_alive() and not errs, errs
+        assert got == _fake_tokens([3, 4], n)   # no gap, no repeat
+        assert len(got) > seen_at_kill, "stream never resumed"
+    finally:
+        fe2.stop()
+        s.stop()
+
+
+def test_client_resume_of_committed_stream_returns_done():
+    """A stream whose commit landed JUST before the kill: the resume
+    finds no live request but ``resumed_done`` knows the trace —
+    clients that already hold every token get a clean DONE, clients
+    missing tokens get the typed unknown_request error."""
+    world = _FakeWorld(1)
+    s = _scheduler(world).start()
+    fe = ServeFrontend(s, authkey=b"s" * 16)
+    fe.resumed_done = {"tr-done": 5}
+    addr = fe.start()
+    try:
+        with ServeClient(addr, b"s" * 16, failover_wait=5.0) as c:
+            c.send(c._sock, {"op": "resume", "trace": "tr-done",
+                             "received": 5, "stream": True, "timeout": 5})
+            assert c.receive(c._sock) == ("DONE", 5)
+        with ServeClient(addr, b"s" * 16, failover_wait=5.0) as c:
+            c.send(c._sock, {"op": "resume", "trace": "tr-done",
+                             "received": 3, "stream": True, "timeout": 5})
+            frame = c.receive(c._sock)
+            assert frame[0] == "ERR" and frame[1] == "unknown_request"
+        with ServeClient(addr, b"s" * 16, failover_wait=5.0) as c:
+            c.send(c._sock, {"op": "resume", "trace": "tr-nobody",
+                             "received": 0, "stream": True, "timeout": 5})
+            frame = c.receive(c._sock)
+            assert frame[0] == "ERR" and frame[1] == "unknown_request"
+    finally:
+        fe.stop()
+        s.stop()
+
+
+def test_client_frontend_unavailable_is_typed():
+    """No standby ever rebinds: the ride-through gives up after
+    ``failover_wait`` with the typed error, quickly."""
+    world = _FakeWorld(1, token_delay=0.1)
+    s = _scheduler(world).start()
+    fe = ServeFrontend(s, authkey=b"s" * 16)
+    addr = fe.start()
+    c = ServeClient(addr, b"s" * 16, failover_wait=1.0)
+    try:
+        stream = c.generate_stream(np.asarray([2], np.int32), 30,
+                                   timeout=30.0)
+        next(stream)
+        fe.stop()                    # nobody comes back
+        t0 = time.monotonic()
+        with pytest.raises(FrontendUnavailable):
+            for _ in stream:
+                pass
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        c.close()
+        s.stop()
+
+
+# ------------------------------------------------------ integration
+
+
+@pytest.mark.slow
+@pytest.mark.integration
+def test_driver_kill_heals_zero_loss(tmp_path, worker_env):
+    """THE tentpole gate at test scale: boot a 2-replica tier, hard-kill
+    the driver control plane mid-stream under concurrent clients,
+    resume from the journal on the same port, and every accepted
+    request completes oracle-exact with zero client errors; the drained
+    journal shows no unfinished obligations."""
+    from tensorflowonspark_tpu.serving import resume_driver
+    from tests.test_serving_cluster import _oracle, _run_serving
+
+    serving = _run_serving(tmp_path, worker_env)
+    rng = np.random.default_rng(7)
+    reqs = [(rng.integers(0, 83, (int(rng.integers(3, 9)),))
+             .astype(np.int32), int(rng.integers(6, 12)))
+            for _ in range(4)]
+    results, errors = {}, []
+    first_token = threading.Event()
+
+    def run_client(i):
+        p, n = reqs[i]
+        try:
+            with serving.client(failover_wait=90.0) as c:
+                toks = []
+                for delta in c.generate_stream(p, n):
+                    toks.extend(delta)
+                    first_token.set()
+                results[i] = toks
+        except Exception as e:
+            errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=run_client, args=(i,))
+               for i in range(len(reqs))]
+    try:
+        for t in threads:
+            t.start()
+        assert first_token.wait(90), "no stream ever started"
+        time.sleep(0.2)              # get streams genuinely mid-flight
+        crashed_at = time.time()
+        serving.crash()              # ← the driver "dies"
+        serving2 = resume_driver(serving.cluster, address=serving.address,
+                                 max_batch=2, crashed_at=crashed_at)
+        try:
+            for t in threads:
+                t.join(180)
+            assert not errors, errors
+            assert len(results) == len(reqs)
+            for i, (p, n) in enumerate(reqs):
+                assert results[i] == _oracle(p, n), f"request {i} diverged"
+            st = ControlPlaneJournal.replay(
+                os.path.join(str(tmp_path), "control_plane.jsonl"))
+            assert not st.unfinished, st.unfinished
+            assert st.resumes == 1
+            assert serving2.scheduler.requeued >= 1
+        finally:
+            serving2.shutdown()
+    finally:
+        for t in threads:
+            t.join(5)
+        serving.cluster._abort()

@@ -207,6 +207,31 @@ def test_event_log_read_skips_truncated_final_line(tmp_path, caplog):
     assert [r["kind"] for r in recs] == ["monitor_started", "crash", "late"]
 
 
+def test_event_log_read_survives_line_cut_mid_utf8_sequence(tmp_path,
+                                                            caplog):
+    """The torn byte can fall INSIDE a multi-byte UTF-8 sequence — a
+    text-mode read would raise ``UnicodeDecodeError`` before any line
+    splitting happens and lose the whole file; the binary-read per-line
+    decode skips exactly the cut line."""
+    import json
+    import logging
+
+    path = str(tmp_path / "events.jsonl")
+    log = observability.EventLog(path)
+    log.emit("monitor_started", workers=2)
+    log.close()
+    whole = json.dumps({"t": 9.0, "kind": "crash", "detail": "nœud"},
+                       ensure_ascii=False).encode("utf-8")
+    cut = whole[:whole.index(b"\xc5") + 1]     # half of the œ
+    with open(path, "ab") as f:
+        f.write(cut)
+    with caplog.at_level(logging.WARNING,
+                         logger="tensorflowonspark_tpu.observability"):
+        recs = observability.EventLog.read(path)
+    assert [r["kind"] for r in recs] == ["monitor_started"]
+    assert any("malformed" in r.message for r in caplog.records)
+
+
 # -- latency histogram -----------------------------------------------------
 
 def test_latency_histogram_percentiles():

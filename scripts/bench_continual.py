@@ -1,0 +1,621 @@
+"""Continual-learning loop benchmark — self-gating artifact.
+
+Runs the PR's standing train→eval→rollout pipeline END TO END on real
+clusters (a training cluster publishing candidates through the queue
+plane, the batch plane scoring them offline, a live serving tier
+canarying the survivors) and pins the acceptance claims as hard gates;
+the script FAILS ITSELF on any miss:
+
+- ``continual_loop``: one ``ContinualPipeline.run`` supervising a real
+  trainer that emits three adapter candidates — a DATA-QUALITY
+  regression (scrambled delta), a LATENCY regression (good weights +
+  an injected per-step delay the offline eval cannot see), and a good
+  candidate.  Gates: the quality regression is rejected at the OFFLINE
+  gate and never canaried (zero rollout records, zero served outputs
+  matching its oracle); the latency regression passes offline but is
+  auto-ROLLED-BACK by the live windowed gate; the good candidate
+  promotes and takes the whole fleet; every served output across the
+  loop is oracle-exact for a vetted version (the incumbent or the good
+  candidate — nothing else ever answered); zero requests lost.
+- ``driver_kill``: a ``TFOS_CHAOS="kill driver after_secs=F"`` plan
+  hard-crashes the control plane MID-ROLLOUT of a gated candidate.
+  ``resume_driver`` replays the journal, a rebuilt pipeline's
+  ``resume()`` re-hydrates the candidate from the payload store and
+  CONTINUES the rollout from its journaled stage (canary re-armed in
+  ``mode="resumed"``, not from scratch).  Gates: the candidate is
+  journaled as emitted exactly once and CONCLUDED exactly once (one
+  ``rollout_done``/``continual_done`` — no double emission / double
+  promotion; ``rollout_started`` appears twice by design: the
+  original plan plus the resumed controller's narrowed plan), the
+  resume promotes, riding pingers lose zero requests and stay
+  oracle-exact, exactly one recorded resume, and the drained journal
+  owes nothing.
+
+Writes ``bench_artifacts/continual.json`` (``--smoke``: a two-candidate
+reject+promote loop only, writes ``continual_smoke.json`` so the
+committed full artifact is never clobbered; wired into
+``scripts/ci.sh --bench-smoke``).
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+from bench_rollout import _make_reqs, _oracle, version_delta  # noqa: E402
+from bench_serving import VOCAB, bench_model_builder  # noqa: E402
+
+#: eval-manifest shape: fixed-length prompt rows so one greedy_generate
+#: call scores a whole shard
+EVAL_LEN, EVAL_NEW = 6, 8
+
+#: delta seeds: the GOOD candidate weights vs the data-quality
+#: regression (a different random bias shift whose outputs diverge from
+#: the held-out references)
+GOOD_SEED, BAD_SEED = 3, 99
+
+
+def _decode_rows(params_delta, rows):
+    """Greedy-decode fixed-length prompt rows under base+delta — the
+    single source of truth for eval references, the eval predict_fn and
+    the bench's oracle ledger (byte-identical encodings)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import greedy_generate
+    from tensorflowonspark_tpu.serving import apply_adapter
+
+    cfg, params = bench_model_builder({})
+    if params_delta is not None:
+        params = apply_adapter(params, params_delta)
+    arr = jnp.asarray(np.asarray(rows, np.int32))
+    out = np.asarray(greedy_generate(cfg, params, arr, EVAL_NEW))
+    return [json.dumps([int(t) for t in r[arr.shape[1]:]]).encode()
+            for r in out]
+
+
+def eval_predict(model, records, trial_params):
+    """Batch-plane predict_fn for the offline gate: apply the
+    candidate's published delta over the pristine base and decode the
+    held-out prompts (top level so spawn pickles it by reference)."""
+    cand = trial_params["continual_candidate"]
+    return _decode_rows(dict(cand["payload"]), records)
+
+
+def trainer_publish_candidates(args, ctx):
+    """Training-side map_fun: 'train' (apply a known delta per step) and
+    publish each step's candidate as an adapter DELTA over the pristine
+    base through the worker's queue plane (top level for spawn)."""
+    from tensorflowonspark_tpu.continual import CheckpointPublisher
+    from tensorflowonspark_tpu.serving import apply_adapter
+
+    _, base = bench_model_builder({})
+    for spec in args["candidates"]:
+        pub = CheckpointPublisher(ctx, args["model"], base=base,
+                                  serve_args=spec.get("serve_args"))
+        params = apply_adapter(base, version_delta(spec["delta_seed"]))
+        pub.publish(spec["step"], params)
+
+
+def _eval_spec(tmp_dir, refs, shards, rows_per_shard, seed):
+    """A held-out eval manifest + the OfflineEval gate scoring against
+    precomputed good-candidate references."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.batch import ShardManifest
+    from tensorflowonspark_tpu.continual import OfflineEval
+
+    rng = np.random.default_rng(seed + 1000)
+    chunks = [rng.integers(0, VOCAB, (rows_per_shard, EVAL_LEN))
+              .astype(np.int32) for _ in range(shards)]
+    manifest = ShardManifest.from_arrays(chunks)
+    rows = [r for c in chunks for r in c]
+    refs.extend(_decode_rows(version_delta(GOOD_SEED), rows))
+
+    def scorer(results):
+        n_ok = sum(1 for got, want in zip(results, refs) if got == want)
+        quality = n_ok / max(1, len(refs))
+        return ({"quality": round(quality, 4), "n": len(refs)},
+                quality >= 0.99)
+
+    return OfflineEval(
+        manifest=manifest,
+        output_dir=os.path.join(tmp_dir, "offline_eval"),
+        predict_fn=eval_predict, scorer=scorer, num_workers=1,
+        job_kwargs={"batch_size": max(4, rows_per_shard)},
+        run_kwargs={"worker_env": {"JAX_PLATFORMS": "cpu"},
+                    "reservation_timeout": 120, "shutdown_timeout": 120,
+                    "max_restarts": 0})
+
+
+def _registry_v1():
+    """The incumbent: v1 is the bare base, eval-passed, with the delay
+    knob EXPLICITLY zero so a rollback resets a regressing canary's
+    injected delay (swap overlays replace same-name keys only)."""
+    from tensorflowonspark_tpu.serving import ModelRegistry
+
+    reg = ModelRegistry()
+    reg.register("m", "v1", bench_model_builder,
+                 serve_args={"serve_step_delay": 0.0})
+    reg.record_eval("m", "v1", {"offline": "incumbent"}, passed=True)
+    return reg
+
+
+def _start_pingers(serving, probes, n_threads, stop, ledger, errors, lock,
+                   failover_wait=None):
+    """Closed-loop riders for the rollouts' canary windows: record every
+    (probe index, tokens) pair raw; classification against the version
+    oracles happens post-run (so fp-exact oracles can be computed from
+    the REGISTERED payloads, not guessed up front)."""
+
+    def pinger(tid):
+        k = tid
+        try:
+            kw = ({"failover_wait": failover_wait}
+                  if failover_wait else {})
+            with serving.client(**kw) as c:
+                while not stop.is_set():
+                    j = k % len(probes)
+                    k += n_threads
+                    p, n = probes[j]
+                    got = c.generate(p, n, timeout=300, model="m").tolist()
+                    with lock:
+                        ledger.append((j, got))
+        except Exception as e:
+            with lock:
+                errors.append(f"pinger {tid}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=pinger, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def _classify(ledger, oracles):
+    """``{name: count}`` of served outputs per version oracle (+
+    ``other`` for outputs matching none — always a gate failure)."""
+    counts = {name: 0 for name in oracles}
+    counts["other"] = 0
+    for j, got in ledger:
+        for name, oracle in oracles.items():
+            if got == oracle[j]:
+                counts[name] += 1
+                break
+        else:
+            counts["other"] += 1
+    return counts
+
+
+def _journal_records(wd):
+    path = os.path.join(wd, "control_plane.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _warm(serving, probes, n):
+    def go():
+        with serving.client() as c:
+            c.generate(probes[0][0], 2, timeout=600, model="m")
+
+    ts = [threading.Thread(target=go) for _ in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(600)
+
+
+# ------------------------------------------------------------ scenarios
+
+def continual_loop_scenario(smoke, seed=0):
+    """The standing loop, end to end: trainer emits → offline gate →
+    live canary, three candidates with three distinct fates."""
+    import tempfile
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import metrics as tfos_metrics
+    from tensorflowonspark_tpu.continual import ContinualPipeline
+    from tensorflowonspark_tpu.serving import RolloutPolicy, ServingCluster
+
+    wd = tempfile.mkdtemp(prefix="tfos_continual_")
+    rng = np.random.default_rng(seed)
+    probes = _make_reqs(rng, 6, blo=6, bhi=9)
+    oracles = {"v1": _oracle(None, probes),
+               "good": _oracle(GOOD_SEED, probes),
+               "bad": _oracle(BAD_SEED, probes)}
+    refs: list = []
+    spec = _eval_spec(wd, refs, shards=1 if smoke else 2,
+                      rows_per_shard=4 if smoke else 6, seed=seed)
+    candidates = [
+        {"step": 1, "delta_seed": BAD_SEED,
+         "serve_args": {"serve_step_delay": 0.0}},
+        {"step": 2, "delta_seed": GOOD_SEED,
+         "serve_args": {"serve_step_delay": 0.08}},   # live-only latency
+        {"step": 3, "delta_seed": GOOD_SEED,
+         "serve_args": {"serve_step_delay": 0.0}},
+    ]
+    expect = {("m", "step-1"): "rejected_offline",
+              ("m", "step-2"): "rolled_back",
+              ("m", "step-3"): "promoted"}
+    if smoke:
+        candidates = [candidates[0], candidates[2]]
+        expect = {("m", "step-1"): "rejected_offline",
+                  ("m", "step-3"): "promoted"}
+    policy = RolloutPolicy(steps=(50, 100),
+                           bake_secs=2.0 if smoke else 4.0,
+                           min_samples=1, max_e2e_ratio=2.5,
+                           max_error_rate=0.2)
+    mreg = tfos_metrics.get_registry()
+    m_versions = mreg.counter("tfos_continual_versions_total",
+                              "Continual-loop candidates by terminal "
+                              "outcome.", labelnames=("outcome",))
+    v0 = {o: m_versions.value(outcome=o)
+          for o in ("promoted", "rejected_offline", "rolled_back")}
+    ledger, errors = [], []
+    stop, lock = threading.Event(), threading.Lock()
+    serving = None
+    t_start = time.monotonic()
+    try:
+        serving = ServingCluster.run(
+            None, 2, registry=_registry_v1(), model=("m", "v1"),
+            working_dir=wd, max_queue_depth=256,
+            worker_env={"JAX_PLATFORMS": "cpu"}, reservation_timeout=120)
+        _warm(serving, probes, 2)
+        threads = _start_pingers(serving, probes, 4, stop, ledger,
+                                 errors, lock)
+        pipe = ContinualPipeline(serving, "m",
+                                 base_builder=bench_model_builder,
+                                 eval_spec=spec, policy=policy)
+        outcomes = pipe.run(
+            trainer_publish_candidates,
+            {"model": "m", "candidates": candidates}, 1,
+            max_restarts=1, poll_interval=0.2,
+            worker_env={"JAX_PLATFORMS": "cpu",
+                        "TFOS_PUBLISH_DRAIN_SECS": "1800"},
+            reservation_timeout=120, shutdown_timeout=120)
+        stop.set()
+        for t in threads:
+            t.join(300)
+        reg = serving.registry
+        states = {v: reg.version("m", v).describe()
+                  for v in reg.versions("m")}
+        fleet = serving.scheduler.model_versions("m")
+        # post-loop probes: the whole fleet serves the promoted weights
+        post = _make_reqs(np.random.default_rng(seed + 9), 4, blo=6,
+                          bhi=9)
+        want = _oracle(GOOD_SEED, post)
+        with serving.client() as c:
+            for (p, n), w in zip(post, want):
+                if c.generate(p, n, timeout=300, model="m").tolist() != w:
+                    raise RuntimeError("continual_loop: post-loop probe "
+                                       "not promoted-candidate-exact")
+        recs = _journal_records(wd)
+    finally:
+        stop.set()
+        if serving is not None:
+            serving.shutdown(timeout=300)
+    wall = time.monotonic() - t_start
+
+    if outcomes != expect:
+        raise RuntimeError(f"continual_loop: outcomes {outcomes} != "
+                           f"{expect}")
+    if errors:
+        raise RuntimeError(f"continual_loop: request errors (zero-loss "
+                           f"gate): {errors[:3]}")
+    counts = _classify(ledger, oracles)
+    if counts["other"]:
+        raise RuntimeError(
+            f"continual_loop: {counts['other']} served output(s) match "
+            f"NO vetted version's oracle (counts={counts})")
+    if counts["bad"]:
+        raise RuntimeError(
+            f"continual_loop: {counts['bad']} output(s) match the "
+            "offline-rejected candidate — it reached the fleet")
+    # the quality regression was never canaried: zero rollout records
+    started = [r["version"] for r in recs if r["kind"] == "rollout_started"]
+    if "step-1" in started:
+        raise RuntimeError("continual_loop: the offline-rejected "
+                           "candidate has a rollout_started record")
+    if states["step-1"]["eval_passed"] is not False:
+        raise RuntimeError(f"continual_loop: step-1 verdict "
+                           f"{states['step-1']['eval_passed']}")
+    if not smoke:
+        if states["step-2"]["state"] != "rolled_back" \
+                or started.count("step-2") != 1:
+            raise RuntimeError(
+                f"continual_loop: latency regression ended "
+                f"{states['step-2']['state']} "
+                f"(rollouts={started.count('step-2')})")
+    if states["step-3"]["state"] != "serving" \
+            or states["v1"]["state"] != "retired":
+        raise RuntimeError(f"continual_loop: final states {states}")
+    if set(fleet) != {"step-3"} or len(fleet["step-3"]) != 2:
+        raise RuntimeError(f"continual_loop: fleet ended on {fleet}")
+    done = {r["version"]: r["outcome"] for r in recs
+            if r["kind"] == "continual_done"}
+    if done != {f"step-{c['step']}": expect[("m", f"step-{c['step']}")]
+                for c in candidates}:
+        raise RuntimeError(f"continual_loop: journal outcomes {done}")
+    dv = {o: m_versions.value(outcome=o) - v0[o] for o in v0}
+    want_dv = {"promoted": 1.0, "rejected_offline": 1.0,
+               "rolled_back": 0.0 if smoke else 1.0}
+    if dv != want_dv:
+        raise RuntimeError(f"continual_loop: outcome counters {dv} != "
+                           f"{want_dv}")
+    return {
+        "scenario": "continual_loop",
+        "candidates": {f"step-{c['step']}": expect[("m", f"step-{c['step']}")]
+                       for c in candidates},
+        "offline_gate": {
+            "rejected": "step-1",
+            "rejected_quality": states["step-1"]["eval_metrics"],
+            "never_canaried": True,
+            "eval_records": len(refs),
+        },
+        "live_gate": (None if smoke else {
+            "rolled_back": "step-2",
+            "regression": "serve_step_delay=0.08 (invisible offline)",
+            "offline_quality": states["step-2"]["eval_metrics"],
+        }),
+        "promoted": "step-3",
+        "served": {k: v for k, v in counts.items() if k != "bad"},
+        "oracle_exact_for_vetted_versions": True,
+        "zero_loss": True,
+        "wall_secs": round(wall, 1),
+    }
+
+
+def driver_kill_scenario(smoke, seed=0, after_secs=130.0):
+    """Chaos mid-loop: the control plane dies DURING a candidate's
+    canary; the resumed driver continues from the journaled stage."""
+    import contextlib
+    import tempfile
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import chaos
+    from tensorflowonspark_tpu.continual import (ContinualPipeline,
+                                                 Publication,
+                                                 payload_digest)
+    from tensorflowonspark_tpu.observability import EventLog
+    from tensorflowonspark_tpu.serving import (RolloutPolicy,
+                                               ServingCluster,
+                                               resume_driver)
+    from tensorflowonspark_tpu.serving.journal import ControlPlaneJournal
+
+    wd = tempfile.mkdtemp(prefix="tfos_continual_kill_")
+    jpath = os.path.join(wd, "control_plane.jsonl")
+    store = os.path.join(wd, "continual_store")
+    rng = np.random.default_rng(seed)
+    probes = _make_reqs(rng, 6, blo=6, bhi=9)
+    oracles = {"v1": _oracle(None, probes),
+               "cand": _oracle(GOOD_SEED, probes)}
+    refs: list = []
+    spec = _eval_spec(wd, refs, shards=1, rows_per_shard=4, seed=seed)
+    payload = version_delta(GOOD_SEED)
+    pub = Publication(model="m", version="cand-1", flavor="adapter",
+                      step=1, payload=payload,
+                      serve_args={"serve_step_delay": 0.0}, metadata={},
+                      digest=payload_digest(payload), src=0, seq=1)
+    pol = dict(min_samples=1, max_e2e_ratio=None, max_error_rate=0.5)
+    ledger, errors, proc_errors = [], [], []
+    stop, lock = threading.Event(), threading.Lock()
+    env0 = {k: os.environ.get(k) for k in ("TFOS_CHAOS", "TFOS_CHAOS_DIR")}
+    os.environ["TFOS_CHAOS"] = f"kill driver after_secs={after_secs:g}"
+    os.environ["TFOS_CHAOS_DIR"] = wd
+    serving = serving2 = None
+    try:
+        serving = ServingCluster.run(
+            None, 2, registry=_registry_v1(), model=("m", "v1"),
+            working_dir=wd, max_queue_depth=256,
+            worker_env={"JAX_PLATFORMS": "cpu"}, reservation_timeout=120)
+        addr = serving.address
+        _warm(serving, probes, 2)
+        threads = _start_pingers(serving, probes, 3, stop, ledger,
+                                 errors, lock, failover_wait=180.0)
+        # the pre-crash pipeline: a long bake so the armed timer lands
+        # inside the first canary step's bake window — AFTER the canary
+        # armed (the controller spends one full bake_secs on its
+        # pre-canary baseline window first), well BEFORE the step gates.
+        # Timeline from chaos arm: ~35s warm+offline-eval, ~60s pre-canary
+        # baseline, then a 60s step-25 bake — after_secs=130 lands ~35s
+        # into it with ~±15s slack on both edges.
+        pipe1 = ContinualPipeline(
+            serving, "m", base_builder=bench_model_builder,
+            eval_spec=spec, store_dir=store,
+            policy=RolloutPolicy(steps=(25, 100), bake_secs=60.0, **pol))
+
+        def run_pipe():
+            try:
+                pipe1.process(pub)
+            except Exception as e:     # expected: it dies with the crash
+                proc_errors.append(f"{type(e).__name__}: {e}")
+
+        pt = threading.Thread(target=run_pipe, daemon=True)
+        pt.start()
+        deadline = time.monotonic() + after_secs
+        while True:
+            recs = (ControlPlaneJournal.replay(jpath).open_rollouts()
+                    if os.path.exists(jpath) else {})
+            if recs.get("m", {}).get("version") == "cand-1":
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "driver_kill: the rollout stage never opened before "
+                    "the chaos window — raise after_secs")
+            time.sleep(0.2)
+        # the canary must be ARMED (traffic on the candidate) before the
+        # kill, so the resumed controller has a survivor to continue on
+        while not any(e.get("kind") == "rollout_canary" for e in
+                      EventLog.read(os.path.join(wd,
+                                                 "serving_events.jsonl"))):
+            if chaos.fired_at(wd, "driver") is not None \
+                    or time.monotonic() > deadline:
+                raise RuntimeError(
+                    "driver_kill: chaos window closed before the canary "
+                    "armed — raise after_secs")
+            time.sleep(0.2)
+        deadline = time.monotonic() + after_secs + 60
+        while chaos.fired_at(wd, "driver") is None:
+            if time.monotonic() > deadline:
+                raise RuntimeError("driver_kill: chaos never fired")
+            time.sleep(0.2)
+        crashed_at = chaos.fired_at(wd, "driver")
+        # the journaled truth at the moment of death
+        st = ControlPlaneJournal.replay(jpath)
+        stage = st.continual[("m", "cand-1")].get("stage")
+        if stage != "rollout" or ("m", "cand-1") not in st.open_candidates():
+            raise RuntimeError(f"driver_kill: crash landed at stage "
+                               f"{stage!r}, not mid-rollout")
+        time.sleep(1.0)     # pingers are in their reconnect loops
+        serving2 = resume_driver(serving.cluster, address=addr,
+                                 model=("m", "v1"),
+                                 registry=_registry_v1(),
+                                 crashed_at=crashed_at)
+        heal_secs = max(0.0, time.time() - crashed_at)
+        pipe2 = ContinualPipeline(
+            serving2, "m", base_builder=bench_model_builder,
+            eval_spec=spec, store_dir=store,
+            policy=RolloutPolicy(steps=(25, 100), bake_secs=2.0, **pol))
+        results = pipe2.resume()
+        time.sleep(2.0)     # post-heal traffic window
+        stop.set()
+        for t in threads:
+            t.join(300)
+        reg2 = serving2.registry
+        cand_state = reg2.version("m", "cand-1").state
+        v1_state = reg2.version("m", "v1").state
+        fleet = serving2.scheduler.model_versions("m")
+        canary_modes = [e.get("mode") for e in EventLog.read(
+            os.path.join(wd, "serving_events.jsonl"))
+            if e.get("kind") == "rollout_canary"]
+        recs = _journal_records(wd)
+    finally:
+        stop.set()
+        for k, v in env0.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if serving2 is not None:
+            serving2.shutdown(timeout=300)
+        elif serving is not None:
+            with contextlib.suppress(Exception):
+                serving.shutdown(timeout=60)
+            with contextlib.suppress(Exception):
+                serving.cluster._abort()
+
+    if results != {("m", "cand-1"): "promoted"}:
+        raise RuntimeError(f"driver_kill: resume settled {results}")
+    if errors:
+        raise RuntimeError(f"driver_kill: pinger errors (zero-loss "
+                           f"gate): {errors[:3]}")
+    counts = _classify(ledger, oracles)
+    if counts["other"]:
+        raise RuntimeError(f"driver_kill: {counts['other']} served "
+                           f"output(s) match neither version's oracle")
+    if counts["cand"] < 1:
+        raise RuntimeError("driver_kill: the candidate never served a "
+                           "request across the resume")
+    if "resumed" not in canary_modes:
+        raise RuntimeError(
+            f"driver_kill: canary modes {canary_modes} — the resumed "
+            "controller re-armed from scratch instead of continuing")
+    emitted = [r for r in recs if r["kind"] == "continual_candidate"
+               and r["version"] == "cand-1"]
+    started = [r for r in recs if r["kind"] == "rollout_started"
+               and r["version"] == "cand-1"]
+    concluded = [r for r in recs if r["kind"] == "rollout_done"
+                 and r["version"] == "cand-1"]
+    done = [r for r in recs if r["kind"] == "continual_done"
+            and r["version"] == "cand-1"]
+    if len(emitted) != 1:
+        raise RuntimeError(f"driver_kill: candidate emitted "
+                           f"{len(emitted)}x — must be exactly once")
+    # exactly two rollout_started: the pre-crash one and the resumed
+    # controller's narrowed-plan restart; exactly ONE conclusion
+    if len(started) != 2 \
+            or [r["outcome"] for r in concluded] != ["promoted"]:
+        raise RuntimeError(
+            f"driver_kill: rollout_started x{len(started)} (want 2: "
+            f"original + resumed narrowed plan), rollout_done "
+            f"{[r.get('outcome') for r in concluded]} (want one "
+            "'promoted')")
+    if [r["outcome"] for r in done] != ["promoted"]:
+        raise RuntimeError(f"driver_kill: continual_done records "
+                           f"{done}")
+    st = ControlPlaneJournal.replay(jpath)
+    if st.unfinished or st.resumes != 1 or st.open_candidates():
+        raise RuntimeError(
+            f"driver_kill: journal owes {sorted(st.unfinished)}, "
+            f"resumes={st.resumes}, open={st.open_candidates()}")
+    if (cand_state, v1_state) != ("serving", "retired") \
+            or set(fleet) != {"cand-1"}:
+        raise RuntimeError(f"driver_kill: final states cand={cand_state}"
+                           f" v1={v1_state} fleet={fleet}")
+    return {
+        "scenario": "driver_kill",
+        "chaos": f"kill driver after_secs={after_secs:g}",
+        "crashed_at_stage": "rollout",
+        "resumed_outcome": "promoted",
+        "canary_modes": canary_modes,
+        "heal_secs": round(heal_secs, 3),
+        "served": counts,
+        "emitted_once": True,
+        "promoted_once": True,
+        "rollout_started_records": len(started),
+        "zero_loss": True,
+        "journal": {"resumes": st.resumes,
+                    "unfinished": len(st.unfinished),
+                    "open_candidates": 0},
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="two-candidate reject+promote loop only; "
+                         "writes continual_smoke.json")
+    ap.add_argument("--kill-after", type=float, default=130.0,
+                    help="driver-kill chaos timer (full mode); must "
+                         "land inside the first canary bake — after "
+                         "warm-up + offline eval (~35s) and the "
+                         "pre-canary baseline window (bake_secs)")
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    rows = [continual_loop_scenario(args.smoke)]
+    if not args.smoke:
+        rows.append(driver_kill_scenario(False,
+                                         after_secs=args.kill_after))
+
+    artifact = {
+        "benchmark": "continual",
+        "smoke": bool(args.smoke),
+        "config": {"model": {"vocab": VOCAB, "platform": "cpu"},
+                   "eval": {"prompt_len": EVAL_LEN,
+                            "new_tokens": EVAL_NEW}},
+        "rows": rows,
+    }
+    out_dir = os.path.join(REPO, "bench_artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    name = "continual_smoke.json" if args.smoke else "continual.json"
+    path = os.path.join(out_dir, name)
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"\nwrote {path}")
+    for row in rows:
+        print(json.dumps(row, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,7 +24,13 @@
 #                               # --failover --smoke (chaos driver kill
 #                               # healed by journal replay: zero-loss,
 #                               # oracle-exact, mid-canary rollout
-#                               # continuation gates)
+#                               # continuation gates) + bench_continual.py
+#                               # --smoke (the standing train→eval→rollout
+#                               # loop: a trainer-published quality
+#                               # regression rejected at the offline gate
+#                               # and never canaried, a good candidate
+#                               # promoted fleet-wide, every served output
+#                               # oracle-exact, zero loss)
 #
 # The analysis gate (docs/analysis.md) runs all six project rules plus the
 # exports-drift check against the committed analysis_baseline.json ratchet
@@ -154,6 +160,20 @@ if [ "${1:-}" = "--bench-smoke" ]; then
     rc=$?
     if [ $rc -ne 0 ]; then
         echo "driver failover bench smoke FAILED (rc=$rc)" >&2
+        exit $rc
+    fi
+    echo "== bench smoke (continual loop) =="
+    # the standing train→eval→rollout pipeline end to end: a real
+    # trainer publishes adapter candidates over the queue plane, the
+    # batch plane's offline gate rejects the quality regression (never
+    # canaried), the good candidate canaries and promotes fleet-wide.
+    # Hard gates: outcomes exact, zero request loss, every served
+    # output oracle-exact for a vetted version; writes
+    # continual_smoke.json (never the committed full artifact)
+    JAX_PLATFORMS=cpu python scripts/bench_continual.py --smoke
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "continual bench smoke FAILED (rc=$rc)" >&2
         exit $rc
     fi
     exit 0

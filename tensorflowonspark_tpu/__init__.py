@@ -72,3 +72,8 @@ from tensorflowonspark_tpu import metrics, tracing  # noqa: F401,E402
 # with per-shard checkpointed progress and resumable bulk predict.  Safe
 # to import eagerly — worker-side jax/model imports happen in the map_fun.
 from tensorflowonspark_tpu import batch  # noqa: F401,E402
+
+# Continual-learning loop (docs/continual.md): a standing
+# train→eval→rollout pipeline — checkpoint publication into the model
+# registry, offline gating on the batch plane, journaled live rollout.
+from tensorflowonspark_tpu import continual  # noqa: F401,E402

@@ -55,6 +55,21 @@ _FORMAT_VERSION = 1
 # Training checkpoints (orbax behind the reference's model_dir UX)
 # --------------------------------------------------------------------------
 
+def _normalize_scalar_leaves(tree):
+    """Promote bare numpy scalars (``np.float32(3.0)`` & friends) to 0-d
+    arrays before handing a pytree to orbax.
+
+    This orbax version's ``StandardSave`` validation rejects ``np.generic``
+    leaves (``Unsupported type: <class 'numpy.float32'>``) even though the
+    equivalent 0-d ``np.ndarray`` round-trips fine.  Users coming from the
+    reference hand us scalar hyperparameters all the time, so normalize here
+    rather than pushing the quirk into every call site."""
+    import jax
+
+    return jax.tree.map(
+        lambda x: np.asarray(x) if isinstance(x, np.generic) else x, tree)
+
+
 class CheckpointManager:
     """Periodic training checkpoints under ``model_dir``.
 
@@ -88,6 +103,16 @@ class CheckpointManager:
             # save has registered one
             item_handlers=ocp.StandardCheckpointHandler(),
         )
+        self._save_listeners: list[Callable[[int, Any], None]] = []
+
+    def add_save_listener(self, fn: Callable[[int, Any], None]) -> None:
+        """Register ``fn(step, state)`` to run after each successful save.
+
+        The emit hook for the continual-learning loop: a
+        ``continual.CheckpointPublisher`` attaches here so every durable
+        checkpoint can be published driver-ward.  Listener exceptions are
+        logged and swallowed — publishing must never kill training."""
+        self._save_listeners.append(fn)
 
     def save(self, step: int, state, force: bool = False) -> bool:
         """Save ``state`` (any pytree) at ``step``; returns True if saved.
@@ -101,8 +126,17 @@ class CheckpointManager:
             if not force:
                 return False
             self._mngr.delete(step)
-        return self._mngr.save(step, args=self._ocp.args.StandardSave(state),
-                               force=force)
+        state = _normalize_scalar_leaves(state)
+        saved = self._mngr.save(step, args=self._ocp.args.StandardSave(state),
+                                force=force)
+        if saved:
+            for fn in self._save_listeners:
+                try:
+                    fn(step, state)
+                except Exception:
+                    logger.exception("checkpoint save listener failed "
+                                     "(step=%d)", step)
+        return saved
 
     def restore(self, step: int | None = None, target=None):
         """Restore the checkpoint at ``step`` (default: latest).
@@ -417,6 +451,7 @@ def export_model(export_dir: str,
     import orbax.checkpoint as ocp
 
     vdir = os.path.join(export_dir, _VARIABLES_DIR)
+    params = _normalize_scalar_leaves(params)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(vdir, params, force=True)
 

@@ -1,0 +1,33 @@
+"""Continual learning: the standing train→eval→rollout loop.
+
+Closes the gap between the training plane this package rebuilds and the
+serving fleet the last PRs grew beside it (ROADMAP item 5): a training
+cluster continuously EMITS candidates (checkpoints or adapter deltas)
+into the ``ModelRegistry`` via :class:`CheckpointPublisher`; the batch
+plane GATES each candidate offline against a held-out eval manifest;
+``RolloutController`` canaries the winner LIVE with windowed metrics
+gates and auto-rollback — all journaled, so a driver failover resumes
+mid-stage and an unvetted version can never serve a request.
+
+    from tensorflowonspark_tpu import continual
+
+    # worker side (inside the training map_fun):
+    pub = continual.CheckpointPublisher(ctx, "m", base=base_params)
+    pub.attach(ckpt_mngr, transform=lambda s: s["params"])
+
+    # driver side, next to a live ServingCluster:
+    pipe = continual.ContinualPipeline(serving, "m",
+                                       base_builder=my_builder,
+                                       eval_spec=continual.OfflineEval(...))
+    pipe.run(trainer_fn, args, num_workers, data=stream)
+
+See ``docs/continual.md`` for the lifecycle, gate semantics and knobs;
+``scripts/bench_continual.py`` pins the gates as a self-gating artifact.
+"""
+
+from tensorflowonspark_tpu.continual.publisher import (  # noqa: F401
+    CONTINUAL_QUEUES, PUBLISH_QUEUE, CheckpointPublisher, Publication,
+    PublicationCollector, build_published_full, diff_params,
+    flatten_params, payload_digest, payload_nbytes, replace_leaves)
+from tensorflowonspark_tpu.continual.pipeline import (  # noqa: F401
+    OUTCOMES, ContinualPipeline, OfflineEval, candidate_trial_params)

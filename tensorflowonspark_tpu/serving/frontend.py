@@ -925,17 +925,23 @@ class ServingCluster:
             # restores through the founding builder)
             model = self._default_model
         payload: dict = {}
+        adapter_payload = False
         if model is not None:
             model = (str(model[0]), str(model[1]))
             payload = {"model": model[0], "version": model[1]}
             if self.registry is not None:
                 payload.update(self.registry.version(*model).swap_payload())
+                adapter_payload = payload.get("base_builder") is not None
         got = pool.acquire()
         if got is None:
             return None
         eid, entry = got
+        # adapter versions promote DELTA-ONLY: the payload already
+        # carries the small delta and the standby rebuilds base+delta
+        # locally — naming a clone peer would ship the full base over
+        # the wire for nothing
         peer = (self.scheduler.peer_replica_info(model=model)
-                if self._standby_clone else None)
+                if self._standby_clone and not adapter_payload else None)
         ready = threading.Event()
         with self._promotions_lock:
             self._promotions[eid] = (time.monotonic(), source, ready)
@@ -1167,8 +1173,13 @@ class ServingCluster:
             else:
                 token = f"swap-{eid}-{time.monotonic_ns()}"
                 waiter = self.scheduler.expect_swap(eid, token=token)
-                peer = self.scheduler.peer_replica_info(
-                    exclude={eid}, model=entry.key)
+                # adapter versions swap DELTA-ONLY: the payload carries
+                # the small delta and the worker re-applies it over its
+                # pristine-base cache; a clone peer would ship full
+                # params over the wire for nothing
+                peer = (None if entry.base_builder is not None
+                        else self.scheduler.peer_replica_info(
+                            exclude={eid}, model=entry.key))
                 # the registry entry is the builder of record for
                 # EVERY version (run() rejects a conflicting explicit
                 # model_builder), so the payload always carries it —

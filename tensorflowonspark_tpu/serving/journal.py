@@ -25,12 +25,22 @@ Record grammar (all records carry ``t`` and ``kind``)::
                                                      #   original `rid`
     replica_added/replica_dead/replica_retired/replica_model   # membership
     registry_register/registry_eval/registry_state             # ModelRegistry
+    registry_evict {model, version}                  # retention: payloads
+                                                     #   dropped, lineage kept
     traffic_split {model, split|null}
     rollout_started {model, version, incumbent, steps}
     rollout_step {model, version, percent}           # step INTENT (pre-shift)
     rollout_step_done {model, version, percent}      # step survived its gate
     rollout_done {model, version, outcome}
     driver_resumed {requeued, replicas}              # a failover happened
+    continual_candidate {model, version, flavor, step, digest, src}
+                                                     # pipeline ingested a
+                                                     #   published candidate
+    continual_stage {model, version, stage}          # stage entered:
+                                                     #   offline_eval|rollout
+    continual_done {model, version, outcome}         # terminal: promoted|
+                                                     #   rejected_offline|
+                                                     #   rolled_back
 
 Replay (:meth:`ControlPlaneJournal.replay`) is idempotent under
 duplicate lines, tolerant of a torn tail (a crash mid-``write``), and
@@ -61,10 +71,11 @@ logger = logging.getLogger(__name__)
 KNOWN_KINDS = frozenset({
     "admit", "route", "commit", "requeue",
     "replica_added", "replica_dead", "replica_retired", "replica_model",
-    "registry_register", "registry_eval", "registry_state",
+    "registry_register", "registry_eval", "registry_state", "registry_evict",
     "traffic_split",
     "rollout_started", "rollout_step", "rollout_step_done", "rollout_done",
     "driver_resumed",
+    "continual_candidate", "continual_stage", "continual_done",
 })
 
 
@@ -191,6 +202,9 @@ class JournalState:
         self.traffic: dict[str, dict | None] = {}
         #: model_id -> rollout position (see ``rollout_*`` fold below)
         self.rollouts: dict[str, dict] = {}
+        #: (model_id, version) -> continual-loop candidate position
+        #: {"flavor","step","digest","src","stage","outcome"}
+        self.continual: dict[tuple, dict] = {}
         #: count of prior driver failovers recorded in this journal
         self.resumes = 0
         self.unknown_kinds = 0
@@ -255,12 +269,12 @@ class JournalState:
             self.registry.setdefault(
                 (rec["model"], rec["version"]),
                 {"state": "registered", "eval_passed": None,
-                 "eval_metrics": None})
+                 "eval_metrics": None, "evicted": False})
         elif kind == "registry_eval":
             ent = self.registry.setdefault(
                 (rec["model"], rec["version"]),
                 {"state": "registered", "eval_passed": None,
-                 "eval_metrics": None})
+                 "eval_metrics": None, "evicted": False})
             ent["eval_passed"] = bool(rec.get("passed"))
             ent["eval_metrics"] = rec.get("metrics")
             if ent["eval_passed"] and ent["state"] == "registered":
@@ -269,8 +283,32 @@ class JournalState:
             ent = self.registry.setdefault(
                 (rec["model"], rec["version"]),
                 {"state": "registered", "eval_passed": None,
-                 "eval_metrics": None})
+                 "eval_metrics": None, "evicted": False})
             ent["state"] = rec.get("state")
+        elif kind == "registry_evict":
+            ent = self.registry.setdefault(
+                (rec["model"], rec["version"]),
+                {"state": "registered", "eval_passed": None,
+                 "eval_metrics": None, "evicted": False})
+            ent["evicted"] = True
+        elif kind == "continual_candidate":
+            self.continual.setdefault(
+                (rec["model"], rec["version"]),
+                {"flavor": rec.get("flavor"), "step": rec.get("step"),
+                 "digest": rec.get("digest"), "src": rec.get("src"),
+                 "stage": "received", "outcome": None})
+        elif kind == "continual_stage":
+            ent = self.continual.setdefault(
+                (rec["model"], rec["version"]),
+                {"flavor": None, "step": None, "digest": None, "src": None,
+                 "stage": "received", "outcome": None})
+            ent["stage"] = rec.get("stage")
+        elif kind == "continual_done":
+            ent = self.continual.setdefault(
+                (rec["model"], rec["version"]),
+                {"flavor": None, "step": None, "digest": None, "src": None,
+                 "stage": "received", "outcome": None})
+            ent["outcome"] = rec.get("outcome")
         elif kind == "traffic_split":
             self.traffic[rec["model"]] = rec.get("split")
         elif kind == "rollout_started":
@@ -304,6 +342,13 @@ class JournalState:
         """Accepted-but-uncommitted admissions: the replay obligation."""
         return {rid: rec for rid, rec in self.admitted.items()
                 if rid not in self.committed}
+
+    def open_candidates(self) -> dict[tuple, dict]:
+        """Continual-loop candidates with no terminal outcome — what a
+        resumed :class:`continual.ContinualPipeline` must pick back up
+        (at their journaled stage, never from scratch)."""
+        return {k: c for k, c in self.continual.items()
+                if c.get("outcome") is None}
 
     def open_rollouts(self) -> dict[str, dict]:
         """Rollouts with no terminal outcome — the mid-flight ones a

@@ -230,26 +230,49 @@ def serve_clone_request(batcher, item: dict, ctx,
     threading.Thread(target=_send, name="serve-clone", daemon=True).start()
 
 
-def resolve_version_params(args, item):
+def resolve_version_params(args, item, base_cache: dict | None = None):
     """Build a model-version payload's parameter tree (the hot-swap
     message / the standby promote payload): the payload's ``builder`` —
     or ``base_builder`` + ``adapter`` delta for adapter versions — run
     over this worker's args with the version's ``serve_args`` overlaid
     (so a builder keying on e.g. ``seed`` sees the version's value).
     Returns ``(params, version_args)``; the caller loads the params and
-    keeps ``version_args`` as its live arg view."""
+    keeps ``version_args`` as its live arg view.
+
+    ``base_cache``: the worker's PRISTINE-BASE cache.  Adapter swaps
+    ship delta-only payloads, and re-applying a delta over the cached
+    base beats rebuilding base+delta every swap.  The cache is only
+    consulted when the payload's ``serve_args`` overlay carries no
+    builder-visible knob (a non-``serve_``-prefixed key like ``seed``
+    changes what the base builder returns) — otherwise the base is
+    rebuilt.  Capped at one entry: a model's adapter versions share one
+    base by construction (adapter-over-adapter is rejected at
+    registration)."""
     version_args = dict(args)
     version_args.update(item.get("serve_args") or {})
     base = item.get("base_builder")
     if base is not None:
-        # ONE implementation of base+adapter resolution: map the
-        # payload onto the spawn-path arg keys and delegate
-        from tensorflowonspark_tpu.serving.rollout import \
-            build_registered_model
+        from tensorflowonspark_tpu.serving.rollout import apply_adapter
 
+        delta = item.get("adapter")
         version_args["serve_base_builder"] = base
-        version_args["serve_adapter"] = item.get("adapter")
-        _, params = build_registered_model(version_args)
+        version_args["serve_adapter"] = delta
+        overlay = item.get("serve_args") or {}
+        cacheable = (base_cache is not None
+                     and not any(not str(k).startswith("serve_")
+                                 for k in overlay))
+        key = (getattr(base, "__module__", None),
+               getattr(base, "__qualname__", repr(base)))
+        base_params = base_cache.get(key) if cacheable else None
+        if base_params is None:
+            _, base_params = base(version_args)
+            if cacheable:
+                base_cache.clear()
+                base_cache[key] = base_params
+        # apply_adapter never mutates the base leaves (delta'd paths get
+        # fresh arrays), so the cached tree stays pristine
+        params = (apply_adapter(base_params, delta) if delta
+                  else base_params)
     else:
         builder = item.get("builder") or args["serve_model_builder"]
         _, params = builder(version_args)
@@ -531,6 +554,9 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
         return batcher.load()["total"] > 0
 
     swap_base = base_args if base_args is not None else args
+    #: pristine-base cache for delta-only adapter swaps (see
+    #: resolve_version_params) — lives for the serve loop's lifetime
+    swap_base_cache: dict = {}
 
     def apply_model_swap(item: dict, cur_delay: float):
         """Apply a drained hot swap (docs/serving.md "Multi-model
@@ -549,7 +575,10 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
         version_args = dict(swap_base)
         version_args.update(item.get("serve_args") or {})
         peer = item.get("peer")
-        if peer is not None:
+        # adapter payloads are DELTA-ONLY: re-applying the delta over the
+        # pristine base (cached locally) always beats cloning full params
+        # from a peer, so the peer hint is ignored for them
+        if peer is not None and item.get("base_builder") is None:
             from tensorflowonspark_tpu.serving.standby import (
                 _STOP, _clone_from_peer)
 
@@ -561,8 +590,8 @@ def run_serve_loop(args, ctx, batcher, *, step_hook=None,
                 params = got["params"]
         try:
             if params is None:
-                params, version_args = resolve_version_params(swap_base,
-                                                              item)
+                params, version_args = resolve_version_params(
+                    swap_base, item, base_cache=swap_base_cache)
             # draft coherence BEFORE the params move: the new version's
             # draft arms (or a version without one clears the old draft)
             # while the old target still serves — a bad draft payload

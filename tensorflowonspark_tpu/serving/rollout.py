@@ -122,7 +122,7 @@ class ModelVersion:
 
     __slots__ = ("model_id", "version", "builder", "base_builder",
                  "adapter", "extra_args", "metadata", "state",
-                 "eval_metrics", "eval_passed")
+                 "eval_metrics", "eval_passed", "evicted", "flavor")
 
     def __init__(self, model_id: str, version: str, builder=None, *,
                  base_builder=None, adapter=None, serve_args=None,
@@ -137,12 +137,25 @@ class ModelVersion:
         self.state = "registered"
         self.eval_metrics: dict | None = None
         self.eval_passed: bool | None = None
+        #: retention evicted the payloads (builder/adapter/serve_args);
+        #: the row survives as lineage only
+        self.evicted = False
+        #: "adapter" | "full", fixed at registration (survives eviction)
+        self.flavor = "adapter" if base_builder is not None else "full"
 
     @property
     def key(self) -> tuple[str, str]:
         return (self.model_id, self.version)
 
+    def _check_payload(self) -> None:
+        if self.evicted:
+            raise RolloutError(
+                f"{self.model_id}@{self.version} was evicted by registry "
+                "retention (keep_versions); its payloads are gone — only "
+                "the lineage row remains")
+
     def serve_args(self) -> dict:
+        self._check_payload()
         a = dict(self.extra_args)
         a["serve_model"] = (self.model_id, self.version)
         if self.base_builder is not None:
@@ -159,6 +172,7 @@ class ModelVersion:
         # args (a promoted standby keeps its promotion overlay) — a
         # version that must RESET a knob another version set should
         # carry it explicitly (e.g. {"serve_step_delay": 0})
+        self._check_payload()
         p = {"serve_args": dict(self.extra_args)}
         if self.base_builder is not None:
             p["base_builder"] = self.base_builder
@@ -170,10 +184,10 @@ class ModelVersion:
     def describe(self) -> dict:
         return {"model": self.model_id, "version": self.version,
                 "state": self.state,
-                "kind": "adapter" if self.base_builder is not None
-                else "full",
+                "kind": self.flavor,
                 "eval_passed": self.eval_passed,
                 "eval_metrics": self.eval_metrics,
+                "evicted": self.evicted,
                 "metadata": dict(self.metadata)}
 
 
@@ -208,7 +222,17 @@ class ModelRegistry:
     docstring).  Thread-safe; the tier, the rollout controller and user
     code all read it concurrently."""
 
-    def __init__(self):
+    def __init__(self, keep_versions: int | None = None):
+        """``keep_versions``: retention knob for the continual-emission
+        loop — at most this many ``retired``/``rolled_back`` versions per
+        model keep their payloads; older dead versions are EVICTED
+        (builder/adapter/serve_args dropped, lineage row kept) so a
+        standing pipeline can't grow the catalog unboundedly.  ``None``
+        (default) keeps everything."""
+        if keep_versions is not None and int(keep_versions) < 0:
+            raise ValueError("keep_versions must be >= 0 or None")
+        self.keep_versions = (None if keep_versions is None
+                              else int(keep_versions))
         self._lock = threading.Lock()
         self._versions: dict[str, dict[str, ModelVersion]] = {}
         self._journal = None
@@ -229,9 +253,7 @@ class ModelRegistry:
                        for e in vs.values()]
         for e in sorted(entries, key=lambda e: (e.model_id, e.version)):
             self._jrecord("registry_register", model=e.model_id,
-                          version=e.version,
-                          flavor="adapter" if e.base_builder is not None
-                          else "full")
+                          version=e.version, flavor=e.flavor)
             if e.eval_passed is not None:
                 self._jrecord("registry_eval", model=e.model_id,
                               version=e.version, passed=bool(e.eval_passed),
@@ -239,6 +261,9 @@ class ModelRegistry:
             if e.state != "registered":
                 self._jrecord("registry_state", model=e.model_id,
                               version=e.version, state=e.state)
+            if e.evicted:
+                self._jrecord("registry_evict", model=e.model_id,
+                              version=e.version)
 
     def _jrecord(self, kind: str, **fields) -> None:
         if self._journal is not None:
@@ -263,6 +288,10 @@ class ModelRegistry:
                 entry.eval_metrics = ent.get("eval_metrics")
             if ent.get("state") in STATES:
                 entry.state = ent["state"]
+            if ent.get("evicted"):
+                # replay honors evictions: the re-registered payloads are
+                # dropped again (already journaled — don't re-record)
+                self._evict(entry, journal=False)
 
     # -- registration ------------------------------------------------------
     def register(self, model_id: str, version: str, builder=None, *,
@@ -384,8 +413,10 @@ class ModelRegistry:
 
     def promotable(self, model_id: str, version: str) -> bool:
         """True once the version's offline eval passed — the gate
-        :class:`RolloutController` (and ``deploy_model``) enforce."""
-        return bool(self.version(model_id, version).eval_passed)
+        :class:`RolloutController` (and ``deploy_model``) enforce.
+        Evicted versions are never promotable (payloads are gone)."""
+        entry = self.version(model_id, version)
+        return bool(entry.eval_passed) and not entry.evicted
 
     def mark(self, model_id: str, version: str, state: str) -> None:
         if state not in STATES:
@@ -394,6 +425,35 @@ class ModelRegistry:
         self.version(model_id, version).state = state
         self._jrecord("registry_state", model=str(model_id),
                       version=str(version), state=state)
+        if state in ("retired", "rolled_back"):
+            self._enforce_retention(str(model_id))
+
+    # -- retention ---------------------------------------------------------
+    def _evict(self, entry: ModelVersion, journal: bool = True) -> None:
+        entry.evicted = True
+        entry.builder = None
+        entry.base_builder = None
+        entry.adapter = None
+        entry.extra_args = {}
+        if journal:
+            logger.info("retention evicted %s@%s (payloads dropped, "
+                        "lineage kept)", entry.model_id, entry.version)
+            self._jrecord("registry_evict", model=entry.model_id,
+                          version=entry.version)
+
+    def _enforce_retention(self, model_id: str) -> None:
+        """Evict the oldest dead versions beyond ``keep_versions``.
+        Registration order approximates age (``_versions`` is
+        insertion-ordered); live states are never touched."""
+        if self.keep_versions is None:
+            return
+        with self._lock:
+            dead = [e for e in self._versions.get(model_id, {}).values()
+                    if e.state in ("retired", "rolled_back")
+                    and not e.evicted]
+        excess = len(dead) - self.keep_versions
+        for e in dead[:max(0, excess)]:
+            self._evict(e)
 
 
 # ------------------------------------------------------------- rollout

@@ -578,6 +578,31 @@ def fn_write_cache_env(args, ctx):
                                  "MISSING"))
 
 
+def fn_publish_crash_once(args, ctx):
+    """Continual-loop crash-atomicity workload: the first attempt
+    publishes a multi-MB candidate and SIGKILLs itself immediately —
+    the driver's collector is racing that enqueue, so it either never
+    sees the message or dies mid-``get`` on a torn stream; a partial
+    payload must never surface.  The second attempt (sentinel present)
+    publishes a small clean candidate and exits 0.  Payloads are
+    deterministic ``np.full`` so the driver asserts whole-or-nothing."""
+    import signal
+
+    import numpy as np
+
+    from tensorflowonspark_tpu.continual import CheckpointPublisher
+
+    pub = CheckpointPublisher(ctx, args["model"], timeout=30.0)
+    sentinel = os.path.join(ctx.working_dir, "publish-crash-injected")
+    if not os.path.exists(sentinel):
+        with open(sentinel, "w"):
+            pass
+        n = int(args.get("big_elems", 1 << 20))
+        pub.publish(1, {"w": np.full((n,), 1.0, np.float64)})
+        os.kill(os.getpid(), signal.SIGKILL)
+    pub.publish(2, {"w": np.full((8,), 2.0, np.float64)})
+
+
 def batch_predict_scale(model, records, trial_params):
     """Batch-plane scorer over array shards: one bytes record per row,
     scaled by the grid trial's ``scale`` (default 2.0) — deterministic, so

@@ -1,0 +1,870 @@
+"""Continual-learning loop (``continual/`` — docs/continual.md).
+
+Four layers:
+
+- **publisher units** — flatten/diff/digest/replace math, the chief-only
+  delta-only emit (payload bytes ≈ delta, never the base), the
+  ``CheckpointManager`` save-listener hook, and the collector's
+  digest/dedupe/config-error handling;
+- **wire acceptance** — a multi-MB publication round-trips the real
+  queue plane pinned to the BULK tier via the ``tfos_transport_*``
+  counters, and a SIGKILL-mid-publish trainer under ``run_with_recovery``
+  never surfaces a partial candidate (crash-atomicity);
+- **retention units** — ``ModelRegistry(keep_versions=)`` eviction:
+  payloads dropped, lineage kept, evicted versions unservable and
+  unpromotable, journal replay/adopt honoring evictions;
+- **pipeline units** — ``ContinualPipeline`` over the fake-replica
+  world: promote / reject-offline / roll-back outcomes with their
+  journal records, the payload store round-trip, and ``resume`` —
+  a concluded rollout finalizes without re-shifting traffic (no double
+  promotion), stored candidates re-hydrate, lost ones are skipped.
+
+The full train→publish→gate→canary scenario (real clusters, chaos
+driver kill) is ``scripts/bench_continual.py``'s job, wired into
+``ci.sh --bench-smoke``.
+"""
+
+import json
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import metrics as _metrics
+from tensorflowonspark_tpu.continual import (CheckpointPublisher,
+                                             Publication,
+                                             PublicationCollector,
+                                             ContinualPipeline, OfflineEval,
+                                             build_published_full,
+                                             diff_params, flatten_params,
+                                             payload_digest, payload_nbytes,
+                                             replace_leaves)
+from tensorflowonspark_tpu.serving import (ModelRegistry, RolloutError,
+                                           RolloutPolicy)
+from tensorflowonspark_tpu.serving.journal import (ControlPlaneJournal,
+                                                   JournalState)
+
+from tests.test_rollout import (_ModelWorld, _builder, _collect,
+                                _fake_tokens, _scheduler, _tier)
+
+AUTH = b"k" * 16
+
+
+class _RecMgr:
+    """In-process stand-in for the worker's queue server: records puts."""
+
+    def __init__(self):
+        self.sent = []
+
+    def queue_put(self, qname, item, timeout=None):
+        self.sent.append((qname, item))
+
+
+class _Ctx:
+    def __init__(self, chief=True, mgr=None):
+        self.executor_id = 0
+        self.is_chief = chief
+        self.mgr = mgr if mgr is not None else _RecMgr()
+
+
+def _pubs_count(outcome):
+    return _metrics.get_registry().counter(
+        "tfos_continual_publications_total",
+        "Checkpoint publications by ingest outcome.",
+        labelnames=("outcome",)).value(outcome=outcome)
+
+
+def _versions_count(outcome):
+    return _metrics.get_registry().counter(
+        "tfos_continual_versions_total",
+        "Continual-loop candidates by terminal outcome.",
+        labelnames=("outcome",)).value(outcome=outcome)
+
+
+# ------------------------------------------------------ publisher units
+
+
+def test_flatten_diff_digest_replace_roundtrip():
+    base = {"a": {"kernel": np.ones((2, 3), np.float32)},
+            "b": np.zeros((4,), np.float64)}
+    flat = flatten_params(base)
+    assert set(flat) == {"a/kernel", "b"}
+    assert payload_nbytes(flat) == 2 * 3 * 4 + 4 * 8
+
+    params = {"a": {"kernel": base["a"]["kernel"] + 0.5},
+              "b": base["b"]}
+    delta = diff_params(base, params)
+    assert set(delta) == {"a/kernel"}          # unchanged leaves excluded
+    np.testing.assert_allclose(delta["a/kernel"], 0.5)
+    assert diff_params(base, params, atol=1.0) == {}   # below atol: noise
+
+    with pytest.raises(ValueError, match="disagree on paths"):
+        diff_params(base, {"a": {"kernel": np.ones((2, 3))}})
+    with pytest.raises(ValueError, match="shape mismatch"):
+        diff_params(base, {"a": {"kernel": np.ones((3, 2))},
+                           "b": base["b"]})
+
+    # the digest covers dtype AND shape — a reshape never collides
+    d1 = payload_digest({"w": np.arange(6, dtype=np.float32)})
+    d2 = payload_digest({"w": np.arange(6, dtype=np.float32).reshape(2, 3)})
+    d3 = payload_digest({"w": np.arange(6, dtype=np.float64)})
+    assert len({d1, d2, d3}) == 3
+
+    # replace_leaves: full-publication application over the structure
+    rebuilt = replace_leaves(base, flatten_params(params))
+    np.testing.assert_allclose(rebuilt["a"]["kernel"], 1.5)
+    assert rebuilt["a"]["kernel"].dtype == np.float32
+    with pytest.raises(ValueError, match="misses leaf"):
+        replace_leaves(base, {"b": np.zeros((4,))})
+    with pytest.raises(ValueError, match="shape"):
+        replace_leaves(base, {"a/kernel": np.ones((9,)), "b": flat["b"]})
+
+
+def test_build_published_full_replaces_every_leaf():
+    def base_builder(args):
+        return {"cfg": True}, {"w": np.zeros((3,), np.float32)}
+
+    cfg, params = build_published_full(
+        {"serve_base_builder": base_builder,
+         "serve_published_params": {"w": np.full((3,), 7.0)}})
+    assert cfg == {"cfg": True}
+    np.testing.assert_allclose(params["w"], 7.0)
+    assert params["w"].dtype == np.float32     # cast to the base's dtype
+
+
+def test_publisher_is_chief_only_and_ships_delta_not_base():
+    """Satellite: an adapter-flavored publication's payload is the DELTA
+    — a small fraction of the base's bytes — and only the chief emits."""
+    base = {"w": np.zeros((1 << 16,), np.float64),   # 512 KB
+            "b": np.zeros((32,), np.float32)}
+    params = {"w": base["w"], "b": base["b"] + 1.0}
+    ctx = _Ctx()
+    pub = CheckpointPublisher(ctx, "m", base=base,
+                              serve_args={"salt": 9})
+    assert pub.publish(5, params) == "step-5"
+    [(qname, msg)] = ctx.mgr.sent
+    assert qname == "publish" and msg["op"] == "publish"
+    assert msg["flavor"] == "adapter" and msg["version"] == "step-5"
+    assert set(msg["payload"]) == {"b"}
+    np.testing.assert_allclose(msg["payload"]["b"], 1.0)
+    assert msg["digest"] == payload_digest(msg["payload"])
+    base_bytes = payload_nbytes(flatten_params(base))
+    assert msg["nbytes"] * 100 < base_bytes, \
+        f"delta payload {msg['nbytes']}B is not ≪ base {base_bytes}B"
+    # a non-chief worker publishes nothing (orbax saves everywhere, one
+    # candidate per step must emerge)
+    ctx2 = _Ctx(chief=False)
+    assert CheckpointPublisher(ctx2, "m").publish(1, params) is None
+    assert ctx2.mgr.sent == []
+    # and a queue-less context (non-SPARK boot) is a typed config error
+    class _NoQueues:
+        executor_id = 0
+        is_chief = True
+        mgr = None
+
+    with pytest.raises(RuntimeError, match="queue server"):
+        CheckpointPublisher(_NoQueues(), "m")
+
+
+def test_checkpoint_save_listener_fires_and_swallows_errors(tmp_path):
+    """The emit hook (``CheckpointManager.add_save_listener``) fires on
+    successful saves with (step, state); a raising listener is logged
+    and swallowed; bare numpy scalars in the state are normalized for
+    orbax (the pre-existing StandardSave failure)."""
+    from tensorflowonspark_tpu.checkpoint import CheckpointManager
+
+    events, boom = [], []
+    with CheckpointManager(str(tmp_path / "ckpt")) as ckpt:
+        ckpt.add_save_listener(lambda step, state: events.append(step))
+
+        def bad(step, state):
+            boom.append(step)
+            raise RuntimeError("listener boom")
+
+        ckpt.add_save_listener(bad)
+        assert ckpt.save(1, {"step": np.int64(1), "w": np.float32(3.0)},
+                         force=True)
+        ckpt.wait()
+        assert events == [1] and boom == [1]   # both ran; boom swallowed
+        assert not ckpt.save(1, {"step": np.int64(1), "w": np.float32(3.0)})
+        assert events == [1], "a skipped save must not publish"
+        state = ckpt.restore()
+        assert int(state["step"]) == 1 and float(state["w"]) == 3.0
+
+
+def test_publisher_attach_publishes_each_durable_save(tmp_path):
+    from tensorflowonspark_tpu.checkpoint import CheckpointManager
+
+    ctx = _Ctx()
+    pub = CheckpointPublisher(ctx, "m", metadata={"run": "r1"})
+    with CheckpointManager(str(tmp_path / "ckpt")) as ckpt:
+        pub.attach(ckpt, transform=lambda s: s["params"])
+        ckpt.save(3, {"params": {"w": np.ones((4,), np.float32)},
+                      "step": np.int64(3)}, force=True)
+    [(qname, msg)] = ctx.mgr.sent
+    assert msg["version"] == "step-3" and msg["flavor"] == "full"
+    assert msg["metadata"] == {"run": "r1"} and msg["step"] == 3
+    np.testing.assert_array_equal(msg["payload"]["w"],
+                                  np.ones((4,), np.float32))
+
+
+def test_collector_rejects_corrupt_dedupes_and_flags_config(tmp_path):
+    """Collector hygiene over a real queue server: a digest-mismatched
+    (partial/corrupt) message is dropped and counted, a duplicate
+    ``(model, version)`` is dropped, a non-publication message is
+    ignored, and a missing ``publish`` queue is a TYPED config error
+    pointing at ``queues=CONTINUAL_QUEUES``."""
+    from tensorflowonspark_tpu.queues import QueueServer
+
+    server = QueueServer(authkey=AUTH, qnames=("publish",), mode="local",
+                         shm=False)
+    server.start()
+
+    class _Cluster:
+        cluster_info = [{"executor_id": 0, "addr": server.addr,
+                         "authkey": AUTH}]
+        cluster_meta = {"queue_shm": False, "queue_bulk": None}
+
+    try:
+        col = PublicationCollector(_Cluster())
+        payload = {"w": np.arange(8, dtype=np.float32)}
+        good = {"op": "publish", "model": "m", "version": "v1",
+                "flavor": "full", "step": 1, "seq": 0, "src": 0,
+                "serve_args": {}, "metadata": {}, "payload": payload,
+                "digest": payload_digest(payload), "nbytes": 32}
+        corrupt_before = _pubs_count("corrupt")
+        dup_before = _pubs_count("duplicate")
+        server.queue_put("publish", dict(good, digest="0" * 64))
+        server.queue_put("publish", {"op": "gen", "rid": 1})
+        server.queue_put("publish", good)
+        server.queue_put("publish", dict(good))        # duplicate version
+        pubs = col.poll()
+        assert [p.version for p in pubs] == ["v1"]
+        np.testing.assert_array_equal(pubs[0].payload["w"], payload["w"])
+        assert _pubs_count("corrupt") == corrupt_before + 1
+        assert _pubs_count("duplicate") == dup_before + 1
+        # mark_seen pre-seeds the dedupe (the resume path)
+        col.mark_seen("m", "v2")
+        server.queue_put("publish", dict(good, version="v2",
+                                         digest=good["digest"]))
+        assert col.poll() == []
+        col.close()
+    finally:
+        server.stop()
+
+    # a server WITHOUT the publish queue: config error, not a dead worker
+    plain = QueueServer(authkey=AUTH, qnames=("input",), mode="local",
+                        shm=False)
+    plain.start()
+
+    class _Cluster2:
+        cluster_info = [{"executor_id": 0, "addr": plain.addr,
+                         "authkey": AUTH}]
+        cluster_meta = {"queue_shm": False, "queue_bulk": None}
+
+    try:
+        col2 = PublicationCollector(_Cluster2())
+        plain.queue_put("input", "x")      # make qsize server-side valid
+        with pytest.raises(RuntimeError, match="CONTINUAL_QUEUES"):
+            col2.poll()
+        col2.close()
+    finally:
+        plain.stop()
+
+
+# ------------------------------------------- wire acceptance (satellite)
+
+
+def _bulk_rx_bytes():
+    return _metrics.get_registry().counter(
+        "tfos_transport_bytes_total",
+        "Bulk-transport payload bytes by tier and direction.",
+        labelnames=("tier", "dir")).value(tier="bulk", dir="rx")
+
+
+def test_weight_stream_rides_bulk_tier_and_delta_stays_small(monkeypatch):
+    """Acceptance: a multi-MB FULL publication crosses the collector's
+    queue client on the BULK tier (pinned via ``tfos_transport_*``
+    counters, digest-exact on arrival); the follow-up ADAPTER
+    publication moves ≈ the delta's bytes — a small fraction of the
+    base — over the same wire."""
+    from tensorflowonspark_tpu import transport as tp
+    from tensorflowonspark_tpu.queues import QueueServer
+
+    monkeypatch.setenv(tp.MIN_KB_ENV, "1")
+    server = QueueServer(authkey=AUTH, qnames=("publish",), mode="local",
+                         shm=False)
+    server.start()
+
+    class _Cluster:
+        cluster_info = [{"executor_id": 0, "addr": server.addr,
+                         "authkey": AUTH}]
+        cluster_meta = {"queue_shm": False, "queue_bulk": None}
+
+    base = {"w": np.zeros((1 << 19,), np.float64),     # 4 MB
+            "b": np.zeros((1 << 12,), np.float32)}     # 16 KB
+    full = {"w": np.arange(1 << 19, dtype=np.float64),
+            "b": np.full((1 << 12,), 2.0, np.float32)}
+    base_bytes = payload_nbytes(flatten_params(base))
+
+    ctx = _Ctx(mgr=server)
+    try:
+        col = PublicationCollector(_Cluster())
+        # full flavor: every leaf crosses the wire
+        CheckpointPublisher(ctx, "m").publish(1, full)
+        before = _bulk_rx_bytes()
+        [pub_full] = col.poll()
+        rx_full = _bulk_rx_bytes() - before
+        assert col._clients[0].bulk_active and not col._clients[0].shm_active
+        assert rx_full >= base_bytes, \
+            f"full payload must ride bulk: rx {rx_full} < {base_bytes}"
+        assert pub_full.flavor == "full"
+        assert payload_digest(pub_full.payload) == pub_full.digest
+        np.testing.assert_array_equal(pub_full.payload["w"], full["w"])
+
+        # adapter flavor: only the delta's bytes move
+        delta_params = {"w": base["w"], "b": base["b"] + 1.0}
+        CheckpointPublisher(ctx, "m", base=base).publish(2, delta_params)
+        before = _bulk_rx_bytes()
+        [pub_delta] = col.poll()
+        rx_delta = _bulk_rx_bytes() - before
+        assert set(pub_delta.payload) == {"b"}
+        assert rx_delta >= pub_delta.nbytes
+        assert rx_delta < base_bytes // 4, \
+            f"adapter swap moved {rx_delta}B — base-sized, not delta-sized"
+        np.testing.assert_allclose(pub_delta.payload["b"], 1.0)
+        col.close()
+    finally:
+        server.stop()
+
+
+def test_publish_crash_atomicity_no_partial_candidate(tmp_path):
+    """Acceptance (crash-atomicity): attempt 1 publishes a multi-MB
+    candidate and SIGKILLs itself while the driver's collector races the
+    stream — whatever the collector surfaces must be WHOLE (digest-clean,
+    value-exact), never partial; the relaunched attempt's clean publish
+    arrives normally."""
+    from tensorflowonspark_tpu.cluster import run_with_recovery
+    from tensorflowonspark_tpu.continual import CONTINUAL_QUEUES
+    from tests import cluster_funcs
+
+    collected: dict = {}
+    corrupt_before = _pubs_count("corrupt")
+
+    def drive(cluster):
+        col = PublicationCollector(cluster)
+        for ver in collected:
+            col.mark_seen("atom", ver)
+        try:
+            while True:
+                for pub in col.poll():
+                    collected[pub.version] = pub
+                codes = cluster.backend.exitcodes()
+                if codes and all(c is not None for c in codes.values()):
+                    for pub in col.poll():
+                        collected[pub.version] = pub
+                    break
+                time.sleep(0.05)
+        finally:
+            col.close()
+        return set()
+
+    run_with_recovery(cluster_funcs.fn_publish_crash_once,
+                      {"model": "atom", "big_elems": 1 << 20}, 1,
+                      max_restarts=2, queues=CONTINUAL_QUEUES,
+                      driver_fn=drive)
+    # attempt 2's clean candidate arrived intact
+    assert "step-2" in collected, sorted(collected)
+    np.testing.assert_array_equal(collected["step-2"].payload["w"],
+                                  np.full((8,), 2.0, np.float64))
+    # whatever else surfaced is whole-or-nothing — the kill raced the
+    # driver's get, so step-1 may be absent entirely, but never partial
+    for ver, pub in collected.items():
+        assert payload_digest(pub.payload) == pub.digest
+    if "step-1" in collected:
+        np.testing.assert_array_equal(
+            collected["step-1"].payload["w"],
+            np.full((1 << 20,), 1.0, np.float64))
+    assert _pubs_count("corrupt") == corrupt_before, \
+        "a torn stream must surface as a dead connection, not corruption"
+
+
+# ------------------------------------------------------ retention units
+
+
+def test_registry_retention_evicts_payload_keeps_lineage():
+    reg = ModelRegistry(keep_versions=1)
+    with pytest.raises(ValueError, match="keep_versions"):
+        ModelRegistry(keep_versions=-1)
+    reg.register("m", "v1", _builder, serve_args={"salt": 0})
+    reg.register("m", "v2", base=_builder,
+                 adapter={"w": np.ones((2,), np.float32)})
+    reg.register("m", "v3", _builder)
+    for v in ("v1", "v2", "v3"):
+        reg.record_eval("m", v, {"ok": 1}, passed=True)
+    reg.mark("m", "v1", "retired")
+    assert not reg.version("m", "v1").evicted      # 1 dead ≤ keep_versions
+    reg.mark("m", "v2", "rolled_back")
+    e1, e2 = reg.version("m", "v1"), reg.version("m", "v2")
+    assert e1.evicted and not e2.evicted, "oldest dead version evicts"
+    # payloads dropped, lineage kept
+    assert e1.builder is None and e1.state == "retired"
+    d = e1.describe()
+    assert d["evicted"] and d["state"] == "retired" and d["kind"] == "full"
+    assert reg.version("m", "v2").describe()["kind"] == "adapter"
+    # an evicted version can never serve or promote again
+    assert not reg.promotable("m", "v1")
+    with pytest.raises(RolloutError, match="keep_versions"):
+        e1.serve_args()
+    with pytest.raises(RolloutError, match="keep_versions"):
+        e1.swap_payload()
+    # live versions untouched
+    assert reg.promotable("m", "v3")
+    assert reg.version("m", "v3").swap_payload()["builder"] is _builder
+
+
+def test_retention_journal_replay_and_adopt(tmp_path):
+    """Evictions journal (``registry_evict``) and survive both replay
+    paths: a live-bound registry's records and the bind-time snapshot of
+    a pre-bind eviction; ``adopt`` re-evicts on the resumed driver."""
+    path = str(tmp_path / "cp.jsonl")
+    j = ControlPlaneJournal(path)
+    reg = ModelRegistry(keep_versions=0)
+    reg.bind_journal(j)
+    reg.register("m", "v1", _builder)
+    reg.register("m", "v2", _builder)
+    for v in ("v1", "v2"):
+        reg.record_eval("m", v, {}, passed=True)
+    reg.mark("m", "v1", "retired")                # keep 0 → evict now
+    assert reg.version("m", "v1").evicted
+    j.close()
+    st = ControlPlaneJournal.replay(path)
+    assert st.registry[("m", "v1")]["evicted"]
+    assert not st.registry[("m", "v2")]["evicted"]
+
+    # the resumed driver re-registers builders then adopts: the evicted
+    # version must come back evicted (its payload is gone for good)
+    reg2 = ModelRegistry()
+    reg2.register("m", "v1", _builder)
+    reg2.register("m", "v2", _builder)
+    reg2.adopt(st)
+    assert reg2.version("m", "v1").evicted
+    with pytest.raises(RolloutError, match="evicted"):
+        reg2.version("m", "v1").serve_args()
+    assert reg2.promotable("m", "v2")
+
+    # bind-time snapshot: an eviction that happened BEFORE the journal
+    # existed is written into the snapshot
+    path2 = str(tmp_path / "cp2.jsonl")
+    reg3 = ModelRegistry(keep_versions=0)
+    reg3.register("m", "v1", _builder)
+    reg3.record_eval("m", "v1", {}, passed=True)
+    reg3.mark("m", "v1", "retired")
+    j2 = ControlPlaneJournal(path2)
+    reg3.bind_journal(j2)
+    j2.close()
+    assert ControlPlaneJournal.replay(path2).registry[("m", "v1")]["evicted"]
+
+
+# ------------------------------------------------- delta-only swap units
+
+
+def test_adapter_swap_ships_delta_only_without_peer_clone():
+    """Satellite: the hot-swap control message for an ADAPTER version
+    carries the delta and NO peer hint — even when a peer already serves
+    the version — so the worker re-applies the delta over its cached
+    pristine base instead of cloning full params; a full version with a
+    serving peer still gets the peer clone."""
+    world = _ModelWorld(3)
+    reg = ModelRegistry()
+    reg.register("m", "v1", _builder, serve_args={"salt": 0})
+    reg.register("m", "v2", base=_builder,
+                 adapter={"w": np.ones((2,), np.float32)},
+                 serve_args={"salt": 9})
+    reg.register("m", "v3", _builder, serve_args={"salt": 5})
+    for v in ("v2", "v3"):
+        reg.record_eval("m", v, {}, passed=True)
+    s = _scheduler(world, model=("m", "v1")).start()
+    tier = _tier(world, s, registry=reg)
+    try:
+        tier.swap_replica_model(1, "m", "v2")
+        tier.swap_replica_model(2, "m", "v2")  # peer 1 serves v2 already
+        msgs = [i for _, i in world.control if i.get("op") == "model"]
+        assert len(msgs) == 2
+        for msg in msgs:
+            assert msg["peer"] is None, \
+                "adapter swaps must not clone full params from a peer"
+            assert set(msg["adapter"]) == {"w"}
+            assert msg.get("builder") is None
+            # the wire payload is delta-sized, not base-sized
+            assert len(pickle.dumps(msg["adapter"])) < 1024
+        # contrast: a FULL version with a serving peer names the peer
+        world.control.clear()
+        tier.swap_replica_model(1, "m", "v3")
+        tier.swap_replica_model(2, "m", "v3")
+        full_msgs = [i for _, i in world.control if i.get("op") == "model"]
+        assert full_msgs[0]["peer"] is None          # nobody serves v3 yet
+        assert full_msgs[1]["peer"] is not None, \
+            "full swaps should keep the peer-clone fast path"
+        # the swapped gangs actually serve the new versions' outputs
+        s.set_traffic_split("m", {"v3": 100})
+        p = np.asarray([3, 4], np.int32)
+        toks, err = _collect(s.submit(p, 3, model="m"))
+        assert err is None and toks == _fake_tokens(p, 3, 5)
+    finally:
+        s.stop()
+
+
+def test_resolve_version_params_reuses_cached_pristine_base():
+    """Worker side of delta-only: two different deltas over one base
+    build the base ONCE and each apply over the PRISTINE tree (delta2's
+    params show no trace of delta1); a builder-visible serve_args knob
+    invalidates the cache, serve_-prefixed knobs don't."""
+    from tensorflowonspark_tpu.serving.replica import resolve_version_params
+
+    calls = {"n": 0}
+
+    def counting_base(args):
+        calls["n"] += 1
+        return None, {"w": np.zeros((4,), np.float32)}
+
+    cache: dict = {}
+    args = {"batch_size": 1}
+    p1, _ = resolve_version_params(
+        args, {"base_builder": counting_base,
+               "adapter": {"w": np.full((4,), 1.0, np.float32)}},
+        base_cache=cache)
+    assert calls["n"] == 1
+    np.testing.assert_allclose(p1["w"], 1.0)
+    p2, _ = resolve_version_params(
+        args, {"base_builder": counting_base,
+               "adapter": {"w": np.full((4,), 5.0, np.float32)}},
+        base_cache=cache)
+    assert calls["n"] == 1, "second delta must reuse the cached base"
+    np.testing.assert_allclose(p2["w"], 5.0)   # delta2 over PRISTINE base
+    np.testing.assert_allclose(p1["w"], 1.0)   # earlier result untouched
+    # serve_-prefixed overlay keys keep the cache valid
+    p3, _ = resolve_version_params(
+        args, {"base_builder": counting_base, "adapter": {},
+               "serve_args": {"serve_step_delay": 0.0}},
+        base_cache=cache)
+    assert calls["n"] == 1
+    np.testing.assert_allclose(p3["w"], 0.0)
+    # a builder-visible knob (e.g. seed) rebuilds the base
+    resolve_version_params(
+        args, {"base_builder": counting_base, "adapter": {},
+               "serve_args": {"seed": 3}}, base_cache=cache)
+    assert calls["n"] == 2
+
+
+# -------------------------------------------------------- pipeline units
+
+
+class _FakeGridSearch:
+    """Offline-gate stand-in: the real GridSearch boots a batch cluster;
+    these units pin the pipeline's WIRING (trial params carry the
+    candidate, the verdict lands in ``record_eval``) over canned
+    results keyed off the candidate's ``quality`` serve arg.  The real
+    batch-plane path is bench_continual's job."""
+
+    instances: list = []
+
+    def __init__(self, manifest, output_dir, predict_fn, param_grid, **kw):
+        self.manifest = manifest
+        self.output_dir = output_dir
+        self.param_grid = param_grid
+        self.ran = None
+        _FakeGridSearch.instances.append(self)
+
+    def run(self, num_workers):
+        self.ran = num_workers
+        return self
+
+    def trial_results(self, trial_id, decode=False):
+        assert trial_id == "t0"
+        cand = self.param_grid[0]["continual_candidate"]
+        return [float(cand["serve_args"].get("quality", 1.0))] * 4
+
+
+def _eval_spec(tmp_path):
+    return OfflineEval(
+        manifest="unused-manifest", output_dir=str(tmp_path / "eval"),
+        predict_fn=lambda model, records, tp: records,
+        scorer=lambda rs: ({"quality": float(np.mean(rs)), "n": len(rs)},
+                           float(np.mean(rs)) >= 0.5),
+        num_workers=1)
+
+
+def _adapter_pub(version, step, *, quality=1.0, salt=9, model="m"):
+    payload = {"w": np.full((2,), 0.25 * step, np.float32)}
+    return Publication(
+        model=model, version=version, flavor="adapter", step=step,
+        payload=payload, serve_args={"salt": salt, "quality": quality},
+        metadata={"run": "r1"}, digest=payload_digest(payload), src=0,
+        seq=step)
+
+
+def _pipeline_world(tmp_path, monkeypatch, keep_versions=None):
+    monkeypatch.setattr("tensorflowonspark_tpu.batch.gridsearch.GridSearch",
+                        _FakeGridSearch)
+    _FakeGridSearch.instances = []
+    world = _ModelWorld(2)
+    journal = ControlPlaneJournal(str(tmp_path / "cp.jsonl"))
+    reg = ModelRegistry(keep_versions=keep_versions)
+    reg.bind_journal(journal)
+    reg.register("m", "v1", _builder, serve_args={"salt": 0})
+    reg.record_eval("m", "v1", {}, passed=True)
+    s = _scheduler(world, model=("m", "v1"), journal=journal).start()
+    tier = _tier(world, s, registry=reg)
+    return world, reg, s, tier
+
+
+def _bg_load(s, stop):
+    def load():
+        k = 0
+        while not stop.is_set():
+            k += 1
+            try:
+                _collect(s.submit(np.asarray([k % 11 + 1], np.int32), 3,
+                                  model="m"), timeout=5)
+            except Exception:
+                return
+            time.sleep(0.01)
+
+    t = threading.Thread(target=load, daemon=True)
+    t.start()
+    return t
+
+
+def _journal_kinds(tmp_path):
+    with open(str(tmp_path / "cp.jsonl")) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+_POLICY = RolloutPolicy(steps=(50, 100), bake_secs=0.4, min_samples=3,
+                        max_e2e_ratio=None)
+
+
+def test_pipeline_promotes_healthy_candidate_and_journals(
+        tmp_path, monkeypatch):
+    world, reg, s, tier = _pipeline_world(tmp_path, monkeypatch)
+    pipe = ContinualPipeline(tier, "m", base_builder=_builder,
+                             eval_spec=_eval_spec(tmp_path),
+                             policy=_POLICY)
+    promoted_before = _versions_count("promoted")
+    stop = threading.Event()
+    t = _bg_load(s, stop)
+    try:
+        pub = _adapter_pub("step-2", 2)
+        assert pipe.process(pub) == "promoted"
+    finally:
+        stop.set()
+        t.join(5)
+        s.stop()
+    assert reg.version("m", "step-2").state == "serving"
+    assert reg.version("m", "v1").state == "retired"
+    assert s.model_versions("m") == {"step-2": [0, 1]}
+    assert _versions_count("promoted") == promoted_before + 1
+    # the offline gate ran the candidate's trial over the eval manifest
+    [gs] = _FakeGridSearch.instances
+    assert gs.manifest == "unused-manifest" and gs.ran == 1
+    cand = gs.param_grid[0]["continual_candidate"]
+    assert cand["version"] == "step-2" and cand["flavor"] == "adapter"
+    assert reg.version("m", "step-2").eval_metrics["quality"] == 1.0
+    # durable lifecycle: candidate → offline_eval → rollout → done
+    recs = _journal_kinds(tmp_path)
+    cand_recs = [r for r in recs if r["kind"] == "continual_candidate"]
+    assert [r["version"] for r in cand_recs] == ["step-2"]
+    assert cand_recs[0]["digest"] == pub.digest
+    stages = [r["stage"] for r in recs if r["kind"] == "continual_stage"]
+    assert stages == ["offline_eval", "rollout"]
+    [done] = [r for r in recs if r["kind"] == "continual_done"]
+    assert done["outcome"] == "promoted" and done["version"] == "step-2"
+    # the payload store round-trips digest-exact
+    back = pipe.load_publication("step-2")
+    assert back is not None and back.digest == pub.digest
+    np.testing.assert_array_equal(back.payload["w"], pub.payload["w"])
+    assert back.serve_args == pub.serve_args
+    # duplicates and foreign models are dropped, not re-run
+    assert pipe.process(_adapter_pub("step-2", 2)) is None
+    assert pipe.process(_adapter_pub("x", 9, model="other")) is None
+
+
+def test_pipeline_rejects_bad_candidate_offline_never_canaries(
+        tmp_path, monkeypatch):
+    """Acceptance: a data-quality regression is caught at the OFFLINE
+    gate — zero canary traffic, zero swap messages, incumbent untouched."""
+    world, reg, s, tier = _pipeline_world(tmp_path, monkeypatch)
+    pipe = ContinualPipeline(tier, "m", base_builder=_builder,
+                             eval_spec=_eval_spec(tmp_path),
+                             policy=_POLICY)
+    rejected_before = _versions_count("rejected_offline")
+    try:
+        out = pipe.process(_adapter_pub("step-3", 3, quality=0.0))
+        assert out == "rejected_offline"
+    finally:
+        s.stop()
+    assert _versions_count("rejected_offline") == rejected_before + 1
+    entry = reg.version("m", "step-3")
+    assert entry.eval_passed is False and not reg.promotable("m", "step-3")
+    assert [i for _, i in world.control if i.get("op") == "model"] == [], \
+        "a rejected candidate must never touch the serving fleet"
+    assert s.model_versions("m") == {"v1": [0, 1]}
+    [done] = [r for r in _journal_kinds(tmp_path)
+              if r["kind"] == "continual_done"]
+    assert done["outcome"] == "rejected_offline"
+    # without an eval harness, an unscored candidate is rejected too —
+    # never silently promoted
+    pipe2 = ContinualPipeline(tier, "m", base_builder=_builder,
+                              eval_spec=None, policy=_POLICY)
+    assert pipe2.process(_adapter_pub("step-4", 4)) == "rejected_offline"
+
+
+def test_pipeline_rolls_back_runtime_regression(tmp_path, monkeypatch):
+    """Acceptance: a candidate that passes offline (the gate can't see
+    runtime behavior) but errors live is auto-rolled back by the canary
+    gate; the incumbent keeps serving."""
+    world, reg, s, tier = _pipeline_world(tmp_path, monkeypatch)
+    pipe = ContinualPipeline(
+        tier, "m", base_builder=_builder, eval_spec=_eval_spec(tmp_path),
+        policy=RolloutPolicy(steps=(50, 100), bake_secs=0.5, min_samples=1,
+                             max_error_rate=0.2, max_e2e_ratio=None))
+    rolled_before = _versions_count("rolled_back")
+    stop = threading.Event()
+    t = _bg_load(s, stop)
+    try:
+        pub = _adapter_pub("step-5", 5)
+        pub.serve_args["fail"] = True        # live-only regression
+        pub.digest = payload_digest(pub.payload)
+        assert pipe.process(pub) == "rolled_back"
+    finally:
+        stop.set()
+        t.join(5)
+        s.stop()
+    assert _versions_count("rolled_back") == rolled_before + 1
+    assert reg.version("m", "step-5").state == "rolled_back"
+    assert s.model_versions("m") == {"v1": [0, 1]}
+    p = np.asarray([8], np.int32)
+    [done] = [r for r in _journal_kinds(tmp_path)
+              if r["kind"] == "continual_done"]
+    assert done["outcome"] == "rolled_back"
+
+
+def test_resume_finalizes_concluded_rollout_without_retraffic(
+        tmp_path, monkeypatch):
+    """No-double-promotion: the driver died AFTER the rollout concluded
+    but BEFORE ``continual_done`` hit the journal — resume just
+    finalizes the outcome; zero new swap/traffic actions."""
+    world, reg, s, tier = _pipeline_world(tmp_path, monkeypatch)
+    # the pre-kill world: step-2 already promoted (serving), v1 retired
+    reg.register("m", "step-2", base=_builder,
+                 adapter={"w": np.ones((2,), np.float32)},
+                 serve_args={"salt": 9})
+    reg.record_eval("m", "step-2", {"quality": 1.0}, passed=True)
+    reg.mark("m", "step-2", "serving")
+    reg.mark("m", "v1", "retired")
+    state = JournalState.from_records([
+        dict(kind="continual_candidate", model="m", version="step-2",
+             flavor="adapter", step=2, digest="d", src=0),
+        dict(kind="continual_stage", model="m", version="step-2",
+             stage="rollout"),
+        dict(kind="rollout_started", model="m", version="step-2",
+             incumbent="v1", steps=[50, 100]),
+        dict(kind="rollout_step", model="m", version="step-2", percent=50),
+        dict(kind="rollout_step_done", model="m", version="step-2",
+             percent=50),
+        dict(kind="rollout_done", model="m", version="step-2",
+             outcome="promoted"),
+    ])
+    assert ("m", "step-2") in state.open_candidates()
+    pipe = ContinualPipeline(tier, "m", base_builder=_builder,
+                             policy=_POLICY)
+    promoted_before = _versions_count("promoted")
+    try:
+        assert pipe.resume(state) == {("m", "step-2"): "promoted"}
+    finally:
+        s.stop()
+    assert _versions_count("promoted") == promoted_before + 1
+    assert [i for _, i in world.control if i.get("op") == "model"] == [], \
+        "finalizing a concluded rollout must not re-shift traffic"
+    assert reg.version("m", "step-2").state == "serving"
+    [done] = [r for r in _journal_kinds(tmp_path)
+              if r["kind"] == "continual_done"]
+    assert done["outcome"] == "promoted"
+    # a second resume finds nothing open (continual_done closed it):
+    # replaying the REAL journal now folds the done record in
+    recs = [dict(kind="continual_candidate", model="m", version="step-2",
+                 flavor="adapter", step=2, digest="d", src=0),
+            dict(kind="continual_done", model="m", version="step-2",
+                 outcome="promoted")]
+    assert JournalState.from_records(recs).open_candidates() == {}
+
+
+def test_resume_rehydrates_stored_candidate_and_skips_lost(
+        tmp_path, monkeypatch):
+    """A candidate journaled before the kill but absent from the rebuilt
+    registry re-registers from the payload store and finishes its loop;
+    one whose store never made it is skipped (awaiting re-publication),
+    not promoted blind."""
+    world, reg, s, tier = _pipeline_world(tmp_path, monkeypatch)
+    pipe = ContinualPipeline(tier, "m", base_builder=_builder,
+                             eval_spec=_eval_spec(tmp_path),
+                             policy=_POLICY)
+    pub = _adapter_pub("step-7", 7)
+    pipe._store(pub)                       # the pre-kill driver stored it
+    state = JournalState.from_records([
+        dict(kind="continual_candidate", model="m", version="step-7",
+             flavor="adapter", step=7, digest=pub.digest, src=0),
+        dict(kind="continual_stage", model="m", version="step-7",
+             stage="offline_eval"),
+        dict(kind="continual_candidate", model="m", version="step-8",
+             flavor="adapter", step=8, digest="lost", src=0),
+    ])
+    stop = threading.Event()
+    t = _bg_load(s, stop)
+    try:
+        results = pipe.resume(state)
+    finally:
+        stop.set()
+        t.join(5)
+        s.stop()
+    assert results == {("m", "step-7"): "promoted"}
+    assert reg.version("m", "step-7").state == "serving"
+    assert "step-8" not in reg.versions("m"), \
+        "a payload-less candidate must wait for re-publication"
+
+
+def test_resume_restores_journaled_eval_verdict(tmp_path, monkeypatch):
+    """A candidate killed mid-ROLLOUT re-hydrates from the store with its
+    journaled offline verdict restored: the rebuilt registry's adopt()
+    ran before the re-registration and had to skip the eval record, so
+    the pipeline must re-apply it — otherwise the rollout gate
+    (require_eval) refuses its own already-vetted candidate."""
+    world, reg, s, tier = _pipeline_world(tmp_path, monkeypatch)
+    pipe = ContinualPipeline(tier, "m", base_builder=_builder,
+                             eval_spec=_eval_spec(tmp_path),
+                             policy=_POLICY)
+    pub = _adapter_pub("step-9", 9)
+    pipe._store(pub)
+    state = JournalState.from_records([
+        dict(kind="continual_candidate", model="m", version="step-9",
+             flavor="adapter", step=9, digest=pub.digest, src=0),
+        dict(kind="registry_eval", model="m", version="step-9",
+             passed=True, metrics={"quality": 1.0}),
+        dict(kind="continual_stage", model="m", version="step-9",
+             stage="rollout"),
+    ])
+    stop = threading.Event()
+    t = _bg_load(s, stop)
+    try:
+        results = pipe.resume(state)
+    finally:
+        stop.set()
+        t.join(5)
+        s.stop()
+    assert results == {("m", "step-9"): "promoted"}
+    entry = reg.version("m", "step-9")
+    assert entry.eval_passed is True
+    assert entry.eval_metrics == {"quality": 1.0}

@@ -32,9 +32,14 @@
 #                               # promoted fleet-wide, every served output
 #                               # oracle-exact, zero loss)
 #
-# The analysis gate (docs/analysis.md) runs all six project rules plus the
-# exports-drift check against the committed analysis_baseline.json ratchet
-# (which ships EMPTY — new findings fail CI, they don't get grandfathered).
+# The analysis gate (docs/analysis.md) runs all eleven project rules —
+# per-file (closure-capture, jit-purity, lock-discipline, resource-lifecycle,
+# broad-except, metric-naming) plus the cross-file protocol/concurrency/drift
+# set (wire-protocol, journal-kinds, blocking-under-lock, compat-discipline,
+# doc-drift) — and the exports-drift check against the committed
+# analysis_baseline.json ratchet (which ships EMPTY — new findings fail CI,
+# they don't get grandfathered).  The gate also enforces a wall-clock budget:
+# the full repo-wide run must finish in under 30 seconds.
 # The tier-1 command mirrors ROADMAP.md exactly, including the timeout and
 # the DOTS_PASSED accounting, so local runs and the driver agree.
 set -uo pipefail
@@ -43,11 +48,18 @@ REPO="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$REPO"
 
 echo "== tfos-check gate =="
-python scripts/tfos_check.py
+_check_t0=$(date +%s)
+python scripts/tfos_check.py --stats
 rc=$?
+_check_secs=$(( $(date +%s) - _check_t0 ))
 if [ $rc -ne 0 ]; then
     echo "tfos-check gate FAILED (rc=$rc)" >&2
     exit $rc
+fi
+echo "tfos-check wall clock: ${_check_secs}s (budget 30s)"
+if [ "$_check_secs" -ge 30 ]; then
+    echo "tfos-check gate FAILED: ${_check_secs}s exceeds the 30s budget" >&2
+    exit 1
 fi
 
 if [ "${1:-}" = "--check" ]; then

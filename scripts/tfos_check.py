@@ -4,9 +4,10 @@
     python scripts/tfos_check.py [--json] [--baseline analysis_baseline.json] paths...
 
 Thin shim over ``python -m tensorflowonspark_tpu.analysis`` (same flags,
-same exit codes; see docs/analysis.md).  With no arguments it runs the
-repo-wide gate exactly as tier-1 does: whole package + exports-drift check
-against the committed baseline.
+same exit codes; see docs/analysis.md).  With no *path* arguments it runs
+the repo-wide gate exactly as tier-1 does: whole package + exports-drift
+check against the committed baseline — so gate modifiers like ``--stats``
+or ``--jobs 4`` compose with the default gate.
 """
 
 from __future__ import annotations
@@ -19,12 +20,30 @@ sys.path.insert(0, REPO_ROOT)
 
 from tensorflowonspark_tpu.analysis.__main__ import main  # noqa: E402
 
+_FLAGS_WITH_VALUE = {"--baseline", "--rules", "--root", "--jobs"}
+
+
+def _has_path(argv: list[str]) -> bool:
+    expect_value = False
+    for arg in argv:
+        if expect_value:
+            expect_value = False
+        elif arg in _FLAGS_WITH_VALUE:
+            expect_value = True
+        elif not arg.startswith("-"):
+            return True
+    return False
+
+
 if __name__ == "__main__":
     argv = sys.argv[1:]
-    if not argv:  # the gate, as CI runs it
-        argv = ["--exports",
-                "--baseline", os.path.join(REPO_ROOT,
-                                           "analysis_baseline.json"),
-                "--root", REPO_ROOT,
-                os.path.join(REPO_ROOT, "tensorflowonspark_tpu")]
+    if not _has_path(argv):  # the gate, as CI runs it
+        if "--exports" not in argv:
+            argv.append("--exports")
+        if "--baseline" not in argv:
+            argv += ["--baseline",
+                     os.path.join(REPO_ROOT, "analysis_baseline.json")]
+        if "--root" not in argv:
+            argv += ["--root", REPO_ROOT]
+        argv.append(os.path.join(REPO_ROOT, "tensorflowonspark_tpu"))
     sys.exit(main(argv))

@@ -309,7 +309,10 @@ class _AgentConn(MessageSocket):
                     self._sock.close()
                 except OSError:
                     pass
-                time.sleep(self.RETRY_BACKOFF_SECS)
+                # the lock serializes request/response framing on ONE
+                # socket; a waiter could not use the half-reconnected
+                # socket anyway, so backing off under it is the point
+                time.sleep(self.RETRY_BACKOFF_SECS)  # tfos: ignore[blocking-under-lock]
                 self._connect()  # propagates if the agent is really gone
                 resp = self._roundtrip(msg)
         if isinstance(resp, tuple) and resp and resp[0] == "ERR":

@@ -3,13 +3,16 @@ invariants.
 
 Usage (``docs/analysis.md`` has the full rule catalog):
 
-    python -m tensorflowonspark_tpu.analysis [--json] \
+    python -m tensorflowonspark_tpu.analysis [--json] [--jobs N] [--stats] \
         [--baseline analysis_baseline.json] paths...
 
-Six rules encode this codebase's invariants — ``closure-capture``,
-``jit-purity``, ``lock-discipline``, ``resource-lifecycle``,
-``broad-except``, ``metric-naming`` — plus the ``exports-drift``
-docs/API consistency check.
+Eleven rules encode this codebase's invariants.  Per-file:
+``closure-capture``, ``jit-purity``, ``lock-discipline``,
+``resource-lifecycle``, ``broad-except``, ``metric-naming``,
+``blocking-under-lock``, ``compat-discipline``.  Cross-file (indexed per
+file, judged in ``finalize()`` over the whole analyzed set):
+``wire-protocol``, ``journal-kinds``, ``doc-drift`` — plus the
+``exports-drift`` docs/API consistency check.
 The closure-capture invariant is also enforced at runtime by
 :func:`~tensorflowonspark_tpu.analysis.preflight.check_payload`, which
 ``TPUCluster.run`` calls before spawning any worker process.
@@ -19,8 +22,13 @@ in CI gates, at submit time inside ``TPUCluster.run``, and from the
 ``scripts/tfos_check.py`` shim on fresh checkouts.
 """
 
+from tensorflowonspark_tpu.analysis.blocking_under_lock import \
+    BlockingUnderLockRule
 from tensorflowonspark_tpu.analysis.broad_except import BroadExceptRule
 from tensorflowonspark_tpu.analysis.closure_capture import ClosureCaptureRule
+from tensorflowonspark_tpu.analysis.compat_discipline import \
+    CompatDisciplineRule
+from tensorflowonspark_tpu.analysis.doc_drift import DocDriftRule
 from tensorflowonspark_tpu.analysis.engine import (Finding, Rule,  # noqa: F401
                                                    analyze_paths,
                                                    analyze_source,
@@ -28,10 +36,12 @@ from tensorflowonspark_tpu.analysis.engine import (Finding, Rule,  # noqa: F401
                                                    new_findings,
                                                    write_baseline)
 from tensorflowonspark_tpu.analysis.jit_purity import JitPurityRule
+from tensorflowonspark_tpu.analysis.journal_kinds import JournalKindsRule
 from tensorflowonspark_tpu.analysis.lock_discipline import LockDisciplineRule
 from tensorflowonspark_tpu.analysis.metric_naming import MetricNamingRule
 from tensorflowonspark_tpu.analysis.resource_lifecycle import \
     ResourceLifecycleRule
+from tensorflowonspark_tpu.analysis.wire_protocol import WireProtocolRule
 
 ALL_RULES = [
     ClosureCaptureRule,
@@ -40,6 +50,11 @@ ALL_RULES = [
     ResourceLifecycleRule,
     BroadExceptRule,
     MetricNamingRule,
+    WireProtocolRule,
+    JournalKindsRule,
+    BlockingUnderLockRule,
+    CompatDisciplineRule,
+    DocDriftRule,
 ]
 
 RULE_IDS = tuple(r.id for r in ALL_RULES)
@@ -47,6 +62,8 @@ RULE_IDS = tuple(r.id for r in ALL_RULES)
 __all__ = [
     "ALL_RULES", "RULE_IDS", "Finding", "Rule", "analyze_paths",
     "analyze_source", "load_baseline", "new_findings", "write_baseline",
-    "BroadExceptRule", "ClosureCaptureRule", "JitPurityRule",
-    "LockDisciplineRule", "MetricNamingRule", "ResourceLifecycleRule",
+    "BlockingUnderLockRule", "BroadExceptRule", "ClosureCaptureRule",
+    "CompatDisciplineRule", "DocDriftRule", "JitPurityRule",
+    "JournalKindsRule", "LockDisciplineRule", "MetricNamingRule",
+    "ResourceLifecycleRule", "WireProtocolRule",
 ]

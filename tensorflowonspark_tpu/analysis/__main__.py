@@ -2,7 +2,8 @@
 
     python -m tensorflowonspark_tpu.analysis [--json] \
         [--baseline analysis_baseline.json] [--write-baseline] \
-        [--rules closure-capture,broad-except] [--exports] paths...
+        [--rules closure-capture,broad-except] [--exports] \
+        [--jobs N] [--stats] paths...
 
 Exit codes: 0 clean (or all findings grandfathered by the baseline),
 1 new findings, 2 usage error.  Default paths: the installed
@@ -57,7 +58,15 @@ def main(argv: list[str] | None = None) -> int:
                         help="path-relativization root (default: the "
                              "checkout root when paths are defaulted — so "
                              "baseline keys match from any cwd — else cwd)")
+    parser.add_argument("--jobs", type=int, default=1, metavar="N",
+                        help="check files in N parallel worker processes "
+                             "(cross-file rule state is merged before "
+                             "finalize — results match --jobs 1)")
+    parser.add_argument("--stats", action="store_true",
+                        help="print per-rule wall-clock timing to stderr")
     args = parser.parse_args(argv)
+    if args.jobs < 1:
+        parser.error("--jobs must be >= 1")
 
     rules = None
     if args.rules:
@@ -72,7 +81,16 @@ def main(argv: list[str] | None = None) -> int:
         args.root or (os.getcwd() if args.paths else _package_root()))
     paths = args.paths or [os.path.join(_package_root(),
                                         "tensorflowonspark_tpu")]
-    findings = analyze_paths(paths, rules=rules, root=root)
+    stats: dict[str, float] = {}
+    findings = analyze_paths(paths, rules=rules, root=root, jobs=args.jobs,
+                             stats=stats if args.stats else None)
+    if args.stats:
+        total = sum(stats.values())
+        for rule_id, secs in sorted(stats.items(), key=lambda kv: -kv[1]):
+            print(f"stats: {rule_id:24s} {secs * 1000:9.1f} ms",
+                  file=sys.stderr)
+        print(f"stats: {'TOTAL':24s} {total * 1000:9.1f} ms "
+              f"(jobs={args.jobs})", file=sys.stderr)
     if args.exports:
         findings = sorted(findings + check_exports(_package_root()),
                           key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,158 @@
+"""Rule ``blocking-under-lock``: calls that can block indefinitely while a
+``self.<lock>`` is held.
+
+A lock in this codebase protects scheduler routing tables, the shm
+segment ring, journal append order — state that every worker thread
+touches on its hot path.  A blocking syscall inside the critical section
+(``sock.recv`` waiting on a peer, ``thread.join()`` with no timeout,
+``queue.get()`` with no timeout, a subprocess, a sleep) turns one slow
+peer into a whole-process stall: every thread contending for that lock
+wedges behind the call, and the heartbeat thread wedging is what the
+health monitor then reports as a *hang* — the worst failure mode to
+debug because the guilty frame is long gone.
+
+This extends ``lock-discipline``'s region tracking: the same
+``with self.<lock>:`` walk, the same lock-attr recognition
+(constructor-assigned or lock-ish name segments), the same explicit
+``acquire()``/``release()`` bracketing, and the same *lock-held-by-caller*
+docstring convention — a method whose docstring says "lock held" is
+analyzed as if the lock were held throughout.
+
+The blocking catalog is deliberate, not exhaustive:
+
+- ``time.sleep`` / bare ``sleep``;
+- ``os.fsync`` (a durability point: fine on a dedicated writer, a stall
+  bomb on a shared structural lock — intentional sites carry a reasoned
+  ``# tfos: ignore[blocking-under-lock]``);
+- socket ops ``recv``/``recv_into``/``recvfrom``/``accept``/``connect``;
+- ``subprocess.run/Popen/check_call/check_output/call``;
+- ``.get()`` with no args and no ``timeout=`` on a queue-shaped receiver
+  (name segments like ``q``/``queue``/``inbox``: ``dict.get`` always
+  takes an argument, and snapshot accessors like ``reservations.get()``
+  are not dequeues — the receiver name is what disambiguates);
+- ``.join()`` with no args and no ``timeout=`` (thread-shaped:
+  ``str.join`` always takes the iterable argument).
+
+``Condition.wait`` is deliberately NOT in the catalog — it releases the
+lock it waits on; flagging it would outlaw the condition-variable idiom
+the scheduler's dispatch loop is built on.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tensorflowonspark_tpu.analysis.engine import (
+    FileContext, Finding, Rule, terminal_name as _terminal_name)
+from tensorflowonspark_tpu.analysis.lock_discipline import (
+    LockDisciplineRule, _self_attr)
+
+_SOCKET_METHODS = {"recv", "recv_into", "recvfrom", "accept", "connect"}
+_SUBPROCESS_METHODS = {"run", "Popen", "check_call", "check_output", "call"}
+_QUEUE_SEGMENTS = {"q", "queue", "queues", "inbox", "outbox", "fifo",
+                   "mailbox"}
+
+
+def _queueish(name: str | None) -> bool:
+    if not name:
+        return False
+    return any(seg in _QUEUE_SEGMENTS
+               for seg in name.lower().split("_") if seg)
+
+
+def _blocking_desc(node: ast.Call) -> str | None:
+    """Human-facing description of why this call blocks, or None."""
+    func = node.func
+    name = _terminal_name(func)
+    if name == "sleep":
+        return "sleep()"
+    if name == "fsync":
+        return "os.fsync()"
+    if name == "Popen":
+        return "subprocess.Popen()"
+    if not isinstance(func, ast.Attribute):
+        return None
+    recv = func.value
+    recv_name = recv.id if isinstance(recv, ast.Name) else (
+        recv.attr if isinstance(recv, ast.Attribute) else None)
+    if recv_name == "subprocess" and func.attr in _SUBPROCESS_METHODS:
+        return f"subprocess.{func.attr}()"
+    if func.attr in _SOCKET_METHODS:
+        return f".{func.attr}()"
+    untimed = not node.args \
+        and not any(kw.arg == "timeout" for kw in node.keywords)
+    if func.attr == "join" and untimed:
+        return ".join() with no timeout"
+    if func.attr == "get" and untimed and _queueish(recv_name):
+        return ".get() with no timeout"
+    return None
+
+
+class BlockingUnderLockRule(Rule):
+    id = "blocking-under-lock"
+    description = ("blocking calls (socket recv/accept/connect, untimed "
+                   "join/get, fsync, subprocess, sleep) inside "
+                   "`with self._lock:` bodies")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for cls in ctx.nodes(ast.ClassDef):
+            findings.extend(self._check_class(cls, ctx))
+        return findings
+
+    def _check_class(self, cls: ast.ClassDef,
+                     ctx: FileContext) -> list[Finding]:
+        lock_attrs = LockDisciplineRule._lock_attrs(cls)
+        findings: list[Finding] = []
+        for m in cls.body:
+            if isinstance(m, ast.FunctionDef):
+                findings.extend(self._check_method(cls.name, m, lock_attrs,
+                                                   ctx))
+        return findings
+
+    def _check_method(self, cls_name: str, m: ast.FunctionDef,
+                      lock_attrs: set[str],
+                      ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        doc = " ".join((ast.get_docstring(m) or "").lower().split())
+        caller_locked = "lock held" in doc
+        ranges = LockDisciplineRule._acquire_release_ranges(m, lock_attrs)
+
+        def report(node: ast.Call, desc: str, lock: str) -> None:
+            where = lock if lock.startswith("<") else f"self.{lock}"
+            findings.append(ctx.finding(
+                self.id, node,
+                f"{cls_name}.{m.name} blocks on {desc} while holding "
+                f"{where} — every thread contending for that lock "
+                "stalls behind this call"))
+
+        def walk(node: ast.AST, held: list[str]) -> None:
+            if isinstance(node, ast.With):
+                acquired = [
+                    lock for item in node.items
+                    if (lock := LockDisciplineRule._acquired_lock(
+                        item.context_expr, lock_attrs))]
+                for child in node.body:
+                    walk(child, held + acquired)
+                return
+            if isinstance(node, ast.Call):
+                in_range = any(a < getattr(node, "lineno", 0) <= b
+                               for a, b in ranges)
+                locks = list(held)
+                if in_range and not locks:
+                    locks = ["<lock>"]
+                if locks:
+                    desc = _blocking_desc(node)
+                    if desc is not None:
+                        report(node, desc, locks[-1])
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                walk(child, held)
+
+        base = ["<caller's lock (docstring: lock held)>"] \
+            if caller_locked else []
+        for stmt in m.body:
+            walk(stmt, base)
+        return findings

@@ -79,9 +79,7 @@ class BroadExceptRule(Rule):
 
     def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
         findings: list[Finding] = []
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
+        for node in ctx.nodes(ast.ExceptHandler):
             broad = _broad_name(node.type)
             if broad is None or _handles(node):
                 continue

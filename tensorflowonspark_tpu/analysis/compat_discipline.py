@@ -1,0 +1,105 @@
+"""Rule ``compat-discipline``: jax symbols shimmed by ``compat.py`` must be
+reached THROUGH the shim, never raw.
+
+The ROADMAP's porting rule ("all jax-version drift is absorbed in one
+seam") lived only in prose until now: ``compat.py`` wraps every symbol
+that moved or changed shape across the jax versions this repo straddles —
+``shard_map`` (``jax.shard_map`` vs ``jax.experimental.shard_map``),
+``jax.lax.axis_size``/``pcast`` (absent on older jax), ``jax.typeof``
+(the vma/varying-axes probe behind ``vma_of``/``has_vma``).  A raw
+reference outside ``compat.py`` compiles fine on one jax and crashes at
+import time on another — exactly the class of breakage a static rule
+catches at review time and a test matrix only catches per-version.
+
+Detection is reference-shaped, not name-shaped: ``from
+tensorflowonspark_tpu.compat import shard_map`` and calling the local
+``shard_map(...)`` is the BLESSED idiom and never flagged; what is
+flagged is any import of a shimmed symbol from a ``jax``-rooted module
+and any ``jax.<sym>`` / ``jax.experimental...<sym>`` / ``lax.<sym>``
+attribute chain outside ``compat.py`` itself.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tensorflowonspark_tpu.analysis.engine import FileContext, Finding, Rule
+
+#: shimmed symbol -> the compat seam callers must use instead
+_SHIMMED = {
+    "shard_map": "compat.shard_map",
+    "axis_size": "compat.axis_size",
+    "pcast": "compat.pcast",
+    "typeof": "compat.vma_of/compat.has_vma",
+}
+
+#: attribute-chain roots that mean "raw jax", per symbol: ``lax`` only
+#: shims lax members (a local variable named ``jax`` is not a thing in
+#: this codebase; a local ``lax`` always is ``jax.lax``)
+_ROOTS = {
+    "shard_map": {"jax"},
+    "axis_size": {"jax", "lax"},
+    "pcast": {"jax", "lax"},
+    "typeof": {"jax"},
+}
+
+
+def _attr_chain(node: ast.Attribute) -> str | None:
+    """Dotted source of an attribute chain rooted at a Name
+    (``jax.experimental.shard_map`` -> that string), else None."""
+    parts: list[str] = []
+    cur: ast.expr = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if not isinstance(cur, ast.Name):
+        return None
+    parts.append(cur.id)
+    return ".".join(reversed(parts))
+
+
+class CompatDisciplineRule(Rule):
+    id = "compat-discipline"
+    description = ("jax symbols shimmed by compat.py (shard_map, axis_size, "
+                   "pcast, typeof) referenced raw outside compat.py")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
+        if ctx.path.endswith("compat.py"):
+            return []
+        findings: list[Finding] = []
+        for node in ctx.nodes(ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax" or mod.startswith("jax."):
+                for alias in node.names:
+                    if alias.name in _SHIMMED:
+                        findings.append(ctx.finding(
+                            self.id, node,
+                            f"imports '{alias.name}' from '{mod}' — use "
+                            f"{_SHIMMED[alias.name]} (the one seam absorbing "
+                            "jax-version drift; ROADMAP porting rule)"))
+        for node in ctx.nodes(ast.Import):
+            for alias in node.names:
+                last = alias.name.rsplit(".", 1)[-1]
+                if alias.name.startswith("jax.") and last in _SHIMMED:
+                    findings.append(ctx.finding(
+                        self.id, node,
+                        f"imports '{alias.name}' — use {_SHIMMED[last]} "
+                        "(the one seam absorbing jax-version drift)"))
+        seen: set[tuple[int, str]] = set()
+        for node in ctx.nodes(ast.Attribute):
+            if node.attr not in _SHIMMED:
+                continue
+            chain = _attr_chain(node)
+            if chain is None:
+                continue
+            root = chain.split(".", 1)[0]
+            key = (getattr(node, "lineno", 0), node.attr)
+            # `jax.experimental.shard_map.shard_map` nests two matching
+            # Attribute nodes on one line — report the reference once
+            if root in _ROOTS[node.attr] and key not in seen:
+                seen.add(key)
+                findings.append(ctx.finding(
+                    self.id, node,
+                    f"raw '{chain}' reference — use {_SHIMMED[node.attr]} "
+                    "(the one seam absorbing jax-version drift)"))
+        return findings

@@ -1,0 +1,190 @@
+"""Rule ``doc-drift``: code-defined catalogs vs their documented tables.
+
+Generalizes the PR-4 ``exports-drift`` pass (``analysis/exports.py``)
+from one hard-coded pair (package ``__init__`` vs ``docs/api.md``) to the
+two catalogs that have actually drifted since:
+
+- **metrics**: every ``tfos_*`` family registered through the telemetry
+  plane (``reg.counter/gauge/histogram("...")`` on a registry receiver,
+  or a ``Counter``/``Gauge``/``Histogram`` constructor imported from
+  :mod:`tensorflowonspark_tpu.metrics` — the same receiver discipline as
+  the ``metric-naming`` rule, so a third-party client never counts) must
+  appear in the ``docs/observability.md`` catalog, and every ``tfos_*``
+  name in that catalog's table must still be registered somewhere;
+- **chaos verbs**: the ``VERBS`` tuple in ``chaos.py`` vs the
+  ``verb = kill | term | ...`` grammar line in ``docs/robustness.md``.
+
+Anchoring is content-shaped so fixtures work without the real repo: the
+metric directions arm only when the analyzed set contains the telemetry
+plane itself (a file defining ``validate_name``) and the chaos
+directions only when it contains a module-level ``VERBS`` string tuple.
+Docs are resolved against the run root (``FileContext.root``) — the
+repo-wide gate anchors both; a fixture directory anchors neither unless
+the fixture ships its own mini catalog.  Stale-doc-row reporting
+additionally requires at least one registration seen, so analyzing a
+single doc-anchored file can't declare the whole catalog stale.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tensorflowonspark_tpu.analysis.engine import FileContext, Finding, Rule
+from tensorflowonspark_tpu.analysis.metric_naming import (
+    _CONSTRUCTORS, _METHODS, _is_registry_call, _metrics_constructor_imports,
+    _registry_bindings)
+
+#: metric names anywhere in the doc (prose counts as "documented")
+_DOC_METRIC_RE = re.compile(r"`(tfos_[a-z0-9_]+)`")
+#: catalog table rows: the names the stale-row direction checks
+_DOC_ROW_RE = re.compile(r"^\|\s*`(tfos_[a-z0-9_]+)`", re.MULTILINE)
+#: the chaos grammar production in docs/robustness.md
+_DOC_VERB_RE = re.compile(r"^verb\s*=\s*(.+)$", re.MULTILINE)
+
+_OBSERVABILITY_DOC = os.path.join("docs", "observability.md")
+_ROBUSTNESS_DOC = os.path.join("docs", "robustness.md")
+
+
+class DocDriftRule(Rule):
+    id = "doc-drift"
+    description = ("tfos_* metric families vs the docs/observability.md "
+                   "catalog; chaos.VERBS vs the docs/robustness.md grammar")
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        #: metric name -> first (path, line) registering it
+        self._metrics: dict[str, tuple[str, int]] = {}
+        #: set when the telemetry plane itself (validate_name) is analyzed
+        self._metrics_anchor: str | None = None
+        #: (verbs tuple, path, line) from a module-level VERBS assignment
+        self._verbs: tuple[tuple[str, ...], str, int] | None = None
+        self._root: str | None = None
+
+    def export_state(self):
+        return (self._metrics, self._metrics_anchor, self._verbs, self._root)
+
+    def merge_state(self, state) -> None:
+        metrics, anchor, verbs, root = state
+        for k, v in metrics.items():
+            # smallest (path, line) per name: file-order independent, so
+            # --jobs N merges match the serial run
+            if k not in self._metrics or v < self._metrics[k]:
+                self._metrics[k] = v
+        if anchor is not None and (self._metrics_anchor is None
+                                   or anchor < self._metrics_anchor):
+            self._metrics_anchor = anchor
+        if verbs is not None and (self._verbs is None
+                                  or verbs[1:] < self._verbs[1:]):
+            self._verbs = verbs
+        self._root = self._root or root
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
+        self._root = ctx.root
+        for fn in ctx.nodes(ast.FunctionDef):
+            if fn.name == "validate_name" and (
+                    self._metrics_anchor is None
+                    or ctx.path < self._metrics_anchor):
+                self._metrics_anchor = ctx.path
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "VERBS" \
+                    and isinstance(node.value, ast.Tuple) \
+                    and all(isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                            for e in node.value.elts):
+                verbs = (tuple(e.value for e in node.value.elts),
+                         ctx.path, node.lineno)
+                if self._verbs is None or verbs[1:] < self._verbs[1:]:
+                    self._verbs = verbs
+        constructors = _metrics_constructor_imports(ctx)
+        reg_names, factories = _registry_bindings(ctx)
+        for node in ctx.nodes(ast.Call):
+            if not node.args:
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                    and first.value.startswith("tfos_")):
+                continue
+            func = node.func
+            registered = False
+            if isinstance(func, ast.Attribute) and func.attr in _METHODS:
+                recv = func.value
+                if isinstance(recv, ast.Name) and recv.id in reg_names \
+                        or _is_registry_call(recv, factories):
+                    registered = True
+            elif isinstance(func, ast.Name) and func.id in constructors:
+                registered = True
+            if registered:
+                site = (ctx.path, node.lineno)
+                if first.value not in self._metrics \
+                        or site < self._metrics[first.value]:
+                    self._metrics[first.value] = site
+        return []
+
+    def _read_doc(self, relpath: str) -> str | None:
+        if self._root is None:
+            return None
+        try:
+            with open(os.path.join(self._root, relpath),
+                      encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def finalize(self) -> list[Finding]:
+        findings: list[Finding] = []
+        if self._metrics_anchor is not None:
+            doc = self._read_doc(_OBSERVABILITY_DOC)
+            if doc is None:
+                findings.append(Finding(
+                    self.id, self._metrics_anchor, 1,
+                    f"telemetry plane analyzed but {_OBSERVABILITY_DOC} is "
+                    "unreadable — the metrics catalog cannot be checked"))
+            else:
+                documented = set(_DOC_METRIC_RE.findall(doc))
+                for name, (path, line) in sorted(self._metrics.items()):
+                    if name not in documented:
+                        findings.append(Finding(
+                            self.id, path, line,
+                            f"metric '{name}' is registered here but missing "
+                            f"from the {_OBSERVABILITY_DOC} catalog"))
+                if self._metrics:
+                    for name in sorted(set(_DOC_ROW_RE.findall(doc))
+                                       - set(self._metrics)):
+                        findings.append(Finding(
+                            self.id, self._metrics_anchor, 1,
+                            f"{_OBSERVABILITY_DOC} catalog row '{name}' "
+                            "names a metric no analyzed code registers — "
+                            "stale row"))
+        if self._verbs is not None:
+            verbs, path, line = self._verbs
+            doc = self._read_doc(_ROBUSTNESS_DOC)
+            if doc is None:
+                findings.append(Finding(
+                    self.id, path, line,
+                    f"chaos VERBS analyzed but {_ROBUSTNESS_DOC} is "
+                    "unreadable — the chaos grammar cannot be checked"))
+            else:
+                m = _DOC_VERB_RE.search(doc)
+                doc_verbs = tuple(
+                    v.strip() for v in m.group(1).split("|")) if m else ()
+                for v in verbs:
+                    if v not in doc_verbs:
+                        findings.append(Finding(
+                            self.id, path, line,
+                            f"chaos verb '{v}' is in VERBS but missing from "
+                            f"the {_ROBUSTNESS_DOC} grammar table"))
+                for v in doc_verbs:
+                    if v and v not in verbs:
+                        findings.append(Finding(
+                            self.id, path, line,
+                            f"{_ROBUSTNESS_DOC} grammar lists verb '{v}' "
+                            "that chaos.VERBS does not define — stale "
+                            "grammar row"))
+        return findings

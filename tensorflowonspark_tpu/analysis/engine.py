@@ -31,6 +31,7 @@ import dataclasses
 import json
 import os
 import re
+import time
 from collections import Counter
 
 __all__ = [
@@ -74,13 +75,38 @@ class Finding:
 
 
 class FileContext:
-    """Per-file state shared by every rule: source, parsed tree, path."""
+    """Per-file state shared by every rule: source, parsed tree, path.
 
-    def __init__(self, path: str, source: str, tree: ast.Module):
+    ``root`` is the absolute path-relativization root of the run — rules
+    whose invariants live partly outside python (``doc-drift`` reading
+    ``docs/``) resolve companion files against it.
+    """
+
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 root: str | None = None):
         self.path = path
         self.source = source
         self.tree = tree
+        self.root = root or os.getcwd()
         self._symtable = None
+        self._node_index: dict[type, list] | None = None
+
+    def nodes(self, *types: type) -> list:
+        """All nodes of the given AST types, from ONE shared whole-tree
+        walk cached on the context — the engine walks each file once and
+        every rule indexes into it, instead of eleven rules each paying
+        their own ``ast.walk`` over the same tree."""
+        if self._node_index is None:
+            index: dict[type, list] = {}
+            for node in ast.walk(self.tree):
+                index.setdefault(type(node), []).append(node)
+            self._node_index = index
+        if len(types) == 1:
+            return self._node_index.get(types[0], [])
+        out: list = []
+        for t in types:
+            out.extend(self._node_index.get(t, ()))
+        return out
 
     def finding(self, rule_id: str, node: ast.AST, message: str) -> Finding:
         return Finding(rule_id, self.path, getattr(node, "lineno", 0), message)
@@ -121,6 +147,16 @@ class Rule:
     def finalize(self) -> list[Finding]:
         """Cross-file findings, emitted after every file was checked."""
         return []
+
+    def export_state(self):
+        """Picklable cross-file state accumulated by ``check`` — what a
+        ``--jobs N`` worker ships back to the parent so ``finalize`` runs
+        over the union.  Rules without cross-file state return None."""
+        return None
+
+    def merge_state(self, state) -> None:
+        """Fold one worker's :meth:`export_state` payload into this
+        instance (parent side of the ``--jobs`` protocol)."""
 
 
 def parse_suppressions(source: str) -> dict[int, set[str]]:
@@ -190,14 +226,16 @@ def _default_rules() -> list[Rule]:
 
 
 def analyze_source(source: str, path: str,
-                   rules: list[Rule] | None = None) -> list[Finding]:
+                   rules: list[Rule] | None = None,
+                   root: str | None = None) -> list[Finding]:
     """Analyze one in-memory source (unit-fixture entry point).  Runs
     per-file checks AND finalizers, so single-file lock-order cycles
     surface too."""
     rules = rules if rules is not None else _default_rules()
     for rule in rules:
         rule.reset()
-    findings, supp = _check_one(source, path, rules)
+    findings, supp = _check_one(source, path, rules,
+                                os.path.abspath(root or os.getcwd()))
     for rule in rules:
         findings.extend(rule.finalize())
     findings = [f for f in findings if not _suppressed(f, {path: supp})]
@@ -205,10 +243,21 @@ def analyze_source(source: str, path: str,
 
 
 def analyze_paths(paths, rules: list[Rule] | None = None,
-                  root: str | None = None) -> list[Finding]:
+                  root: str | None = None, jobs: int = 1,
+                  stats: dict[str, float] | None = None) -> list[Finding]:
     """Analyze files/directories; paths in findings are relative to
     ``root`` (default: cwd) with posix separators, so the baseline is
-    stable across checkouts."""
+    stable across checkouts.
+
+    ``jobs > 1`` checks files across that many worker processes: each
+    worker runs fresh rule instances over its files and ships findings +
+    per-rule cross-file state back, the parent merges the state
+    (:meth:`Rule.merge_state`) and runs every ``finalize`` over the
+    union — so cross-file rules see exactly what a serial run sees.
+    ``stats``, when given a dict, accumulates per-rule wall seconds
+    (summed across workers, so under ``--jobs`` it is aggregate CPU
+    cost, not critical-path time).
+    """
     rules = rules if rules is not None else _default_rules()
     for rule in rules:
         rule.reset()
@@ -225,35 +274,107 @@ def analyze_paths(paths, rules: list[Rule] | None = None,
                 "read-error", rel, 0,
                 "path does not exist (or is not a .py file or directory) — "
                 "nothing was analyzed for it"))
+    files = []
     for fpath in iter_py_files(paths):
         rel = os.path.relpath(os.path.abspath(fpath), root).replace(os.sep, "/")
-        try:
-            with open(fpath, encoding="utf-8") as f:
-                source = f.read()
-        except (OSError, UnicodeDecodeError) as e:
-            findings.append(Finding("read-error", rel, 0, str(e)))
-            continue
-        file_findings, file_supp = _check_one(source, rel, rules)
-        findings.extend(file_findings)
-        supp[rel] = file_supp
+        files.append((fpath, rel))
+    if jobs > 1 and len(files) > 1:
+        findings.extend(_check_parallel(files, rules, root, jobs, stats, supp))
+    else:
+        for fpath, rel in files:
+            try:
+                with open(fpath, encoding="utf-8") as f:
+                    source = f.read()
+            except (OSError, UnicodeDecodeError) as e:
+                findings.append(Finding("read-error", rel, 0, str(e)))
+                continue
+            file_findings, file_supp = _check_one(source, rel, rules, root,
+                                                  stats)
+            findings.extend(file_findings)
+            supp[rel] = file_supp
     for rule in rules:
+        t0 = time.perf_counter()
         findings.extend(rule.finalize())
+        if stats is not None:
+            stats[rule.id] = stats.get(rule.id, 0.0) + \
+                (time.perf_counter() - t0)
     findings = [f for f in findings if not _suppressed(f, supp)]
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 
-def _check_one(source: str, rel: str,
-               rules: list[Rule]) -> tuple[list[Finding], dict[int, set[str]]]:
+def _check_one(source: str, rel: str, rules: list[Rule], root: str,
+               stats: dict[str, float] | None = None
+               ) -> tuple[list[Finding], dict[int, set[str]]]:
     findings: list[Finding] = []
     try:
         tree = ast.parse(source, filename=rel)
     except SyntaxError as e:
         return ([Finding("syntax-error", rel, e.lineno or 0, e.msg or str(e))],
                 {})
-    ctx = FileContext(rel, source, tree)
+    ctx = FileContext(rel, source, tree, root=root)
     for rule in rules:
+        t0 = time.perf_counter()
         findings.extend(rule.check(tree, ctx))
+        if stats is not None:
+            stats[rule.id] = stats.get(rule.id, 0.0) + \
+                (time.perf_counter() - t0)
     return findings, parse_suppressions(source)
+
+
+def _check_batch(args):
+    """``--jobs`` worker: check one batch of files with FRESH rule
+    instances and return everything picklable the parent needs —
+    findings, suppressions, per-rule timings, and each rule's exported
+    cross-file state (merged parent-side before ``finalize``)."""
+    file_batch, rule_classes, root = args
+    rules = [cls() for cls in rule_classes]
+    for rule in rules:
+        rule.reset()
+    findings: list[Finding] = []
+    supp: dict[str, dict[int, set[str]]] = {}
+    stats: dict[str, float] = {}
+    for fpath, rel in file_batch:
+        try:
+            with open(fpath, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding("read-error", rel, 0, str(e)))
+            continue
+        file_findings, file_supp = _check_one(source, rel, rules, root, stats)
+        findings.extend(file_findings)
+        supp[rel] = file_supp
+    states = [rule.export_state() for rule in rules]
+    return findings, supp, stats, states
+
+
+def _check_parallel(files, rules: list[Rule], root: str, jobs: int,
+                    stats: dict[str, float] | None,
+                    supp: dict[str, dict[int, set[str]]]) -> list[Finding]:
+    """Fan the file list over ``jobs`` processes in contiguous batches
+    (deterministic assignment — findings are sorted at the end anyway,
+    but batch shape should not depend on pool scheduling)."""
+    import multiprocessing
+
+    jobs = max(1, min(jobs, len(files)))
+    batches = [files[i::jobs] for i in range(jobs)]
+    rule_classes = [type(r) for r in rules]
+    ctx = multiprocessing.get_context(
+        "fork" if "fork" in multiprocessing.get_all_start_methods()
+        else "spawn")
+    findings: list[Finding] = []
+    with ctx.Pool(jobs) as pool:
+        results = pool.map(_check_batch,
+                           [(b, rule_classes, root) for b in batches])
+    for batch_findings, batch_supp, batch_stats, states in results:
+        findings.extend(batch_findings)
+        supp.update(batch_supp)
+        if stats is not None:
+            for rid, secs in batch_stats.items():
+                stats[rid] = stats.get(rid, 0.0) + secs
+        for rule, state in zip(rules, states):
+            if state is not None:
+                rule.merge_state(state)
+    return findings
 
 
 # -- baseline ratchet ------------------------------------------------------

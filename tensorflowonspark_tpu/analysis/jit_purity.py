@@ -80,22 +80,20 @@ class JitPurityRule(Rule):
 
     def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
         _Parented().visit(tree)
-        jit_names = self._assigned_jit_names(tree)
+        jit_names = self._assigned_jit_names(ctx)
         findings: list[Finding] = []
-        for node in ast.walk(tree):
-            if isinstance(node, ast.FunctionDef) and (
-                    any(_is_jit_expr(d) for d in node.decorator_list)
-                    or node.name in jit_names):
+        for node in ctx.nodes(ast.FunctionDef):
+            if any(_is_jit_expr(d) for d in node.decorator_list) \
+                    or node.name in jit_names:
                 findings.extend(self._check_jit_fn(node, ctx))
         return findings
 
     @staticmethod
-    def _assigned_jit_names(tree: ast.Module) -> set[str]:
+    def _assigned_jit_names(ctx: FileContext) -> set[str]:
         """Functions jit-wrapped by assignment: ``g = jax.jit(f)``."""
         names: set[str] = set()
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call) and _terminal_name(node.func) in \
-                    _JIT_NAMES and node.args \
+        for node in ctx.nodes(ast.Call):
+            if _terminal_name(node.func) in _JIT_NAMES and node.args \
                     and isinstance(node.args[0], ast.Name):
                 names.add(node.args[0].id)
         return names

@@ -1,0 +1,213 @@
+"""Rule ``journal-kinds``: journal record kinds, the ``KNOWN_KINDS``
+allowlist, and the replay fold must agree — and so must the tracing
+context-kind set and the event emitters.
+
+The control-plane journal (``serving/journal.py``) is an allowlisted
+write-ahead log: ``record("k")`` appends, replay folds only kinds in
+``KNOWN_KINDS`` and SILENTLY skips the rest (forward compatibility).
+That skip is exactly where drift hides — a new subsystem that records
+``"my_kind"`` without adding it to the allowlist journals bytes that a
+failover replay then throws away, i.e. durable-looking state that is not
+durable.  Three cross-file directions, each gated on having actually
+seen both sides in the analyzed set (a partial run stays quiet):
+
+1. a kind recorded anywhere (``journal.record("k")`` / ``jnl.record`` /
+   ``self._jrecord("k")`` / ``scheduler.journal_record("k")``) that is
+   missing from ``KNOWN_KINDS`` — replay silently drops it;
+2. a ``KNOWN_KINDS`` entry never compared by the ``_fold`` dispatch —
+   allowlisted but still dropped state;
+3. a ``KNOWN_KINDS`` entry no producer ever records — a dead kind.
+
+The same idiom is applied to the tracing plane:
+``tracing.CONTEXT_KINDS`` names the failure-event kinds
+``stitch_trace`` folds into a request timeline as ``[context]`` rows; a
+context kind nothing ever emits (``EventLog.emit``/``_emit`` literals,
+or an UPPERCASE module string constant — ``health.py`` routes its kinds
+through ``CRASH``/``HANG``/... constants) can never appear in a stitched
+trace and is reported at the ``CONTEXT_KINDS`` definition.
+
+Anchors are content-shaped, not path-shaped (a ``KNOWN_KINDS = frozenset``
+assignment, a ``_fold`` method, a ``CONTEXT_KINDS`` tuple), so the rule
+is fixture-testable on a single self-contained file.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tensorflowonspark_tpu.analysis.engine import FileContext, Finding, Rule
+
+#: call-target attribute names that mean "journal append"; ``.record`` is
+#: only counted on journal-ish receivers (``self.journal`` / ``jnl``), so
+#: a goodput recorder's ``.record("step", secs)`` never false-positives
+_WRAPPER_METHODS = {"_jrecord", "journal_record"}
+_RECEIVER_SEGMENTS = {"journal", "jnl"}
+
+
+def _receiver_name(func: ast.Attribute) -> str | None:
+    """Terminal identifier of the receiver: 'journal' for both
+    ``journal.record`` and ``self.journal.record``."""
+    v = func.value
+    if isinstance(v, ast.Name):
+        return v.id
+    if isinstance(v, ast.Attribute):
+        return v.attr
+    return None
+
+
+def _journalish(name: str | None) -> bool:
+    if not name:
+        return False
+    return any(seg in _RECEIVER_SEGMENTS
+               for seg in name.lower().split("_") if seg)
+
+
+def _keep_min(d: dict, key: str, site: tuple) -> None:
+    """Keep the lexicographically-smallest (path, line) site per key —
+    file-order independent, so --jobs N merges match the serial run."""
+    if key not in d or site < d[key]:
+        d[key] = site
+
+
+def _str_elts(node: ast.expr) -> list[str] | None:
+    """String elements of a tuple/list/set literal (or a
+    ``frozenset({...})`` / ``frozenset((...))`` call), else None."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("frozenset", "set", "tuple") and node.args:
+        return _str_elts(node.args[0])
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+class JournalKindsRule(Rule):
+    id = "journal-kinds"
+    description = ("journal record kinds vs KNOWN_KINDS vs the replay "
+                   "fold; tracing CONTEXT_KINDS vs event emitters")
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        #: kind -> (path, line) of the KNOWN_KINDS allowlist entry site
+        self._known: dict[str, tuple[str, int]] = {}
+        self._known_site: tuple[str, int] | None = None
+        #: kinds the replay fold dispatches on (== comparisons in _fold)
+        self._folded: set[str] = set()
+        self._fold_seen = False
+        #: kind -> first (path, line) that records it
+        self._recorded: dict[str, tuple[str, int]] = {}
+        #: tracing CONTEXT_KINDS tuple + its definition site
+        self._context: dict[str, tuple[str, int]] = {}
+        #: kinds observably emitted: emit/_emit literals + UPPERCASE
+        #: module string constants (health.py's CRASH/HANG/... routing)
+        self._emitted: set[str] = set()
+        self._emit_seen = False
+
+    def export_state(self):
+        return (self._known, self._known_site, self._folded, self._fold_seen,
+                self._recorded, self._context, self._emitted, self._emit_seen)
+
+    def merge_state(self, state) -> None:
+        known, site, folded, fold_seen, recorded, context, emitted, \
+            emit_seen = state
+        for k, v in known.items():
+            _keep_min(self._known, k, v)
+        if site is not None and (self._known_site is None
+                                 or site < self._known_site):
+            self._known_site = site
+        self._folded |= folded
+        self._fold_seen = self._fold_seen or fold_seen
+        for k, v in recorded.items():
+            _keep_min(self._recorded, k, v)
+        for k, v in context.items():
+            _keep_min(self._context, k, v)
+        self._emitted |= emitted
+        self._emit_seen = self._emit_seen or emit_seen
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
+        for node in ctx.nodes(ast.Assign):
+            if len(node.targets) == 1 and isinstance(node.targets[0],
+                                                     ast.Name):
+                name = node.targets[0].id
+                elts = _str_elts(node.value)
+                if name == "KNOWN_KINDS" and elts is not None:
+                    site = (ctx.path, node.lineno)
+                    if self._known_site is None or site < self._known_site:
+                        self._known_site = site
+                    for k in elts:
+                        _keep_min(self._known, k, site)
+                elif name == "CONTEXT_KINDS" and elts is not None:
+                    for k in elts:
+                        _keep_min(self._context, k,
+                                  (ctx.path, node.lineno))
+                elif name.isupper() and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    self._emitted.add(node.value.value)
+        for fn in ctx.nodes(ast.FunctionDef):
+            if fn.name == "_fold":
+                self._fold_seen = True
+                for cmp_node in ast.walk(fn):
+                    if not isinstance(cmp_node, ast.Compare):
+                        continue
+                    for op, comp in zip(cmp_node.ops, cmp_node.comparators):
+                        if isinstance(op, ast.Eq) \
+                                and isinstance(comp, ast.Constant) \
+                                and isinstance(comp.value, str):
+                            self._folded.add(comp.value)
+        for node in ctx.nodes(ast.Call):
+            if not isinstance(node.func, ast.Attribute) or not node.args:
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                continue
+            attr = node.func.attr
+            if attr in _WRAPPER_METHODS or (
+                    attr == "record"
+                    and _journalish(_receiver_name(node.func))):
+                _keep_min(self._recorded, first.value,
+                          (ctx.path, node.lineno))
+            elif attr in ("emit", "_emit"):
+                self._emit_seen = True
+                self._emitted.add(first.value)
+        return []
+
+    def finalize(self) -> list[Finding]:
+        findings: list[Finding] = []
+        if self._known_site is not None:
+            kpath, kline = self._known_site
+            for kind, (path, line) in sorted(self._recorded.items()):
+                if kind not in self._known:
+                    findings.append(Finding(
+                        self.id, path, line,
+                        f"journal kind '{kind}' is recorded here but missing "
+                        f"from KNOWN_KINDS ({kpath}) — replay silently "
+                        "skips it, so this record is not durable"))
+            if self._fold_seen:
+                for kind in sorted(set(self._known) - self._folded):
+                    findings.append(Finding(
+                        self.id, kpath, kline,
+                        f"journal kind '{kind}' is in KNOWN_KINDS but the "
+                        "replay _fold never dispatches on it — allowlisted "
+                        "state is still dropped at failover"))
+            if self._recorded:
+                for kind in sorted(set(self._known) - set(self._recorded)):
+                    findings.append(Finding(
+                        self.id, kpath, kline,
+                        f"journal kind '{kind}' is in KNOWN_KINDS but no "
+                        "analyzed producer ever records it — dead kind"))
+        if self._context and self._emit_seen:
+            for kind, (path, line) in sorted(self._context.items()):
+                if kind not in self._emitted:
+                    findings.append(Finding(
+                        self.id, path, line,
+                        f"trace context kind '{kind}' in CONTEXT_KINDS is "
+                        "never emitted by any analyzed event producer — "
+                        "stitch_trace can never fold it into a timeline"))
+        return findings

@@ -80,11 +80,17 @@ class LockDisciplineRule(Rule):
         # detects cycles; reset keeps reused instances from leaking runs)
         self._order_edges: dict[tuple[str, str], Finding] = {}
 
+    def export_state(self):
+        return self._order_edges
+
+    def merge_state(self, state) -> None:
+        for edge, witness in state.items():
+            self._order_edges.setdefault(edge, witness)
+
     def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
         findings: list[Finding] = []
-        for node in ast.walk(tree):
-            if isinstance(node, ast.ClassDef):
-                findings.extend(self._check_class(node, ctx))
+        for node in ctx.nodes(ast.ClassDef):
+            findings.extend(self._check_class(node, ctx))
         return findings
 
     # -- per-class analysis ------------------------------------------------

@@ -40,14 +40,13 @@ _CONSTRUCTORS = {"Counter": "counter", "Gauge": "gauge",
 _METRICS_MODULE = "tensorflowonspark_tpu.metrics"
 
 
-def _metrics_constructor_imports(tree: ast.Module) -> set[str]:
+def _metrics_constructor_imports(ctx: FileContext) -> set[str]:
     """Names bound in this file to Counter/Gauge/Histogram imported from
     the metrics module — only those constructors are metric
     registrations (``collections.Counter`` must not false-positive)."""
     out: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) \
-                and node.module == _METRICS_MODULE:
+    for node in ctx.nodes(ast.ImportFrom):
+        if node.module == _METRICS_MODULE:
             for alias in node.names:
                 if alias.name in _CONSTRUCTORS:
                     out.add(alias.asname or alias.name)
@@ -69,20 +68,18 @@ def _is_registry_call(node: ast.AST, factory_imports: set[str]) -> bool:
     return isinstance(f, ast.Attribute) and f.attr in _REGISTRY_FACTORIES
 
 
-def _registry_bindings(tree: ast.Module) -> tuple[set[str], set[str]]:
+def _registry_bindings(ctx: FileContext) -> tuple[set[str], set[str]]:
     """(names bound to a registry instance, local names of the registry
     factories imported from the metrics module)."""
     factories: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) \
-                and node.module == _METRICS_MODULE:
+    for node in ctx.nodes(ast.ImportFrom):
+        if node.module == _METRICS_MODULE:
             for alias in node.names:
                 if alias.name in _REGISTRY_FACTORIES:
                     factories.add(alias.asname or alias.name)
     names: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign) \
-                and _is_registry_call(node.value, factories):
+    for node in ctx.nodes(ast.Assign):
+        if _is_registry_call(node.value, factories):
             for tgt in node.targets:
                 if isinstance(tgt, ast.Name):
                     names.add(tgt.id)
@@ -106,11 +103,11 @@ class MetricNamingRule(Rule):
                    "snake_case with a unit suffix")
 
     def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
-        constructors = _metrics_constructor_imports(tree)
-        reg_names, factories = _registry_bindings(tree)
+        constructors = _metrics_constructor_imports(ctx)
+        reg_names, factories = _registry_bindings(ctx)
         findings: list[Finding] = []
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call) or not node.args:
+        for node in ctx.nodes(ast.Call):
+            if not node.args:
                 continue
             first = node.args[0]
             if not (isinstance(first, ast.Constant)

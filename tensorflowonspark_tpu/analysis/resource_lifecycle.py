@@ -78,9 +78,8 @@ class ResourceLifecycleRule(Rule):
 
     def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
         findings: list[Finding] = []
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                findings.extend(self._check_fn(node, ctx))
+        for node in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            findings.extend(self._check_fn(node, ctx))
         return findings
 
     def _check_fn(self, fn: ast.AST, ctx: FileContext) -> list[Finding]:

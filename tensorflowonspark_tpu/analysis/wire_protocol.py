@@ -1,0 +1,543 @@
+"""Rule ``wire-protocol``: producers and consumers of queue-plane message
+dicts must agree — ops, events, and the fields handlers read.
+
+The serving/batch/continual tiers speak hand-rolled ``{"op": ...}`` /
+``{"event": ...}`` dicts over the queue plane (frontend edge ops, gang
+barriers, clone/model-swap/adopt/prefix control messages, replica
+response events).  Nothing ties the two ends together: renaming an op at
+the producer compiles clean and turns every consumer dispatch into dead
+code — messages silently fall through the ``elif`` chain (most loops
+drop unknown ops by design, for forward compatibility, which is exactly
+why the regression is invisible at runtime).  This rule indexes both
+ends across every analyzed file and reports, from ``finalize()``:
+
+- an op/event **produced but never handled** anywhere;
+- a handler dispatching on an op/event **nothing ever sends**;
+- a handler **hard-reading** ``msg["field"]`` that no producer of that
+  op ever sets (``.get("field")`` soft reads are never flagged).
+
+Two namespaces: dicts carrying an ``"op"`` key (``"event"`` inside one
+is a sub-dispatch of that op) and bare ``{"event": ...}`` dicts with no
+``"op"`` (the replica→driver response stream).  Indexing is literal-
+driven and *honest about dynamism*: a producer whose op/event value is
+not a resolvable string literal becomes a namespace wildcard (the
+"never produced" direction goes quiet rather than lie), a consumer
+comparing against a non-literal consumes everything, a producer dict
+with ``**spread`` or computed keys has open fields (field checks skip
+it).  Module- and function-local ``NAME = "literal"`` constants are
+resolved on both ends.  Every cross-file direction is additionally
+gated on having seen at least one counterpart in the analyzed set, so a
+single-file run never reports a protocol as one-sided.
+
+Intentionally asymmetric messages (probes, hellos, fire-and-forget
+notifications) carry a reasoned ``# tfos: ignore[wire-protocol]`` at the
+producing site — see docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tensorflowonspark_tpu.analysis.engine import FileContext, Finding, Rule
+
+_TERMINAL = (ast.Return, ast.Continue, ast.Break, ast.Raise)
+
+
+def _const_str(node: ast.expr, consts: dict[str, str]) -> str | None:
+    """The string a value expression statically is, resolving single-
+    assignment ``NAME = "literal"`` constants; None when dynamic."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _key_access(node: ast.expr, key: str) -> ast.expr | None:
+    """The receiver expression when ``node`` is ``X.get("<key>" [, d])``
+    or ``X["<key>"]`` — unwrapping the guarded-assignment idiom
+    ``X.get("op") if isinstance(X, dict) else None`` — else None."""
+    if isinstance(node, ast.IfExp):
+        return _key_access(node.body, key) or _key_access(node.orelse, key)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "get" and node.args \
+            and isinstance(node.args[0], ast.Constant) \
+            and node.args[0].value == key:
+        return node.func.value
+    if isinstance(node, ast.Subscript) \
+            and isinstance(node.slice, ast.Constant) \
+            and node.slice.value == key:
+        return node.value
+    return None
+
+
+class _Producer:
+    __slots__ = ("path", "line", "event", "fields")
+
+    def __init__(self, path: str, line: int, event: str | None,
+                 fields: set[str] | None):
+        self.path = path
+        self.line = line
+        self.event = event      # None: no event key; "*": unresolvable
+        self.fields = fields    # None: open (**spread / computed keys)
+
+
+class _Consumer:
+    __slots__ = ("path", "line", "events", "event_wildcard", "reads")
+
+    def __init__(self, path: str, line: int):
+        self.path = path
+        self.line = line
+        self.events: set[str] = set()
+        #: True when the handler matched the op with no event refinement,
+        #: or compared the event against a non-literal — it handles every
+        #: event of the op
+        self.event_wildcard = False
+        #: hard-read field -> first (path, line) reading it
+        self.reads: dict[str, tuple[str, int]] = {}
+
+
+class _Test:
+    """What one ``if`` test says about op/event dispatch."""
+
+    __slots__ = ("op_eq", "op_ne", "ev_eq", "ev_ne", "op_wild", "ev_wild")
+
+    def __init__(self):
+        self.op_eq: list[str] = []
+        self.op_ne: list[str] = []
+        self.ev_eq: list[str] = []
+        self.ev_ne: list[str] = []
+        self.op_wild = False
+        self.ev_wild = False
+
+
+class WireProtocolRule(Rule):
+    id = "wire-protocol"
+    description = ("queue-plane {'op'/'event'} message dicts: ops produced "
+                   "with no handler, handlers for never-sent ops, handler "
+                   "field reads no producer sets")
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self._op_producers: dict[str, list[_Producer]] = {}
+        self._op_producer_wild = False
+        self._op_consumers: dict[str, list[_Consumer]] = {}
+        self._op_consumer_wild = False
+        self._ev_producers: dict[str, list[tuple[str, int]]] = {}
+        self._ev_producer_wild = False
+        self._ev_consumers: dict[str, list[tuple[str, int]]] = {}
+        self._ev_consumer_wild = False
+
+    def export_state(self):
+        return (self._op_producers, self._op_producer_wild,
+                self._op_consumers, self._op_consumer_wild,
+                self._ev_producers, self._ev_producer_wild,
+                self._ev_consumers, self._ev_consumer_wild)
+
+    def merge_state(self, state) -> None:
+        (op_p, op_pw, op_c, op_cw, ev_p, ev_pw, ev_c, ev_cw) = state
+        for k, v in op_p.items():
+            self._op_producers.setdefault(k, []).extend(v)
+        for k, v in op_c.items():
+            self._op_consumers.setdefault(k, []).extend(v)
+        for k, v in ev_p.items():
+            self._ev_producers.setdefault(k, []).extend(v)
+        for k, v in ev_c.items():
+            self._ev_consumers.setdefault(k, []).extend(v)
+        self._op_producer_wild |= op_pw
+        self._op_consumer_wild |= op_cw
+        self._ev_producer_wild |= ev_pw
+        self._ev_consumer_wild |= ev_cw
+
+    # -- per-file indexing -------------------------------------------------
+    def check(self, tree: ast.Module, ctx: FileContext) -> list[Finding]:
+        module_consts: dict[str, str] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                module_consts[node.targets[0].id] = node.value.value
+        seen_dicts: set[int] = set()
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            self._scan_function(fn, dict(module_consts), ctx, seen_dicts)
+        for d in ctx.nodes(ast.Dict):
+            if id(d) not in seen_dicts:
+                self._register_dict(d, module_consts, ctx)
+        return []
+
+    def _register_dict(self, d: ast.Dict, consts: dict[str, str],
+                       ctx: FileContext) -> _Producer | None:
+        """Index one dict literal as an op/bare-event producer (or
+        neither).  Returns the op-producer record so a function scan can
+        keep adding incrementally-assigned fields to it."""
+        has_op = has_event = False
+        op_val = ev_val = None
+        fields: set[str] | None = set()
+        for k, v in zip(d.keys, d.values):
+            if k is None or not (isinstance(k, ast.Constant)
+                                 and isinstance(k.value, str)):
+                fields = None    # **spread / computed key: open fields
+                continue
+            if k.value == "op":
+                has_op = True
+                op_val = _const_str(v, consts)
+            elif k.value == "event":
+                has_event = True
+                ev_val = _const_str(v, consts)
+            elif fields is not None:
+                fields.add(k.value)
+        if has_op:
+            if op_val is None:
+                self._op_producer_wild = True
+                return None
+            p = _Producer(ctx.path, d.lineno,
+                          (ev_val or "*") if has_event else None, fields)
+            self._op_producers.setdefault(op_val, []).append(p)
+            return p
+        if has_event:
+            # a bare {"event": <dynamic>} (or a non-string value) makes
+            # the bare-event namespace open-world
+            if ev_val is None:
+                self._ev_producer_wild = True
+            else:
+                self._ev_producers.setdefault(ev_val, []).append(
+                    (ctx.path, d.lineno))
+        return None
+
+    def _scan_function(self, fn, consts: dict[str, str], ctx: FileContext,
+                       seen_dicts: set[int]) -> None:
+        # function-local string constants extend the module-level map
+        producers_by_name: dict[str, _Producer] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                consts[node.targets[0].id] = node.value.value
+        # producers: every dict literal in the function; one assigned to
+        # a name keeps absorbing later `name["field"] = ...` writes
+        assigned_dicts: dict[int, str] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Dict):
+                assigned_dicts[id(node.value)] = node.targets[0].id
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Dict):
+                seen_dicts.add(id(node))
+                p = self._register_dict(node, consts, ctx)
+                if p is not None and id(node) in assigned_dicts:
+                    producers_by_name[assigned_dicts[id(node)]] = p
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Subscript):
+                sub = node.targets[0]
+                if isinstance(sub.value, ast.Name) \
+                        and sub.value.id in producers_by_name \
+                        and isinstance(sub.slice, ast.Constant) \
+                        and isinstance(sub.slice.value, str):
+                    p = producers_by_name[sub.value.id]
+                    if p.fields is not None:
+                        p.fields.add(sub.slice.value)
+        # consumers
+        op_vars, ev_vars = self._dispatch_vars(fn)
+        has_op_dispatch = self._has_op_access(fn, op_vars)
+        self._visit_body(list(fn.body), None, op_vars, ev_vars, consts,
+                         has_op_dispatch, ctx)
+
+    @staticmethod
+    def _dispatch_vars(fn) -> tuple[dict[str, ast.expr], dict[str, ast.expr]]:
+        """Names assigned from ``X.get("op")``/``X["op"]`` (and "event"),
+        mapped to the receiver expression."""
+        op_vars: dict[str, ast.expr] = {}
+        ev_vars: dict[str, ast.expr] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                recv = _key_access(node.value, "op")
+                if recv is not None:
+                    op_vars[node.targets[0].id] = recv
+                recv = _key_access(node.value, "event")
+                if recv is not None:
+                    ev_vars[node.targets[0].id] = recv
+        return op_vars, ev_vars
+
+    @staticmethod
+    def _has_op_access(fn, op_vars: dict) -> bool:
+        if op_vars:
+            return True
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Call, ast.Subscript)) \
+                    and _key_access(node, "op") is not None:
+                return True
+        return False
+
+    @staticmethod
+    def _recv_names(op_vars: dict, test: ast.expr | None) -> set[str]:
+        """Message-receiver variable names: the receivers of
+        ``op = X.get("op")`` assignments plus any ``X.get("op")`` /
+        ``X["op"]`` access in the dispatching test itself."""
+        names = {r.id for r in op_vars.values() if isinstance(r, ast.Name)}
+        if test is not None:
+            for node in ast.walk(test):
+                if isinstance(node, (ast.Call, ast.Subscript)):
+                    recv = _key_access(node, "op")
+                    if isinstance(recv, ast.Name):
+                        names.add(recv.id)
+        return names
+
+    def _op_expr(self, node: ast.expr, op_vars: dict) -> bool:
+        """Is ``node`` an access to the message op?"""
+        if isinstance(node, ast.Name) and node.id in op_vars:
+            return True
+        return _key_access(node, "op") is not None
+
+    def _ev_expr(self, node: ast.expr, ev_vars: dict) -> bool:
+        if isinstance(node, ast.Name) and node.id in ev_vars:
+            return True
+        return _key_access(node, "event") is not None
+
+    def _analyze_test(self, test: ast.expr, op_vars: dict, ev_vars: dict,
+                      consts: dict[str, str], out: _Test,
+                      negate: bool = False) -> None:
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            self._analyze_test(test.operand, op_vars, ev_vars, consts, out,
+                               not negate)
+            return
+        if isinstance(test, ast.BoolOp):
+            for v in test.values:
+                self._analyze_test(v, op_vars, ev_vars, consts, out, negate)
+            return
+        if not isinstance(test, ast.Compare) or len(test.ops) != 1:
+            return
+        left, op, comp = test.left, test.ops[0], test.comparators[0]
+        is_op = self._op_expr(left, op_vars)
+        is_ev = self._ev_expr(left, ev_vars)
+        if not (is_op or is_ev):
+            return
+        eq_bucket, ne_bucket = (out.op_eq, out.op_ne) if is_op \
+            else (out.ev_eq, out.ev_ne)
+        if isinstance(op, (ast.Eq, ast.NotEq)):
+            inverted = isinstance(op, ast.NotEq) != negate
+            val = _const_str(comp, consts)
+            if val is None:
+                if is_op:
+                    out.op_wild = True
+                else:
+                    out.ev_wild = True
+            elif inverted:
+                ne_bucket.append(val)
+            else:
+                eq_bucket.append(val)
+        elif isinstance(op, ast.In) and isinstance(comp, (ast.Tuple,
+                                                          ast.List,
+                                                          ast.Set)):
+            for e in comp.elts:
+                val = _const_str(e, consts)
+                if val is not None:
+                    eq_bucket.append(val)
+                elif is_op:
+                    out.op_wild = True
+                else:
+                    out.ev_wild = True
+
+    def _visit_body(self, stmts: list, op_ctx: _Consumer | None,
+                    op_vars: dict, ev_vars: dict, consts: dict[str, str],
+                    has_op_dispatch: bool, ctx: FileContext) -> None:
+        for idx, stmt in enumerate(stmts):
+            if isinstance(stmt, ast.If):
+                t = _Test()
+                self._analyze_test(stmt.test, op_vars, ev_vars, consts, t)
+                if t.op_wild:
+                    self._op_consumer_wild = True
+                if t.ev_wild and op_ctx is not None:
+                    op_ctx.event_wildcard = True
+                if t.op_eq:
+                    recv_names = self._recv_names(op_vars, stmt.test)
+                    for op_name in t.op_eq:
+                        rec = _Consumer(ctx.path, stmt.lineno)
+                        self._op_consumers.setdefault(op_name, []).append(rec)
+                        if t.ev_eq:
+                            rec.events.update(t.ev_eq)
+                        elif t.ev_wild:
+                            rec.event_wildcard = True
+                        self._collect_handler(stmt.body, rec, recv_names,
+                                              ctx)
+                        self._visit_body(stmt.body, rec, op_vars, ev_vars,
+                                         consts, has_op_dispatch, ctx)
+                elif t.op_ne and self._is_guard(stmt):
+                    # `if msg.get("op") != "x": continue` — the REST of
+                    # the enclosing body is the handler for "x"
+                    recv_names = self._recv_names(op_vars, stmt.test)
+                    for op_name in t.op_ne:
+                        rec = _Consumer(ctx.path, stmt.lineno)
+                        self._op_consumers.setdefault(op_name, []).append(rec)
+                        if t.ev_eq:
+                            rec.events.update(t.ev_eq)
+                        tail = stmts[idx + 1:]
+                        self._collect_handler(tail, rec, recv_names, ctx)
+                        self._visit_body(tail, rec, op_vars, ev_vars, consts,
+                                         has_op_dispatch, ctx)
+                    self._visit_body(stmt.orelse, op_ctx, op_vars, ev_vars,
+                                     consts, has_op_dispatch, ctx)
+                    return
+                elif t.ev_eq or t.ev_ne:
+                    evs = t.ev_eq + (t.ev_ne if self._is_guard(stmt) else [])
+                    if op_ctx is not None:
+                        op_ctx.events.update(evs)
+                    elif not has_op_dispatch:
+                        for ev in evs:
+                            self._ev_consumers.setdefault(ev, []).append(
+                                (ctx.path, stmt.lineno))
+                    self._visit_body(stmt.body, op_ctx, op_vars, ev_vars,
+                                     consts, has_op_dispatch, ctx)
+                else:
+                    self._visit_body(stmt.body, op_ctx, op_vars, ev_vars,
+                                     consts, has_op_dispatch, ctx)
+                self._visit_body(stmt.orelse, op_ctx, op_vars, ev_vars,
+                                 consts, has_op_dispatch, ctx)
+            elif isinstance(stmt, (ast.For, ast.While, ast.With,
+                                   ast.AsyncFor, ast.AsyncWith)):
+                self._visit_body(list(stmt.body), op_ctx, op_vars, ev_vars,
+                                 consts, has_op_dispatch, ctx)
+                self._visit_body(list(getattr(stmt, "orelse", []) or []),
+                                 op_ctx, op_vars, ev_vars, consts,
+                                 has_op_dispatch, ctx)
+            elif isinstance(stmt, ast.Try):
+                for body in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._visit_body(list(body), op_ctx, op_vars, ev_vars,
+                                     consts, has_op_dispatch, ctx)
+                for h in stmt.handlers:
+                    self._visit_body(list(h.body), op_ctx, op_vars, ev_vars,
+                                     consts, has_op_dispatch, ctx)
+
+    @staticmethod
+    def _is_guard(stmt: ast.If) -> bool:
+        """True when the If body bails out of the surrounding flow —
+        the `if <not my op>: continue/return/raise/break` guard idiom."""
+        return bool(stmt.body) and isinstance(stmt.body[-1], _TERMINAL) \
+            and not stmt.orelse
+
+    def _collect_handler(self, stmts: list, rec: _Consumer,
+                         recv_names: set[str], ctx: FileContext) -> None:
+        """Hard field reads (``recv["field"]``) inside a handler body,
+        attributed to the consumed op."""
+        if not recv_names:
+            return
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Subscript) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id in recv_names \
+                        and isinstance(node.slice, ast.Constant) \
+                        and isinstance(node.slice.value, str) \
+                        and isinstance(node.ctx, ast.Load):
+                    f = node.slice.value
+                    if f not in ("op", "event"):
+                        rec.reads.setdefault(f, (ctx.path, node.lineno))
+
+    # -- cross-file verdicts ----------------------------------------------
+    def finalize(self) -> list[Finding]:
+        # "first site" selections below must be file-order independent so
+        # --jobs N merges match the serial run
+        for plist in self._op_producers.values():
+            plist.sort(key=lambda p: (p.path, p.line))
+        for clist in self._op_consumers.values():
+            clist.sort(key=lambda c: (c.path, c.line))
+        for sites in self._ev_producers.values():
+            sites.sort()
+        for sites in self._ev_consumers.values():
+            sites.sort()
+        findings: list[Finding] = []
+        emitted: set[tuple] = set()
+
+        def emit(path: str, line: int, msg: str) -> None:
+            key = (path, line, msg)
+            if key not in emitted:
+                emitted.add(key)
+                findings.append(Finding(self.id, path, line, msg))
+
+        # op namespace -----------------------------------------------------
+        if self._op_consumers and not self._op_consumer_wild:
+            for op_name, producers in sorted(self._op_producers.items()):
+                if op_name not in self._op_consumers:
+                    p = producers[0]
+                    emit(p.path, p.line,
+                         f"op '{op_name}' is produced here but no analyzed "
+                         "consumer dispatches on it — dead send (or a "
+                         "renamed handler)")
+        if self._op_producers and not self._op_producer_wild:
+            for op_name, consumers in sorted(self._op_consumers.items()):
+                if op_name not in self._op_producers:
+                    c = consumers[0]
+                    emit(c.path, c.line,
+                         f"handler dispatches on op '{op_name}' that no "
+                         "analyzed producer ever sends — dead handler (or "
+                         "a renamed producer)")
+        # event sub-dispatch within an op
+        for op_name, producers in sorted(self._op_producers.items()):
+            consumers = self._op_consumers.get(op_name, [])
+            if not consumers:
+                continue
+            consumed_events: set[str] = set()
+            any_wild = any(c.event_wildcard or not c.events
+                           for c in consumers)
+            for c in consumers:
+                consumed_events |= c.events
+            produced_events = {p.event for p in producers}
+            event_open = "*" in produced_events or any(
+                p.event is None and p.fields is None for p in producers)
+            if not any_wild:
+                for p in producers:
+                    if p.event is not None and p.event != "*" \
+                            and p.event not in consumed_events:
+                        emit(p.path, p.line,
+                             f"op '{op_name}' event '{p.event}' is produced "
+                             "here but no handler of that op matches this "
+                             "event")
+                    if p.event is None and consumed_events:
+                        emit(p.path, p.line,
+                             f"op '{op_name}' is produced here without an "
+                             "'event' but every handler of that op "
+                             "dispatches on one — this message matches no "
+                             "branch")
+            if not event_open:
+                for c in consumers:
+                    for ev in sorted(c.events - {p.event for p in producers}):
+                        emit(c.path, c.line,
+                             f"handler matches op '{op_name}' event '{ev}' "
+                             "that no analyzed producer ever sends")
+            # field reads: a hard msg["f"] read must be set by SOME
+            # producer of the op (skip when any producer has open fields)
+            if any(p.fields is None for p in producers):
+                continue
+            field_union: set[str] = set()
+            for p in producers:
+                field_union |= p.fields
+            for c in consumers:
+                for f, (path, line) in sorted(c.reads.items()):
+                    if f not in field_union:
+                        emit(path, line,
+                             f"handler of op '{op_name}' reads msg['{f}'] "
+                             "but no producer of that op ever sets it")
+        # bare-event namespace ----------------------------------------------
+        if self._ev_consumers and not self._ev_consumer_wild:
+            for ev, sites in sorted(self._ev_producers.items()):
+                if ev not in self._ev_consumers:
+                    path, line = sites[0]
+                    emit(path, line,
+                         f"event '{ev}' is produced here but no analyzed "
+                         "consumer matches it — dead send (or a renamed "
+                         "handler)")
+        if self._ev_producers and not self._ev_producer_wild:
+            for ev, sites in sorted(self._ev_consumers.items()):
+                if ev not in self._ev_producers:
+                    path, line = sites[0]
+                    emit(path, line,
+                         f"handler matches event '{ev}' that no analyzed "
+                         "producer ever sends — dead handler (or a renamed "
+                         "producer)")
+        return findings

@@ -100,7 +100,10 @@ class ProgressLedger:
         with self._lock:
             self._f.write(line + "\n")
             self._f.flush()
-            os.fsync(self._f.fileno())
+            # exactly-once resume depends on fsync-before-release: a
+            # DONE released before it is durable can double-commit a
+            # shard after a driver restart
+            os.fsync(self._f.fileno())  # tfos: ignore[blocking-under-lock]
 
     def attempt(self, **fields) -> None:
         """Mark the start of one dispatch attempt (restart boundary)."""

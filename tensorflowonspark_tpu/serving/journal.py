@@ -125,7 +125,10 @@ class ControlPlaneJournal:
             try:
                 f.write(line + "\n")
                 f.flush()
-                os.fsync(f.fileno())
+                # the WAL contract IS fsync-before-ack under the append
+                # lock: a record released before it is durable could be
+                # acked, lost, and then missing from a failover replay
+                os.fsync(f.fileno())  # tfos: ignore[blocking-under-lock]
             except (OSError, ValueError):
                 if not self._write_failed:
                     self._write_failed = True

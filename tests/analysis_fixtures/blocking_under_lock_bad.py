@@ -1,0 +1,40 @@
+"""blocking-under-lock positive fixture: the full blocking catalog, each
+inside a held-lock region (``with``, explicit acquire/release bracketing,
+and the lock-held-by-caller docstring convention)."""
+
+import os
+import subprocess
+import threading
+import time
+
+
+class Plane:
+    def __init__(self, sock, q, worker):
+        self._lock = threading.Lock()
+        self._sock = sock
+        self._queue = q
+        self._worker = worker
+
+    def pump(self):
+        with self._lock:
+            time.sleep(0.5)
+            data = self._sock.recv(4096)
+            item = self._queue.get()
+            self._worker.join()
+            return data, item
+
+    def persist(self, f, line):
+        with self._lock:
+            f.write(line)
+            os.fsync(f.fileno())
+
+    def shell(self, cmd):
+        self._lock.acquire()
+        try:
+            return subprocess.run(cmd, capture_output=True)
+        finally:
+            self._lock.release()
+
+    def _drain(self):
+        """Drain the queue (lock held by caller)."""
+        return self._queue.get()

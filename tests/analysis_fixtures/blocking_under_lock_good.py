@@ -1,0 +1,44 @@
+"""blocking-under-lock negative fixture: the same calls outside any lock
+region, timeout-bounded variants under the lock, the condition-wait idiom
+(which releases the lock), non-queue ``.get()`` accessors, and a
+reasoned suppression."""
+
+import os
+import threading
+import time
+
+
+class Plane:
+    def __init__(self, sock, q, worker, reservations):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._sock = sock
+        self._queue = q
+        self._worker = worker
+        self._reservations = reservations
+
+    def pump(self):
+        data = self._sock.recv(4096)
+        with self._lock:
+            item = self._queue.get(timeout=1.0)
+        self._worker.join(timeout=5.0)
+        time.sleep(0.1)
+        return data, item
+
+    def wait_ready(self):
+        with self._cond:
+            # Condition.wait releases the lock while blocked: the idiom
+            # the dispatch loop is built on, never flagged
+            self._cond.wait()
+
+    def snapshot(self):
+        with self._lock:
+            # a snapshot accessor, not a dequeue: receiver is not
+            # queue-shaped
+            return self._reservations.get()
+
+    def persist(self, f, line):
+        with self._lock:
+            f.write(line)
+            # durability contract: record must be on disk before release
+            os.fsync(f.fileno())  # tfos: ignore[blocking-under-lock]

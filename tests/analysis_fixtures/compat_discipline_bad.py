@@ -1,0 +1,29 @@
+"""compat-discipline positive fixture: every raw-reference shape —
+from-imports off jax roots, dotted module imports, and attribute chains
+(including the nested experimental path, which must report once)."""
+
+import jax
+import jax.experimental.shard_map
+from jax import typeof
+from jax.experimental.shard_map import shard_map
+from jax import lax
+
+
+def spread(f, mesh, specs):
+    return jax.shard_map(f, mesh=mesh, in_specs=specs)
+
+
+def spread_old(f, mesh):
+    return jax.experimental.shard_map.shard_map(f, mesh=mesh)
+
+
+def group_size(axis):
+    return lax.axis_size(axis)
+
+
+def widen(x, axes):
+    return jax.lax.pcast(x, axes)
+
+
+def probe(x):
+    return jax.typeof(x)

@@ -1,0 +1,27 @@
+"""compat-discipline negative fixture: the blessed idiom — every shimmed
+symbol reached through the compat seam; unshimmed jax usage stays raw."""
+
+import jax
+import jax.numpy as jnp
+from tensorflowonspark_tpu.compat import (axis_size, has_vma, pcast,
+                                          shard_map, vma_of)
+
+
+def spread(f, mesh, specs):
+    return shard_map(f, mesh=mesh, in_specs=specs)
+
+
+def group_size(axis):
+    return axis_size(axis)
+
+
+def widen(x, axes):
+    return pcast(x, axes)
+
+
+def probe(x):
+    # unshimmed jax API is fine raw — only the drift-prone symbols
+    # route through compat
+    if has_vma(x):
+        return vma_of(x)
+    return jax.device_count(), jnp.asarray(x)

@@ -1,0 +1,17 @@
+"""doc-drift positive fixture root: an undocumented registration, a stale
+catalog row, and a chaos-verb grammar drift in both directions (see the
+sibling docs/)."""
+
+from tensorflowonspark_tpu.metrics import get_registry
+
+VERBS = ("kill", "flap")
+
+reg = get_registry()
+
+documented = reg.counter("tfos_documented_total", "in the catalog")
+undocumented = reg.counter("tfos_undocumented_total",
+                           "missing from the catalog")
+
+
+def validate_name(name):
+    return name.startswith("tfos_")

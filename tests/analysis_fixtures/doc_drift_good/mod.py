@@ -1,0 +1,14 @@
+"""doc-drift negative fixture root: code catalogs and the sibling docs/
+agree exactly."""
+
+from tensorflowonspark_tpu.metrics import get_registry
+
+VERBS = ("kill", "term")
+
+reg = get_registry()
+
+documented = reg.counter("tfos_documented_total", "in the catalog")
+
+
+def validate_name(name):
+    return name.startswith("tfos_")

@@ -1,0 +1,38 @@
+"""journal-kinds positive fixture: all four drift directions fire.
+
+Content-anchored like the real control plane: a KNOWN_KINDS allowlist,
+a replay ``_fold`` dispatch, recorder call sites, and a tracing
+CONTEXT_KINDS tuple with its emitters.
+"""
+
+KNOWN_KINDS = frozenset({"admit", "finish", "ghost_kind"})
+
+CONTEXT_KINDS = ("crash", "comet_strike")
+
+CRASH = "crash"
+
+
+class State:
+    def _fold(self, rec):
+        kind = rec.get("kind")
+        if kind == "admit":
+            self.inflight = rec["rid"]
+        # "finish" is allowlisted but never folded: replayed state
+        # silently loses completions
+
+
+class Plane:
+    def admit(self, rid):
+        self.journal.record("admit", rid=rid)
+
+    def finish(self, rid):
+        self._jrecord("finish", rid=rid)
+
+    def rogue(self, rid):
+        # recorded but not in KNOWN_KINDS: replay drops it
+        self.journal.record("not_allowlisted", rid=rid)
+
+
+def report(log):
+    log.emit("crash", node=0)
+    # "comet_strike" is in CONTEXT_KINDS but nothing ever emits it

@@ -1,0 +1,35 @@
+"""journal-kinds negative fixture: allowlist, fold, recorders, and the
+tracing context kinds all agree — including the UPPERCASE-constant
+emitter routing (health.py's idiom)."""
+
+KNOWN_KINDS = frozenset({"admit", "finish"})
+
+CONTEXT_KINDS = ("crash", "hang")
+
+CRASH = "crash"
+
+
+class State:
+    def _fold(self, rec):
+        kind = rec.get("kind")
+        if kind == "admit":
+            self.inflight = rec["rid"]
+        elif kind == "finish":
+            self.inflight = None
+
+
+class Plane:
+    def admit(self, rid):
+        self.journal.record("admit", rid=rid)
+
+    def finish(self, rid):
+        self._jrecord("finish", rid=rid)
+
+    def note(self, secs):
+        # a goodput recorder is not the journal: never counted
+        self.goodput.record("step", secs)
+
+
+def report(log):
+    log.emit(CRASH, node=0)
+    log.emit("hang", node=1)

@@ -1,0 +1,51 @@
+"""wire-protocol positive fixture: every cross-file direction fires.
+
+Self-contained on purpose: the rule's finalize directions are gated on
+having seen BOTH ends of the protocol in the analyzed set, so one file
+holding a producer side and a consumer side exercises the cross-file
+logic exactly as a repo-wide run does.
+"""
+
+OP_ORBIT = "orbit"
+
+
+def send_launch(conn, send, payload):
+    # consumed below, but the handler hard-reads a field nobody sets
+    send(conn, {"op": "launch", "payload": payload})
+
+
+def send_orbit(send, conn):
+    # produced (via a module constant) but no handler dispatches on it
+    send(conn, {"op": OP_ORBIT, "alt_km": 550})
+
+
+def send_dock_with_wrong_event(send, conn):
+    # handlers of "dock" only match event "hard"; "soft" falls through
+    send(conn, {"op": "dock", "event": "soft", "port": 2})
+
+
+def send_telemetry(emit):
+    # bare-event namespace: produced, never matched by any consumer
+    emit({"event": "telemetry", "rssi": -70})
+
+
+def serve(recv, send, conn):
+    while True:
+        msg = recv(conn)
+        op = msg.get("op")
+        if op == "launch":
+            # "payload" exists; "fuel_kg" is set by no producer of launch
+            send(conn, (msg["payload"], msg["fuel_kg"]))
+        elif op == "dock":
+            if msg.get("event") == "hard":
+                send(conn, "clamped")
+        elif op == "land":
+            # nothing ever sends "land": dead handler
+            send(conn, "down")
+
+
+def drain(events):
+    for e in events:
+        if e.get("event") == "splashdown":
+            # nothing ever emits "splashdown"
+            return e

@@ -1,0 +1,61 @@
+"""wire-protocol negative fixture: matched ends, wildcard honesty, and
+the guard-tail + incremental-field idioms all stay clean."""
+
+OP_STATS = "stats"
+
+
+def send_generate(send, conn, prompt):
+    msg = {"op": "generate", "prompt": prompt}
+    msg["max_new_tokens"] = 64  # incremental field: counts as set
+    send(conn, msg)
+
+
+def send_stats(send, conn):
+    send(conn, {"op": OP_STATS})
+
+
+def send_gang(send, conn, event):
+    # dynamic event: the producer is honest about not being indexable,
+    # so event-refined handlers of "gang" are not findings
+    send(conn, {"op": "gang", "event": event, "seq": 1})
+
+
+def send_done(emit, rid):
+    emit({"event": "done", "rid": rid})
+
+
+def serve(recv, send, conn):
+    while True:
+        msg = recv(conn)
+        op = msg.get("op") if isinstance(msg, dict) else None
+        if op == "generate":
+            send(conn, (msg["prompt"], msg.get("max_new_tokens")))
+        elif op == "stats":
+            send(conn, "ok")
+        elif op == "gang":
+            if msg.get("event") == "barrier":
+                send(conn, "ack")
+
+
+def wait_ack(recv, conn, want):
+    while True:
+        msg = recv(conn)
+        # comparing against a non-literal consumes every event of "gang"
+        if msg.get("op") == "gang" and msg.get("event") == want:
+            return msg
+
+
+def pump(q):
+    while True:
+        item = q.get(timeout=1)
+        if item.get("op") != "generate":
+            continue
+        # guard-tail handler: these reads belong to op "generate"
+        return item["prompt"]
+
+
+def drain(events):
+    for e in events:
+        ev = e.get("event")
+        if ev == "done":
+            return e["rid"]

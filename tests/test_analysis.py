@@ -64,7 +64,10 @@ class TestRepoGate:
     def test_every_rule_registered(self):
         assert set(RULE_IDS) == {"closure-capture", "jit-purity",
                                  "lock-discipline", "resource-lifecycle",
-                                 "broad-except", "metric-naming"}
+                                 "broad-except", "metric-naming",
+                                 "wire-protocol", "journal-kinds",
+                                 "blocking-under-lock", "compat-discipline",
+                                 "doc-drift"}
 
 
 # ------------------------------------------------------------- rule units
@@ -80,6 +83,10 @@ class TestRuleFixtures:
         ("resource-lifecycle", "resource_lifecycle"),
         ("broad-except", "broad_except"),
         ("metric-naming", "metric_naming"),
+        ("wire-protocol", "wire_protocol"),
+        ("journal-kinds", "journal_kinds"),
+        ("blocking-under-lock", "blocking_under_lock"),
+        ("compat-discipline", "compat_discipline"),
     ])
     def test_positive_and_negative(self, rule_id, stem):
         bad = fixture_findings(f"{stem}_bad.py")
@@ -154,6 +161,209 @@ class TestRuleFixtures:
                  for f in fixture_findings("resource_lifecycle_bad.py")}
         assert kinds == {"socket", "shared-memory segment", "thread",
                          "file handle"}
+
+
+# -------------------------------------------------- cross-file rules (v2)
+
+class TestCrossFileRules:
+    """wire-protocol / journal-kinds / doc-drift finalize() verdicts, the
+    blocking-under-lock catalog, and the gating that keeps partial runs
+    quiet."""
+
+    def test_wire_protocol_all_directions(self):
+        msgs = [f.message for f in fixture_findings("wire_protocol_bad.py")
+                if f.rule == "wire-protocol"]
+        joined = " | ".join(msgs)
+        assert "op 'orbit' is produced here but no analyzed consumer" \
+            in joined
+        assert "dispatches on op 'land' that no analyzed producer" in joined
+        assert "op 'dock' event 'soft' is produced here but no handler" \
+            in joined
+        assert "reads msg['fuel_kg'] but no producer of that op" in joined
+        assert "event 'telemetry' is produced here but no analyzed consumer" \
+            in joined
+        assert "event 'splashdown' that no analyzed producer" in joined
+
+    def test_wire_protocol_findings_name_file_and_line(self):
+        orbit = [f for f in fixture_findings("wire_protocol_bad.py")
+                 if "orbit" in f.message]
+        assert orbit and orbit[0].path == "wire_protocol_bad.py"
+        assert orbit[0].line > 0
+
+    def test_wire_protocol_one_sided_run_is_quiet(self):
+        """A producer-only file (no consumer anywhere in the analyzed set)
+        must report nothing — the directions are gated on having seen
+        both sides, so partial-path runs can't cry wolf."""
+        src = 'def send(conn, post):\n    post(conn, {"op": "launch"})\n'
+        assert [f for f in analyze_source(src, "p.py")
+                if f.rule == "wire-protocol"] == []
+
+    def test_journal_kinds_all_directions(self):
+        msgs = [f.message for f in fixture_findings("journal_kinds_bad.py")
+                if f.rule == "journal-kinds"]
+        joined = " | ".join(msgs)
+        assert "'not_allowlisted' is recorded here but missing from " \
+            "KNOWN_KINDS" in joined
+        assert "'finish' is in KNOWN_KINDS but the replay _fold never" \
+            in joined
+        assert "'ghost_kind' is in KNOWN_KINDS but no analyzed producer" \
+            in joined
+        assert "context kind 'comet_strike' in CONTEXT_KINDS is never " \
+            "emitted" in joined
+
+    def test_journal_kinds_recorder_only_file_is_quiet(self):
+        """record("k") calls with no KNOWN_KINDS in the analyzed set must
+        not report — the allowlist side wasn't seen."""
+        src = ("class P:\n"
+               "    def admit(self, rid):\n"
+               "        self.journal.record('anything', rid=rid)\n")
+        assert [f for f in analyze_source(src, "p.py")
+                if f.rule == "journal-kinds"] == []
+
+    def test_blocking_under_lock_catalog(self):
+        msgs = [f.message for f in
+                fixture_findings("blocking_under_lock_bad.py")
+                if f.rule == "blocking-under-lock"]
+        joined = " | ".join(msgs)
+        for marker in ("sleep()", ".recv()", ".get() with no timeout",
+                       ".join() with no timeout", "os.fsync()",
+                       "subprocess.run()"):
+            assert marker in joined, f"blocking-under-lock missed {marker}"
+        assert "while holding self._lock" in joined
+        # the lock-held-by-caller docstring convention seeds a held lock
+        assert any("_drain" in m and "caller's lock" in m for m in msgs)
+
+    def test_doc_drift_positive_and_negative(self):
+        bad_root = os.path.join(FIXTURES, "doc_drift_bad")
+        bad = analyze_paths([os.path.join(bad_root, "mod.py")],
+                            root=bad_root)
+        joined = " | ".join(f.message for f in bad
+                            if f.rule == "doc-drift")
+        assert "'tfos_undocumented_total' is registered here but missing" \
+            in joined
+        assert "'tfos_ghost_total'" in joined and "stale row" in joined
+        assert "chaos verb 'flap' is in VERBS but missing" in joined
+        assert "verb 'term' that chaos.VERBS does not define" in joined
+        assert "'tfos_documented_total'" not in joined
+        good_root = os.path.join(FIXTURES, "doc_drift_good")
+        good = analyze_paths([os.path.join(good_root, "mod.py")],
+                             root=good_root)
+        assert [f for f in good if f.rule == "doc-drift"] == []
+
+    def test_doc_drift_unanchored_run_is_quiet(self):
+        """Registering a metric without the telemetry plane (validate_name)
+        in the analyzed set must not consult any docs."""
+        src = ("from tensorflowonspark_tpu.metrics import get_registry\n"
+               "reg = get_registry()\n"
+               "c = reg.counter('tfos_orphan_total', 'x')\n")
+        assert [f for f in analyze_source(src, "p.py")
+                if f.rule == "doc-drift"] == []
+
+
+# ------------------------------------------------------- mutation seeding
+
+class TestMutationRegressions:
+    """The acceptance bar for the cross-file rules: seed a realistic
+    regression into a copy of the REAL repo sources and assert the rule
+    names the file, the symbol, and the missing counterpart."""
+
+    def _mutate(self, tmp_path, relpath, old, new):
+        src = open(os.path.join(PKG_DIR, relpath), encoding="utf-8").read()
+        assert old in src, f"mutation anchor {old!r} gone from {relpath}"
+        out = tmp_path / os.path.basename(relpath)
+        out.write_text(src.replace(old, new))
+        return str(out)
+
+    def test_wire_protocol_catches_renamed_op(self, tmp_path):
+        """Rename the client's 'generate' op: the frontend's dispatch goes
+        dead and BOTH ends are named."""
+        from tensorflowonspark_tpu.analysis import WireProtocolRule
+
+        mutated = self._mutate(tmp_path, os.path.join("serving", "client.py"),
+                               '"op": "generate"', '"op": "generate_v2"')
+        findings = analyze_paths(
+            [mutated, os.path.join(PKG_DIR, "serving", "frontend.py")],
+            rules=[WireProtocolRule()], root=str(tmp_path))
+        msgs = [f.format() for f in findings]
+        assert any("generate_v2" in m and "no analyzed consumer" in m
+                   and "client.py" in m for m in msgs), msgs
+        assert any("op 'generate'" in m and "no analyzed producer" in m
+                   and "frontend.py" in m for m in msgs), msgs
+
+    def test_wire_protocol_intact_package_is_clean(self):
+        """The unmutated protocol surface — every op/event/field pair in
+        the real serving, batch, and queue planes — reconciles."""
+        from tensorflowonspark_tpu.analysis import WireProtocolRule
+
+        findings = analyze_paths([PKG_DIR], rules=[WireProtocolRule()],
+                                 root=REPO_ROOT)
+        assert [f for f in findings if f.rule == "wire-protocol"] == []
+
+    def test_journal_kinds_catches_dropped_kind(self, tmp_path):
+        """Drop 'admit' from KNOWN_KINDS: the scheduler's admit record is
+        journaled but no longer durable, and the finding says so."""
+        from tensorflowonspark_tpu.analysis import JournalKindsRule
+
+        mutated = self._mutate(tmp_path, os.path.join("serving",
+                                                      "journal.py"),
+                               '"admit",', '')
+        findings = analyze_paths(
+            [mutated, os.path.join(PKG_DIR, "serving", "scheduler.py")],
+            rules=[JournalKindsRule()], root=str(tmp_path))
+        msgs = [f.format() for f in findings]
+        assert any("journal kind 'admit' is recorded here but missing "
+                   "from KNOWN_KINDS" in m and "scheduler.py" in m
+                   for m in msgs), msgs
+
+    def test_compat_discipline_catches_raw_shard_map(self, tmp_path):
+        """Reintroduce a raw jax.shard_map call into a copy of a real
+        module: flagged with the compat counterpart named."""
+        from tensorflowonspark_tpu.analysis import CompatDisciplineRule
+
+        src = open(os.path.join(PKG_DIR, "serving", "sharded.py"),
+                   encoding="utf-8").read()
+        out = tmp_path / "sharded.py"
+        out.write_text(src + "\n\ndef _raw(f, mesh):\n"
+                             "    import jax\n"
+                             "    return jax.shard_map(f, mesh=mesh)\n")
+        findings = analyze_paths([str(out)],
+                                 rules=[CompatDisciplineRule()],
+                                 root=str(tmp_path))
+        msgs = [f.format() for f in findings]
+        assert any("raw 'jax.shard_map'" in m and "compat.shard_map" in m
+                   for m in msgs), msgs
+
+    def test_compat_discipline_repo_is_clean(self):
+        from tensorflowonspark_tpu.analysis import CompatDisciplineRule
+
+        findings = analyze_paths([PKG_DIR], rules=[CompatDisciplineRule()],
+                                 root=REPO_ROOT)
+        assert findings == []
+
+
+# -------------------------------------------------------------- parallel
+
+class TestParallelJobs:
+    def test_jobs_matches_serial_on_fixtures(self):
+        """--jobs must be invisible in the results: per-file findings AND
+        cross-file finalize verdicts identical to the serial run."""
+        serial = analyze_paths([FIXTURES], root=FIXTURES)
+        parallel = analyze_paths([FIXTURES], root=FIXTURES, jobs=4)
+        assert [f.to_dict() for f in parallel] == \
+            [f.to_dict() for f in serial]
+
+    def test_jobs_matches_serial_on_package(self):
+        serial = analyze_paths([PKG_DIR], root=REPO_ROOT)
+        parallel = analyze_paths([PKG_DIR], root=REPO_ROOT, jobs=3)
+        assert [f.to_dict() for f in parallel] == \
+            [f.to_dict() for f in serial]
+
+    def test_stats_collects_every_rule(self):
+        stats = {}
+        analyze_paths([os.path.join(FIXTURES, "broad_except_bad.py")],
+                      root=FIXTURES, stats=stats)
+        assert set(RULE_IDS) <= set(stats)
+        assert all(v >= 0 for v in stats.values())
 
 
 # ---------------------------------------------------- suppressions/baseline
@@ -494,6 +704,19 @@ class TestCLI:
         assert cli_main(["--write-baseline", "--baseline", base, bad]) == 0
         # same findings now grandfathered
         assert cli_main(["--baseline", base, bad]) == 0
+        capsys.readouterr()
+
+    def test_jobs_and_stats_flags(self, capsys):
+        rc = cli_main(["--jobs", "2", "--stats",
+                       os.path.join(FIXTURES, "broad_except_good.py")])
+        captured = capsys.readouterr()
+        assert rc == 0
+        assert "0 new finding(s)" in captured.out
+        assert "stats:" in captured.err and "TOTAL" in captured.err
+
+    def test_bad_jobs_is_usage_error(self, capsys):
+        with pytest.raises(SystemExit):
+            cli_main(["--jobs", "0", FIXTURES])
         capsys.readouterr()
 
     def test_scripts_shim(self):
